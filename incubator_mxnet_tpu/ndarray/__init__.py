@@ -1,0 +1,41 @@
+"""`mx.nd` namespace: NDArray + every registered operator as a function.
+
+Reference: python/mxnet/ndarray/ — op wrappers are code-generated at import
+from the C op registry (python/mxnet/base.py _init_op_module). Here the
+registry is Python-native, so the namespace resolves ops lazily via module
+__getattr__ (PEP 562) — same user surface (`mx.nd.FullyConnected(...)`),
+no codegen step.
+"""
+from __future__ import annotations
+
+from . import random
+from .ndarray import (NDArray, arange, array, concatenate, empty, eye, from_jax,
+                      full, linspace, moveaxis, ones, waitall, zeros)
+from .utils import load, save
+
+# trigger op registration
+from ..ops import registry as _registry
+from ..ops import tensor_ops as _tensor_ops  # noqa: F401
+from ..ops import nn_ops as _nn_ops  # noqa: F401
+from ..ops import random_ops as _random_ops  # noqa: F401
+
+
+def _make_wrapper(opdef):
+    def wrapper(*args, **kwargs):
+        return _registry.apply_op(opdef, *args, **kwargs)
+
+    wrapper.__name__ = opdef.name
+    wrapper.__doc__ = opdef.fn.__doc__
+    return wrapper
+
+
+def __getattr__(name):
+    if name in _registry.OPS:
+        w = _make_wrapper(_registry.OPS.get(name))
+        globals()[name] = w  # cache
+        return w
+    raise AttributeError(f"module 'nd' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + _registry.OPS.keys()))

@@ -1,0 +1,103 @@
+"""Random samplers (reference src/operator/random/: sample_op.cc, multisample,
+shuffle.cc; per-device RNG resource include/mxnet/random_generator.h).
+
+TPU-native redesign: the reference keeps mutable per-device Philox states
+handed out by the ResourceManager; here every sampler is a pure function of a
+jax PRNG key. The framework-level key chain lives in ndarray/random.py
+(split-per-call), which is the functional equivalent of the reference's
+per-device stateful generators and is what makes samplers safe under jit and
+across a device mesh.
+"""
+from __future__ import annotations
+
+from ..base import dtype_np
+from .registry import register
+
+import jax
+import jax.numpy as jnp
+
+
+@register(name="_random_uniform", aliases=("uniform",), stateful=True, nondiff=True)
+def _random_uniform(*, low=0.0, high=1.0, shape=(1,), dtype="float32", rng=None):
+    return jax.random.uniform(rng, tuple(shape), dtype_np(dtype), low, high)
+
+
+@register(name="_random_normal", aliases=("normal",), stateful=True, nondiff=True)
+def _random_normal(*, loc=0.0, scale=1.0, shape=(1,), dtype="float32", rng=None):
+    return jax.random.normal(rng, tuple(shape), dtype_np(dtype)) * scale + loc
+
+
+@register(name="_random_gamma", stateful=True, nondiff=True)
+def _random_gamma(*, alpha=1.0, beta=1.0, shape=(1,), dtype="float32", rng=None):
+    return jax.random.gamma(rng, alpha, tuple(shape), dtype_np(dtype)) * beta
+
+
+@register(name="_random_exponential", stateful=True, nondiff=True)
+def _random_exponential(*, lam=1.0, shape=(1,), dtype="float32", rng=None):
+    return jax.random.exponential(rng, tuple(shape), dtype_np(dtype)) / lam
+
+
+@register(name="_random_poisson", stateful=True, nondiff=True)
+def _random_poisson(*, lam=1.0, shape=(1,), dtype="float32", rng=None):
+    return jax.random.poisson(rng, lam, tuple(shape)).astype(dtype_np(dtype))
+
+
+@register(name="_random_negative_binomial", stateful=True, nondiff=True)
+def _random_negative_binomial(*, k=1, p=1.0, shape=(1,), dtype="float32", rng=None):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, tuple(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(dtype_np(dtype))
+
+
+@register(name="_random_generalized_negative_binomial", stateful=True, nondiff=True)
+def _random_gnb(*, mu=1.0, alpha=1.0, shape=(1,), dtype="float32", rng=None):
+    k1, k2 = jax.random.split(rng)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, tuple(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(dtype_np(dtype))
+
+
+@register(name="_random_randint", stateful=True, nondiff=True)
+def _random_randint(*, low=0, high=1, shape=(1,), dtype="int32", rng=None):
+    return jax.random.randint(rng, tuple(shape), low, high, dtype_np(dtype))
+
+
+@register(name="_sample_multinomial", stateful=True, nondiff=True)
+def _sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32", rng=None):
+    """data: (..., K) probabilities; draw `shape` samples per distribution
+    (reference src/operator/random/sample_multinomial_op.cc)."""
+    n = 1
+    for s in (shape if isinstance(shape, (tuple, list)) else (shape,)):
+        n *= max(int(s), 1)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    out_shape = data.shape[:-1] + ((n,) if shape else ())
+    draws = jax.random.categorical(rng, logits, axis=-1,
+                                   shape=(n,) + data.shape[:-1])
+    if data.ndim == 1:
+        samp = draws if shape else draws[0]
+    else:
+        samp = jnp.moveaxis(draws, 0, -1)
+        if not shape:
+            samp = samp[..., 0]
+    samp = samp.astype(dtype_np(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.log(jnp.maximum(data, 1e-37)),
+            samp.astype(jnp.int32).reshape(data.shape[:-1] + (-1,)), axis=-1)
+        return (samp, lp.reshape(samp.shape))
+    return samp
+
+
+@register(name="_shuffle", stateful=True, nondiff=True)
+def _shuffle(data, *, rng=None):
+    """Shuffle along first axis (reference src/operator/random/shuffle_op.cc)."""
+    perm = jax.random.permutation(rng, data.shape[0])
+    return data[perm]
+
+
+@register(name="_sample_unique_zipfian", stateful=True, nondiff=True)
+def _sample_unique_zipfian(*, range_max, shape=(1,), rng=None):
+    u = jax.random.uniform(rng, tuple(shape))
+    out = (jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0).astype(jnp.int32)
+    return jnp.clip(out, 0, range_max - 1)

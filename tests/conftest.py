@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of re-running the CPU suite on other devices
+(tests/python/gpu/test_operator_gpu.py does `from test_operator import *` with
+a GPU default ctx): here the suite runs on the CPU backend with 8 virtual
+devices so sharding/collective paths are exercised without TPU hardware.
+Must run before jax is imported anywhere.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = os.environ.get("MXTPU_TEST_PLATFORM", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs(request):
+    """Per-test deterministic seeding (reference tests/python/unittest/common.py:117
+    @with_seed). Honors MXTPU_TEST_SEED for reproduction."""
+    seed = int(os.environ.get("MXTPU_TEST_SEED", "0"))
+    if seed == 0:
+        seed = abs(hash(request.node.nodeid)) % (2**31 - 1)
+    np.random.seed(seed)
+    import incubator_mxnet_tpu as mx
+    mx.random.seed(seed)
+    yield

@@ -1,0 +1,160 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * x
+    y.backward()
+    expected = np.exp(2.0) * 2 + np.exp(2.0)
+    np.testing.assert_allclose(x.grad.asnumpy(), [expected], rtol=1e-5)
+
+
+def test_multi_input():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [4, 5])
+    np.testing.assert_allclose(b.grad.asnumpy(), [1, 2])
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([2.0, 4.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [6, 12])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_grad_req_write_overwrites():
+    x = nd.array([1.0])
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_pause():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 10  # not recorded
+        w = y + 1
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_training_flags():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+            assert autograd.is_recording()
+    assert not autograd.is_recording()
+
+
+def test_reduction_grad():
+    x = nd.ones((2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x * 3)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 3 * np.ones((2, 3)))
+
+
+def test_matmul_grad():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(4, 5).astype(np.float32)
+    a, b = nd.array(a_np), nd.array(b_np)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = nd.dot(a, b)
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), np.ones((3, 5)) @ b_np.T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(), a_np.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_autograd_grad_api():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    g = autograd.grad(y, x)
+    np.testing.assert_allclose(g.asnumpy(), [6.0])
+
+
+def test_stop_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * nd.BlockGrad(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            self.y = nd.sigmoid(x)
+            return self.y
+
+        def backward(self, dy):
+            return dy * self.y * (1 - self.y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-np.array([0.0, 1.0])))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_softmax_output_grad_semantics():
+    x = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array([0.0, 1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    p = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(-1, keepdims=True)
+    oh = np.eye(5)[[0, 1, 2, 3]]
+    np.testing.assert_allclose(x.grad.asnumpy(), p - oh, rtol=1e-4, atol=1e-5)

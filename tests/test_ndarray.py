@@ -1,0 +1,169 @@
+"""NDArray API tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_create_and_asnumpy():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    np.testing.assert_array_equal(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_preserved():
+    a = nd.array(np.arange(4, dtype=np.int32))
+    assert a.dtype == np.int32
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+
+
+def test_factories():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    np.testing.assert_allclose(nd.full((2,), 3.5).asnumpy(), [3.5, 3.5])
+    np.testing.assert_allclose(nd.arange(0, 6, 2).asnumpy(), [0, 2, 4])
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).asnumpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).asnumpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a + 1).asnumpy(), [2, 3, 4])
+    np.testing.assert_allclose((1 - a).asnumpy(), [0, -1, -2])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+    np.testing.assert_allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace_arithmetic():
+    a = nd.ones((3,))
+    a += 2
+    np.testing.assert_allclose(a.asnumpy(), [3, 3, 3])
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), [6, 6, 6])
+
+
+def test_comparisons_return_input_dtype():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    eq = (a == b)
+    assert eq.dtype == np.float32
+    np.testing.assert_allclose(eq.asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose((a > 1.5).asnumpy(), [0, 1, 1])
+
+
+def test_broadcasting():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    np.testing.assert_array_equal(a[1].asnumpy(), np.arange(12, 24).reshape(3, 4))
+    np.testing.assert_array_equal(a[:, 1, :].asnumpy(),
+                                  np.arange(24).reshape(2, 3, 4)[:, 1, :])
+    np.testing.assert_array_equal(a[0, 1:3].asnumpy(),
+                                  np.arange(24).reshape(2, 3, 4)[0, 1:3])
+
+
+def test_setitem():
+    a = nd.zeros((2, 3))
+    a[0, 1] = 5
+    assert a.asnumpy()[0, 1] == 5
+    a[:] = 1
+    np.testing.assert_allclose(a.asnumpy(), np.ones((2, 3)))
+    a[1] = nd.array([7.0, 8.0, 9.0])
+    np.testing.assert_allclose(a.asnumpy()[1], [7, 8, 9])
+
+
+def test_reshape_mxnet_spec():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert nd.reshape(a, shape=(-2,)).shape == (2, 3, 4)
+    assert nd.reshape(a, shape=(-3, 4)).shape == (6, 4)
+    assert nd.reshape(a, shape=(-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+
+
+def test_reductions():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(a.sum().asnumpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(nd.sum(a, axis=1).asnumpy(), x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(nd.mean(a, axis=(0, 2)).asnumpy(), x.mean((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(nd.max(a, axis=1, keepdims=True).asnumpy(),
+                               x.max(1, keepdims=True), rtol=1e-5)
+
+
+def test_scalar_conversion():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert a.asscalar() == pytest.approx(3.5)
+    with pytest.raises(Exception):
+        nd.ones((2,)).asscalar()
+
+
+def test_copy_and_context():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b[0, 0] = 9
+    assert a.asnumpy()[0, 0] == 1
+    assert a.context.device_typename in ("cpu", "tpu", "gpu")
+    c = a.as_in_context(mx.cpu())
+    assert c.context.device_typename == "cpu"
+
+
+def test_save_load_dict_and_list(tmp_path):
+    f = str(tmp_path / "arrays.params")
+    d = {"arg:w": nd.ones((2, 2)), "aux:m": nd.zeros((3,))}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"arg:w", "aux:m"}
+    np.testing.assert_allclose(loaded["arg:w"].asnumpy(), np.ones((2, 2)))
+
+    nd.save(f, [nd.ones((2,)), nd.zeros((1,))])
+    lst = nd.load(f)
+    assert isinstance(lst, list) and len(lst) == 2
+
+
+def test_wait_and_waitall():
+    a = nd.ones((4,))
+    a.wait_to_read()
+    nd.waitall()
+
+
+def test_concat_split_stack():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.split(c, num_outputs=2, axis=0)
+    assert len(s) == 2 and s[0].shape == (2, 3)
+    st = nd.stack(a, b, axis=0)
+    assert st.shape == (2, 2, 3)
+
+
+def test_iteration_len():
+    a = nd.array(np.arange(6).reshape(3, 2))
+    assert len(a) == 3
+    rows = [r.asnumpy() for r in a]
+    assert len(rows) == 3
+
+
+def test_random_shapes_and_seed():
+    mx.random.seed(42)
+    u1 = nd.random.uniform(shape=(3, 3)).asnumpy()
+    mx.random.seed(42)
+    u2 = nd.random.uniform(shape=(3, 3)).asnumpy()
+    np.testing.assert_allclose(u1, u2)
+    n = nd.random.normal(2.0, 0.5, shape=(1000,)).asnumpy()
+    assert abs(n.mean() - 2.0) < 0.1
+    r = nd.random.randint(0, 10, shape=(100,))
+    assert r.dtype == np.int32
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10

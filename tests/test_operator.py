@@ -1,0 +1,256 @@
+"""Operator tests vs numpy references (reference: tests/python/unittest/test_operator.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_unary_ops():
+    x = _rand(3, 4) * 0.9
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.exp(a).asnumpy(), np.exp(x), rtol=1e-4)
+    np.testing.assert_allclose(nd.tanh(a).asnumpy(), np.tanh(x), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(nd.abs(a).asnumpy(), np.abs(x), rtol=1e-6)
+    np.testing.assert_allclose(nd.square(a).asnumpy(), x * x, rtol=1e-6)
+    np.testing.assert_allclose(nd.sigmoid(a).asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-4)
+    xp = np.abs(x) + 0.1
+    np.testing.assert_allclose(nd.log(nd.array(xp)).asnumpy(), np.log(xp), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(nd.sqrt(nd.array(xp)).asnumpy(), np.sqrt(xp), rtol=1e-4)
+    np.testing.assert_allclose(nd.rsqrt(nd.array(xp)).asnumpy(), 1 / np.sqrt(xp), rtol=1e-4)
+
+
+def test_broadcast_ops():
+    x, y = _rand(2, 1, 4), _rand(1, 3, 4)
+    np.testing.assert_allclose(nd.broadcast_add(nd.array(x), nd.array(y)).asnumpy(),
+                               x + y, rtol=1e-6)
+    np.testing.assert_allclose(nd.broadcast_maximum(nd.array(x), nd.array(y)).asnumpy(),
+                               np.maximum(x, y), rtol=1e-6)
+
+
+def test_dot():
+    a, b = _rand(3, 4), _rand(4, 5)
+    np.testing.assert_allclose(nd.dot(nd.array(a), nd.array(b)).asnumpy(), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True).asnumpy(), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a.T), nd.array(b), transpose_a=True).asnumpy(), a @ b, rtol=1e-5)
+
+
+def test_batch_dot():
+    a, b = _rand(5, 3, 4), _rand(5, 4, 2)
+    np.testing.assert_allclose(nd.batch_dot(nd.array(a), nd.array(b)).asnumpy(),
+                               a @ b, rtol=1e-5)
+
+
+def test_fully_connected():
+    x, w, b = _rand(2, 5), _rand(3, 5), _rand(3)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
+    np.testing.assert_allclose(out.asnumpy(), x @ w.T + b, rtol=1e-5)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), no_bias=True, num_hidden=3)
+    np.testing.assert_allclose(out.asnumpy(), x @ w.T, rtol=1e-5)
+
+
+def test_fully_connected_flatten():
+    x, w = _rand(2, 3, 4), _rand(6, 12)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), no_bias=True, num_hidden=6)
+    np.testing.assert_allclose(out.asnumpy(), x.reshape(2, -1) @ w.T, rtol=1e-5)
+
+
+def test_convolution_matches_naive():
+    x = _rand(1, 1, 5, 5)
+    w = _rand(2, 1, 3, 3)
+    out = nd.Convolution(nd.array(x), nd.array(w), no_bias=True,
+                         kernel=(3, 3), num_filter=2).asnumpy()
+    ref = np.zeros((1, 2, 3, 3), np.float32)
+    for o in range(2):
+        for i in range(3):
+            for j in range(3):
+                ref[0, o, i, j] = np.sum(x[0, 0, i:i + 3, j:j + 3] * w[o, 0])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_stride_pad_groups():
+    x = _rand(2, 4, 8, 8)
+    w = _rand(6, 2, 3, 3)
+    out = nd.Convolution(nd.array(x), nd.array(w), no_bias=True, kernel=(3, 3),
+                         num_filter=6, num_group=2, stride=(2, 2), pad=(1, 1))
+    assert out.shape == (2, 6, 4, 4)
+
+
+def test_pooling():
+    x = _rand(1, 2, 4, 4)
+    mx_max = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max").asnumpy()
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(mx_max, ref, rtol=1e-6)
+    mx_avg = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg").asnumpy()
+    ref = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(mx_avg, ref, rtol=1e-5)
+    gp = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg").asnumpy()
+    np.testing.assert_allclose(gp[:, :, 0, 0], x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_softmax_family():
+    x = _rand(3, 5)
+    sm = nd.softmax(nd.array(x)).asnumpy()
+    ref = np.exp(x) / np.exp(x).sum(-1, keepdims=True)
+    np.testing.assert_allclose(sm, ref, rtol=1e-5)
+    np.testing.assert_allclose(nd.log_softmax(nd.array(x)).asnumpy(), np.log(ref), rtol=1e-4)
+    np.testing.assert_allclose(sm.sum(-1), np.ones(3), rtol=1e-5)
+
+
+def test_batchnorm_inference_and_training():
+    x = _rand(4, 3, 2, 2)
+    gamma, beta = np.ones(3, np.float32), np.zeros(3, np.float32)
+    mean, var = np.zeros(3, np.float32), np.ones(3, np.float32)
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mean), nd.array(var), eps=0.0)
+    o = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(o.asnumpy(), x, rtol=1e-4, atol=1e-5)
+    with autograd.record():
+        out_t = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                             nd.array(mean), nd.array(var), eps=1e-5)
+    o, m, v = out_t
+    np.testing.assert_allclose(m.asnumpy(), x.mean(axis=(0, 2, 3)), rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm():
+    x = _rand(2, 5)
+    g, b = np.ones(5, np.float32), np.zeros(5, np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b)).asnumpy()
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_activation_and_leaky():
+    x = _rand(3, 4)
+    np.testing.assert_allclose(nd.Activation(nd.array(x), act_type="relu").asnumpy(),
+                               np.maximum(x, 0), rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1).asnumpy(),
+        np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    elu = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0).asnumpy()
+    np.testing.assert_allclose(elu, np.where(x > 0, x, np.expm1(x)), rtol=1e-5)
+
+
+def test_transpose_reshape_ops():
+    x = _rand(2, 3, 4)
+    np.testing.assert_allclose(nd.transpose(nd.array(x)).asnumpy(),
+                               x.transpose(), rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.transpose(nd.array(x), axes=(1, 0, 2)).asnumpy(), x.transpose(1, 0, 2))
+    np.testing.assert_allclose(nd.flatten(nd.array(x)).asnumpy(), x.reshape(2, -1))
+    np.testing.assert_allclose(nd.expand_dims(nd.array(x), axis=1).asnumpy(),
+                               x[:, None])
+    np.testing.assert_allclose(nd.flip(nd.array(x), axis=2).asnumpy(), x[:, :, ::-1])
+    np.testing.assert_allclose(nd.tile(nd.array(x), reps=(1, 2, 1)).asnumpy(),
+                               np.tile(x, (1, 2, 1)))
+
+
+def test_slice_ops():
+    x = _rand(4, 5, 6)
+    np.testing.assert_allclose(
+        nd.slice(nd.array(x), begin=(1, 0, 2), end=(3, 4, 6)).asnumpy(),
+        x[1:3, 0:4, 2:6])
+    np.testing.assert_allclose(
+        nd.slice_axis(nd.array(x), axis=1, begin=1, end=4).asnumpy(), x[:, 1:4])
+
+
+def test_take_pick_onehot():
+    x = _rand(5, 4)
+    idx = nd.array([0.0, 2.0, 4.0])
+    np.testing.assert_allclose(nd.take(nd.array(x), idx).asnumpy(), x[[0, 2, 4]])
+    p = nd.pick(nd.array(x), nd.array([1.0, 0.0, 3.0, 2.0, 1.0]), axis=1)
+    np.testing.assert_allclose(p.asnumpy(), x[np.arange(5), [1, 0, 3, 2, 1]])
+    oh = nd.one_hot(nd.array([0.0, 2.0]), depth=4).asnumpy()
+    np.testing.assert_allclose(oh, [[1, 0, 0, 0], [0, 0, 1, 0]])
+
+
+def test_embedding():
+    w = _rand(10, 4)
+    idx = nd.array([1.0, 3.0, 1.0])
+    out = nd.Embedding(idx, nd.array(w), input_dim=10, output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), w[[1, 3, 1]])
+
+
+def test_gather_scatter_nd():
+    x = _rand(3, 4)
+    idx = nd.array(np.array([[0, 2], [1, 3]], np.float32))
+    out = nd.gather_nd(nd.array(x), idx)
+    np.testing.assert_allclose(out.asnumpy(), x[[0, 2], [1, 3]])
+    s = nd.scatter_nd(out, idx, shape=(3, 4)).asnumpy()
+    assert s[0, 1] == pytest.approx(x[0, 1])
+    assert s[2, 3] == pytest.approx(x[2, 3])
+
+
+def test_ordering():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+    np.testing.assert_allclose(nd.sort(nd.array(x)).asnumpy(), np.sort(x))
+    args = nd.argsort(nd.array(x)).asnumpy()
+    assert args.dtype == np.float32
+    np.testing.assert_allclose(args, np.argsort(x))
+    v, i = nd.topk(nd.array(x), k=2, ret_typ="both")
+    np.testing.assert_allclose(v.asnumpy(), [[3, 2], [5, 4]])
+    np.testing.assert_allclose(i.asnumpy(), [[0, 2], [1, 2]])
+    np.testing.assert_allclose(nd.argmax(nd.array(x), axis=1).asnumpy(), [0, 1])
+
+
+def test_where_clip():
+    x, y = _rand(3, 3), _rand(3, 3)
+    cond = (x > 0).asnumpy() if isinstance(x, nd.NDArray) else (x > 0)
+    out = nd.where(nd.array(cond.astype(np.float32)), nd.array(x), nd.array(y))
+    np.testing.assert_allclose(out.asnumpy(), np.where(cond, x, y))
+    np.testing.assert_allclose(nd.clip(nd.array(x), a_min=-0.5, a_max=0.5).asnumpy(),
+                               np.clip(x, -0.5, 0.5))
+
+
+def test_sequence_ops():
+    x = _rand(4, 3, 2)  # (T, B, F)
+    lens = nd.array([2.0, 4.0, 1.0])
+    m = nd.SequenceMask(nd.array(x), lens, use_sequence_length=True, value=-1.0).asnumpy()
+    assert (m[2:, 0] == -1).all() and (m[1:, 2] == -1).all()
+    np.testing.assert_allclose(m[:2, 0], x[:2, 0])
+    last = nd.SequenceLast(nd.array(x), lens, use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(last[0], x[1, 0], rtol=1e-6)
+    np.testing.assert_allclose(last[1], x[3, 1], rtol=1e-6)
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    out = nd.Dropout(x, p=0.5)  # not training -> identity
+    np.testing.assert_allclose(out.asnumpy(), np.ones((100, 100)))
+    with autograd.record():
+        out = nd.Dropout(x, p=0.5)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = out.asnumpy()[out.asnumpy() != 0]
+    np.testing.assert_allclose(kept, 2.0 * np.ones_like(kept), rtol=1e-5)
+
+
+def test_numeric_gradient_conv_dense():
+    """Finite-difference gradient check (reference test_utils.check_numeric_gradient:872)."""
+    from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+    check_numeric_gradient(lambda a: nd.sum(nd.square(a)), [_rand(3, 3)])
+    w = nd.array(_rand(2, 4))
+    check_numeric_gradient(
+        lambda a: nd.sum(nd.FullyConnected(a, w, no_bias=True, num_hidden=2)),
+        [_rand(3, 4)])
+
+
+def test_norm_ops():
+    x = _rand(3, 4)
+    np.testing.assert_allclose(nd.norm(nd.array(x)).asnumpy(),
+                               np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(nd.L2Normalization(nd.array(x)).asnumpy(),
+                               x / np.sqrt((x * x).sum(1, keepdims=True) + 1e-10),
+                               rtol=1e-5)
+
+
+def test_cast():
+    x = nd.array([1.5, 2.5])
+    assert nd.cast(x, dtype="int32").dtype == np.int32
+    assert nd.cast(x, dtype="float16").dtype == np.float16
