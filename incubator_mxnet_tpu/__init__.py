@@ -23,8 +23,23 @@ def _configure_jax():
     # bf16 passes; force full precision globally. Performance-critical paths
     # (bench, model zoo inference/training in bf16) pass bf16 inputs, which is
     # the idiomatic TPU way to use the MXU and is unaffected by this setting.
+    import os
     import jax
     jax.config.update("jax_default_matmul_precision", "highest")
+    # Persistent XLA compilation cache: eager mode compiles one executable per
+    # (op, shape) like the reference's cudnn autotune cache persists algo
+    # choices (src/operator/nn/cudnn/cudnn_algoreg*) — ours persists whole
+    # binaries across processes.
+    cache_dir = os.environ.get("MXTPU_COMPILE_CACHE",
+                               os.path.expanduser("~/.cache/mxtpu_xla"))
+    if cache_dir and cache_dir != "0":
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:
+            pass
 
 
 _configure_jax()

@@ -76,6 +76,26 @@ def _is_tracer(x):
     return isinstance(x, jax.core.Tracer)
 
 
+class _TraceScope:
+    """Active while a hybridize trace is being built: nested hybridized blocks
+    must run their eager path so the whole subtree lowers into ONE flat XLA
+    program (the reference inlines sub-CachedOps the same way,
+    cached_op.h inline_limit)."""
+
+    _tls = threading.local()
+
+    def __enter__(self):
+        self._tls.depth = getattr(self._tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        self._tls.depth -= 1
+
+    @classmethod
+    def active(cls):
+        return getattr(cls._tls, "depth", 0) > 0
+
+
 # patch Parameter.set_data to intercept traced writes
 _orig_set_data = Parameter.set_data
 
@@ -275,7 +295,8 @@ class HybridBlock(Block):
                 p._finish_deferred_init()
 
     def forward(self, *args):
-        if self._active and args and isinstance(args[0], NDArray):
+        if self._active and not _TraceScope.active() and args and \
+                isinstance(args[0], NDArray):
             return self._call_cached(*args)
         return self._eager_forward(*args)
 
@@ -336,17 +357,14 @@ class HybridBlock(Block):
         import jax
 
         # resolve deferred shapes cheaply via abstract tracing
-        try:
-            for p in self.collect_params().values():
-                if p._deferred_init is not None:
+        for p in self.collect_params().values():
+            if p._deferred_init is not None:
+                with _TraceScope(), autograd.pause(train_mode=training), \
+                        _rnd._TraceKeyScope(jax.random.PRNGKey(0)):
                     jax.eval_shape(lambda *xs: self._abstract_forward(xs),
                                    *[jax.ShapeDtypeStruct(a.shape, a.dtype)
                                      for a in [x._data for x in args]])
-                    break
-        except Exception:
-            # fall back: run the eager path once for shape resolution
-            with autograd.pause(train_mode=training):
-                self._eager_forward(*args)
+                break
 
         param_list = self._trace_param_list()
         for p in param_list:
@@ -362,7 +380,7 @@ class HybridBlock(Block):
                 old.append(p._data._data)
                 p._data._data = t
             try:
-                with _rnd._TraceKeyScope(rng), \
+                with _TraceScope(), _rnd._TraceKeyScope(rng), \
                         autograd.pause(train_mode=training), \
                         _StateWriteScope() as sw:
                     out = self._eager_forward(*wrapped)

@@ -1,0 +1,146 @@
+"""DataLoader.
+
+Reference: python/mxnet/gluon/data/dataloader.py — multiprocessing worker
+pool, shared-memory NDArray pickling (dataloader.py:55-98 ForkingPickler over
+cpu_shared storage), default_batchify_fn.
+
+TPU-native redesign: workers exchange numpy arrays (host memory); the single
+host->HBM transfer happens once per *batch* at the end of batchify (the
+reference moves per-sample NDArrays through POSIX shm for the same reason:
+avoid serialization copies). jax's async dispatch overlaps the transfer with
+device compute.
+"""
+from __future__ import annotations
+
+import io
+import multiprocessing
+import pickle
+import sys
+
+import numpy as _np
+
+from ... import nd
+from ...base import MXNetError
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    arr = _np.asarray(data)
+    return nd.array(arr, dtype=str(arr.dtype) if arr.dtype != _np.float64
+                    else "float32")
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+def _as_numpy(sample):
+    if isinstance(sample, nd.NDArray):
+        return sample.asnumpy()
+    if isinstance(sample, tuple):
+        return tuple(_as_numpy(s) for s in sample)
+    return sample
+
+
+_worker_dataset = None
+
+
+def _worker_init(dataset_bytes):
+    global _worker_dataset
+    _worker_dataset = pickle.loads(dataset_bytes)
+
+
+def _worker_fn(indices):
+    return [_as_numpy(_worker_dataset[i]) for i in indices]
+
+
+class DataLoader:
+    """Reference gluon/data/dataloader.py DataLoader."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size required when no batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None or
+              last_batch is not None):
+            raise MXNetError("batch_size/shuffle/sampler/last_batch mutually "
+                             "exclusive with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._thread_pool = thread_pool
+        self._pool = None
+        if self._num_workers > 0:
+            self._start_pool()
+
+    def _start_pool(self):
+        try:
+            payload = pickle.dumps(self._dataset)
+        except Exception:
+            # unpicklable dataset: degrade to single-process
+            self._num_workers = 0
+            return
+        if self._thread_pool:
+            from multiprocessing.pool import ThreadPool
+            global _worker_dataset
+            _worker_dataset = self._dataset
+            self._pool = ThreadPool(self._num_workers)
+        else:
+            ctx = multiprocessing.get_context("fork") if sys.platform != "win32" \
+                else multiprocessing.get_context()
+            self._pool = ctx.Pool(self._num_workers, initializer=_worker_init,
+                                  initargs=(payload,))
+
+    def __iter__(self):
+        if self._num_workers == 0 or self._pool is None:
+            for batch_idx in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+            return
+
+        # pipelined async fetch through the pool
+        import collections
+        pending = collections.deque()
+        it = iter(self._batch_sampler)
+        exhausted = False
+        while True:
+            while not exhausted and len(pending) < max(self._prefetch, 1):
+                try:
+                    idx = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.append(self._pool.apply_async(_worker_fn, (idx,)))
+            if not pending:
+                return
+            samples = pending.popleft().get()
+            yield self._batchify_fn([_renumpy(s) for s in samples])
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()
+
+
+def _renumpy(s):
+    return s

@@ -85,15 +85,20 @@ class Parameter:
         self._finish_init(init, ctx, default_init)
 
     def _finish_init(self, init, ctx, default_init):
+        import jax
         ctx = ctx if isinstance(ctx, Context) or ctx is None else \
             (ctx[0] if isinstance(ctx, (list, tuple)) and ctx else None)
-        arr = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx)
-        filler = init or self.init or default_init
-        if isinstance(filler, str):
-            filler = initializer.create(filler)
-        desc = initializer.InitDesc(self.name)
-        with autograd.pause():
-            filler(desc, arr)
+        # ensure_compile_time_eval: deferred init may fire while a hybridize
+        # trace is being built; parameters must be real device arrays, not
+        # tracers of that trace.
+        with jax.ensure_compile_time_eval():
+            arr = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx)
+            filler = init or self.init or default_init
+            if isinstance(filler, str):
+                filler = initializer.create(filler)
+            desc = initializer.InitDesc(self.name)
+            with autograd.pause():
+                filler(desc, arr)
         self._data = arr
         self._deferred_init = None
         if self._grad_req != "null":

@@ -1,0 +1,5 @@
+from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, CSVIter,
+                 ResizeIter, PrefetchingIter, MXDataIter, ImageRecordIter)
+
+__all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter", "MXDataIter", "ImageRecordIter"]

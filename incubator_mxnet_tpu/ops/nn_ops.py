@@ -144,28 +144,28 @@ def pooling(data, *, kernel=(), pool_type="max", global_pool=False, stride=(),
     else:
         pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
 
+    # NOTE: init values must be weak-typed python scalars — jax's
+    # reduce_window autodiff rule does not linearize with array inits.
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
-                                 window, strides, pads)
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else int(jnp.iinfo(data.dtype).min)
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
-                              window, strides, pads)
+        s = lax.reduce_window(data, 0., lax.add, window, strides, pads)
         if pool_type == "sum":
             return s
         if count_include_pad:
             denom = 1
             for k in kernel:
                 denom *= k
-            return s / jnp.asarray(denom, data.dtype)
+            return (s / denom).astype(data.dtype)
         ones = jnp.ones_like(data)
-        cnt = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
-                                window, strides, pads)
-        return s / cnt
+        cnt = lax.reduce_window(ones, 0., lax.add, window, strides, pads)
+        return (s / cnt).astype(data.dtype)
     if pool_type == "lp":
-        pw = lax.reduce_window(jnp.abs(data) ** p_value, jnp.asarray(0, data.dtype),
-                               lax.add, window, strides, pads)
-        return pw ** (1.0 / p_value)
+        pw = lax.reduce_window(jnp.abs(data) ** p_value, 0., lax.add,
+                               window, strides, pads)
+        return (pw ** (1.0 / p_value)).astype(data.dtype)
     raise MXNetError(f"unknown pool_type {pool_type}")
 
 

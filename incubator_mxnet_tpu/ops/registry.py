@@ -57,6 +57,34 @@ class OpDef:
         self.train_aware = train_aware
         self._jit_cache = {}
 
+    def vjp_jitted(self, **params):
+        """Cached jitted backward: (cts, *primals) -> input cotangents.
+
+        Recomputes the forward inside the executable (rematerialization) so
+        the whole fwd+bwd pair is compiled ONCE per (op, params, shapes) and
+        reused every step — the reference's analog is the cached `_backward_*`
+        op + autotuned kernel; a fresh jax.vjp per call would recompile the
+        linearized program every training step.
+        """
+        import jax
+        key = ("vjp", _hashable(params))
+        f = self._jit_cache.get(key)
+        if f is None:
+            if self.stateful:
+                def fwd(rng, *xs, _p=params):
+                    return self.fn(*xs, rng=rng, **_p)
+            else:
+                def fwd(*xs, _p=params):
+                    return self.fn(*xs, **_p)
+
+            def bwd(cts, *primals):
+                _, vjp_fn = jax.vjp(fwd, *primals)
+                return vjp_fn(cts)
+
+            f = jax.jit(bwd)
+            self._jit_cache[key] = f
+        return f
+
     def jitted(self, **params):
         """A jax.jit specialization of this op for the given params.
 
@@ -141,14 +169,31 @@ def apply_op(op: OpDef, *args, out=None, **params):
 
     recording = autograd.is_recording() and not op.nondiff
 
-    if recording:
-        # vjp at forward time: residuals live on device, backward is a closure
-        # call (reference records NNVM nodes and replays _backward_* ops).
+    # Inside an outer trace (hybridize / pjit train step) call the raw fn:
+    # nested jit would both block some vjp rules (reduce_window) and prevent
+    # whole-graph fusion. Eagerly, the jit-cached specialization is the fast
+    # dispatch path (reference: engine op bulking, graph_executor.cc:1288).
+    import jax.core as _core
+    traced = any(isinstance(a, _core.Tracer) for a in arrs)
+    if traced:
+        if op.stateful:
+            fn = lambda rng, *xs, _p=params: op.fn(*xs, rng=rng, **_p)
+        else:
+            fn = lambda *xs, _p=params: op.fn(*xs, **_p)
+    else:
         fn = op.jitted(**params)
+
+    if recording and traced:
+        # inside an outer trace the vjp is part of that trace; no caching issue
         out_data, vjp_fn = jax.vjp(fn, *arrs)
     else:
-        out_data = op.jitted(**params)(*arrs)
+        out_data = fn(*arrs)
         vjp_fn = None
+        if recording:
+            # deferred, jit-cached backward (recomputes forward in-executable)
+            bwd = op.vjp_jitted(**params)
+            saved = list(arrs)
+            vjp_fn = lambda cts, _b=bwd, _s=saved: _b(cts, *_s)
 
     multi = isinstance(out_data, (tuple, list))
     outs = [NDArray(o) for o in (out_data if multi else (out_data,))]

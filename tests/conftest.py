@@ -9,10 +9,18 @@ Must run before jax is imported anywhere.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = os.environ.get("MXTPU_TEST_PLATFORM", "cpu")
+_plat = os.environ.get("MXTPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _plat
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize may have imported jax already (with the
+# axon TPU backend forced); env vars alone are then too late — override the
+# live config so tests really run on the 8-device virtual CPU mesh.
+if "jax" in sys.modules and _plat:
+    import jax
+    jax.config.update("jax_platforms", _plat)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -24,9 +32,10 @@ import pytest
 def _seed_rngs(request):
     """Per-test deterministic seeding (reference tests/python/unittest/common.py:117
     @with_seed). Honors MXTPU_TEST_SEED for reproduction."""
+    import zlib
     seed = int(os.environ.get("MXTPU_TEST_SEED", "0"))
     if seed == 0:
-        seed = abs(hash(request.node.nodeid)) % (2**31 - 1)
+        seed = zlib.crc32(request.node.nodeid.encode()) % (2**31 - 1)
     np.random.seed(seed)
     import incubator_mxnet_tpu as mx
     mx.random.seed(seed)

@@ -1,0 +1,279 @@
+"""Gluon tests (reference: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.gluon import Block, HybridBlock, Parameter, Trainer, loss, nn
+
+
+def test_parameter():
+    p = Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.init.One())
+    np.testing.assert_allclose(p.data().asnumpy(), np.ones((3, 4)))
+    assert p.grad() is not None
+    p.zero_grad()
+
+
+def test_parameter_deferred_init():
+    p = Parameter("weight", shape=(3, 0), allow_deferred_init=True)
+    p.initialize()
+    p._infer_shape((3, 7))
+    assert p.data().shape == (3, 7)
+
+
+def test_dense_forward():
+    layer = nn.Dense(4, in_units=3)
+    layer.initialize()
+    x = nd.ones((2, 3))
+    out = layer(x)
+    assert out.shape == (2, 4)
+    w = layer.weight.data().asnumpy()
+    b = layer.bias.data().asnumpy()
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)) @ w.T + b, rtol=1e-5)
+
+
+def test_dense_deferred_shape():
+    layer = nn.Dense(5)
+    layer.initialize()
+    out = layer(nd.ones((2, 7)))
+    assert out.shape == (2, 5)
+    assert layer.weight.shape == (5, 7)
+
+
+def test_sequential():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8), nn.Dense(2))
+    net.initialize()
+    out = net(nd.random.uniform(shape=(4, 10)))
+    assert out.shape == (4, 2)
+    assert len(net) == 3
+    assert isinstance(net[0], nn.Dense)
+
+
+def test_collect_params_names():
+    net = nn.HybridSequential(prefix="net_")
+    with_scope = nn.Dense(2, prefix="fc0_")
+    net.add(with_scope)
+    net.initialize()
+    net(nd.ones((1, 3)))
+    params = net.collect_params()
+    assert any(k.endswith("weight") for k in params.keys())
+
+
+def test_custom_hybrid_block():
+    class Net(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.fc1 = nn.Dense(8)
+            self.fc2 = nn.Dense(3)
+
+        def hybrid_forward(self, F, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    net.initialize()
+    out = net(nd.ones((2, 4)))
+    assert out.shape == (2, 3)
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.random.uniform(shape=(3, 8))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+    # second call goes through the cache
+    hybrid2 = net(x).asnumpy()
+    np.testing.assert_allclose(hybrid, hybrid2, rtol=1e-6)
+
+
+def test_hybridize_grad_matches_eager():
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="tanh"), nn.Dense(1))
+        return net
+
+    mx.random.seed(7)
+    net1 = build()
+    net1.initialize(mx.init.Xavier())
+    x = nd.random.uniform(shape=(4, 5))
+
+    with autograd.record():
+        y1 = net1(x)
+        l1 = nd.sum(y1 * y1)
+    l1.backward()
+    eager_grads = {k: p.grad().asnumpy().copy()
+                   for k, p in net1.collect_params().items()}
+
+    net1.hybridize()
+    with autograd.record():
+        y2 = net1(x)
+        l2 = nd.sum(y2 * y2)
+    l2.backward()
+    for k, p in net1.collect_params().items():
+        np.testing.assert_allclose(p.grad().asnumpy(), eager_grads[k],
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm(in_channels=3, momentum=0.5)
+    bn.initialize()
+    x = nd.array(np.random.rand(8, 3, 4, 4).astype(np.float32) * 3 + 1)
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0)  # moved toward batch mean
+    expected = 0.5 * 0 + 0.5 * x.asnumpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(rm, expected, rtol=1e-3)
+
+
+def test_batchnorm_running_stats_update_hybridized():
+    bn = nn.BatchNorm(in_channels=3, momentum=0.5)
+    bn.initialize()
+    bn.hybridize()
+    x = nd.array(np.random.rand(8, 3, 2, 2).astype(np.float32) * 3 + 1)
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    expected = 0.5 * x.asnumpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(rm, expected, rtol=1e-3)
+
+
+def test_conv2d_layer():
+    layer = nn.Conv2D(8, kernel_size=3, padding=1, activation="relu")
+    layer.initialize()
+    out = layer(nd.random.uniform(shape=(2, 3, 8, 8)))
+    assert out.shape == (2, 8, 8, 8)
+    assert layer.weight.shape == (8, 3, 3, 3)
+
+
+def test_pool_layers():
+    x = nd.random.uniform(shape=(2, 3, 8, 8))
+    assert nn.MaxPool2D()(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+
+
+def test_losses():
+    pred = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array([0.0, 1.0, 2.0, 3.0])
+    l = loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    logp = np.log(np.exp(pred.asnumpy()) /
+                  np.exp(pred.asnumpy()).sum(-1, keepdims=True))
+    expected = -logp[np.arange(4), [0, 1, 2, 3]]
+    np.testing.assert_allclose(l.asnumpy(), expected, rtol=1e-4)
+
+    p2 = nd.array(np.random.rand(4, 3).astype(np.float32))
+    t2 = nd.array(np.random.rand(4, 3).astype(np.float32))
+    np.testing.assert_allclose(loss.L2Loss()(p2, t2).asnumpy(),
+                               0.5 * ((p2.asnumpy() - t2.asnumpy()) ** 2).mean(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(loss.L1Loss()(p2, t2).asnumpy(),
+                               np.abs(p2.asnumpy() - t2.asnumpy()).mean(1),
+                               rtol=1e-5)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(mx.init.One())
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = nd.ones((4, 2))
+    with autograd.record():
+        y = net(x)
+        l = nd.sum(y)
+    l.backward()
+    trainer.step(batch_size=4)
+    # grad = d(sum(x@w.T))/dw = sum of x rows = [4,4]; rescaled by 1/4 -> [1,1]
+    np.testing.assert_allclose(net.weight.data().asnumpy(), [[0.9, 0.9]], rtol=1e-5)
+
+
+def test_trainer_optimizers():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "nag", "adadelta",
+                 "adamax", "signum", "ftrl", "nadam"]:
+        net = nn.Dense(2, in_units=3)
+        net.initialize()
+        tr = Trainer(net.collect_params(), name,
+                     {"learning_rate": 0.01} if name != "adadelta" else {})
+        with autograd.record():
+            l = nd.sum(net(nd.ones((2, 3))) ** 2)
+        l.backward()
+        before = net.weight.data().asnumpy().copy()
+        tr.step(2)
+        after = net.weight.data().asnumpy()
+        assert not np.allclose(before, after), name
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((1, 3)))
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(f)
+    x = nd.random.uniform(shape=(2, 3))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(), rtol=1e-6)
+
+
+def test_dropout_layer_train_vs_eval():
+    layer = nn.Dropout(0.5)
+    x = nd.ones((50, 50))
+    out = layer(x)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((50, 50)))
+    with autograd.record():
+        out = layer(x)
+    assert (out.asnumpy() == 0).any()
+
+
+def test_mnist_lenet_end_to_end():
+    """The minimum end-to-end slice (SURVEY.md §7 stage 3): LeNet on synthetic
+    MNIST learns to separate two simple classes (reference
+    example/gluon/mnist/mnist.py + tests/python/train/test_conv.py)."""
+    mx.random.seed(0)
+    np.random.seed(0)
+
+    net = nn.HybridSequential()
+    net.add(
+        nn.Conv2D(8, kernel_size=5, activation="relu"),
+        nn.MaxPool2D(pool_size=2, strides=2),
+        nn.Conv2D(16, kernel_size=3, activation="relu"),
+        nn.MaxPool2D(pool_size=2, strides=2),
+        nn.Flatten(),
+        nn.Dense(64, activation="relu"),
+        nn.Dense(10),
+    )
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    # synthetic "digits": class k = gaussian blob with mean k/10
+    n, k = 256, 10
+    labels_np = np.random.randint(0, k, n)
+    data_np = (np.random.randn(n, 1, 28, 28) * 0.1 +
+               (labels_np[:, None, None, None] - 4.5) * 0.2).astype(np.float32)
+
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.02, "momentum": 0.9})
+    sce = loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    batch = 64
+    for epoch in range(18):
+        metric.reset()
+        for i in range(0, n, batch):
+            x = nd.array(data_np[i:i + batch])
+            y = nd.array(labels_np[i:i + batch].astype(np.float32))
+            with autograd.record():
+                out = net(x)
+                l = sce(out, y)
+            l.backward()
+            trainer.step(batch)
+            metric.update([y], [out])
+    name, acc = metric.get()
+    assert acc > 0.8, f"LeNet failed to learn: acc={acc}"
