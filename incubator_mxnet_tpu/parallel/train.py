@@ -1,0 +1,177 @@
+"""Fully-compiled SPMD training step over a device mesh.
+
+Reference analog: the steady-state Module.fit loop (SURVEY.md §3.3) where
+RunOps iterates pre-built cached engine segments with kvstore push/pull
+between forward/backward and update. TPU-native: the WHOLE step — forward,
+backward, gradient allreduce, optimizer update, BatchNorm stat update — is
+ONE XLA program under jit with NamedShardings; the compiler schedules the
+collectives to overlap the backward (what the reference gets from engine
+asynchrony + kvstore priority ordering, graph_executor.cc InitOpSegs +
+kvstore priority=-key).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .functional import functionalize
+
+__all__ = ["TrainStep", "shard_batch"]
+
+
+def shard_batch(batch, mesh, axis="dp"):
+    """Place a host batch onto the mesh sharded on its leading dim (replaces
+    gluon.utils.split_and_load's per-GPU copies)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch)
+
+
+class TrainStep:
+    """Compiled train step for a Gluon net.
+
+    usage:
+        step = TrainStep(net, loss_fn, optimizer="sgd",
+                         optimizer_params={...}, mesh=mesh,
+                         example_inputs=[x, y])
+        loss = step(x_batch, y_batch)   # one fused XLA program
+
+    loss_fn(outputs, label_array) -> scalar jax value. Parameters live inside
+    TrainStep as a sharded pytree and are written back into the Gluon
+    Parameters on `sync()` (for checkpointing / eval through the normal API).
+    """
+
+    def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, example_inputs=None, param_spec_fn=None,
+                 data_axis="dp", dtype=None, donate=True):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if example_inputs is None:
+            raise MXNetError("TrainStep needs example_inputs")
+        self.net = net
+        self.mesh = mesh
+        self.data_axis = data_axis
+        opt_kwargs = dict(optimizer_params or {})
+        self._lr = float(opt_kwargs.pop("learning_rate", 0.01))
+        self._momentum = float(opt_kwargs.pop("momentum", 0.0))
+        self._wd = float(opt_kwargs.pop("wd", 0.0))
+        self._opt_name = optimizer
+
+        params, apply_fn = functionalize(net, example_inputs, training=True)
+        if dtype is not None:
+            params = OrderedDict((k, v.astype(dtype) if
+                                  jnp.issubdtype(v.dtype, jnp.floating) and
+                                  "running" not in k else v)
+                                 for k, v in params.items())
+        self._param_names = list(params.keys())
+        self._apply_fn = apply_fn
+        self._param_list = [net.collect_params()[k]
+                            for k in sorted(net.collect_params().keys())]
+
+        # optimizer state mirrors param tree
+        if optimizer == "sgd" and self._momentum:
+            opt_state = {k: jnp.zeros_like(v) for k, v in params.items()}
+        elif optimizer == "adam":
+            opt_state = {k: (jnp.zeros_like(v), jnp.zeros_like(v))
+                         for k, v in params.items()}
+        else:
+            opt_state = {}
+
+        # shardings: params replicated (or per param_spec_fn), batch on dp
+        if mesh is not None:
+            pspec = {k: (param_spec_fn(k, v) if param_spec_fn else P())
+                     for k, v in params.items()}
+            param_sh = {k: NamedSharding(mesh, s) for k, s in pspec.items()}
+            params = {k: jax.device_put(v, param_sh[k])
+                      for k, v in params.items()}
+            opt_state = jax.tree_util.tree_map(
+                lambda v: jax.device_put(v, NamedSharding(mesh, P())),
+                opt_state) if optimizer != "sgd" or self._momentum else opt_state
+            if optimizer == "sgd" and self._momentum:
+                opt_state = {k: jax.device_put(v, param_sh[k])
+                             for k, v in opt_state.items()}
+            self._data_sharding = NamedSharding(mesh, P(data_axis))
+        else:
+            self._data_sharding = None
+
+        self.params = dict(params)
+        self.opt_state = opt_state
+        self._step_count = 0
+        non_diff = {p.name for p in self._param_list if p.grad_req == "null"}
+
+        lr, momentum, wd = self._lr, self._momentum, self._wd
+        opt_name = optimizer
+
+        def step_fn(params, opt_state, rng, step_i, *batch):
+            inputs, label = batch[:-1], batch[-1]
+
+            def loss_of(diff_params):
+                full = dict(params)
+                full.update(diff_params)
+                outs, writes = apply_fn(full, rng, *inputs)
+                out = outs[0]
+                return loss_fn(out, label), (writes, out)
+
+            diff_params = {k: v for k, v in params.items() if k not in non_diff}
+            (loss, (writes, out)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(diff_params)
+
+            new_params = dict(params)
+            new_opt = dict(opt_state) if isinstance(opt_state, dict) else opt_state
+            for k, g in grads.items():
+                w = params[k]
+                g = g.astype(w.dtype)
+                if opt_name == "sgd" and momentum:
+                    m = opt_state[k]
+                    m2 = momentum * m - lr * (g + wd * w)
+                    new_params[k] = w + m2
+                    new_opt[k] = m2
+                elif opt_name == "sgd":
+                    new_params[k] = w - lr * (g + wd * w)
+                elif opt_name == "adam":
+                    b1, b2, eps = 0.9, 0.999, 1e-8
+                    m, v = opt_state[k]
+                    m2 = b1 * m + (1 - b1) * g
+                    v2 = b2 * v + (1 - b2) * jnp.square(g)
+                    t = step_i + 1
+                    alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+                    new_params[k] = w - alpha * m2 / (jnp.sqrt(v2) + eps)
+                    new_opt[k] = (m2, v2)
+                else:
+                    raise MXNetError(f"TrainStep optimizer {opt_name} "
+                                     f"unsupported (use Trainer)")
+            # fold state writes (BN running stats) into the param tree
+            for k, v in writes.items():
+                new_params[k] = v.astype(params[k].dtype)
+            return new_params, new_opt, loss
+
+        self._jit_step = jax.jit(step_fn, donate_argnums=(0, 1) if donate else ())
+
+    def __call__(self, *batch):
+        import jax
+        import numpy as _np
+        from ..ndarray.ndarray import NDArray
+        from ..ndarray import random as _rnd
+        arrs = []
+        for b in batch:
+            a = b._data if isinstance(b, NDArray) else jax.numpy.asarray(b)
+            if self._data_sharding is not None:
+                a = jax.device_put(a, self._data_sharding)
+            arrs.append(a)
+        rng = _rnd.next_key()
+        self.params, self.opt_state, loss = self._jit_step(
+            self.params, self.opt_state, rng, self._step_count, *arrs)
+        self._step_count += 1
+        return loss
+
+    def sync(self):
+        """Write the compiled-step params back into the Gluon Parameters so
+        save_parameters()/eval see the trained weights."""
+        for p in self._param_list:
+            if p.name in self.params:
+                p._data._data = self.params[p.name].astype(p.data().dtype)
