@@ -77,27 +77,41 @@ class TransformerLM:
         v = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
         return ((x - m) * jax.lax.rsqrt(v + 1e-5)).astype(x.dtype) * g + b
 
-    def _block(self, params, prefix, x, sp_axis):
+    def _block(self, params, prefix, x, sp_axis, tp_axis=None):
+        """One pre-norm block. Inside shard_map, attention/MLP weights may be
+        Megatron-sharded over `tp_axis` (wq/wk/wv/w_in column-parallel,
+        wo/w_out row-parallel): each device computes its local slice of heads
+        / hidden units and a psum over tp after each row-parallel matmul
+        restores the full residual stream. Head/hidden split is read off the
+        *local* weight shapes, so the same code serves the unsharded path."""
         cfg = self.cfg
         B, T, D = x.shape
-        H = cfg.n_heads
-        hd = D // H
+        hd = D // cfg.n_heads
         h = self._ln(x, params[prefix + "ln1_g"], params[prefix + "ln1_b"])
-        q = (h @ params[prefix + "wq"]).reshape(B, T, H, hd)
-        kk = (h @ params[prefix + "wk"]).reshape(B, T, H, hd)
-        v = (h @ params[prefix + "wv"]).reshape(B, T, H, hd)
+        wq = params[prefix + "wq"]
+        d_local = wq.shape[1]          # = D/tp inside shard_map with TP
+        h_local = d_local // hd        # local head count
+        q = (h @ wq).reshape(B, T, h_local, hd)
+        kk = (h @ params[prefix + "wk"]).reshape(B, T, h_local, hd)
+        v = (h @ params[prefix + "wv"]).reshape(B, T, h_local, hd)
         if sp_axis is not None:
             attn = ring_attention(q, kk, v, sp_axis, causal=True)
         else:
             attn = attention_reference(q, kk, v, causal=True)
-        x = x + attn.reshape(B, T, D) @ params[prefix + "wo"]
+        attn_out = attn.reshape(B, T, d_local) @ params[prefix + "wo"]
+        if tp_axis is not None:
+            attn_out = jax.lax.psum(attn_out, tp_axis)
+        x = x + attn_out
         h = self._ln(x, params[prefix + "ln2_g"], params[prefix + "ln2_b"])
-        x = x + jax.nn.gelu(h @ params[prefix + "w_in"]) @ params[prefix + "w_out"]
-        return x
+        y = jax.nn.gelu(h @ params[prefix + "w_in"]) @ params[prefix + "w_out"]
+        if tp_axis is not None:
+            y = jax.lax.psum(y, tp_axis)
+        return x + y
 
-    def apply(self, params, tokens, sp_axis=None, positions=None):
+    def apply(self, params, tokens, sp_axis=None, positions=None, tp_axis=None):
         """tokens (B, T) int32 -> logits (B, T, vocab). When called inside a
-        shard_map with a sequence axis, pass sp_axis and per-shard positions."""
+        shard_map with a sequence axis, pass sp_axis and per-shard positions;
+        pass tp_axis when attention/MLP weights are Megatron-sharded."""
         cfg = self.cfg
         x = params["embed"][tokens]
         if positions is None:
@@ -105,17 +119,18 @@ class TransformerLM:
         x = x + params["pos_embed"][positions]
         if cfg.remat:
             block = jax.checkpoint(
-                lambda p, pref, y: self._block(p, pref, y, sp_axis),
+                lambda p, pref, y: self._block(p, pref, y, sp_axis, tp_axis),
                 static_argnums=(1,))
         else:
-            block = lambda p, pref, y: self._block(p, pref, y, sp_axis)
+            block = lambda p, pref, y: self._block(p, pref, y, sp_axis, tp_axis)
         for i in range(cfg.n_layers):
             x = block(params, f"layer{i}_", x)
         x = self._ln(x, params["lnf_g"], params["lnf_b"])
         return (x @ params["embed"].T).astype(jnp.float32)
 
-    def loss(self, params, tokens, targets, sp_axis=None, positions=None):
-        logits = self.apply(params, tokens, sp_axis, positions)
+    def loss(self, params, tokens, targets, sp_axis=None, positions=None,
+             tp_axis=None):
+        logits = self.apply(params, tokens, sp_axis, positions, tp_axis)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return jnp.mean(nll)
@@ -123,11 +138,12 @@ class TransformerLM:
     # -- sharded training ---------------------------------------------------
     def param_sharding(self, mesh, tp_axis="tp"):
         from ..parallel.tensor_parallel import transformer_param_specs
+        has_tp = tp_axis in mesh.axis_names
         shd = {}
         for name in self._param_names():
             shd[name] = NamedSharding(
                 mesh, transformer_param_specs(name, _FakeNd(2), tp_axis)
-                if _rank_of(name) >= 2 else P())
+                if has_tp and _rank_of(name) >= 2 else P())
         return shd
 
     def _param_names(self):
@@ -141,21 +157,37 @@ class TransformerLM:
     def make_train_step(self, mesh, lr=1e-3, use_sp=True):
         """Fully-sharded train step: dp on batch, tp on weights, sp on
         sequence (ring attention through shard_map). Adam in fp32 master
-        precision. Returns (step_fn, shard_params_fn)."""
-        from jax.experimental.shard_map import shard_map
+        precision. Returns (step_fn, shard_params_fn, init_opt_fn);
+        step_fn(params, opt_state, tokens, targets, step_i) -> (params,
+        opt_state, loss) with params/opt_state donated."""
+        from ..parallel._compat import shard_map
         from ..parallel.tensor_parallel import transformer_param_specs
 
         axis_names = mesh.axis_names
         has = {a: a in axis_names for a in ("dp", "tp", "sp")}
         sp_axis = "sp" if (use_sp and has["sp"]) else None
 
-        pspec = {n: (transformer_param_specs(n, _FakeNd(2))
-                     if _rank_of(n) >= 2 else P())
-                 for n in self._param_names()}
+        def _is_matmul(n):
+            return n.endswith(("wq", "wk", "wv", "wo", "w_in", "w_out"))
+
+        # weights are tp-sharded only when the mesh actually has a 'tp' axis.
+        # On the shard_map (sp) path the block does manual Megatron TP, so
+        # only the attention/MLP matmul weights are sharded and the embedding
+        # stays replicated (apply() indexes the full table in-shard); on the
+        # pure-jit GSPMD path XLA handles any spec, embedding included.
+        if sp_axis is not None:
+            pspec = {n: (transformer_param_specs(n, _FakeNd(2))
+                         if has["tp"] and _is_matmul(n) else P())
+                     for n in self._param_names()}
+        else:
+            pspec = {n: (transformer_param_specs(n, _FakeNd(2))
+                         if has["tp"] and _rank_of(n) >= 2 else P())
+                     for n in self._param_names()}
         data_spec = P("dp" if has["dp"] else None,
                       sp_axis)
 
         model = self
+        tp_in_block = "tp" if (sp_axis is not None and has["tp"]) else None
 
         def loss_fn(params, tokens, targets):
             if sp_axis is not None:
@@ -164,7 +196,8 @@ class TransformerLM:
                     idx = jax.lax.axis_index(sp_axis)
                     t_local = tokens_.shape[1]
                     positions = idx * t_local + jnp.arange(t_local)
-                    l = model.loss(params_, tokens_, targets_, sp_axis, positions)
+                    l = model.loss(params_, tokens_, targets_, sp_axis,
+                                   positions, tp_in_block)
                     terms = jax.lax.pmean(l, sp_axis)
                     if has["dp"]:
                         terms = jax.lax.pmean(terms, "dp")
@@ -172,26 +205,27 @@ class TransformerLM:
                         terms = jax.lax.pmean(terms, "tp")
                     return terms
 
-                fn = shard_map(local, mesh=mesh,
-                               in_specs=(pspec, data_spec, data_spec),
-                               out_specs=P(), check_vma=False)
+                fn = shard_map(local, mesh,
+                               (pspec, data_spec, data_spec), P())
                 return fn(params, tokens, targets)
             return model.loss(params, tokens, targets)
+
+        from ..ops.optimizer_ops import adam_update as _adam_op
 
         def step(params, opt_state, tokens, targets, step_i):
             loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
             new_params, new_opt = {}, {}
-            b1, b2, eps = 0.9, 0.999, 1e-8
+            b1, b2 = 0.9, 0.999
             t = step_i + 1
+            # bias correction folded into lr, as the reference's python
+            # Optimizer does before calling the fused adam_update op
+            alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
             for k, g in grads.items():
                 m, v = opt_state[k]
-                g32 = g.astype(jnp.float32)
-                m2 = b1 * m + (1 - b1) * g32
-                v2 = b2 * v + (1 - b2) * jnp.square(g32)
-                alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
-                new_params[k] = (params[k].astype(jnp.float32) -
-                                 alpha * m2 / (jnp.sqrt(v2) + eps)
-                                 ).astype(params[k].dtype)
+                w32, m2, v2 = _adam_op.fn(params[k].astype(jnp.float32),
+                                          g.astype(jnp.float32), m, v,
+                                          lr=alpha, beta1=b1, beta2=b2)
+                new_params[k] = w32.astype(params[k].dtype)
                 new_opt[k] = (m2, v2)
             return new_params, new_opt, loss
 
@@ -207,7 +241,11 @@ class TransformerLM:
                            donate_argnums=(0, 1))
 
         def shard_params(params):
-            return {k: jax.device_put(v, NamedSharding(mesh, pspec[k]))
+            # jnp.asarray copy first: device_put may alias the source buffer
+            # (zero-copy on CPU), and the donated step would then delete the
+            # caller's arrays with it
+            return {k: jax.device_put(jnp.asarray(v).copy(),
+                                      NamedSharding(mesh, pspec[k]))
                     for k, v in params.items()}
 
         def init_opt(params):

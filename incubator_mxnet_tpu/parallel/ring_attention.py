@@ -106,7 +106,7 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
     """shard_map wrapper: q,k,v (B,T,H,D) get sharded on T over `axis_name`
     (and batch over 'dp' if present) and attention runs as a ring."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from ._compat import shard_map
 
     batch_axis = "dp" if "dp" in mesh.axis_names else None
     spec = P(batch_axis, axis_name, None, None)
@@ -114,6 +114,5 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name, causal=causal,
                           sm_scale=sm_scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh, (spec, spec, spec), spec)
     return fn(q, k, v)

@@ -17,9 +17,91 @@ import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
+from ..ops import optimizer_ops as _oo
 from .functional import functionalize
 
 __all__ = ["TrainStep", "shard_batch"]
+
+
+def _make_update_rule(opt_name, lr, momentum, wd, opt_kwargs):
+    """Map an optimizer name to (state_init, update) built on the REGISTERED
+    fused update ops (ops/optimizer_ops.py) — the same kernels the eager
+    Trainer path uses, so the compiled and eager optimizers cannot drift.
+    Every optimizer_params key must be consumed; leftovers raise, so a typo'd
+    or unsupported hyperparameter never silently trains with a default.
+
+    state_init(param) -> tuple of state arrays
+    update(w, g, states, t) -> (new_w, new_states); t is the 1-based step.
+    """
+    import jax.numpy as jnp
+
+    kw = dict(opt_kwargs)
+    common = dict(rescale_grad=float(kw.pop("rescale_grad", 1.0)),
+                  clip_gradient=float(kw.pop("clip_gradient", -1.0)))
+
+    def _done(rule):
+        if kw:
+            raise MXNetError(f"TrainStep optimizer {opt_name!r}: unknown "
+                             f"optimizer_params {sorted(kw)}")
+        return rule
+
+    if opt_name == "sgd" and not momentum:
+        return _done((lambda v: (),
+                      lambda w, g, st, t: (_oo.sgd_update.fn(
+                          w, g, lr=lr, wd=wd, **common), ())))
+    if opt_name in ("sgd", "nag"):
+        op = _oo.sgd_mom_update if opt_name == "sgd" else _oo.nag_mom_update
+
+        def upd(w, g, st, t, _op=op):
+            w2, m2 = _op.fn(w, g, st[0], lr=lr, momentum=momentum, wd=wd,
+                            **common)
+            return w2, (m2,)
+        return _done((lambda v: (jnp.zeros_like(v),), upd))
+    if opt_name == "adam":
+        b1 = float(kw.pop("beta1", 0.9))
+        b2 = float(kw.pop("beta2", 0.999))
+        eps = float(kw.pop("epsilon", 1e-8))
+
+        def upd(w, g, st, t):
+            alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+            w2, m2, v2 = _oo.adam_update.fn(w, g, st[0], st[1], lr=alpha,
+                                            beta1=b1, beta2=b2, epsilon=eps,
+                                            wd=wd, **common)
+            return w2, (m2, v2)
+        return _done((lambda v: (jnp.zeros_like(v), jnp.zeros_like(v)), upd))
+    if opt_name == "rmsprop":
+        gamma1 = float(kw.pop("gamma1", 0.95))
+        eps = float(kw.pop("epsilon", 1e-8))
+
+        def upd(w, g, st, t):
+            w2, n2 = _oo.rmsprop_update.fn(w, g, st[0], lr=lr, gamma1=gamma1,
+                                           epsilon=eps, wd=wd, **common)
+            return w2, (n2,)
+        return _done((lambda v: (jnp.zeros_like(v),), upd))
+    if opt_name == "signum":
+        wd_lh = float(kw.pop("wd_lh", 0.0))
+
+        def upd(w, g, st, t):
+            w2, m2 = _oo.signum_update.fn(w, g, st[0], lr=lr,
+                                          momentum=momentum, wd=wd,
+                                          wd_lh=wd_lh, **common)
+            return w2, (m2,)
+        return _done((lambda v: (jnp.zeros_like(v),), upd))
+    if opt_name == "adamw":
+        b1 = float(kw.pop("beta1", 0.9))
+        b2 = float(kw.pop("beta2", 0.999))
+        eps = float(kw.pop("epsilon", 1e-8))
+        eta = float(kw.pop("eta", 1.0))
+
+        def upd(w, g, st, t):
+            w2, m2, v2 = _oo.adamw_update.fn(
+                w, g, st[0], st[1], lr=lr, beta1=b1, beta2=b2, epsilon=eps,
+                eta=eta, wd=wd, clip_gradient=common["clip_gradient"],
+                rescale_grad=common["rescale_grad"])
+            return w2, (m2, v2)
+        return _done((lambda v: (jnp.zeros_like(v), jnp.zeros_like(v)), upd))
+    raise MXNetError(f"TrainStep optimizer {opt_name!r} unsupported; one of "
+                     "sgd/nag/adam/rmsprop/signum/adamw (or use Trainer)")
 
 
 def shard_batch(batch, mesh, axis="dp"):
@@ -73,28 +155,22 @@ class TrainStep:
         self._param_list = [net.collect_params()[k]
                             for k in sorted(net.collect_params().keys())]
 
-        # optimizer state mirrors param tree
-        if optimizer == "sgd" and self._momentum:
-            opt_state = {k: jnp.zeros_like(v) for k, v in params.items()}
-        elif optimizer == "adam":
-            opt_state = {k: (jnp.zeros_like(v), jnp.zeros_like(v))
-                         for k, v in params.items()}
-        else:
-            opt_state = {}
+        # optimizer state mirrors the param tree; the update rule is built on
+        # the registered fused update ops shared with the eager Trainer path
+        state_init, update = _make_update_rule(
+            optimizer, self._lr, self._momentum, self._wd, opt_kwargs)
+        opt_state = {k: state_init(v) for k, v in params.items()}
 
-        # shardings: params replicated (or per param_spec_fn), batch on dp
+        # shardings: params replicated (or per param_spec_fn), optimizer
+        # state sharded exactly like its weight, batch on dp
         if mesh is not None:
             pspec = {k: (param_spec_fn(k, v) if param_spec_fn else P())
                      for k, v in params.items()}
             param_sh = {k: NamedSharding(mesh, s) for k, s in pspec.items()}
             params = {k: jax.device_put(v, param_sh[k])
                       for k, v in params.items()}
-            opt_state = jax.tree_util.tree_map(
-                lambda v: jax.device_put(v, NamedSharding(mesh, P())),
-                opt_state) if optimizer != "sgd" or self._momentum else opt_state
-            if optimizer == "sgd" and self._momentum:
-                opt_state = {k: jax.device_put(v, param_sh[k])
-                             for k, v in opt_state.items()}
+            opt_state = {k: tuple(jax.device_put(s, param_sh[k]) for s in st)
+                         for k, st in opt_state.items()}
             self._data_sharding = NamedSharding(mesh, P(data_axis))
         else:
             self._data_sharding = None
@@ -103,9 +179,6 @@ class TrainStep:
         self.opt_state = opt_state
         self._step_count = 0
         non_diff = {p.name for p in self._param_list if p.grad_req == "null"}
-
-        lr, momentum, wd = self._lr, self._momentum, self._wd
-        opt_name = optimizer
 
         def step_fn(params, opt_state, rng, step_i, *batch):
             inputs, label = batch[:-1], batch[-1]
@@ -122,29 +195,12 @@ class TrainStep:
                 loss_of, has_aux=True)(diff_params)
 
             new_params = dict(params)
-            new_opt = dict(opt_state) if isinstance(opt_state, dict) else opt_state
+            new_opt = dict(opt_state)
+            t = step_i + 1
             for k, g in grads.items():
                 w = params[k]
-                g = g.astype(w.dtype)
-                if opt_name == "sgd" and momentum:
-                    m = opt_state[k]
-                    m2 = momentum * m - lr * (g + wd * w)
-                    new_params[k] = w + m2
-                    new_opt[k] = m2
-                elif opt_name == "sgd":
-                    new_params[k] = w - lr * (g + wd * w)
-                elif opt_name == "adam":
-                    b1, b2, eps = 0.9, 0.999, 1e-8
-                    m, v = opt_state[k]
-                    m2 = b1 * m + (1 - b1) * g
-                    v2 = b2 * v + (1 - b2) * jnp.square(g)
-                    t = step_i + 1
-                    alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
-                    new_params[k] = w - alpha * m2 / (jnp.sqrt(v2) + eps)
-                    new_opt[k] = (m2, v2)
-                else:
-                    raise MXNetError(f"TrainStep optimizer {opt_name} "
-                                     f"unsupported (use Trainer)")
+                new_params[k], new_opt[k] = update(w, g.astype(w.dtype),
+                                                   opt_state[k], t)
             # fold state writes (BN running stats) into the param tree
             for k, v in writes.items():
                 new_params[k] = v.astype(params[k].dtype)
@@ -171,7 +227,14 @@ class TrainStep:
 
     def sync(self):
         """Write the compiled-step params back into the Gluon Parameters so
-        save_parameters()/eval see the trained weights."""
+        save_parameters()/eval see the trained weights. Mesh-sharded arrays
+        are gathered to the default device — the eager path runs single-chip."""
+        import numpy as _np
+        import jax.numpy as _jnp
         for p in self._param_list:
             if p.name in self.params:
-                p._data._data = self.params[p.name].astype(p.data().dtype)
+                v = self.params[p.name]
+                if getattr(v, "sharding", None) is not None and \
+                        len(getattr(v.sharding, "device_set", ())) > 1:
+                    v = _jnp.asarray(_np.asarray(v))
+                p._data._data = v.astype(p.data().dtype)

@@ -1,0 +1,192 @@
+"""Tests for the parallel stack on the 8-device virtual CPU mesh.
+
+What the reference validates with multi-process kvstore scripts
+(tests/nightly/dist_sync_kvstore.py, multi_lenet.py) we validate here as
+single-process SPMD: collectives really execute across the 8 virtual
+devices, so a wrong spec or missing psum shows up as a numeric mismatch.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.parallel import (
+    make_mesh, ring_attention_sharded, TrainStep, shard_batch)
+from incubator_mxnet_tpu.parallel.ring_attention import attention_reference
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _qkv(B=2, T=32, H=4, D=8, seed=0):
+    k = jax.random.PRNGKey(seed)
+    kq, kk, kv = jax.random.split(k, 3)
+    q = jax.random.normal(kq, (B, T, H, D), jnp.float32)
+    kk = jax.random.normal(kk, (B, T, H, D), jnp.float32)
+    v = jax.random.normal(kv, (B, T, H, D), jnp.float32)
+    return q, kk, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    q, k, v = _qkv()
+    mesh = make_mesh({"sp": 8})
+    out = ring_attention_sharded(q, k, v, mesh, causal=causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_dp_sp_mesh():
+    q, k, v = _qkv(B=4, T=16)
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    out = ring_attention_sharded(q, k, v, mesh, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def _mlp():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    return net
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_trainstep_dp_convergence(optimizer):
+    # 4-class linearly separable blobs; loss must drop under dp=8
+    rs = np.random.RandomState(0)
+    centers = rs.randn(4, 16) * 3
+    xs = np.concatenate([centers[i] + 0.1 * rs.randn(16, 16) for i in range(4)])
+    ys = np.repeat(np.arange(4), 16).astype(np.int32)
+
+    net = _mlp()
+    mesh = make_mesh({"dp": 8})
+
+    def loss_fn(out, label):
+        logp = jax.nn.log_softmax(out, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, label[:, None], axis=1))
+
+    step = TrainStep(net, loss_fn, optimizer=optimizer,
+                     optimizer_params={"learning_rate": 0.1}, mesh=mesh,
+                     example_inputs=[mx.nd.array(xs[:8])])
+    first = float(step(xs, ys))
+    for _ in range(30):
+        last = float(step(xs, ys))
+    assert last < first * 0.5, (first, last)
+    # params sync back into the Gluon block
+    step.sync()
+    out = net(mx.nd.array(xs))
+    acc = (out.asnumpy().argmax(1) == ys).mean()
+    assert acc > 0.9
+
+
+def test_trainstep_momentum_matches_registered_op():
+    """One TrainStep sgd+momentum update must equal hand-applying the
+    registered sgd_mom_update op to the same (w, g) — proves the compiled
+    path really runs the shared kernel, not a private reimplementation."""
+    from incubator_mxnet_tpu.ops.optimizer_ops import sgd_mom_update
+    from incubator_mxnet_tpu.parallel.train import _make_update_rule
+    lr, mom, wd = 0.05, 0.9, 0.01
+    init, upd = _make_update_rule("sgd", lr, mom, wd, {})
+    w = jnp.asarray(np.random.RandomState(0).randn(4, 3), jnp.float32)
+    g = jnp.asarray(np.random.RandomState(1).randn(4, 3), jnp.float32)
+    st = init(w)
+    # two steps so momentum state actually carries
+    w1, st = upd(w, g, st, 1)
+    w2, _ = upd(w1, g, st, 2)
+    ew1, em = sgd_mom_update.fn(w, g, jnp.zeros_like(w), lr=lr, momentum=mom,
+                                wd=wd)
+    ew2, _ = sgd_mom_update.fn(ew1, g, em, lr=lr, momentum=mom, wd=wd)
+    np.testing.assert_allclose(np.asarray(w2), np.asarray(ew2), rtol=1e-6)
+
+
+def test_trainstep_unknown_hyperparam_raises():
+    from incubator_mxnet_tpu.parallel.train import _make_update_rule
+    with pytest.raises(mx.MXNetError, match="beta_1"):
+        _make_update_rule("adam", 0.01, 0.0, 0.0, {"beta_1": 0.95})
+
+
+def test_trainstep_unknown_optimizer_raises():
+    net = _mlp()
+    xs = np.random.randn(8, 16).astype(np.float32)
+    with pytest.raises(mx.MXNetError):
+        TrainStep(net, lambda o, l: jnp.mean(o), optimizer="lbfgs",
+                  example_inputs=[mx.nd.array(xs)])
+
+
+def _tiny_cfg(**kw):
+    from incubator_mxnet_tpu.models.transformer import TransformerConfig
+    base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_len=64, dtype="float32", remat=False)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _tokens(B, T, vocab, seed=0):
+    rs = np.random.RandomState(seed)
+    toks = rs.randint(0, vocab, (B, T)).astype(np.int32)
+    tgts = np.roll(toks, -1, axis=1).astype(np.int32)
+    return jnp.asarray(toks), jnp.asarray(tgts)
+
+
+@pytest.mark.parametrize("axes", [{"dp": 8}, {"dp": 2, "sp": 4},
+                                  {"dp": 2, "tp": 2, "sp": 2}])
+def test_transformer_train_step_meshes(axes):
+    from incubator_mxnet_tpu.models.transformer import TransformerLM
+    model = TransformerLM(_tiny_cfg())
+    mesh = make_mesh(axes)
+    step, shard_params, init_opt = model.make_train_step(mesh, lr=1e-2)
+    params = shard_params(model.init_params(jax.random.PRNGKey(0)))
+    opt = init_opt(params)
+    toks, tgts = _tokens(8, 16, 64)
+    losses = []
+    for i in range(5):
+        params, opt, loss = step(params, opt, toks, tgts, i)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_transformer_sp_loss_matches_single_device():
+    """The sharded (sp, manual-TP) loss must equal the plain single-device
+    loss on identical params/tokens — collectives change layout, not math."""
+    from incubator_mxnet_tpu.models.transformer import TransformerLM
+    model = TransformerLM(_tiny_cfg())
+    params = model.init_params(jax.random.PRNGKey(1))
+    toks, tgts = _tokens(4, 16, 64)
+    ref = float(model.loss(params, toks, tgts))
+
+    for axes in ({"sp": 8}, {"dp": 2, "tp": 2, "sp": 2}):
+        mesh = make_mesh(axes)
+        step, shard_params, init_opt = model.make_train_step(mesh, lr=0.0)
+        sp = shard_params(params)
+        _, _, loss = step(sp, init_opt(sp), toks, tgts, 0)
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+
+
+def test_tp_specs_actually_shard():
+    """Column/row-parallel weights land sharded over 'tp' on the mesh."""
+    from incubator_mxnet_tpu.models.transformer import TransformerLM
+    model = TransformerLM(_tiny_cfg())
+    mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2})
+    step, shard_params, _ = model.make_train_step(mesh)
+    params = shard_params(model.init_params(jax.random.PRNGKey(0)))
+    wq = params["layer0_wq"]
+    # column parallel: last dim split over tp=2
+    shard_shapes = {s.data.shape for s in wq.addressable_shards}
+    assert shard_shapes == {(32, 16)}, shard_shapes
+    wo = params["layer0_wo"]
+    shard_shapes = {s.data.shape for s in wo.addressable_shards}
+    assert shard_shapes == {(16, 32)}, shard_shapes
+
+
+def test_shard_batch_places_on_mesh():
+    mesh = make_mesh({"dp": 8})
+    x = np.random.randn(16, 4).astype(np.float32)
+    out = shard_batch(jnp.asarray(x), mesh)
+    assert out.sharding.spec == P("dp")
+    np.testing.assert_array_equal(np.asarray(out), x)
