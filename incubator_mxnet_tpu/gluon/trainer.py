@@ -58,13 +58,17 @@ class Trainer:
 
     def _init_kvstore(self):
         """Reference trainer.py:169. A kvstore is created for 'dist*'/'tpu'
-        types; plain single-process training needs none."""
+        types; plain single-process training needs none (XLA reduces sharded
+        grads inside the compiled step)."""
         if self._kvstore_type and str(self._kvstore_type) not in ("None", "local",
                                                                  "device"):
             from .. import kvstore as kvs
             self._kvstore = kvs.create(self._kvstore_type)
             if self._compression_params:
                 self._kvstore.set_gradient_compression(self._compression_params)
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null" and p._data is not None:
+                    self._kvstore.init(i, p.data())
         self._kv_initialized = True
 
     @property

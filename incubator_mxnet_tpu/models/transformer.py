@@ -210,23 +210,19 @@ class TransformerLM:
                 return fn(params, tokens, targets)
             return model.loss(params, tokens, targets)
 
-        from ..ops.optimizer_ops import adam_update as _adam_op
+        from ..parallel.train import _make_update_rule
+        _, adam_rule = _make_update_rule("adam", lr, 0.0, 0.0, {})
 
         def step(params, opt_state, tokens, targets, step_i):
             loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
             new_params, new_opt = {}, {}
-            b1, b2 = 0.9, 0.999
             t = step_i + 1
-            # bias correction folded into lr, as the reference's python
-            # Optimizer does before calling the fused adam_update op
-            alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
             for k, g in grads.items():
-                m, v = opt_state[k]
-                w32, m2, v2 = _adam_op.fn(params[k].astype(jnp.float32),
-                                          g.astype(jnp.float32), m, v,
-                                          lr=alpha, beta1=b1, beta2=b2)
+                # fp32 master weights around the shared adam rule
+                w32, new_opt[k] = adam_rule(params[k].astype(jnp.float32),
+                                            g.astype(jnp.float32),
+                                            opt_state[k], t)
                 new_params[k] = w32.astype(params[k].dtype)
-                new_opt[k] = (m2, v2)
             return new_params, new_opt, loss
 
         in_shardings = (
