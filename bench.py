@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Benchmark harness.
+
+Measures hybridized/compiled ResNet-50 ImageNet-shape throughput on the
+available chip and compares against the reference's published numbers
+(BASELINE.md, from docs/faq/perf.md: train fp32 b32 = 298.51 img/s,
+b128 = 363.69, inference fp32 b32 = 1,076.81 on 1x V100; scripts
+example/image-classification/benchmark_score.py + train_imagenet.py).
+
+stdout: ONE JSON line for the headline metric
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+stderr: the full table (all configs + MFU).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+# fwd-pass GFLOPs per 224x224 image (standard ResNet-50 conv+fc count);
+# training approximated at 3x forward (fwd + 2x bwd)
+RESNET50_FWD_GFLOP = 4.09
+BASELINES = {  # from BASELINE.md (1x V100)
+    ("train", 32, "float32"): 298.51,
+    ("train", 128, "float32"): 363.69,
+    ("inference", 32, "float32"): 1076.81,
+    ("inference", 32, "bfloat16"): 2085.51,   # fp16 row
+}
+# dense peak TFLOP/s per chip for MFU (bf16; fp32 counted at the same MXU
+# peak since TPUs compute fp32 matmuls via bf16 passes)
+PEAK_TFLOPS = {
+    "TPU v4": 275, "TPU v5 lite": 197, "TPU v5e": 197, "TPU v5": 459,
+    "TPU v5p": 459, "TPU v6e": 918, "TPU v6": 918, "TPU v7": 4614,
+}
+
+
+def _sync(x):
+    import jax
+    jax.tree_util.tree_map(
+        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+        else a, x)
+
+
+def _device_peak():
+    import jax
+    kind = jax.devices()[0].device_kind
+    for k, v in sorted(PEAK_TFLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.lower().startswith(k.lower()):
+            return kind, v * 1e12
+    return kind, None
+
+
+def bench_train(batch, dtype, steps, image_size=224):
+    """Fully-compiled train step (forward+backward+SGD update in one XLA
+    program — the steady state of Module.fit, SURVEY §3.3)."""
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.parallel import TrainStep
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+
+    def loss_fn(out, label):
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, label[:, None], axis=1))
+
+    x0 = mx.nd.array(np.random.randn(batch, 3, image_size, image_size)
+                     .astype(np.float32))
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.01,
+                                       "momentum": 0.9},
+                     example_inputs=[x0],
+                     dtype=dtype if dtype != "float32" else None)
+
+    import jax.numpy as jnp
+    # stage the synthetic batch on-device once: we measure compute, not the
+    # host link (the input pipeline overlaps transfers in real training)
+    x = jnp.asarray(np.random.randn(batch, 3, image_size, image_size)
+                    .astype(np.float32))
+    if dtype != "float32":
+        x = x.astype(dtype)
+    y = jnp.asarray(np.random.randint(0, 1000, batch).astype(np.int32))
+    _sync(x), _sync(y)
+    _sync(step(x, y))          # compile + warmup
+    _sync(step(x, y))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step(x, y)
+    _sync(out)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def bench_inference(batch, dtype, steps, image_size=224):
+    """Hybridized forward, jit-compiled once (benchmark_score.py analog)."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.parallel.functional import functionalize
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    if dtype != "float32":
+        net.cast(dtype)
+    x0 = mx.nd.array(np.random.randn(batch, 3, image_size, image_size)
+                     .astype(np.float32)).astype(dtype)
+    params, apply_fn = functionalize(net, [x0], training=False)
+
+    fwd = jax.jit(lambda p, rng, xx: apply_fn(p, rng, xx)[0][0])
+    rng = jax.random.PRNGKey(0)
+    xa = x0._data
+    _sync(fwd(params, rng, xa))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fwd(params, rng, xa)
+    _sync(out)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=None,
+                    help="timed steps (default: 20 on TPU, 3 on CPU)")
+    ap.add_argument("--full", action="store_true",
+                    help="run every config, not just the headline")
+    args = ap.parse_args()
+
+    import jax
+    platform = jax.devices()[0].platform
+    kind, peak = _device_peak()
+    steps = args.steps or (20 if platform == "tpu" else 3)
+    on_tpu = platform == "tpu"
+
+    configs = [("train", 32, "float32")]
+    if args.full or on_tpu:
+        configs += [("train", 32, "bfloat16"),
+                    ("train", 128, "float32"),
+                    ("train", 128, "bfloat16"),
+                    ("inference", 32, "float32"),
+                    ("inference", 32, "bfloat16")]
+
+    results = []
+    for mode, batch, dtype in configs:
+        try:
+            fn = bench_train if mode == "train" else bench_inference
+            ips = fn(batch, dtype, steps)
+        except Exception as e:  # OOM on small chips must not kill the run
+            print(f"[bench] {mode} b{batch} {dtype}: FAILED {e!r}",
+                  file=sys.stderr)
+            continue
+        flops = RESNET50_FWD_GFLOP * 1e9 * (3.0 if mode == "train" else 1.0)
+        mfu = (ips * flops / peak) if peak else None
+        base = BASELINES.get((mode, batch, dtype))
+        results.append({"mode": mode, "batch": batch, "dtype": dtype,
+                        "img_per_sec": round(ips, 2),
+                        "mfu": round(mfu, 4) if mfu is not None else None,
+                        "vs_baseline": round(ips / base, 3) if base else None})
+        print(f"[bench] {mode:9s} b{batch:<4d} {dtype:8s} "
+              f"{ips:9.2f} img/s"
+              + (f"  MFU {mfu*100:5.1f}%" if mfu is not None else "")
+              + (f"  {ips/base:5.2f}x baseline" if base else ""),
+              file=sys.stderr)
+
+    print(f"[bench] device: {kind} ({platform}), timed steps: {steps}",
+          file=sys.stderr)
+    print("[bench] all: " + json.dumps(results), file=sys.stderr)
+
+    head = next((r for r in results
+                 if (r["mode"], r["batch"], r["dtype"]) ==
+                 ("train", 32, "float32")), None)
+    if head is None:
+        print(json.dumps({"metric": "resnet50_train_b32_fp32",
+                          "value": None, "unit": "img/s",
+                          "vs_baseline": None}))
+        return 1
+    print(json.dumps({
+        "metric": "resnet50_train_b32_fp32_img_per_sec",
+        "value": head["img_per_sec"], "unit": "img/s",
+        "vs_baseline": head["vs_baseline"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

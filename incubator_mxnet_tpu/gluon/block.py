@@ -311,7 +311,10 @@ class HybridBlock(Block):
     def _call_cached(self, *args):
         import jax
 
-        training = autograd.is_training() if autograd.is_recording() else False
+        # train-mode flag mirrors the eager ops' train_aware gating exactly:
+        # `with autograd.train_mode():` outside record() must still run
+        # Dropout/BatchNorm in training mode (reference train_mode semantics)
+        training = autograd.is_training()
         arrs = [a._data for a in args]
         key = (tuple((tuple(a.shape), str(a.dtype)) for a in arrs), training)
         entry = self._cached_graph.get(key)
@@ -356,11 +359,14 @@ class HybridBlock(Block):
         (the CachedOp build, reference cached_op.cc ctor + Forward:904)."""
         import jax
 
-        # resolve deferred shapes cheaply via abstract tracing
+        # resolve deferred shapes cheaply via abstract tracing; the state
+        # scope swallows traced stat writes (BatchNorm running stats) that
+        # would otherwise store abstract tracers into Parameters
         for p in self.collect_params().values():
             if p._deferred_init is not None:
                 with _TraceScope(), autograd.pause(train_mode=training), \
-                        _rnd._TraceKeyScope(jax.random.PRNGKey(0)):
+                        _rnd._TraceKeyScope(jax.random.PRNGKey(0)), \
+                        _StateWriteScope():
                     jax.eval_shape(lambda *xs: self._abstract_forward(xs),
                                    *[jax.ShapeDtypeStruct(a.shape, a.dtype)
                                      for a in [x._data for x in args]])

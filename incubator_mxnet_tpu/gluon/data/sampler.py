@@ -1,4 +1,10 @@
-"""Samplers (reference python/mxnet/gluon/data/sampler.py)."""
+"""Index samplers for DataLoader.
+
+Reference surface: python/mxnet/gluon/data/sampler.py (Sequential/Random/
+Batch). Written generator-first: every sampler is an iterable of indices,
+BatchSampler chunks any sampler lazily with keep/discard/rollover tail
+policies.
+"""
 from __future__ import annotations
 
 import numpy as _np
@@ -7,6 +13,8 @@ __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
 
 
 class Sampler:
+    """Iterable over dataset indices."""
+
     def __len__(self):
         raise NotImplementedError
 
@@ -15,61 +23,69 @@ class Sampler:
 
 
 class SequentialSampler(Sampler):
+    """start, start+1, ..., start+length-1."""
+
     def __init__(self, length, start=0):
-        self._length = length
-        self._start = start
+        self._range = range(start, start + length)
 
     def __iter__(self):
-        return iter(range(self._start, self._start + self._length))
+        yield from self._range
 
     def __len__(self):
-        return self._length
+        return len(self._range)
 
 
 class RandomSampler(Sampler):
+    """A fresh uniform permutation per epoch."""
+
     def __init__(self, length):
         self._length = length
 
     def __iter__(self):
-        indices = _np.arange(self._length)
-        _np.random.shuffle(indices)
-        return iter(indices.tolist())
+        for i in _np.random.permutation(self._length):
+            yield int(i)
 
     def __len__(self):
         return self._length
 
 
 class BatchSampler(Sampler):
-    """Group a sampler into batches; last_batch in {keep, discard, rollover}
-    (reference sampler.py BatchSampler)."""
+    """Chunk `sampler` into lists of batch_size indices.
+
+    last_batch: 'keep' yields the short tail, 'discard' drops it,
+    'rollover' prepends it to the next epoch.
+    """
+
+    _POLICIES = ("keep", "discard", "rollover")
 
     def __init__(self, sampler, batch_size, last_batch="keep"):
+        if last_batch not in self._POLICIES:
+            raise ValueError(f"last_batch must be one of {self._POLICIES}, "
+                             f"got {last_batch!r}")
         self._sampler = sampler
         self._batch_size = batch_size
         self._last_batch = last_batch
-        self._prev = []
+        self._carry = []
 
     def __iter__(self):
-        batch, self._prev = self._prev, []
-        for i in self._sampler:
-            batch.append(i)
+        batch = self._carry
+        self._carry = []
+        for idx in self._sampler:
+            batch.append(idx)
             if len(batch) == self._batch_size:
                 yield batch
                 batch = []
-        if batch:
-            if self._last_batch == "keep":
-                yield batch
-            elif self._last_batch == "discard":
-                pass
-            elif self._last_batch == "rollover":
-                self._prev = batch
-            else:
-                raise ValueError(f"bad last_batch {self._last_batch}")
+        if not batch:
+            return
+        if self._last_batch == "keep":
+            yield batch
+        elif self._last_batch == "rollover":
+            self._carry = batch
 
     def __len__(self):
         n = len(self._sampler)
         if self._last_batch == "keep":
-            return (n + self._batch_size - 1) // self._batch_size
+            return -(-n // self._batch_size)
         if self._last_batch == "discard":
             return n // self._batch_size
-        return (n + len(self._prev)) // self._batch_size
+        return (n + len(self._carry)) // self._batch_size

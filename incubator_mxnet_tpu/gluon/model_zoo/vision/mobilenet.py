@@ -1,8 +1,13 @@
-"""MobileNet v1/v2 (reference python/mxnet/gluon/model_zoo/vision/mobilenet.py).
+"""MobileNet v1 / v2, paper-table driven.
 
-Depthwise convs = Convolution with num_group=channels; XLA:TPU lowers grouped
-convs natively (no hand-written depthwise kernels like the reference's
-depthwise_convolution_tf.cuh).
+Same architectures as the reference (python/mxnet/gluon/model_zoo/vision/
+mobilenet.py) but generated from the published stage tables: v1 from a
+(out_channels, stride) list of depthwise-separable pairs, v2 from the
+(expansion t, out c, repeats n, stride s) table of the MobileNetV2 paper.
+
+Depthwise convs are grouped Conv2D (groups == channels); XLA lowers grouped
+convolutions natively, so no hand-written depthwise kernels are needed
+(the reference carries depthwise_convolution_tf.cuh for CUDA).
 """
 from __future__ import annotations
 
@@ -14,58 +19,68 @@ __all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
            "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25",
            "get_mobilenet", "get_mobilenet_v2"]
 
+# v1: (out_channels, stride) per depthwise-separable pair
+_V1_TABLE = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+             (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+             (1024, 1)]
 
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
-              active=True, relu6=False):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
-                      use_bias=False))
-    out.add(nn.BatchNorm(scale=True))
-    if active:
-        out.add(RELU6() if relu6 else nn.Activation("relu"))
-
-
-class RELU6(HybridBlock):
-    def hybrid_forward(self, F, x):
-        return F.clip(x, a_min=0.0, a_max=6.0)
+# v2: (expansion t, out channels c, repeats n, first stride s) — paper tab.2
+_V2_TABLE = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+             (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
 
 
-def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
-    _add_conv(out, dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels, relu6=relu6)
-    _add_conv(out, channels, relu6=relu6)
+class _ConvBN(HybridBlock):
+    """conv -> BN -> optional (relu | relu6)."""
 
-
-class LinearBottleneck(HybridBlock):
-    """MobileNetV2 inverted residual (reference mobilenet.py LinearBottleneck)."""
-
-    def __init__(self, in_channels, channels, t, stride, **kwargs):
+    def __init__(self, channels, kernel=1, stride=1, groups=1, act="relu",
+                 **kwargs):
         super().__init__(**kwargs)
-        self.use_shortcut = stride == 1 and in_channels == channels
-        self.out = nn.HybridSequential()
-        _add_conv(self.out, in_channels * t, relu6=True)
-        _add_conv(self.out, in_channels * t, kernel=3, stride=stride, pad=1,
-                  num_group=in_channels * t, relu6=True)
-        _add_conv(self.out, channels, active=False, relu6=True)
+        self.conv = nn.Conv2D(channels, kernel, strides=stride,
+                              padding=kernel // 2, groups=groups,
+                              use_bias=False)
+        self.bn = nn.BatchNorm()
+        self._act = act
 
     def hybrid_forward(self, F, x):
-        out = self.out(x)
-        if self.use_shortcut:
-            out = out + x
-        return out
+        y = self.bn(self.conv(x))
+        if self._act == "relu":
+            y = F.relu(y)
+        elif self._act == "relu6":
+            y = F.clip(y, a_min=0.0, a_max=6.0)
+        return y
+
+
+class _InvertedResidual(HybridBlock):
+    """MobileNetV2 block: 1x1 expand (t*) -> 3x3 depthwise -> 1x1 linear
+    project, identity shortcut when shapes allow."""
+
+    def __init__(self, in_ch, out_ch, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self._identity = (stride == 1 and in_ch == out_ch)
+        mid = in_ch * t
+        self.layers = nn.HybridSequential(prefix="")
+        if t != 1:
+            self.layers.add(_ConvBN(mid, 1, act="relu6"))
+        self.layers.add(_ConvBN(mid, 3, stride, groups=mid, act="relu6"))
+        self.layers.add(_ConvBN(out_ch, 1, act=None))
+
+    def hybrid_forward(self, F, x):
+        y = self.layers(x)
+        return x + y if self._identity else y
 
 
 class MobileNet(HybridBlock):
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda c: max(1, int(c * multiplier))
         self.features = nn.HybridSequential(prefix="")
-        _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2, pad=1)
-        dw_channels = [int(x * multiplier) for x in
-                       [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
-        channels = [int(x * multiplier) for x in
-                    [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
-        strides = [1, 2, 1, 2, 1, 2] + [1] * 5 + [2, 1]
-        for dwc, c, s in zip(dw_channels, channels, strides):
-            _add_conv_dw(self.features, dwc, c, s)
+        self.features.add(_ConvBN(scale(32), 3, 2))
+        prev = scale(32)
+        for out, stride in _V1_TABLE:
+            # depthwise 3x3 over prev channels, then 1x1 pointwise to out
+            self.features.add(_ConvBN(prev, 3, stride, groups=prev))
+            self.features.add(_ConvBN(scale(out), 1))
+            prev = scale(out)
         self.features.add(nn.GlobalAvgPool2D())
         self.features.add(nn.Flatten())
         self.output = nn.Dense(classes)
@@ -77,26 +92,22 @@ class MobileNet(HybridBlock):
 class MobileNetV2(HybridBlock):
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda c: max(1, int(c * multiplier))
         self.features = nn.HybridSequential(prefix="features_")
-        _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2,
-                  pad=1, relu6=True)
-        in_channels_group = [int(x * multiplier) for x in
-                             [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4 +
-                             [96] * 3 + [160] * 3]
-        channels_group = [int(x * multiplier) for x in
-                          [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3 +
-                          [160] * 3 + [320]]
-        ts = [1] + [6] * 16
-        strides = [1, 2] + [1, 2] + [1] * 2 + [2] + [1] * 3 + [1] * 3 + [2] + \
-            [1] * 2 + [1]
-        for in_c, c, t, s in zip(in_channels_group, channels_group, ts, strides):
-            self.features.add(LinearBottleneck(in_c, c, t, s, prefix=""))
-        last_channels = int(1280 * multiplier) if multiplier > 1.0 else 1280
-        _add_conv(self.features, last_channels, relu6=True)
+        prev = scale(32)
+        self.features.add(_ConvBN(prev, 3, 2, act="relu6"))
+        for t, c, n, s in _V2_TABLE:
+            for i in range(n):
+                out = scale(c)
+                self.features.add(_InvertedResidual(prev, out, t,
+                                                    s if i == 0 else 1))
+                prev = out
+        head = 1280 if multiplier <= 1.0 else scale(1280)
+        self.features.add(_ConvBN(head, 1, act="relu6"))
         self.features.add(nn.GlobalAvgPool2D())
         self.output = nn.HybridSequential(prefix="output_")
-        self.output.add(nn.Conv2D(classes, 1, use_bias=False, prefix="pred_"),
-                        nn.Flatten())
+        self.output.add(nn.Conv2D(classes, 1, use_bias=False))
+        self.output.add(nn.Flatten())
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
@@ -106,37 +117,23 @@ def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     return MobileNet(multiplier, **kwargs)
 
 
-def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
+                     **kwargs):
     return MobileNetV2(multiplier, **kwargs)
 
 
-def mobilenet1_0(**kwargs):
-    return get_mobilenet(1.0, **kwargs)
+def _ctor(factory, mult, name):
+    def f(**kwargs):
+        return factory(mult, **kwargs)
+    f.__name__ = name
+    return f
 
 
-def mobilenet0_75(**kwargs):
-    return get_mobilenet(0.75, **kwargs)
-
-
-def mobilenet0_5(**kwargs):
-    return get_mobilenet(0.5, **kwargs)
-
-
-def mobilenet0_25(**kwargs):
-    return get_mobilenet(0.25, **kwargs)
-
-
-def mobilenet_v2_1_0(**kwargs):
-    return get_mobilenet_v2(1.0, **kwargs)
-
-
-def mobilenet_v2_0_75(**kwargs):
-    return get_mobilenet_v2(0.75, **kwargs)
-
-
-def mobilenet_v2_0_5(**kwargs):
-    return get_mobilenet_v2(0.5, **kwargs)
-
-
-def mobilenet_v2_0_25(**kwargs):
-    return get_mobilenet_v2(0.25, **kwargs)
+mobilenet1_0 = _ctor(get_mobilenet, 1.0, "mobilenet1_0")
+mobilenet0_75 = _ctor(get_mobilenet, 0.75, "mobilenet0_75")
+mobilenet0_5 = _ctor(get_mobilenet, 0.5, "mobilenet0_5")
+mobilenet0_25 = _ctor(get_mobilenet, 0.25, "mobilenet0_25")
+mobilenet_v2_1_0 = _ctor(get_mobilenet_v2, 1.0, "mobilenet_v2_1_0")
+mobilenet_v2_0_75 = _ctor(get_mobilenet_v2, 0.75, "mobilenet_v2_0_75")
+mobilenet_v2_0_5 = _ctor(get_mobilenet_v2, 0.5, "mobilenet_v2_0_5")
+mobilenet_v2_0_25 = _ctor(get_mobilenet_v2, 0.25, "mobilenet_v2_0_25")

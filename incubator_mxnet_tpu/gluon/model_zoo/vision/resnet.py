@@ -1,9 +1,14 @@
-"""ResNet V1/V2 (reference python/mxnet/gluon/model_zoo/vision/resnet.py:
-resnet18-152 v1/v2, BasicBlockV1/V2, BottleneckV1/V2).
+"""ResNet, spec-driven.
 
-TPU notes: NCHW layout is kept for API parity (XLA:TPU transposes to its
-preferred layout internally); all convs lower to MXU-tiled
-conv_general_dilated; train in bfloat16 via net.cast("bfloat16").
+Capability parity with the reference's resnet18-152 v1/v2 families
+(python/mxnet/gluon/model_zoo/vision/resnet.py), built differently: one
+residual-unit block covers basic/bottleneck x post-act(v1)/pre-act(v2), and
+the whole family is generated from a depth->(unit kind, stage repeats)
+table instead of a class per variant.
+
+TPU-first choices: `net.cast("bfloat16")` runs every conv/matmul on the MXU
+in bf16 (BatchNorm statistics stay fp32 inside the op); NCHW is accepted at
+the API and XLA:TPU re-lays out internally, so no NHWC shim is needed.
 """
 from __future__ import annotations
 
@@ -17,265 +22,179 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
            "get_resnet"]
 
-
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
-
-
-class BasicBlockV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(residual + x, act_type="relu")
+# depth -> (unit kind, per-stage unit counts); stage base widths are fixed
+_SPECS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+}
+_WIDTHS = (64, 128, 256, 512)
 
 
-class BottleneckV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
+class _ResUnit(HybridBlock):
+    """One residual unit.
 
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
+    kind='basic': two 3x3 convs. kind='bottleneck': 1x1 reduce, 3x3, 1x1
+    expand (4x). preact=False is the v1 arrangement (conv-bn-relu chain,
+    add, final relu); preact=True is v2 (bn-relu before each conv, identity
+    add, projection taken from the pre-activated input).
+    """
 
-
-class BasicBlockV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
-
-
-class BottleneckV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1, use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
-
-
-class ResNetV1(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+    def __init__(self, width, stride, kind, preact, project, in_channels=0,
                  **kwargs):
         super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        self.features = nn.HybridSequential(prefix="")
-        if thumbnail:
-            self.features.add(_conv3x3(channels[0], 1, 0))
+        self._preact = preact
+        out = width if kind == "basic" else width * 4
+        if kind == "basic":
+            plan = [(width, 3, stride), (out, 3, 1)]
         else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+            plan = [(width, 1, stride), (width, 3, 1), (out, 1, 1)]
+
+        self.convs = nn.HybridSequential(prefix="")
+        self.norms = nn.HybridSequential(prefix="")
+        for ch, ksz, st in plan:
+            self.convs.add(nn.Conv2D(ch, ksz, strides=st, padding=ksz // 2,
+                                     use_bias=False))
+            self.norms.add(nn.BatchNorm())
+        self.shortcut = (nn.Conv2D(out, 1, strides=stride, use_bias=False,
+                                   in_channels=in_channels)
+                         if project else None)
+        self.shortcut_norm = (nn.BatchNorm()
+                              if project and not preact else None)
+
+    def _forward_v1(self, F, x):
+        y = x
+        convs = list(self.convs._children.values())
+        norms = list(self.norms._children.values())
+        for i, (conv, norm) in enumerate(zip(convs, norms)):
+            y = norm(conv(y))
+            if i < len(convs) - 1:
+                y = F.relu(y)
+        s = x
+        if self.shortcut is not None:
+            s = self.shortcut_norm(self.shortcut(s))
+        return F.relu(y + s)
+
+    def _forward_v2(self, F, x):
+        convs = list(self.convs._children.values())
+        norms = list(self.norms._children.values())
+        y = F.relu(norms[0](x))
+        s = self.shortcut(y) if self.shortcut is not None else x
+        y = convs[0](y)
+        for conv, norm in zip(convs[1:], norms[1:]):
+            y = conv(F.relu(norm(y)))
+        return y + s
+
+    def hybrid_forward(self, F, x):
+        return self._forward_v2(F, x) if self._preact else self._forward_v1(F, x)
+
+
+class _ResNet(HybridBlock):
+    """Shared trunk builder for both versions."""
+
+    def __init__(self, num_layers, preact, classes=1000, thumbnail=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if num_layers not in _SPECS:
+            raise MXNetError(f"no resnet spec for depth {num_layers}; "
+                             f"choose from {sorted(_SPECS)}")
+        kind, repeats = _SPECS[num_layers]
+        expansion = 1 if kind == "basic" else 4
+
+        self.features = nn.HybridSequential(prefix="")
+        if preact:
+            self.features.add(nn.BatchNorm(scale=False, center=False))
+        if thumbnail:
+            # CIFAR-style 3x3 stem
+            self.features.add(nn.Conv2D(64, 3, strides=1, padding=1,
+                                        use_bias=False))
+        else:
+            self.features.add(nn.Conv2D(64, 7, strides=2, padding=3,
+                                        use_bias=False))
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.MaxPool2D(3, 2, 1))
-        for i, num_layer in enumerate(layers):
-            stride = 1 if i == 0 else 2
-            self.features.add(self._make_layer(block, num_layer, channels[i + 1],
-                                               stride, i + 1,
-                                               in_channels=channels[i]))
-        self.features.add(nn.GlobalAvgPool2D())
-        self.output = nn.Dense(classes, in_units=channels[-1])
 
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix=f"stage{stage_index}_")
-        layer.add(block(channels, stride, channels != in_channels,
-                        in_channels=in_channels, prefix=""))
-        for _ in range(layers - 1):
-            layer.add(block(channels, 1, False, in_channels=channels, prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(F.flatten(x))
-
-
-class ResNetV2(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        self.features = nn.HybridSequential(prefix="")
-        self.features.add(nn.BatchNorm(scale=False, center=False))
-        if thumbnail:
-            self.features.add(_conv3x3(channels[0], 1, 0))
-        else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+        in_ch = 64
+        for stage, (width, count) in enumerate(zip(_WIDTHS, repeats)):
+            out_ch = width * expansion
+            for unit in range(count):
+                stride = 2 if (unit == 0 and stage > 0) else 1
+                self.features.add(_ResUnit(
+                    width, stride, kind, preact,
+                    project=(unit == 0 and (in_ch != out_ch or stride != 1)),
+                    in_channels=in_ch))
+                in_ch = out_ch
+        if preact:
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(3, 2, 1))
-        in_channels = channels[0]
-        for i, num_layer in enumerate(layers):
-            stride = 1 if i == 0 else 2
-            self.features.add(self._make_layer(block, num_layer, channels[i + 1],
-                                               stride, i + 1,
-                                               in_channels=in_channels))
-            in_channels = channels[i + 1]
-        self.features.add(nn.BatchNorm())
-        self.features.add(nn.Activation("relu"))
         self.features.add(nn.GlobalAvgPool2D())
         self.features.add(nn.Flatten())
-        self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix=f"stage{stage_index}_")
-        layer.add(block(channels, stride, channels != in_channels,
-                        in_channels=in_channels, prefix=""))
-        for _ in range(layers - 1):
-            layer.add(block(channels, 1, False, in_channels=channels, prefix=""))
-        return layer
+        self.output = nn.Dense(classes, in_units=in_ch)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
-resnet_spec = {
-    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
-}
-resnet_net_versions = [ResNetV1, ResNetV2]
-resnet_block_versions = [
-    {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
-    {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2},
-]
+class ResNetV1(_ResNet):
+    def __init__(self, num_layers=50, **kwargs):
+        super().__init__(num_layers, preact=False, **kwargs)
+
+
+class ResNetV2(_ResNet):
+    def __init__(self, num_layers=50, **kwargs):
+        super().__init__(num_layers, preact=True, **kwargs)
+
+
+# unit-level classes kept for API parity with the reference's exports;
+# `channels` is the unit's OUTPUT channel count, as in the reference
+class BasicBlockV1(_ResUnit):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+        super().__init__(channels, stride, "basic", False, downsample,
+                         in_channels, **kw)
+
+
+class BasicBlockV2(_ResUnit):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+        super().__init__(channels, stride, "basic", True, downsample,
+                         in_channels, **kw)
+
+
+class BottleneckV1(_ResUnit):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+        super().__init__(channels // 4, stride, "bottleneck", False,
+                         downsample, in_channels, **kw)
+
+
+class BottleneckV2(_ResUnit):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kw):
+        super().__init__(channels // 4, stride, "bottleneck", True,
+                         downsample, in_channels, **kw)
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
                **kwargs):
-    """Reference model_zoo/vision/resnet.py get_resnet."""
-    if num_layers not in resnet_spec:
-        raise MXNetError(f"invalid resnet depth {num_layers}")
-    block_type, layers, channels = resnet_spec[num_layers]
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    """Reference model_zoo get_resnet signature; pretrained weights are not
+    shipped (zero-egress build) — load_parameters() from a local file."""
+    if version not in (1, 2):
+        raise MXNetError(f"resnet version must be 1 or 2, got {version}")
+    net = (ResNetV1 if version == 1 else ResNetV2)(num_layers, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable (zero-egress); "
-                         "load_parameters() from a local file instead")
+        raise MXNetError("pretrained weights are not available in this build")
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _make_ctor(version, depth):
+    def ctor(**kwargs):
+        return get_resnet(version, depth, **kwargs)
+    ctor.__name__ = f"resnet{depth}_v{version}"
+    ctor.__doc__ = f"ResNet-{depth} v{version} (reference resnet.py)."
+    return ctor
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+resnet18_v1, resnet34_v1, resnet50_v1, resnet101_v1, resnet152_v1 = \
+    (_make_ctor(1, d) for d in (18, 34, 50, 101, 152))
+resnet18_v2, resnet34_v2, resnet50_v2, resnet101_v2, resnet152_v2 = \
+    (_make_ctor(2, d) for d in (18, 34, 50, 101, 152))

@@ -1,4 +1,9 @@
-"""SqueezeNet 1.0/1.1 (reference python/mxnet/gluon/model_zoo/vision/squeezenet.py)."""
+"""SqueezeNet 1.0/1.1, stage-spec driven.
+
+Same fire-module architectures as the reference (python/mxnet/gluon/
+model_zoo/vision/squeezenet.py), but the two versions are data: a layout
+list of fire widths and pool markers, consumed by one builder.
+"""
 from __future__ import annotations
 
 from ....base import MXNetError
@@ -8,69 +13,48 @@ from ... import nn
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1", "get_squeezenet"]
 
 
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
-    out = nn.HybridSequential(prefix="")
-    out.add(_make_fire_conv(squeeze_channels, 1))
-    paths = _FireExpand(expand1x1_channels, expand3x3_channels)
-    out.add(paths)
-    return out
+class _Fire(HybridBlock):
+    """squeeze 1x1 -> relu -> parallel expand 1x1 / expand 3x3 -> concat."""
 
-
-def _make_fire_conv(channels, kernel_size, padding=0):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(channels, kernel_size, padding=padding))
-    out.add(nn.Activation("relu"))
-    return out
-
-
-class _FireExpand(HybridBlock):
-    def __init__(self, e1, e3, **kwargs):
+    def __init__(self, squeeze, expand, **kwargs):
         super().__init__(**kwargs)
-        self.p1 = _make_fire_conv(e1, 1)
-        self.p3 = _make_fire_conv(e3, 3, 1)
+        self.squeeze = nn.Conv2D(squeeze, 1, activation="relu")
+        self.left = nn.Conv2D(expand, 1, activation="relu")
+        self.right = nn.Conv2D(expand, 3, padding=1, activation="relu")
 
     def hybrid_forward(self, F, x):
-        return F.concat(self.p1(x), self.p3(x), dim=1)
+        y = self.squeeze(x)
+        return F.concat(self.left(y), self.right(y), dim=1)
+
+
+# layout entries: "P" = 3x3/2 ceil max-pool, int n = fire(squeeze=n,
+# expand=4n per branch — the published ratio), tuple = stem conv
+_LAYOUTS = {
+    "1.0": [(96, 7, 2), "P", 16, 16, 32, "P", 32, 48, 48, 64, "P", 64],
+    "1.1": [(64, 3, 2), "P", 16, 16, "P", 32, 32, "P", 48, 48, 64, 64],
+}
 
 
 class SqueezeNet(HybridBlock):
     def __init__(self, version, classes=1000, **kwargs):
         super().__init__(**kwargs)
-        if version not in ("1.0", "1.1"):
-            raise MXNetError("version must be 1.0 or 1.1")
+        if version not in _LAYOUTS:
+            raise MXNetError(f"squeezenet version {version!r} not in "
+                             f"{sorted(_LAYOUTS)}")
         self.features = nn.HybridSequential(prefix="")
-        if version == "1.0":
-            self.features.add(nn.Conv2D(96, kernel_size=7, strides=2))
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-            self.features.add(_make_fire(16, 64, 64))
-            self.features.add(_make_fire(16, 64, 64))
-            self.features.add(_make_fire(32, 128, 128))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-            self.features.add(_make_fire(32, 128, 128))
-            self.features.add(_make_fire(48, 192, 192))
-            self.features.add(_make_fire(48, 192, 192))
-            self.features.add(_make_fire(64, 256, 256))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-            self.features.add(_make_fire(64, 256, 256))
-        else:
-            self.features.add(nn.Conv2D(64, kernel_size=3, strides=2))
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-            self.features.add(_make_fire(16, 64, 64))
-            self.features.add(_make_fire(16, 64, 64))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-            self.features.add(_make_fire(32, 128, 128))
-            self.features.add(_make_fire(32, 128, 128))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
-            self.features.add(_make_fire(48, 192, 192))
-            self.features.add(_make_fire(48, 192, 192))
-            self.features.add(_make_fire(64, 256, 256))
-            self.features.add(_make_fire(64, 256, 256))
+        for entry in _LAYOUTS[version]:
+            if entry == "P":
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            elif isinstance(entry, tuple):
+                ch, k, s = entry
+                self.features.add(nn.Conv2D(ch, k, strides=s,
+                                            activation="relu"))
+            else:
+                self.features.add(_Fire(entry, entry * 4))
         self.features.add(nn.Dropout(0.5))
+        # fully-convolutional classifier head
         self.output = nn.HybridSequential(prefix="")
-        self.output.add(nn.Conv2D(classes, kernel_size=1))
-        self.output.add(nn.Activation("relu"))
+        self.output.add(nn.Conv2D(classes, 1, activation="relu"))
         self.output.add(nn.GlobalAvgPool2D())
         self.output.add(nn.Flatten())
 

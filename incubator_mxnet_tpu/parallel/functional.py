@@ -31,8 +31,10 @@ def functionalize(net, example_inputs, training=True):
                  for x in example_inputs]
     # resolve deferred shapes with one abstract pass
     import jax
+    # the state scope swallows traced stat writes (BatchNorm running stats)
+    # so abstract tracers never land in Parameters
     with _TraceScope(), autograd.pause(train_mode=training), \
-            _rnd._TraceKeyScope(jax.random.PRNGKey(0)):
+            _rnd._TraceKeyScope(jax.random.PRNGKey(0)), _StateWriteScope():
         jax.eval_shape(
             lambda *xs: _abstract(net, xs),
             *[jax.ShapeDtypeStruct(x._data.shape, x._data.dtype)

@@ -96,31 +96,69 @@ class MXRecordIO:
         self.close()
         self.open()
 
-    def write(self, buf):
-        assert self.writable
-        data = bytes(buf)
-        lrec = len(data)
+    # cflag values in the lrecord high bits (dmlc-core recordio multipart
+    # encoding): 0=complete, 1=begin, 2=middle, 3=end
+    _LEN_MASK = (1 << 29) - 1
+    _CHUNK = (1 << 29) - 4     # max payload per physical record
+
+    def _write_one(self, cflag, data):
+        lrec = (cflag << 29) | len(data)
         self.record.write(struct.pack("<II", _MAGIC, lrec))
         self.record.write(data)
-        pad = (4 - (lrec % 4)) % 4
+        pad = (4 - (len(data) % 4)) % 4
         if pad:
             self.record.write(b"\x00" * pad)
 
-    def read(self):
-        assert not self.writable
-        self._check_pid()
+    def write(self, buf):
+        assert self.writable
+        data = bytes(buf)
+        if len(data) <= self._LEN_MASK:
+            self._write_one(0, data)
+            return
+        # oversized: split into begin/middle.../end physical records
+        chunks = [data[i:i + self._CHUNK]
+                  for i in range(0, len(data), self._CHUNK)]
+        for i, c in enumerate(chunks):
+            cflag = 1 if i == 0 else (3 if i == len(chunks) - 1 else 2)
+            self._write_one(cflag, c)
+
+    def _read_one(self):
         header = self.record.read(8)
         if len(header) < 8:
-            return None
+            return None, None
         magic, lrec = struct.unpack("<II", header)
         if magic != _MAGIC:
             raise MXNetError("invalid record magic; corrupt file?")
-        length = lrec & ((1 << 29) - 1)
+        cflag = lrec >> 29
+        length = lrec & self._LEN_MASK
         data = self.record.read(length)
         pad = (4 - (length % 4)) % 4
         if pad:
             self.record.read(pad)
-        return data
+        return cflag, data
+
+    def read(self):
+        assert not self.writable
+        self._check_pid()
+        cflag, data = self._read_one()
+        if data is None:
+            return None
+        if cflag == 0:
+            return data
+        if cflag != 1:
+            raise MXNetError(f"multipart record starts with cflag {cflag}; "
+                             "corrupt or mid-stream seek")
+        parts = [data]
+        while True:
+            cflag, data = self._read_one()
+            if data is None:
+                raise MXNetError("truncated multipart record")
+            parts.append(data)
+            if cflag == 3:
+                return b"".join(parts)
+            if cflag != 2:
+                raise MXNetError(f"unexpected cflag {cflag} inside "
+                                 "multipart record")
 
     def tell(self):
         return self.record.tell()

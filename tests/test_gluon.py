@@ -277,3 +277,21 @@ def test_mnist_lenet_end_to_end():
             metric.update([y], [out])
     name, acc = metric.get()
     assert acc > 0.8, f"LeNet failed to learn: acc={acc}"
+
+
+def test_train_mode_outside_record_hybridized():
+    """`with autograd.train_mode():` outside record() must run Dropout in
+    training mode on the cached path, matching eager train_aware ops
+    (reference train_mode semantics; round-1 divergence fix)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dropout(0.5))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((200,))
+    with autograd.train_mode():
+        out = net(x).asnumpy()
+    # dropout active: some elements zeroed, survivors scaled by 2
+    assert (out == 0).sum() > 20
+    assert np.allclose(out[out != 0], 2.0)
+    # and inference mode is still identity
+    assert np.allclose(net(x).asnumpy(), 1.0)

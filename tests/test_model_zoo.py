@@ -1,0 +1,106 @@
+"""Model zoo tests: forward shapes for every family (eager + hybridized),
+plus one short convergence run — the reference validates its zoo with
+pretrained-forward parity (tests/python/gpu/test_gluon_model_zoo_gpu.py);
+without shipped weights, shape + trainability are the oracles here.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, autograd
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+
+def _forward(net, hw=32, batch=2):
+    net.initialize()
+    x = mx.nd.array(np.random.randn(batch, 3, hw, hw).astype(np.float32))
+    return net(x)
+
+
+SMALL_MODELS = [
+    ("resnet18_v1", 32), ("resnet34_v1", 32), ("resnet18_v2", 32),
+    ("mobilenet0.25", 32), ("mobilenetv2_0.25", 32),
+    ("squeezenet1.0", 64), ("squeezenet1.1", 64),
+    ("densenet121", 32),
+    ("alexnet", 224),
+    ("vgg11", 32),
+]
+
+
+@pytest.mark.parametrize("name,hw", SMALL_MODELS)
+def test_forward_shape(name, hw):
+    net = vision.get_model(name, classes=10)
+    out = _forward(net, hw)
+    assert out.shape == (2, 10)
+    assert np.all(np.isfinite(out.asnumpy()))
+
+
+@pytest.mark.parametrize("name", ["resnet18_v1", "mobilenetv2_0.25",
+                                  "squeezenet1.1"])
+def test_hybridize_matches_eager(name):
+    hw = 64 if "squeeze" in name else 32
+    net = vision.get_model(name, classes=10)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, hw, hw).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_bottleneck_param_counts():
+    """Canonical parameter counts pin the architecture (ImageNet head)."""
+    counts = {}
+    for name in ("resnet18_v1", "resnet50_v1"):
+        net = vision.get_model(name, classes=1000)
+        net.initialize()
+        _forward(net, 32, 1)
+        counts[name] = sum(int(np.prod(p.shape))
+                           for p in net.collect_params().values())
+    # canonical no-bias-conv variants (+BN on every projection shortcut)
+    assert counts["resnet18_v1"] == 11_699_112, counts
+    assert counts["resnet50_v1"] == 25_610_152, counts
+
+
+def test_resnet_v2_thumbnail_and_bad_depth():
+    net = vision.get_resnet(2, 18, thumbnail=True, classes=10)
+    out = _forward(net)
+    assert out.shape == (2, 10)
+    with pytest.raises(mx.MXNetError):
+        vision.get_resnet(1, 77)
+    with pytest.raises(mx.MXNetError):
+        vision.get_resnet(3, 18)
+
+
+def test_get_model_registry():
+    assert "resnet50_v1" in vision._models
+    with pytest.raises(mx.MXNetError):
+        vision.get_model("resnet9000")
+
+
+def test_short_convergence_resnet18():
+    """A hybridized resnet18 on 4-class toy images: loss must halve."""
+    rs = np.random.RandomState(0)
+    xs = np.zeros((32, 3, 32, 32), np.float32)
+    ys = np.repeat(np.arange(4), 8).astype(np.int32)
+    for i, y in enumerate(ys):   # class-dependent quadrant brightness
+        xs[i, :, (y // 2) * 16:(y // 2) * 16 + 16,
+           (y % 2) * 16:(y % 2) * 16 + 16] = 1.0
+    xs += 0.05 * rs.randn(*xs.shape).astype(np.float32)
+
+    net = vision.resnet18_v1(classes=4)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x, y = mx.nd.array(xs), mx.nd.array(ys)
+    first = None
+    for _ in range(10):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(32)
+        if first is None:
+            first = float(loss.mean().asnumpy())
+    assert float(loss.mean().asnumpy()) < first * 0.5
