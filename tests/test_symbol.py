@@ -1,0 +1,232 @@
+"""Symbol + Executor tests (reference: tests/python/unittest/test_symbol.py,
+test_executor.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu import symbol as sym
+from incubator_mxnet_tpu.base import MXNetError
+
+REF_JSON = "/root/reference/tests/python/unittest/save_000800.json"
+
+
+def _mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_and_listing():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+    assert out.list_auxiliary_states() == []
+    assert out.name == "softmax"
+
+
+def test_infer_shape_params():
+    out = _mlp()
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(8, 10),
+                                                softmax_label=(8,))
+    shapes = dict(zip(out.list_arguments(), arg_shapes))
+    assert shapes["fc1_weight"] == (16, 10)
+    assert shapes["fc1_bias"] == (16,)
+    assert shapes["fc2_weight"] == (4, 16)
+    assert out_shapes == [(8, 4)]
+
+
+def test_infer_shape_conv_bn():
+    d = sym.var("data")
+    c = sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="conv0")
+    b = sym.BatchNorm(c, name="bn0")
+    arg_shapes, out_shapes, aux_shapes = b.infer_shape(data=(2, 3, 8, 8))
+    shapes = dict(zip(b.list_arguments(), arg_shapes))
+    assert shapes["conv0_weight"] == (8, 3, 3, 3)
+    assert shapes["bn0_gamma"] == (8,)
+    assert aux_shapes == [(8,), (8,)]
+    assert out_shapes[0] == (2, 8, 8, 8)
+    assert b.list_auxiliary_states() == ["bn0_moving_mean", "bn0_moving_var"]
+
+
+def test_infer_shape_partial():
+    out = _mlp()
+    arg_shapes, out_shapes, _ = out.infer_shape_partial()
+    assert out_shapes[0] is None
+
+
+def test_infer_type():
+    out = _mlp()
+    arg_types, out_types, _ = out.infer_type(data="float32")
+    assert all(t == np.float32 for t in arg_types)
+    assert out_types == [np.float32]
+
+
+def test_arithmetic_matches_ndarray():
+    a = sym.var("a")
+    b = sym.var("b")
+    expr = (a + b) * 2.0 - b / (a + 1.5) + (2.0 - a) ** 2
+    av = np.random.rand(3, 4).astype(np.float32) + 0.5
+    bv = np.random.rand(3, 4).astype(np.float32)
+    got = expr.eval_dict({"a": nd.array(av), "b": nd.array(bv)}).asnumpy()
+    want = (av + bv) * 2 - bv / (av + 1.5) + (2.0 - av) ** 2
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_symbol_methods():
+    a = sym.var("a")
+    out = a.reshape(shape=(2, 6)).sum(axis=1)
+    av = np.arange(12).astype(np.float32).reshape(3, 4)
+    got = out.eval_dict({"a": nd.array(av)}).asnumpy()
+    np.testing.assert_allclose(got, av.reshape(2, 6).sum(1))
+
+
+def test_group_and_getitem():
+    a = sym.var("a")
+    s1 = sym.exp(a, name="e")
+    s2 = sym.log(a + 1.0, name="l")
+    g = sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    av = np.random.rand(2, 2).astype(np.float32)
+    outs = g.eval_dict({"a": nd.array(av)})
+    np.testing.assert_allclose(outs[0].asnumpy(), np.exp(av), rtol=1e-5)
+    np.testing.assert_allclose(outs[1].asnumpy(), np.log(av + 1), rtol=1e-5)
+    e = g["e_output"]
+    assert e.list_outputs() == ["e_output"]
+
+
+def test_get_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    d = json.loads(js)
+    assert "nodes" in d and "arg_nodes" in d and "heads" in d
+    out2 = sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    x = np.random.rand(4, 10).astype(np.float32)
+    w = {n: nd.array(np.random.rand(*s).astype(np.float32) * 0.1)
+         for n, s in zip(out.list_arguments()[1:-1],
+                         out.infer_shape(data=(4, 10), softmax_label=(4,))[0][1:-1])}
+    bindings = {"data": nd.array(x), "softmax_label": nd.zeros((4,)), **w}
+    np.testing.assert_allclose(out.eval_dict(bindings).asnumpy(),
+                               out2.eval_dict(bindings).asnumpy(), rtol=1e-6)
+
+
+@pytest.mark.skipif(not os.path.exists(REF_JSON),
+                    reason="reference checkout not available")
+def test_load_reference_legacy_json():
+    """Load a reference-era (v0 format) model JSON and run it."""
+    s = sym.load(REF_JSON)
+    assert "fc1_weight" in s.list_arguments()
+    assert s.list_auxiliary_states() == [
+        "batchnorm0_moving_mean", "batchnorm0_moving_var"]
+    ex = s.simple_bind(mx.cpu(), data=(2, 100), softmax_label=(2,))
+    out = ex.forward(data=np.random.rand(2, 100), softmax_label=np.zeros(2))
+    assert out[0].shape == (2, 10)
+    np.testing.assert_allclose(out[0].asnumpy().sum(1), np.ones(2), rtol=1e-5)
+
+
+def test_executor_backward_softmax_head():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(8, 10), softmax_label=(8,))
+    rs = np.random.RandomState(3)
+    for n in ("fc1_weight", "fc2_weight"):
+        ex.arg_dict[n][:] = rs.normal(0, 0.1, ex.arg_dict[n].shape)
+    x = rs.normal(0, 1, (8, 10)).astype(np.float32)
+    y = rs.randint(0, 4, (8,)).astype(np.float32)
+    ex.forward(is_train=True, data=x, softmax_label=y)
+    ex.backward()
+    # SoftmaxOutput head: d(data) = p - onehot(y)
+    p = ex.outputs[0].asnumpy()
+    oh = np.eye(4, dtype=np.float32)[y.astype(int)]
+    # chain check on fc2_bias: grad = sum over batch of (p - oh)
+    np.testing.assert_allclose(ex.grad_dict["fc2_bias"].asnumpy(),
+                               (p - oh).sum(0), rtol=1e-4, atol=1e-5)
+
+
+def test_executor_grad_req_add_and_null():
+    a = sym.var("a")
+    out = sym.sum(a * a, name="loss")
+    av = np.random.rand(3, 3).astype(np.float32)
+    ex = out.bind(mx.cpu(), args={"a": nd.array(av)},
+                  grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), 4 * av, rtol=1e-5)
+
+    ex2 = out.bind(mx.cpu(), args={"a": nd.array(av)}, grad_req="null")
+    ex2.forward(is_train=True)
+    ex2.backward()  # no-op
+    assert ex2.grad_dict == {}
+
+
+def test_executor_batchnorm_aux_update():
+    d = sym.var("data")
+    b = sym.BatchNorm(d, name="bn0", momentum=0.5)
+    ex = b.simple_bind(mx.cpu(), data=(4, 3, 2, 2))
+    ex.arg_dict["bn0_gamma"][:] = 1.0
+    x = np.random.rand(4, 3, 2, 2).astype(np.float32) * 3
+    mv0 = ex.aux_dict["bn0_moving_var"].asnumpy().copy()
+    ex.forward(is_train=True, data=x)
+    bm = x.mean((0, 2, 3))
+    np.testing.assert_allclose(ex.aux_dict["bn0_moving_mean"].asnumpy(),
+                               0.5 * bm, rtol=1e-4)
+    # eval forward must NOT update aux
+    mm = ex.aux_dict["bn0_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=False, data=x)
+    np.testing.assert_allclose(ex.aux_dict["bn0_moving_mean"].asnumpy(), mm)
+
+
+def test_executor_reshape():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(8, 10), softmax_label=(8,))
+    ex.arg_dict["fc1_weight"][:] = 0.1
+    ex2 = ex.reshape(data=(2, 10), softmax_label=(2,))
+    assert ex2.arg_dict["data"].shape == (2, 10)
+    np.testing.assert_allclose(ex2.arg_dict["fc1_weight"].asnumpy(),
+                               ex.arg_dict["fc1_weight"].asnumpy())
+    ex2.forward(data=np.zeros((2, 10)), softmax_label=np.zeros(2))
+
+
+def test_variable_shape_hint():
+    a = sym.var("a", shape=(3, 4), dtype="float32")
+    out = sym.relu(a)
+    arg_shapes, out_shapes, _ = out.infer_shape()
+    assert out_shapes == [(3, 4)]
+
+
+def test_multi_output_requires_index():
+    d = sym.var("data")
+    s = sym.split(d, num_outputs=2, axis=1)
+    assert len(s.list_outputs()) == 2
+    with pytest.raises(MXNetError):
+        sym.relu(s)
+    r = sym.relu(s[0])
+    got = r.eval_dict({"data": nd.array(np.ones((2, 4), np.float32))})
+    assert got.shape == (2, 2)
+
+
+def test_simple_bind_type_dict():
+    a = sym.var("a")
+    out = sym.relu(a)
+    ex = out.simple_bind(mx.cpu(), a=(2, 2), type_dict={"a": "float16"})
+    assert ex.arg_dict["a"].dtype == np.float16
