@@ -78,7 +78,6 @@ def bench_train(batch, dtype, steps, image_size=224):
                      example_inputs=[x0],
                      dtype=dtype if dtype != "float32" else None)
 
-    import jax.numpy as jnp
     # stage the synthetic batch on-device once: we measure compute, not the
     # host link (the input pipeline overlaps transfers in real training)
     x = jnp.asarray(np.random.randn(batch, 3, image_size, image_size)

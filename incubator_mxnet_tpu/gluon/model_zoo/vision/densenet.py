@@ -41,10 +41,8 @@ class _DenseBlock(HybridBlock):
             self.convs2.add(nn.Conv2D(growth, 3, padding=1, use_bias=False))
 
     def hybrid_forward(self, F, x):
-        for n1, c1, n2, c2 in zip(self.norms1._children.values(),
-                                  self.convs1._children.values(),
-                                  self.norms2._children.values(),
-                                  self.convs2._children.values()):
+        for n1, c1, n2, c2 in zip(self.norms1, self.convs1,
+                                  self.norms2, self.convs2):
             y = c1(F.relu(n1(x)))
             y = c2(F.relu(n2(y)))
             if self._dropout:

@@ -59,8 +59,10 @@ class _InvertedResidual(HybridBlock):
         self._identity = (stride == 1 and in_ch == out_ch)
         mid = in_ch * t
         self.layers = nn.HybridSequential(prefix="")
-        if t != 1:
-            self.layers.add(_ConvBN(mid, 1, act="relu6"))
+        # the reference LinearBottleneck keeps the 1x1 expansion even at t=1
+        # (python/mxnet/gluon/model_zoo/vision/mobilenet.py _add_conv chain),
+        # so parameter layouts line up with reference-exported weights
+        self.layers.add(_ConvBN(mid, 1, act="relu6"))
         self.layers.add(_ConvBN(mid, 3, stride, groups=mid, act="relu6"))
         self.layers.add(_ConvBN(out_ch, 1, act=None))
 

@@ -49,8 +49,12 @@ class _ResUnit(HybridBlock):
         out = width if kind == "basic" else width * 4
         if kind == "basic":
             plan = [(width, 3, stride), (out, 3, 1)]
-        else:
+        elif not preact:
+            # v1 bottleneck strides at the 1x1 reduce, v2 at the 3x3
+            # (reference BottleneckV1 vs BottleneckV2)
             plan = [(width, 1, stride), (width, 3, 1), (out, 1, 1)]
+        else:
+            plan = [(width, 1, 1), (width, 3, stride), (out, 1, 1)]
 
         self.convs = nn.HybridSequential(prefix="")
         self.norms = nn.HybridSequential(prefix="")
@@ -66,11 +70,10 @@ class _ResUnit(HybridBlock):
 
     def _forward_v1(self, F, x):
         y = x
-        convs = list(self.convs._children.values())
-        norms = list(self.norms._children.values())
-        for i, (conv, norm) in enumerate(zip(convs, norms)):
+        n = len(self.convs)
+        for i, (conv, norm) in enumerate(zip(self.convs, self.norms)):
             y = norm(conv(y))
-            if i < len(convs) - 1:
+            if i < n - 1:
                 y = F.relu(y)
         s = x
         if self.shortcut is not None:
@@ -78,8 +81,8 @@ class _ResUnit(HybridBlock):
         return F.relu(y + s)
 
     def _forward_v2(self, F, x):
-        convs = list(self.convs._children.values())
-        norms = list(self.norms._children.values())
+        convs = list(self.convs)
+        norms = list(self.norms)
         y = F.relu(norms[0](x))
         s = self.shortcut(y) if self.shortcut is not None else x
         y = convs[0](y)

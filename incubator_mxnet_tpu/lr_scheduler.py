@@ -65,7 +65,9 @@ class FactorScheduler(LRScheduler):
         self.stop_factor_lr = stop_factor_lr
 
     def _decayed(self, num_update):
-        drops = num_update // self.step
+        # strict boundary: no drop at num_update == k*step itself, matching
+        # MultiFactorScheduler's bisect_left milestone semantics below
+        drops = max(0, num_update - 1) // self.step
         return max(self.stop_factor_lr, self.base_lr * self.factor ** drops)
 
 
