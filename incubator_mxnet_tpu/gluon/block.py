@@ -96,6 +96,25 @@ class _TraceScope:
         return getattr(cls._tls, "depth", 0) > 0
 
 
+class _SymbolicScope:
+    """Active while exporting: hybrid_forward runs with F = the symbol
+    namespace and parameters as named variables, producing the serving graph
+    (the reference traces hybrid_forward with Symbol args, block.py:786)."""
+
+    _tls = threading.local()
+
+    def __enter__(self):
+        self._tls.depth = getattr(self._tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        self._tls.depth -= 1
+
+    @classmethod
+    def active(cls):
+        return getattr(cls._tls, "depth", 0) > 0
+
+
 # patch Parameter.set_data to intercept traced writes
 _orig_set_data = Parameter.set_data
 
@@ -281,6 +300,11 @@ class HybridBlock(Block):
 
     # -- eager path ---------------------------------------------------------
     def _eager_forward(self, *args):
+        if _SymbolicScope.active():
+            from .. import symbol as _sym
+            params = {k: _sym.var(p.name)
+                      for k, p in self._reg_params.items()}
+            return self.hybrid_forward(_sym, *args, **params)
         try:
             params = {k: p.data() for k, p in self._reg_params.items()}
         except DeferredInitializationError:
@@ -295,6 +319,8 @@ class HybridBlock(Block):
                 p._finish_deferred_init()
 
     def forward(self, *args):
+        if args and isinstance(args[0], NDArray):
+            self._num_inputs = len(args)
         if self._active and not _TraceScope.active() and args and \
                 isinstance(args[0], NDArray):
             return self._call_cached(*args)
@@ -425,19 +451,47 @@ class HybridBlock(Block):
         flat, _ = _flatten_outputs(out)
         return tuple(o._data for o in flat)
 
-    def export(self, path, epoch=0):
-        """Serving export (reference gluon/block.py:907): params +
-        a JSON graph descriptor via the symbol layer."""
-        params = self._collect_params_with_prefix()
-        nd.save(f"{path}-{epoch:04d}.params",
-                {("arg:" + k): p.data() for k, p in params.items()})
-        try:
-            from .. import symbol as _sym
-            # symbolic export requires a traced symbol; best-effort
-            with open(f"{path}-symbol.json", "w") as f:
-                f.write('{"nodes": [], "format": "mxtpu-0.1"}')
-        except Exception:
-            pass
+    def _trace_symbol(self, num_inputs=None):
+        """Trace hybrid_forward into a Symbol graph (reference
+        block.py:786 _build_cache with Symbol args)."""
+        from .. import symbol as _sym
+
+        n = num_inputs or getattr(self, "_num_inputs", 1)
+        names = ["data"] if n == 1 else [f"data{i}" for i in range(n)]
+        inputs = [_sym.var(nm) for nm in names]
+        with _SymbolicScope(), autograd.pause():
+            out = self._eager_forward(*inputs)
+        if isinstance(out, (list, tuple)):
+            flat = []
+            for o in out:
+                flat.extend(o if isinstance(o, (list, tuple)) else [o])
+            out = _sym.Group(flat)
+        return out, names
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Serving export (reference gluon/block.py:907): traces the block
+        into `path-symbol.json` + `path-{epoch:04d}.params` loadable by
+        SymbolBlock.imports, the Module API, or any reference-compatible
+        consumer."""
+        deferred = [p.name for p in self.collect_params().values()
+                    if p._data is None]
+        if deferred:
+            raise MXNetError(
+                "export() requires fully-initialized parameters; run a "
+                f"forward pass first (uninitialized: {deferred[:5]}...)")
+        sym_out, _ = self._trace_symbol()
+        sym_out.save(f"{path}-symbol.json")
+
+        arg_names = set(sym_out.list_arguments())
+        aux_names = set(sym_out.list_auxiliary_states())
+        save_dict = {}
+        for p in self.collect_params().values():
+            if p.name in aux_names:
+                save_dict[f"aux:{p.name}"] = p.data()
+            elif p.name in arg_names:
+                save_dict[f"arg:{p.name}"] = p.data()
+        nd.save(f"{path}-{epoch:04d}.params", save_dict)
+        return sym_out
 
 
 def _flatten_outputs(out):
@@ -465,7 +519,8 @@ def _flatten_outputs(out):
 
 class SymbolBlock(HybridBlock):
     """Run a symbolic graph as a Block (reference gluon/block.py:992).
-    Constructed from symbol outputs + inputs, typically via `.imports`."""
+    Constructed from symbol outputs + inputs, typically via `.imports`
+    of a `HybridBlock.export` (or reference-exported) artifact."""
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=None)
@@ -473,14 +528,16 @@ class SymbolBlock(HybridBlock):
         self._out_sym = outputs if isinstance(outputs, _sym.Symbol) else outputs
         self._in_syms = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         in_names = {s.name for s in self._in_syms}
-        for arg in self._out_sym.list_arguments():
-            if arg not in in_names:
-                p = Parameter(arg, allow_deferred_init=True)
-                if params is not None and arg in params:
-                    p._infer_shape(params[arg].shape)
-                    p.set_data(params[arg])
-                self._reg_params[arg] = p
-                self._params._params[arg] = p
+        names = ([a for a in self._out_sym.list_arguments()
+                  if a not in in_names] +
+                 self._out_sym.list_auxiliary_states())
+        for arg in names:
+            p = Parameter(arg, allow_deferred_init=True)
+            if params is not None and arg in params:
+                p._infer_shape(params[arg].shape)
+                p.set_data(params[arg])
+            self._reg_params[arg] = p
+            self._params._params[arg] = p
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):

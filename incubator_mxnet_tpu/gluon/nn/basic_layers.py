@@ -168,11 +168,16 @@ class BatchNorm(HybridBlock):
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         training = autograd.is_training() and not self._use_global_stats
-        out, mean, var = F.BatchNorm(
+        res = F.BatchNorm(
             x, gamma, beta, running_mean, running_var, eps=self._epsilon,
             momentum=self._momentum, fix_gamma=not self._scale,
             use_global_stats=self._use_global_stats, axis=self._axis,
             training=training)
+        if not isinstance(res, (tuple, list)):
+            # symbolic trace: one visible output; stat updates are the
+            # executor's job (executor.py BatchNorm aux wiring)
+            return res
+        out, mean, var = res
         if training:
             with autograd.pause():
                 m = self._momentum

@@ -129,8 +129,9 @@ class Conv3DTranspose(_Conv):
 
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, global_pool, pool_type,
-                 ceil_mode=False, count_include_pad=True, **kwargs):
+                 ceil_mode=False, count_include_pad=True, ndim=2, **kwargs):
         super().__init__(**kwargs)
+        self._ndim = ndim
         self._kernel = pool_size
         self._stride = strides if strides is not None else pool_size
         self._pad = padding
@@ -140,7 +141,9 @@ class _Pooling(HybridBlock):
         self._count_include_pad = count_include_pad
 
     def hybrid_forward(self, F, x):
-        ndim = x.ndim - 2
+        # spatial rank comes from the layer config, not the input, so the
+        # same code traces symbolically (Symbols have no static ndim)
+        ndim = self._ndim
         return F.Pooling(x, kernel=_tup(self._kernel, ndim),
                          stride=_tup(self._stride, ndim),
                          pad=_tup(self._pad, ndim), pool_type=self._pool_type,
@@ -153,35 +156,35 @@ class MaxPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kwargs):
         super().__init__(pool_size, strides, padding, False, "max", ceil_mode,
-                         **kwargs)
+                         ndim=1, **kwargs)
 
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
                  ceil_mode=False, **kwargs):
         super().__init__(pool_size, strides, padding, False, "max", ceil_mode,
-                         **kwargs)
+                         ndim=2, **kwargs)
 
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(pool_size, strides, padding, False, "max", ceil_mode,
-                         **kwargs)
+                         ndim=3, **kwargs)
 
 
 class AvgPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(pool_size, strides, padding, False, "avg", ceil_mode,
-                         count_include_pad, **kwargs)
+                         count_include_pad, ndim=1, **kwargs)
 
 
 class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(pool_size, strides, padding, False, "avg", ceil_mode,
-                         count_include_pad, **kwargs)
+                         count_include_pad, ndim=2, **kwargs)
 
 
 class AvgPool3D(_Pooling):
@@ -189,37 +192,37 @@ class AvgPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, count_include_pad=True,
                  **kwargs):
         super().__init__(pool_size, strides, padding, False, "avg", ceil_mode,
-                         count_include_pad, **kwargs)
+                         count_include_pad, ndim=3, **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__(1, None, 0, True, "max", **kwargs)
+        super().__init__(1, None, 0, True, "max", ndim=1, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, 0, True, "max", **kwargs)
+        super().__init__((1, 1), None, 0, True, "max", ndim=2, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, 0, True, "max", **kwargs)
+        super().__init__((1, 1, 1), None, 0, True, "max", ndim=3, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__(1, None, 0, True, "avg", **kwargs)
+        super().__init__(1, None, 0, True, "avg", ndim=1, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, 0, True, "avg", **kwargs)
+        super().__init__((1, 1), None, 0, True, "avg", ndim=2, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, 0, True, "avg", **kwargs)
+        super().__init__((1, 1, 1), None, 0, True, "avg", ndim=3, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

@@ -21,8 +21,20 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
 _REG = Registry("metric")
 
 
+# short names accepted by create() (reference metric.py registers these
+# through mx.registry alias lists, e.g. 'acc' for Accuracy)
+_ALIASES = {
+    "Accuracy": ("acc",),
+    "TopKAccuracy": ("top_k_accuracy", "top_k_acc"),
+    "CrossEntropy": ("ce",),
+    "NegativeLogLikelihood": ("nll_loss",),
+    "PearsonCorrelation": ("pearsonr",),
+    "MCC": ("mcc",),
+}
+
+
 def register(cls):
-    _REG.register(cls)
+    _REG.register(cls, aliases=_ALIASES.get(cls.__name__, ()))
     return cls
 
 

@@ -1,0 +1,72 @@
+"""Monitor: per-op output statistics for debugging.
+
+Reference: python/mxnet/monitor.py:146 — installs a stat callback on
+executors (MXExecutorSetMonitorCallback), collects (batch, node, stat) rows
+between tic()/toc(). Our Executor exposes the same set_monitor_callback hook.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return x.abs().mean() if hasattr(x, "abs") else x
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        exe.set_monitor_callback(self._stat_helper)
+        self.exes.append(exe)
+
+    def _stat_helper(self, name, arr):
+        if not self.activated or not self.re_pattern.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for exe in self.exes:
+            for name, array in exe.arg_dict.items():
+                if self.re_pattern.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            if not isinstance(v_list, list):
+                v_list = [v_list]
+            s = " ".join(str(float(v.asnumpy().reshape(-1)[0]))
+                         if isinstance(v, NDArray) else str(v)
+                         for v in v_list)
+            res.append((n, k, s))
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        return res
+
+    def toc_print(self):
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

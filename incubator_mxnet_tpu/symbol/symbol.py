@@ -781,6 +781,10 @@ def _create(op, args, kwargs, name=None):
                 nxt = pos[0]
                 if isinstance(nxt, Symbol):
                     s = pos.pop(0)
+                elif nxt is None:
+                    # explicit "no input" slot (bias=None when use_bias=False)
+                    pos.pop(0)
+                    continue
             if s is None:
                 # auto-create a trailing parameter variable when needed
                 if required or _wants_auto_var(op, aname, attrs):
@@ -794,6 +798,11 @@ def _create(op, args, kwargs, name=None):
         if sym_kwargs:
             raise MXNetError(f"{op.name}: unknown symbol kwargs "
                              f"{sorted(sym_kwargs)}")
+
+    if op.train_aware:
+        # symbols carry no train-mode attr — the mode comes from the
+        # executor's is_train at run time (reference: OpContext.is_train)
+        attrs.pop("training", None)
 
     node = _Node(op, name, attrs, inputs, extra=extra,
                  arg_names=arg_names_used)

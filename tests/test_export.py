@@ -1,0 +1,75 @@
+"""HybridBlock.export -> SymbolBlock.imports / Module round-trip
+(reference: gluon/block.py:907 export + :992 SymbolBlock)."""
+import os
+import tempfile
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.block import SymbolBlock
+
+
+def _lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(16, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(32, activation="relu"),
+            nn.Dense(10))
+    return net
+
+
+def test_export_symbolblock_roundtrip():
+    net = _lenet()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(2, 1, 16, 16).astype(np.float32))
+    want = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "lenet")
+        net.export(path, epoch=7)
+        assert os.path.exists(path + "-symbol.json")
+        assert os.path.exists(path + "-0007.params")
+        back = SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                   path + "-0007.params")
+        got = back(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_export_batchnorm_model_into_module():
+    """Exported gluon model (with BatchNorm aux) must be loadable by the
+    Module/checkpoint API (reference cross-API serving path)."""
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.GlobalAvgPool2D(), nn.Flatten(),
+            nn.Dense(3))
+    net.initialize()
+    x = nd.array(np.random.rand(2, 2, 8, 8).astype(np.float32))
+    want = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bnmodel")
+        net.export(path)
+        symbol, arg_params, aux_params = mx.model.load_checkpoint(path, 0)
+        assert symbol.list_auxiliary_states()  # BN moving stats present
+        ex = symbol.simple_bind(mx.cpu(), data=(2, 2, 8, 8))
+        ex.copy_params_from(arg_params, aux_params)
+        got = ex.forward(is_train=False, data=x)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_export_model_zoo_resnet():
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    net = resnet18_v1(classes=10)
+    net.initialize()
+    x = nd.array(np.random.rand(1, 3, 32, 32).astype(np.float32))
+    want = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "resnet18")
+        net.export(path)
+        back = SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                   path + "-0000.params")
+        got = back(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)

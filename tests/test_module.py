@@ -1,0 +1,187 @@
+"""Module API tests (reference: tests/python/unittest/test_module.py +
+tests/python/train/test_mlp.py convergence oracle)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu import symbol as sym
+from incubator_mxnet_tpu.io import DataBatch, DataDesc, NDArrayIter
+from incubator_mxnet_tpu.module import BucketingModule, Module
+
+
+def _mlp_sym(num_hidden=32, classes=4):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax", normalization="batch")
+
+
+def _toy_data(n=256, dim=20, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.normal(0, 1, (n, dim)).astype(np.float32)
+    W = rs.normal(0, 1, (dim, classes)).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+    return X, Y
+
+
+def test_module_fit_converges():
+    X, Y = _toy_data()
+    train = NDArrayIter({"data": X}, {"softmax_label": Y}, batch_size=64,
+                        shuffle=True)
+    mod = Module(_mlp_sym(), data_names=("data",),
+                 label_names=("softmax_label",))
+    mod.fit(train, num_epoch=25, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    score = mod.score(NDArrayIter({"data": X}, {"softmax_label": Y},
+                                  batch_size=64), "acc")
+    assert dict(score)["accuracy"] > 0.95
+
+
+def test_module_forward_backward_update():
+    X, Y = _toy_data(n=64)
+    mod = Module(_mlp_sym())
+    mod.bind(data_shapes=[DataDesc("data", (32, 20))],
+             label_shapes=[DataDesc("softmax_label", (32,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = DataBatch(data=[nd.array(X[:32])], label=[nd.array(Y[:32])])
+    mod.forward(batch, is_train=True)
+    out0 = mod.get_outputs()[0].asnumpy()
+    assert out0.shape == (32, 4)
+    mod.backward()
+    w_before = mod.get_params()[0]["fc1_weight"].asnumpy()
+    mod.update()
+    w_after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    assert not np.allclose(w_before, w_after)
+
+
+def test_module_predict():
+    X, Y = _toy_data(n=64)
+    it = NDArrayIter({"data": X}, {"softmax_label": Y}, batch_size=16)
+    mod = Module(_mlp_sym())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (64, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(1), np.ones(64), rtol=1e-4)
+
+
+def test_module_checkpoint_roundtrip():
+    X, Y = _toy_data(n=64)
+    mod = Module(_mlp_sym())
+    it = NDArrayIter({"data": X}, {"softmax_label": Y}, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "model")
+        mod.save_checkpoint(prefix, 3)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0003.params")
+        mod2 = Module.load(prefix, 3)
+        mod2.bind(data_shapes=it.provide_data,
+                  label_shapes=it.provide_label)
+        a1, _ = mod.get_params()
+        a2, _ = mod2.get_params()
+        for k in a1:
+            np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy())
+        b = DataBatch(data=[nd.array(X[:16])], label=[nd.array(Y[:16])])
+        mod.forward(b, is_train=False)
+        mod2.forward(b, is_train=False)
+        np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                                   mod2.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_module_fixed_params():
+    mod = Module(_mlp_sym(), fixed_param_names=["fc1_weight", "fc1_bias"])
+    mod.bind(data_shapes=[DataDesc("data", (8, 20))],
+             label_shapes=[DataDesc("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 1.0})
+    X, Y = _toy_data(n=8)
+    b = DataBatch(data=[nd.array(X)], label=[nd.array(Y)])
+    mod.forward(b, is_train=True)
+    mod.backward()
+    fixed_before = mod.get_params()[0]["fc1_weight"].asnumpy()
+    free_before = mod.get_params()[0]["fc2_weight"].asnumpy()
+    mod.update()
+    np.testing.assert_allclose(mod.get_params()[0]["fc1_weight"].asnumpy(),
+                               fixed_before)
+    assert not np.allclose(mod.get_params()[0]["fc2_weight"].asnumpy(),
+                           free_before)
+
+
+def test_bucketing_module():
+    """Variable-length 'sequences' via buckets (reference
+    tests/python/train/test_bucketing.py shape)."""
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        # bucket-length-independent parameters, as in RNN bucketing: reduce
+        # the variable axis before the shared dense layers
+        pooled = sym.mean(data, axis=1, keepdims=True, name=f"pool{seq_len}")
+        net = sym.FullyConnected(pooled, num_hidden=16, name="fc_shared")
+        net = sym.Activation(net, act_type="relu", name="act")
+        net = sym.FullyConnected(net, num_hidden=2, name="out")
+        return (sym.SoftmaxOutput(net, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    buckets = [8, 16]
+    mod = BucketingModule(sym_gen, default_bucket_key=16)
+    mod.bind(data_shapes=[DataDesc("data", (4, 16))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+
+    rs = np.random.RandomState(0)
+    for step in range(4):
+        blen = buckets[step % 2]
+        x = rs.normal(0, 1, (4, blen)).astype(np.float32)
+        # reuse of fc_shared across buckets forces weight-shape agreement
+        # only on the shared tail; pad data to the bucket's length
+        batch = DataBatch(
+            data=[nd.array(x)], label=[nd.array(np.zeros(4))],
+            bucket_key=blen,
+            provide_data=[DataDesc("data", (4, blen))],
+            provide_label=[DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets) == {8, 16}
+    # out_weight shape is bucket-independent -> values must be shared
+    a8, _ = mod._buckets[8].get_params()
+    a16, _ = mod._buckets[16].get_params()
+    np.testing.assert_allclose(a8["out_weight"].asnumpy(),
+                               a16["out_weight"].asnumpy())
+
+
+def test_module_with_kvstore():
+    X, Y = _toy_data()
+    train = NDArrayIter({"data": X}, {"softmax_label": Y}, batch_size=64)
+    mod = Module(_mlp_sym())
+    mod.fit(train, num_epoch=20, kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    score = mod.score(train, "acc")
+    assert dict(score)["accuracy"] > 0.9
+
+
+def test_speedometer_and_checkpoint_callbacks():
+    X, Y = _toy_data(n=128)
+    train = NDArrayIter({"data": X}, {"softmax_label": Y}, batch_size=32)
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "cb")
+        mod = Module(_mlp_sym())
+        mod.fit(train, num_epoch=2,
+                batch_end_callback=mx.callback.Speedometer(32, 2),
+                epoch_end_callback=mx.callback.do_checkpoint(prefix),
+                optimizer_params={"learning_rate": 0.1})
+        assert os.path.exists(prefix + "-0001.params")
+        assert os.path.exists(prefix + "-0002.params")
+        s, arg, aux = mx.model.load_checkpoint(prefix, 2)
+        assert "fc1_weight" in arg
