@@ -7,6 +7,7 @@ class (thin shim over Module here, as in late-1.x reference usage).
 """
 from __future__ import annotations
 
+import logging as _logging
 from collections import namedtuple
 
 from . import nd
@@ -80,15 +81,18 @@ class FeedForward:
                             shuffle=True)
         mod = Module(self.symbol,
                      data_names=[d.name for d in X.provide_data],
-                     label_names=[d.name for d in (X.provide_label or [])])
+                     label_names=[d.name for d in (X.provide_label or [])],
+                     logger=logger or _logging)
         mod.fit(X, eval_data=eval_data, eval_metric=eval_metric,
                 epoch_end_callback=epoch_end_callback,
                 batch_end_callback=batch_end_callback, kvstore=kvstore,
                 optimizer=self.optimizer,
                 optimizer_params=self.kwargs or None,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
                 initializer=self.initializer,
                 arg_params=self.arg_params, aux_params=self.aux_params,
-                begin_epoch=self.begin_epoch,
+                begin_epoch=self.begin_epoch, monitor=monitor,
                 num_epoch=self.num_epoch or 1)
         self._mod = mod
         self.arg_params, self.aux_params = mod.get_params()

@@ -60,6 +60,11 @@ class BucketingModule(BaseModule):
             self.switch_bucket(self._default_bucket_key, data_shapes,
                                label_shapes)
             return
+        # rebind invalidates every bucket executor: stale modules alias the
+        # OLD default executor's arrays (reference _reset_bind)
+        self._buckets = {}
+        self.params_initialized = False
+        self.optimizer_initialized = False
         mod = self._gen_module(self._default_bucket_key)
         mod.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
                  force_rebind=False, grad_req=grad_req)

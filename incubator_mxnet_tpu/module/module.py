@@ -223,12 +223,15 @@ class Module(BaseModule):
         # stay consistent across bucket executors whose argument orders may
         # differ (reference keys kvstore by name, kvstore.py:123)
         if self._kvstore is not None:
+            from ..ndarray import NDArray
             for name in self._param_names:
                 g = self._exec.grad_dict.get(name)
                 if g is None:
                     continue
                 self._kvstore.push(name, g)
-                agg = self._exec.arg_dict[name].copy()
+                # pull rebinds the buffer wholesale, so a zero-copy view is
+                # enough as the out slot (no per-step weight copy)
+                agg = NDArray(g._data)
                 self._kvstore.pull(name, out=agg)
                 self._updater(name, agg, self._exec.arg_dict[name])
         else:

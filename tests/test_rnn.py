@@ -1,0 +1,196 @@
+"""RNN tests: fused op vs cells, gradients, bidirectional, PTB-style LM
+(reference: tests/python/unittest/test_gluon_rnn.py + test_operator.py RNN,
+tests/python/train config 3)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.gluon import nn, rnn
+from incubator_mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+
+def _rand(*shape):
+    return np.random.uniform(-0.5, 0.5, shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("mode,cell_cls", [
+    ("rnn_tanh", lambda h: rnn.RNNCell(h, activation="tanh")),
+    ("lstm", rnn.LSTMCell),
+    ("gru", rnn.GRUCell),
+])
+def test_fused_layer_matches_cell_unroll(mode, cell_cls):
+    """The fused lax.scan op and the python-unrolled cell must agree."""
+    T, N, I, H = 5, 3, 4, 6
+    x = _rand(T, N, I)
+
+    layer_cls = {"rnn_tanh": lambda h: rnn.RNN(h, activation="tanh"),
+                 "lstm": rnn.LSTM, "gru": rnn.GRU}[mode]
+    layer = layer_cls(H)
+    layer.initialize()
+    states = layer.begin_state(N)
+    out, out_states = layer(nd.array(x), states)
+
+    cell = cell_cls(H)
+    cell.initialize()
+    # copy fused layer params into the cell
+    lp = {k: v for k, v in layer._reg_params.items()}
+    cell.i2h_weight._infer_shape(lp["l0_i2h_weight"].shape)
+    cell.i2h_weight.set_data(lp["l0_i2h_weight"].data())
+    cell.h2h_weight._infer_shape(lp["l0_h2h_weight"].shape)
+    cell.h2h_weight.set_data(lp["l0_h2h_weight"].data())
+    cell.i2h_bias._infer_shape(lp["l0_i2h_bias"].shape)
+    cell.i2h_bias.set_data(lp["l0_i2h_bias"].data())
+    cell.h2h_bias._infer_shape(lp["l0_h2h_bias"].shape)
+    cell.h2h_bias.set_data(lp["l0_h2h_bias"].data())
+
+    outs, _ = cell.unroll(T, nd.array(x), layout="TNC", merge_outputs=True)
+    # cell unroll merges on axis 0 (TNC layout)
+    np.testing.assert_allclose(out.asnumpy(), outs.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_op_flat_params_shapes():
+    T, N, I, H, L = 3, 2, 5, 4, 2
+    for mode in ("rnn_relu", "rnn_tanh", "lstm", "gru"):
+        for bi in (False, True):
+            D = 2 if bi else 1
+            psize = rnn_param_size(mode, I, H, L, bi)
+            params = nd.array(_rand(psize))
+            x = nd.array(_rand(T, N, I))
+            h0 = nd.zeros((L * D, N, H))
+            args = [x, params, h0]
+            if mode == "lstm":
+                args.append(nd.zeros((L * D, N, H)))
+            res = nd.RNN(*args, state_size=H, num_layers=L, mode=mode,
+                         bidirectional=bi, state_outputs=True)
+            out = res[0]
+            assert out.shape == (T, N, H * D), (mode, bi)
+            assert res[1].shape == (L * D, N, H)
+
+
+def test_lstm_layer_gradient_flows():
+    T, N, I, H = 4, 2, 3, 5
+    layer = rnn.LSTM(H, num_layers=2, dropout=0.0)
+    layer.initialize()
+    x = nd.array(_rand(T, N, I))
+    params = layer.collect_params()
+    for p in params.values():
+        p.grad_req = "write"
+    states = layer.begin_state(N)
+    with autograd.record():
+        out, _ = layer(x, states)
+        loss = out.sum()
+    loss.backward()
+    g = params[list(params.keys())[0]].grad()
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_bidirectional_lstm_shape_and_reverse_consistency():
+    T, N, I, H = 6, 2, 4, 3
+    layer = rnn.LSTM(H, bidirectional=True)
+    layer.initialize()
+    x = nd.array(_rand(T, N, I))
+    out = layer(x)
+    assert out.shape == (T, N, 2 * H)
+
+
+def test_ntc_layout():
+    N, T, I, H = 3, 5, 4, 6
+    layer = rnn.GRU(H, layout="NTC")
+    layer.initialize()
+    x = nd.array(_rand(N, T, I))
+    out = layer(x)
+    assert out.shape == (N, T, H)
+
+
+def test_rnn_hybridize_parity():
+    T, N, I, H = 4, 2, 3, 5
+    layer = rnn.LSTM(H)
+    layer.initialize()
+    x = nd.array(_rand(T, N, I))
+    states = layer.begin_state(N)
+    out_eager, _ = layer(x, states)
+    layer.hybridize()
+    out_hyb, _ = layer(x, states)
+    np.testing.assert_allclose(out_eager.asnumpy(), out_hyb.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_and_modifier_cells():
+    T, N, I, H = 4, 2, 3, 5
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(H)))
+    stack.add(rnn.DropoutCell(0.0))
+    stack.initialize()
+    out, states = stack.unroll(T, nd.array(_rand(T, N, I)), layout="TNC",
+                               merge_outputs=True)
+    assert out.shape == (T, N, H)
+    assert len(states) == 4  # 2 LSTM cells x (h, c)
+
+
+def test_bidirectional_cell():
+    T, N, I, H = 5, 2, 3, 4
+    bi = rnn.BidirectionalCell(rnn.GRUCell(H), rnn.GRUCell(H))
+    bi.initialize()
+    out, states = bi.unroll(T, nd.array(_rand(T, N, I)), layout="TNC",
+                            merge_outputs=True)
+    assert out.shape == (T, N, 2 * H)
+
+
+def test_ptb_style_lm_converges():
+    """BASELINE config 3 shape: embed -> LSTM -> dense over a tiny synthetic
+    corpus; perplexity must drop (reference example/rnn/word_lm)."""
+    V, E, H, T, N = 32, 16, 32, 8, 16
+    rs = np.random.RandomState(0)
+    # synthetic periodic corpus = learnable transitions
+    corpus = np.tile(np.arange(V), 40)
+    noise = rs.randint(0, V, corpus.shape)
+    mask = rs.rand(*corpus.shape) < 0.05
+    corpus = np.where(mask, noise, corpus)
+
+    class WordLM(nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.embed = nn.Embedding(V, E)
+            self.lstm = rnn.LSTM(H, input_size=E)
+            self.out = nn.Dense(V, flatten=False)
+
+        def hybrid_forward(self, F, x, h, c):
+            e = self.embed(x)  # [N, T, E]
+            e = F.swapaxes(e, dim1=0, dim2=1)
+            o, _ = self.lstm(e, [h, c])
+            o = F.swapaxes(o, dim1=0, dim2=1)
+            return self.out(o)
+
+    model = WordLM()
+    model.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(model.collect_params(), "adam",
+                               {"learning_rate": 0.01})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def batches():
+        data = corpus[:(len(corpus) // (N * T)) * N * T].reshape(N, -1)
+        for i in range(0, data.shape[1] - T - 1, T):
+            yield data[:, i:i + T], data[:, i + 1:i + T + 1]
+
+    losses = []
+    for epoch in range(6):
+        tot, cnt = 0.0, 0
+        for xb, yb in batches():
+            x = nd.array(xb.astype(np.float32))
+            y = nd.array(yb.astype(np.float32))
+            h = nd.zeros((1, N, H))
+            c = nd.zeros((1, N, H))
+            with autograd.record():
+                logits = model(x, h, c)
+                loss = loss_fn(logits, y)
+            loss.backward()
+            trainer.step(N)
+            tot += float(loss.mean().asnumpy())
+            cnt += 1
+        losses.append(tot / cnt)
+    assert losses[-1] < losses[0] * 0.6, losses
+    ppl = np.exp(losses[-1])
+    assert ppl < np.exp(losses[0]), (ppl, losses)
