@@ -426,9 +426,12 @@ class HybridBlock(Block):
         jit_fwd = jax.jit(fun)
 
         def bwd(pf, rng, inputs, cts):
-            _, vjp_fn = jax.vjp(
+            from ..ops.registry import _match_ct_dtypes
+
+            outs, vjp_fn = jax.vjp(
                 lambda pf_, *ins: fun(pf_, rng, *ins)[0], list(pf), *inputs)
-            grads = vjp_fn(tuple(cts))
+            # under AMP a bf16 block output can receive an fp32 cotangent
+            grads = vjp_fn(_match_ct_dtypes(tuple(cts), tuple(outs)))
             return grads  # (pf_grads_list, *input_grads)
 
         jit_bwd = jax.jit(bwd)

@@ -374,11 +374,13 @@ class ResidualCell(ModifierCell):
         self.base_cell._modified = False
         outputs, states = self.base_cell.unroll(
             length, inputs, begin_state=begin_state, layout=layout,
-            merge_outputs=True, valid_length=valid_length)
+            merge_outputs=merge_outputs, valid_length=valid_length)
         self.base_cell._modified = True
         seq, axis, _ = _format_sequence(length, inputs, layout, True)
-        merged_in = _merge_outputs(seq, axis)
-        outputs = outputs + merged_in
+        if isinstance(outputs, list):
+            outputs = [o + s for o, s in zip(outputs, seq)]
+        else:
+            outputs = outputs + _merge_outputs(seq, axis)
         return outputs, states
 
 

@@ -61,7 +61,9 @@ class BucketingModule(BaseModule):
                                label_shapes)
             return
         # rebind invalidates every bucket executor: stale modules alias the
-        # OLD default executor's arrays (reference _reset_bind)
+        # OLD default executor's arrays (reference _reset_bind). Trained
+        # values survive the rebind (reference round-trips get/set_params).
+        saved_params = self.get_params() if self.params_initialized else None
         self._buckets = {}
         self.params_initialized = False
         self.optimizer_initialized = False
@@ -73,6 +75,10 @@ class BucketingModule(BaseModule):
         self._curr_bucket_key = self._default_bucket_key
         self.binded = True
         self.for_training = for_training
+        if saved_params is not None:
+            arg, aux = saved_params
+            mod.init_params(arg_params=arg, aux_params=aux, force_init=True)
+            self.params_initialized = True
         self._bind_args = dict(for_training=for_training,
                                inputs_need_grad=inputs_need_grad,
                                grad_req=grad_req)

@@ -26,6 +26,27 @@ __all__ = ["OpDef", "register", "get_op", "invoke", "OPS", "apply_op"]
 
 OPS = Registry("operator")
 
+# AMP dispatch hook (contrib/amp/amp.py): fn(op_name, arr_list) -> arr_list,
+# applied to unwrapped jax arrays before dispatch. The reference instead
+# monkey-patches every generated op wrapper (contrib/amp/amp.py:48-140);
+# here ONE choke point covers eager, hybridized, and symbolic execution.
+AMP_HOOK = None
+
+
+def _match_ct_dtypes(cts, out):
+    """Cast cotangents to the primal outputs' dtypes — under AMP a bf16
+    op output can receive an fp32 cotangent from a downstream fp32 op."""
+    import jax.numpy as jnp
+
+    def _one(ct, o):
+        if hasattr(ct, "dtype") and hasattr(o, "dtype") and ct.dtype != o.dtype:
+            return ct.astype(o.dtype)
+        return ct
+
+    if isinstance(out, (tuple, list)):
+        return tuple(_one(c, o) for c, o in zip(cts, out))
+    return _one(cts, out)
+
 
 def _hashable(v):
     if isinstance(v, (list,)):
@@ -78,8 +99,8 @@ class OpDef:
                     return self.fn(*xs, **_p)
 
             def bwd(cts, *primals):
-                _, vjp_fn = jax.vjp(fwd, *primals)
-                return vjp_fn(cts)
+                out, vjp_fn = jax.vjp(fwd, *primals)
+                return vjp_fn(_match_ct_dtypes(cts, out))
 
             f = jax.jit(bwd)
             self._jit_cache[key] = f
@@ -156,6 +177,9 @@ def apply_op(op: OpDef, *args, out=None, **params):
         else:
             arrs.append(a)
 
+    if AMP_HOOK is not None:
+        arrs = AMP_HOOK(op.name, arrs, params)
+
     if op.train_aware and params.get("training") is None:
         params = dict(params)
         params["training"] = autograd.is_training()
@@ -185,7 +209,9 @@ def apply_op(op: OpDef, *args, out=None, **params):
 
     if recording and traced:
         # inside an outer trace the vjp is part of that trace; no caching issue
-        out_data, vjp_fn = jax.vjp(fn, *arrs)
+        out_data, _raw_vjp = jax.vjp(fn, *arrs)
+        vjp_fn = lambda cts, _v=_raw_vjp, _o=out_data: \
+            _v(_match_ct_dtypes(cts, _o))
     else:
         out_data = fn(*arrs)
         vjp_fn = None

@@ -514,6 +514,8 @@ class Symbol:
                     continue
                 ins = [cache[id(inp)][oi] for inp, oi in node.inputs]
                 kwargs = dict(node.attrs)
+                if _registry.AMP_HOOK is not None:
+                    ins = _registry.AMP_HOOK(node.op.name, ins, kwargs)
                 if node.op.train_aware:
                     kwargs.setdefault("training", training)
                 if node.op.stateful:

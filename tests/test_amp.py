@@ -1,0 +1,156 @@
+"""AMP tests (reference: tests/python/gpu/test_contrib_amp.py shape)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.contrib import amp
+from incubator_mxnet_tpu.contrib.amp.amp import _off
+from incubator_mxnet_tpu.gluon import nn
+
+
+@pytest.fixture(autouse=True)
+def _amp_cleanup():
+    yield
+    _off()
+
+
+def test_amp_casts_flop_heavy_ops_to_bf16():
+    amp.init("bfloat16")
+    x = nd.array(np.random.rand(4, 8).astype(np.float32))
+    w = nd.array(np.random.rand(16, 8).astype(np.float32))
+    out = nd.FullyConnected(x, w, None, num_hidden=16, no_bias=True)
+    assert "bfloat16" in str(out.dtype)
+
+
+def test_amp_keeps_sensitive_ops_fp32():
+    amp.init("bfloat16")
+    x = nd.array(np.random.rand(4, 8).astype(np.float32)).astype("bfloat16")
+    out = nd.softmax(x)
+    assert out.dtype == np.float32
+
+
+def test_amp_widest_promotion():
+    amp.init("bfloat16")
+    a = nd.array(np.random.rand(3, 3).astype(np.float32)).astype("bfloat16")
+    b = nd.array(np.random.rand(3, 3).astype(np.float32))
+    out = nd.broadcast_add(a, b)
+    assert out.dtype == np.float32
+
+
+def test_amp_training_converges():
+    """MLP trains under AMP with scaled loss (reference: train_dtype fp16
+    convergence tests)."""
+    amp.init("bfloat16")
+    rs = np.random.RandomState(0)
+    X = rs.normal(0, 1, (256, 16)).astype(np.float32)
+    W = rs.normal(0, 1, (16, 3)).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.5})
+    amp.init_trainer(trainer)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    correct = 0
+    for epoch in range(30):
+        correct = 0
+        for i in range(0, 256, 64):
+            x, y = nd.array(X[i:i + 64]), nd.array(Y[i:i + 64])
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+                with amp.scale_loss(loss, trainer) as scaled:
+                    scaled.backward()
+            trainer.step(64)
+            correct += int((out.asnumpy().argmax(1) == Y[i:i + 64]).sum())
+    assert correct / 256 > 0.9, correct / 256
+
+
+def test_amp_training_hybridized():
+    """The cached fwd/bwd executables must accept fp32 cotangents against
+    bf16 block outputs (regression: cached-backward dtype mismatch)."""
+    amp.init("bfloat16")
+    rs = np.random.RandomState(1)
+    X = rs.normal(0, 1, (128, 8)).astype(np.float32)
+    Y = (X.sum(1) > 0).astype(np.float32)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.3})
+    amp.init_trainer(trainer)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    for i in range(0, 128, 32):
+        x, y = nd.array(X[i:i + 32]), nd.array(Y[i:i + 32])
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+            with amp.scale_loss(loss, trainer) as scaled:
+                scaled.backward()
+        trainer.step(32)
+    # a step happened (weights moved)
+    assert trainer._amp_loss_scaler is not None
+
+
+def test_loss_scaler_overflow_skips_and_halves():
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    amp.init_trainer(trainer, init_scale=2.0 ** 8)
+    x = nd.array(np.random.rand(2, 4).astype(np.float32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    # poison the gradient with inf
+    w = net.weight
+    g = w.grad()
+    g._data = g._data.at[0, 0].set(np.inf)
+    w_before = w.data().asnumpy().copy()
+    scale_before = trainer._amp_loss_scaler.loss_scale
+    trainer.step(2)
+    np.testing.assert_allclose(w.data().asnumpy(), w_before)  # skipped
+    assert trainer._amp_loss_scaler.loss_scale == scale_before / 2
+
+    # clean gradient -> update applies
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)
+    assert not np.allclose(w.data().asnumpy(), w_before)
+
+
+def test_convert_hybrid_block():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.Flatten(), nn.Dense(3))
+    net.initialize()
+    x = nd.array(np.random.rand(2, 2, 8, 8).astype(np.float32))
+    net(x)  # materialize deferred shapes
+    net2 = amp.convert_hybrid_block(net, "bfloat16")
+    out = net2(x)
+    # conv weight is bf16, BN gamma stays fp32
+    convw = [p for n, p in net2.collect_params().items()
+             if n.endswith("weight") and "conv" in n][0]
+    gammas = [p for n, p in net2.collect_params().items()
+              if n.endswith("gamma")]
+    assert "bfloat16" in str(convw.data().dtype)
+    assert gammas[0].data().dtype == np.float32
+    assert out.shape == (2, 3)
+
+
+def test_convert_model_symbolic():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.softmax(net)
+    arg_shapes, _, _ = net.infer_shape(data=(2, 4))
+    # arg_params from a checkpoint hold weights only, never the data input
+    args = {n: nd.zeros(s) for n, s in
+            zip(net.list_arguments(), arg_shapes) if n != "data"}
+    sym2, args2, _ = amp.convert_model(net, args, {}, "bfloat16")
+    assert "bfloat16" in str(args2["fc1_weight"].dtype)
+    assert "bfloat16" in str(args2["fc1_bias"].dtype)
