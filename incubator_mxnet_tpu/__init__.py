@@ -95,7 +95,8 @@ def __getattr__(name):
         "runtime": ".runtime",
         "parallel": ".parallel",
         "models": ".models",
-        "utils": ".utils",
+        "util": ".util",
+        "utils": ".util",
         "test_utils": ".test_utils",
         "visualization": ".visualization",
         "viz": ".visualization",

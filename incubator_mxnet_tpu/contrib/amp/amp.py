@@ -83,10 +83,12 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
     CONDITIONAL_FP32_FUNCS)."""
     global _LOW_SET, _FP32_SET, _COND_FP32
     tgt = dtype_np(target_dtype)
-    if target_precision_ops is not None:
-        _LOW_SET = frozenset(target_precision_ops)
-    if fp32_ops is not None:
-        _FP32_SET = frozenset(fp32_ops)
+    # each init starts from the defaults — custom lists never leak across
+    # inits (or tests)
+    _LOW_SET = frozenset(target_precision_ops) \
+        if target_precision_ops is not None else frozenset(LOW_PRECISION_OPS)
+    _FP32_SET = frozenset(fp32_ops) if fp32_ops is not None \
+        else frozenset(FP32_OPS)
     _COND_FP32 = {}
     for entry in (conditional_fp32_ops or []):
         op_name, pname, values = entry
@@ -141,7 +143,25 @@ def init_trainer(trainer, init_scale=2.0 ** 16):
         self.allreduce_grads()
         self._update(ignore_stale_grad)
 
+    def amp_update(self, batch_size, ignore_stale_grad=False):
+        # same overflow-skip + unscale semantics for the no-allreduce path
+        scaler_ = self._amp_loss_scaler
+        overflow = scaler_.has_overflow(self._params)
+        scaler_.update_scale(overflow)
+        if overflow:
+            self._amp_unscaled = False
+            logging.info("AMP: overflow, skipping update; loss scale -> %g",
+                         scaler_.loss_scale)
+            return
+        scale = 1.0 if self._amp_unscaled else scaler_.loss_scale
+        self._amp_unscaled = False
+        self._optimizer.rescale_grad = self._scale / (batch_size * scale)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._update(ignore_stale_grad)
+
     trainer.step = types.MethodType(amp_step, trainer)
+    trainer.update = types.MethodType(amp_update, trainer)
     return trainer
 
 

@@ -179,8 +179,13 @@ class Module(BaseModule):
         """Reference module.py:474 + model._create_kvstore."""
         if self.optimizer_initialized and not force_init:
             return
-        optimizer_params = optimizer_params or {"learning_rate": 0.01}
+        optimizer_params = dict(optimizer_params or {"learning_rate": 0.01})
         if isinstance(optimizer, str):
+            # reference module.py:498: default rescale_grad = 1/batch_size
+            # (SoftmaxOutput's default normalization sums over the batch)
+            if "rescale_grad" not in optimizer_params and self.binded:
+                batch = self._data_shapes[0][1][0]
+                optimizer_params["rescale_grad"] = 1.0 / batch
             optimizer = _opt.create(optimizer, **optimizer_params)
         self._optimizer = optimizer
         self._updater = _opt.get_updater(optimizer)

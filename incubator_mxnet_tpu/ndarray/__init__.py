@@ -20,6 +20,7 @@ from ..ops import nn_ops as _nn_ops  # noqa: F401
 from ..ops import random_ops as _random_ops  # noqa: F401
 from ..ops import optimizer_ops as _optimizer_ops  # noqa: F401
 from ..ops import rnn_ops as _rnn_ops  # noqa: F401
+from ..ops import quantization_ops as _quantization_ops  # noqa: F401
 
 
 def _make_wrapper(opdef):

@@ -1,0 +1,163 @@
+"""INT8 quantization operators.
+
+Reference: src/operator/quantization/ (5,622 LoC): quantize(_v2)/
+dequantize/requantize + quantized conv/FC with int8 inputs and int32
+accumulation. TPU-native: int8 matmul/conv lower to the MXU via
+lax.dot_general/conv with preferred_element_type=int32 — the same
+int8-in/int32-accum contract cuDNN/MKLDNN give the reference.
+Affine scheme matches the reference: symmetric int8 ([-127, 127], zero
+point 0) and asymmetric uint8 ([0, 255]).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+__all__ = []
+
+
+def _ranges(out_type):
+    if out_type == "int8":
+        return -127.0, 127.0
+    if out_type == "uint8":
+        return 0.0, 255.0
+    raise MXNetError(f"unsupported quantized dtype {out_type!r}")
+
+
+@register(name="_contrib_quantize_v2", aliases=("quantize_v2",),
+          nondiff=True)
+def quantize_v2(data, *, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """Reference quantize_v2-inl.h: affine-quantize fp32 -> int8/uint8
+    with calibrated (or on-the-fly) ranges. Returns (qdata, min, max)."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx_ = jnp.max(data).astype(jnp.float32)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx_ = jnp.float32(max_calib_range)
+    qmin, qmax = _ranges(out_type)
+    if out_type == "int8":
+        # symmetric: scale by max(|min|, |max|) (reference
+        # quantize_v2 QuantizeToInt8)
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        scale = qmax / jnp.maximum(amax, 1e-30)
+        q = jnp.clip(jnp.round(data * scale), qmin, qmax).astype(jnp.int8)
+        return q, -amax, amax
+    scale = (qmax - qmin) / jnp.maximum(mx_ - mn, 1e-30)
+    q = jnp.clip(jnp.round((data - mn) * scale), qmin, qmax).astype(jnp.uint8)
+    return q, mn, mx_
+
+
+@register(name="_contrib_quantize", aliases=("quantize",), nondiff=True)
+def quantize(data, min_range, max_range, *, out_type="uint8"):
+    """Reference quantize-inl.h (explicit range arrays). Range inputs stay
+    traced — this op runs jitted."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx_ = jnp.asarray(max_range, jnp.float32).reshape(())
+    qmin, qmax = _ranges(out_type)
+    if out_type == "int8":
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        scale = qmax / jnp.maximum(amax, 1e-30)
+        q = jnp.clip(jnp.round(data * scale), qmin, qmax).astype(jnp.int8)
+        return q, -amax, amax
+    scale = (qmax - qmin) / jnp.maximum(mx_ - mn, 1e-30)
+    q = jnp.clip(jnp.round((data - mn) * scale), qmin, qmax).astype(jnp.uint8)
+    return q, mn, mx_
+
+
+@register(name="_contrib_dequantize", aliases=("dequantize",), nondiff=True)
+def dequantize(qdata, min_range, max_range, *, out_type="float32"):
+    """Reference dequantize-inl.h."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx_ = jnp.asarray(max_range, jnp.float32).reshape(())
+    if qdata.dtype == jnp.int8:
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        return qdata.astype(jnp.float32) * (amax / 127.0)
+    if qdata.dtype == jnp.int32:
+        # int32 accumulator from quantized_conv/FC: full-scale mapping
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        return qdata.astype(jnp.float32) * (amax / 2147483647.0)
+    scale = (mx_ - mn) / 255.0
+    return qdata.astype(jnp.float32) * scale + mn
+
+
+@register(name="_contrib_requantize", aliases=("requantize",), nondiff=True)
+def requantize(qdata, min_range, max_range, *, out_type="int8",
+               min_calib_range=None, max_calib_range=None):
+    """int32 accumulator -> int8 (reference requantize-inl.h)."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx_ = jnp.asarray(max_range, jnp.float32).reshape(())
+    real = qdata.astype(jnp.float32) * \
+        (jnp.maximum(jnp.abs(mn), jnp.abs(mx_)) / 2147483647.0)
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = max(abs(min_calib_range), abs(max_calib_range))
+    else:
+        amax = jnp.max(jnp.abs(real))
+    q = jnp.clip(jnp.round(real * (127.0 / jnp.maximum(amax, 1e-30))),
+                 -127, 127).astype(jnp.int8)
+    return q, -jnp.asarray(amax, jnp.float32), jnp.asarray(amax, jnp.float32)
+
+
+@register(name="_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",), nondiff=True)
+def quantized_fully_connected(data, weight, bias, data_min, data_max,
+                              weight_min, weight_max, bias_min=None,
+                              bias_max=None, *, num_hidden=0, no_bias=False,
+                              flatten=True):
+    """int8 x int8 -> int32 matmul on the MXU (reference
+    quantized_fully_connected.cc). Returns (out_i32, out_min, out_max)."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = jnp.reshape(x, (x.shape[0], -1))
+    out = lax.dot_general(x, weight,
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    d_amax = jnp.maximum(jnp.abs(data_min), jnp.abs(data_max)).reshape(())
+    w_amax = jnp.maximum(jnp.abs(weight_min), jnp.abs(weight_max)).reshape(())
+    out_amax = d_amax * w_amax * (2147483647.0 / (127.0 * 127.0))
+    if bias is not None and not no_bias:
+        b_amax = jnp.maximum(jnp.abs(bias_min), jnp.abs(bias_max)).reshape(())
+        # rescale bias into the output's int32 scale
+        b_real = bias.astype(jnp.float32) * (b_amax / 127.0)
+        scale = 2147483647.0 / jnp.maximum(out_amax, 1e-30)
+        out = out + jnp.round(b_real * scale).astype(jnp.int32)
+    return out, -out_amax, out_amax
+
+
+@register(name="_contrib_quantized_conv", aliases=("quantized_conv",),
+          nondiff=True)
+def quantized_conv(data, weight, bias, data_min, data_max, weight_min,
+                   weight_max, bias_min=None, bias_max=None, *, kernel,
+                   stride=(), dilate=(), pad=(), num_filter=0, num_group=1,
+                   no_bias=False, layout=None, workspace=1024,
+                   cudnn_tune=None, cudnn_off=False):
+    """int8 convolution with int32 accumulation (reference
+    quantized_conv.cc). NCHW, weight OIHW like the fp op."""
+    nd_ = len(kernel)
+    stride = tuple(stride) or (1,) * nd_
+    dilate = tuple(dilate) or (1,) * nd_
+    pad = tuple(pad) or (0,) * nd_
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW") if nd_ == 2 else
+                                    ("NCW", "OIW", "NCW"))
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    d_amax = jnp.maximum(jnp.abs(data_min), jnp.abs(data_max)).reshape(())
+    w_amax = jnp.maximum(jnp.abs(weight_min), jnp.abs(weight_max)).reshape(())
+    out_amax = d_amax * w_amax * (2147483647.0 / (127.0 * 127.0))
+    if bias is not None and not no_bias:
+        b_amax = jnp.maximum(jnp.abs(bias_min), jnp.abs(bias_max)).reshape(())
+        b_real = bias.astype(jnp.float32) * (b_amax / 127.0)
+        scale = 2147483647.0 / jnp.maximum(out_amax, 1e-30)
+        out = out + jnp.round(b_real * scale).astype(jnp.int32).reshape(
+            (1, -1) + (1,) * nd_)
+    return out, -out_amax, out_amax

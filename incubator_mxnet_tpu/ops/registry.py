@@ -26,11 +26,17 @@ __all__ = ["OpDef", "register", "get_op", "invoke", "OPS", "apply_op"]
 
 OPS = Registry("operator")
 
-# AMP dispatch hook (contrib/amp/amp.py): fn(op_name, arr_list) -> arr_list,
-# applied to unwrapped jax arrays before dispatch. The reference instead
-# monkey-patches every generated op wrapper (contrib/amp/amp.py:48-140);
-# here ONE choke point covers eager, hybridized, and symbolic execution.
+# AMP dispatch hook (contrib/amp/amp.py): fn(op_name, arr_list, params) ->
+# arr_list, applied to unwrapped jax arrays before dispatch. The reference
+# instead monkey-patches every generated op wrapper (contrib/amp/amp.py:
+# 48-140); here ONE choke point covers eager, hybridized, and symbolic
+# execution.
 AMP_HOOK = None
+
+# Profiler dispatch hook (profiler.py): fn(op_name, callable, args) -> out,
+# times eager op dispatch (the reference wraps engine-op execution,
+# src/profiler/profiler.h:251).
+PROFILER_HOOK = None
 
 
 def _match_ct_dtypes(cts, out):
@@ -213,7 +219,10 @@ def apply_op(op: OpDef, *args, out=None, **params):
         vjp_fn = lambda cts, _v=_raw_vjp, _o=out_data: \
             _v(_match_ct_dtypes(cts, _o))
     else:
-        out_data = fn(*arrs)
+        if PROFILER_HOOK is not None and not traced:
+            out_data = PROFILER_HOOK(op.name, fn, arrs)
+        else:
+            out_data = fn(*arrs)
         vjp_fn = None
         if recording:
             # deferred, jit-cached backward (recomputes forward in-executable)

@@ -18,7 +18,9 @@ def _mlp_sym(num_hidden=32, classes=4):
     net = sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
     net = sym.Activation(net, act_type="relu", name="relu1")
     net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
-    return sym.SoftmaxOutput(net, name="softmax", normalization="batch")
+    # default normalization (sum over batch) + Module's rescale_grad =
+    # 1/batch_size — the reference pairing (module.py:498)
+    return sym.SoftmaxOutput(net, name="softmax")
 
 
 def _toy_data(n=256, dim=20, classes=4, seed=0):

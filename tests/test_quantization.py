@@ -1,0 +1,101 @@
+"""INT8 quantization tests (reference: tests/python/quantization/
+test_quantization.py shape)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib import quantization as q
+from incubator_mxnet_tpu.io import NDArrayIter
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    x = np.random.uniform(-3, 3, (4, 8)).astype(np.float32)
+    qd, mn, mx_ = nd.quantize_v2(nd.array(x), out_type="int8")
+    assert qd.dtype == np.int8
+    back = nd.dequantize(qd, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x, atol=3.0 / 127 + 1e-3)
+
+
+def test_quantize_uint8():
+    x = np.random.uniform(0, 5, (4, 8)).astype(np.float32)
+    qd, mn, mx_ = nd.quantize_v2(nd.array(x), out_type="uint8")
+    assert qd.dtype == np.uint8
+    back = nd.dequantize(qd, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x, atol=5.0 / 255 + 1e-3)
+
+
+def test_quantized_fc_matches_fp32():
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-1, 1, (8, 16)).astype(np.float32)
+    w = rs.uniform(-1, 1, (4, 16)).astype(np.float32)
+    want = x @ w.T
+    qx, xmn, xmx = nd.quantize_v2(nd.array(x), out_type="int8")
+    qw, wmn, wmx = nd.quantize_v2(nd.array(w), out_type="int8")
+    qout, omn, omx = nd.quantized_fully_connected(
+        qx, qw, None, xmn, xmx, wmn, wmx, num_hidden=4, no_bias=True)
+    assert qout.dtype == np.int32
+    got = nd.dequantize(qout, omn, omx).asnumpy()
+    # int8 quantization error ~ 1/127 per operand over 16-term dots
+    np.testing.assert_allclose(got, want, atol=0.35, rtol=0.1)
+
+
+def test_quantized_conv_matches_fp32():
+    rs = np.random.RandomState(1)
+    x = rs.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32)
+    w = rs.uniform(-1, 1, (4, 3, 3, 3)).astype(np.float32)
+    want = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                          num_filter=4, no_bias=True).asnumpy()
+    qx, xmn, xmx = nd.quantize_v2(nd.array(x), out_type="int8")
+    qw, wmn, wmx = nd.quantize_v2(nd.array(w), out_type="int8")
+    qout, omn, omx = nd.quantized_conv(qx, qw, None, xmn, xmx, wmn, wmx,
+                                       kernel=(3, 3), num_filter=4,
+                                       no_bias=True)
+    got = nd.dequantize(qout, omn, omx).asnumpy()
+    np.testing.assert_allclose(got, want, atol=0.6, rtol=0.12)
+
+
+@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+def test_quantize_model_end_to_end(calib_mode):
+    """Quantized MLP keeps classification behavior (reference
+    test_quantization.py quantize_model cases)."""
+    rs = np.random.RandomState(0)
+    X = rs.normal(0, 1, (128, 20)).astype(np.float32)
+    W = rs.normal(0, 1, (20, 4)).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    # train fp32 briefly
+    train = NDArrayIter({"data": X}, {"softmax_label": Y}, batch_size=32)
+    mod = mx.mod.Module(net)
+    mod.fit(train, num_epoch=20, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    arg, aux = mod.get_params()
+    fp32_acc = dict(mod.score(train, "acc"))["accuracy"]
+
+    calib = NDArrayIter({"data": X}, {"softmax_label": Y}, batch_size=32)
+    qsym, qarg, qaux = q.quantize_model(
+        net, arg, aux, calib_mode=calib_mode, calib_data=calib,
+        num_calib_examples=64)
+    assert any("quantized_" in n for n in
+               (node.name for node in
+                __import__("incubator_mxnet_tpu").symbol.symbol._topo(
+                    qsym._outputs)))
+
+    ex = qsym.simple_bind(mx.cpu(), data=(128, 20), softmax_label=(128,))
+    ex.copy_params_from(qarg, qaux, allow_extra_params=True)
+    out = ex.forward(data=X, softmax_label=Y)[0].asnumpy()
+    q_acc = (out.argmax(1) == Y).mean()
+    assert q_acc >= fp32_acc - 0.1, (q_acc, fp32_acc)
+
+
+def test_quantize_graph_excluded():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    qsym = q.quantize_graph(net, excluded_sym_names=["fc1"])
+    assert qsym is net  # nothing to rewrite
