@@ -23,48 +23,53 @@ __all__ = ["quantize_model", "quantize_graph", "_calibrate_quantized_sym"]
 _QUANTIZABLE = {"FullyConnected", "Convolution"}
 
 
-def _optimal_threshold_kl(arr, quantized_dtype="int8", num_bins=8001,
-                          num_quantized_bins=255):
-    """KL-divergence threshold search (reference quantization.py
-    _get_optimal_threshold / LayerHistogramCollector.combine)."""
+def _optimal_threshold_kl(arr, quantized_dtype="int8", num_bins=2048,
+                          num_quantized_bins=128):
+    """KL-divergence-optimal clipping threshold over the |x| histogram
+    (the algorithm behind the reference's entropy mode, quantization.py
+    _get_optimal_threshold; smoothing per the standard TensorRT-style
+    calibration so sparse histograms don't collapse to tiny thresholds)."""
     arr = _np.asarray(arr, dtype=_np.float64).ravel()
     arr = arr[_np.isfinite(arr)]
     if arr.size == 0:
         return 1e-8
-    amax = float(_np.abs(arr).max())
+    mag = _np.abs(arr)
+    amax = float(mag.max())
     if amax < 1e-12:
         return 1e-8
-    hist, edges = _np.histogram(arr, bins=num_bins, range=(-amax, amax))
-    zero_bin = num_bins // 2
+    hist, edges = _np.histogram(mag, bins=num_bins, range=(0.0, amax))
+    hist = hist.astype(_np.float64)
+    eps = 1e-10
     best_div, best_t = None, amax
-    # sweep candidate thresholds outward from the center
-    for i in range(num_quantized_bins // 2 + 1, num_bins // 2 + 1, 32):
-        p_start, p_stop = zero_bin - i, zero_bin + i + 1
-        sliced = hist[p_start:p_stop].astype(_np.float64)
-        p = sliced.copy()
-        # outliers clamp into the edge bins
-        p[0] += hist[:p_start].sum()
-        p[-1] += hist[p_stop:].sum()
-        # quantize p into num_quantized_bins then expand back
-        factor = len(sliced) / num_quantized_bins
-        q = _np.zeros_like(p)
-        for j in range(num_quantized_bins):
-            lo = int(j * factor)
-            hi = int((j + 1) * factor) if j < num_quantized_bins - 1 \
-                else len(sliced)
-            seg = sliced[lo:hi]
-            nz = (seg != 0).sum()
-            if nz:
-                q[lo:hi] = _np.where(seg != 0, seg.sum() / nz, 0)
-        p_sum, q_sum = p.sum(), q.sum()
-        if p_sum <= 0 or q_sum <= 0:
+    stride = max(1, num_bins // 512)
+    for i in range(num_quantized_bins, num_bins + 1, stride):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # clip outliers into the last kept bin
+        if p.sum() <= 0:
             continue
-        p_n, q_n = p / p_sum, q / q_sum
-        mask = (p_n > 0) & (q_n > 0)
-        div = float(_np.sum(p_n[mask] * _np.log(p_n[mask] / q_n[mask])))
-        t = (i + 0.5) * (2 * amax / num_bins)
+        # quantize kept bins into num_quantized_bins, expand back over the
+        # nonzero support only
+        factor = i / num_quantized_bins
+        q = _np.zeros(i)
+        for j in range(num_quantized_bins):
+            lo = int(_np.floor(j * factor))
+            hi = int(_np.ceil((j + 1) * factor)) if j < num_quantized_bins - 1 \
+                else i
+            seg = hist[lo:hi]
+            nz = seg != 0
+            n_nz = int(nz.sum())
+            if n_nz:
+                q[lo:hi][nz] = seg[nz].sum() / n_nz
+        p_n = p / p.sum()
+        q_sum = q.sum()
+        if q_sum <= 0:
+            continue
+        q_n = q / q_sum
+        mask = p_n > 0
+        div = float(_np.sum(p_n[mask] *
+                            _np.log(p_n[mask] / (q_n[mask] + eps))))
         if best_div is None or div < best_div:
-            best_div, best_t = div, t
+            best_div, best_t = div, float(edges[i])
     return best_t
 
 
