@@ -179,13 +179,34 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 _accum(cot, keep, inp, ict)
 
     # write into .grad buffers per grad_req
+    from .ndarray.sparse import RowSparseNDArray, row_sparse_combine
     for arr_id, (arr, g) in keep.items():
         req = getattr(arr, "_grad_req", None)
         if req in (None, "null"):
             continue
         if arr._grad is None:
             continue
-        if req == "add":
+        buf_sparse = isinstance(arr._grad, RowSparseNDArray)
+        if isinstance(g, RowSparseNDArray):
+            if buf_sparse:
+                arr._grad = g if req != "add" else \
+                    row_sparse_combine(arr._grad, g)
+            elif req == "add":
+                # dense buffer keeps its identity (mark_variables aliasing)
+                arr._grad._data = arr._grad._data + g.todense()._data
+            else:
+                arr._grad._data = g.todense()._data.astype(
+                    arr._grad._data.dtype)
+        elif buf_sparse:
+            # dense cotangent into a row_sparse buffer (e.g. a hybridized
+            # step after eager sparse steps): buffer stays row_sparse
+            from .ndarray.sparse import cast_storage
+            from .ndarray import NDArray as _ND
+            dense_g = _ND(jnp.asarray(g))
+            rs = cast_storage(dense_g, "row_sparse")
+            arr._grad = rs if req != "add" else \
+                row_sparse_combine(arr._grad, rs)
+        elif req == "add":
             arr._grad._data = arr._grad._data + g
         else:
             arr._grad._data = jnp.asarray(g, arr._grad.dtype)
@@ -200,11 +221,24 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 def _accum(cot, keep, arr, g):
     k = id(arr)
     if k in cot:
-        cot[k] = cot[k] + g
+        cot[k] = _add_ct(cot[k], g)
     else:
         cot[k] = g
     if getattr(arr, "_grad", None) is not None:
         keep[k] = (arr, cot[k])
+
+
+def _add_ct(a, b):
+    """Cotangent addition incl. row_sparse + row_sparse/dense mixes."""
+    from .ndarray.sparse import RowSparseNDArray, row_sparse_combine
+
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        return row_sparse_combine(a, b)
+    if isinstance(a, RowSparseNDArray):
+        return a.todense()._data + b
+    if isinstance(b, RowSparseNDArray):
+        return a + b.todense()._data
+    return a + b
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,

@@ -192,13 +192,25 @@ class Embedding(HybridBlock):
         super().__init__(**kwargs)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
         self.weight = self.params.get("weight", shape=(input_dim, output_dim),
-                                      init=weight_initializer, dtype=dtype)
+                                      init=weight_initializer, dtype=dtype,
+                                      grad_stype="row_sparse" if sparse_grad
+                                      else "default")
         self._reg_params["weight"] = self.weight
 
     def hybrid_forward(self, F, x, weight):
+        from ..block import _TraceScope
+        if self._sparse_grad and F is nd and autograd.is_recording() \
+                and not _TraceScope.active():
+            # eager-only: under hybridize the whole step is one XLA program
+            # and a dense scatter-add grad is what the compiler fuses best
+            from ...ndarray.sparse import sparse_embedding
+            return sparse_embedding(x, weight, self._input_dim,
+                                    self._output_dim)
         return F.Embedding(x, weight, input_dim=self._input_dim,
-                           output_dim=self._output_dim)
+                           output_dim=self._output_dim,
+                           sparse_grad=self._sparse_grad)
 
 
 class Flatten(HybridBlock):

@@ -45,6 +45,8 @@ class Parameter:
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self._differentiable = differentiable
+        self._stype = stype
+        self._grad_stype = grad_stype
         self._data = None
         self._deferred_init = None  # (init, ctx, default_init)
         self._sharding = None  # optional jax.sharding spec (set by parallel/)
@@ -62,7 +64,7 @@ class Parameter:
                 self._data._grad = None
                 self._data._grad_req = "null"
             else:
-                self._data.attach_grad(req)
+                self._data.attach_grad(req, stype=self._grad_stype)
 
     def __repr__(self):
         return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
@@ -102,7 +104,7 @@ class Parameter:
         self._data = arr
         self._deferred_init = None
         if self._grad_req != "null":
-            self._data.attach_grad(self._grad_req)
+            self._data.attach_grad(self._grad_req, stype=self._grad_stype)
 
     def _finish_deferred_init(self):
         if self._deferred_init is None:
@@ -185,7 +187,8 @@ class Parameter:
             had_grad = self._data._grad is not None
             self._data = self._data.astype(dtype)
             if had_grad:
-                self._data.attach_grad(self._grad_req)
+                self._data.attach_grad(self._grad_req,
+                                       stype=self._grad_stype)
 
     def var(self):
         from .. import symbol

@@ -95,7 +95,16 @@ class Trainer:
         if self._kvstore is not None:
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
-                    self._kvstore.pushpull(i, p.grad(), out=p.grad())
+                    g = p.grad()
+                    if getattr(g, "stype", "default") == "row_sparse":
+                        # the kvstore reduce path is dense; densify for the
+                        # collective and keep the dense result (the lazy
+                        # single-process path never reaches here)
+                        dense = g.todense()
+                        self._kvstore.pushpull(i, dense, out=dense)
+                        p.data()._grad = dense
+                    else:
+                        self._kvstore.pushpull(i, g, out=g)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -12,6 +12,8 @@ from . import random
 from .ndarray import (NDArray, arange, array, concatenate, empty, eye, from_jax,
                       full, linspace, moveaxis, ones, waitall, zeros)
 from .utils import load, save
+from . import sparse
+from .sparse import cast_storage
 
 # trigger op registration
 from ..ops import registry as _registry

@@ -196,9 +196,16 @@ class NDArray:
 
     # ---- autograd ---------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
-        """Reference python/mxnet/ndarray/ndarray.py attach_grad."""
-        jnp = _jnp()
-        self._grad = NDArray(jnp.zeros(self.shape, self.dtype))
+        """Reference python/mxnet/ndarray/ndarray.py attach_grad. With
+        stype='row_sparse' the grad buffer starts as an empty row-sparse
+        array (Embedding sparse_grad path)."""
+        if stype == "row_sparse":
+            from .sparse import zeros as sparse_zeros
+            self._grad = sparse_zeros("row_sparse", self.shape,
+                                      dtype=self.dtype)
+        else:
+            jnp = _jnp()
+            self._grad = NDArray(jnp.zeros(self.shape, self.dtype))
         self._grad_req = grad_req
 
     def backward(self, out_grad=None, retain_graph=False, train_mode=True):
@@ -208,7 +215,22 @@ class NDArray:
     def zero_grad(self):
         if self._grad is not None:
             jnp = _jnp()
-            self._grad._data = jnp.zeros(self._grad.shape, self._grad.dtype)
+            if getattr(self._grad, "stype", "default") != "default":
+                # a row_sparse grad buffer resets to a fresh dense zero
+                self._grad = NDArray(jnp.zeros(self.shape, self.dtype))
+            else:
+                self._grad._data = jnp.zeros(self._grad.shape,
+                                             self._grad.dtype)
+
+    @property
+    def stype(self):
+        """Storage type (reference ndarray.h:61-66); dense arrays are
+        'default', see ndarray/sparse.py for row_sparse/csr."""
+        return "default"
+
+    def tostype(self, stype):
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
 
     # ---- indexing ---------------------------------------------------------
     def _index_data(self, key):

@@ -29,6 +29,56 @@ __all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "FTML", "NAG", "SGLD",
 _REG = Registry("optimizer")
 
 
+def _is_row_sparse(grad):
+    return getattr(grad, "stype", "default") == "row_sparse"
+
+
+def _sparse_sgd_update(weight, grad, state, lr, wd, momentum, rescale,
+                       clip):
+    """Lazy row_sparse SGD (reference optimizer_op.cc SGDUpdateRsp): only
+    rows present in the gradient are touched — weight, momentum, AND the
+    fp32 master copy in multi-precision mode."""
+    import jax.numpy as jnp
+
+    idx = grad.indices._data
+    mom, w32 = (state if isinstance(state, tuple) else (state, None))
+    # multi-precision: compute on the fp32 master rows
+    master = w32 if w32 is not None else weight
+    g = grad.data._data.astype(master.dtype) * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    w_rows = master._data[idx]
+    g = g + wd * w_rows
+    if mom is not None:
+        m_rows = momentum * mom._data[idx] - lr * g
+        mom._data = mom._data.at[idx].set(m_rows)
+        master._data = master._data.at[idx].add(m_rows)
+    else:
+        master._data = master._data.at[idx].add(-lr * g)
+    if w32 is not None:
+        weight._data = weight._data.at[idx].set(
+            master._data[idx].astype(weight.dtype))
+
+
+def _sparse_adam_update(weight, grad, mean, var, lr, beta1, beta2, eps, wd,
+                        rescale, clip):
+    """Lazy row_sparse Adam (reference optimizer_op.cc AdamUpdateRsp)."""
+    import jax.numpy as jnp
+
+    idx = grad.indices._data
+    g = grad.data._data.astype(weight.dtype) * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    w_rows = weight._data[idx]
+    g = g + wd * w_rows
+    m_rows = beta1 * mean._data[idx] + (1 - beta1) * g
+    v_rows = beta2 * var._data[idx] + (1 - beta2) * g * g
+    mean._data = mean._data.at[idx].set(m_rows)
+    var._data = var._data.at[idx].set(v_rows)
+    weight._data = weight._data.at[idx].add(
+        -lr * m_rows / (jnp.sqrt(v_rows) + eps))
+
+
 def register(cls):
     _REG.register(cls)
     return cls
@@ -150,6 +200,12 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        if _is_row_sparse(grad):
+            # lazy update: only the rows present in the sparse grad move
+            # (reference optimizer_op.cc SGDUpdateRsp / sgd_mom row_sparse)
+            _sparse_sgd_update(weight, grad, state, lr, wd, self.momentum,
+                               self.rescale_grad, self._clip())
+            return
         if isinstance(state, tuple):  # multi-precision
             mom, w32 = state
             if mom is not None:
@@ -287,6 +343,14 @@ class Adam(Optimizer):
         lr = self._get_lr(index)
         lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         mean, var = state
+        if _is_row_sparse(grad):
+            # lazy adam: moments + weight move only on touched rows
+            # (reference AdamUpdateRsp, optimizer_op.cc)
+            _sparse_adam_update(weight, grad, mean, var, lr, self.beta1,
+                                self.beta2, self.epsilon,
+                                self._get_wd(index), self.rescale_grad,
+                                self._clip())
+            return
         w, m, v = invoke("adam_update", weight, grad, mean, var, lr=lr,
                          beta1=self.beta1, beta2=self.beta2,
                          epsilon=self.epsilon, wd=self._get_wd(index),

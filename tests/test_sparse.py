@@ -1,0 +1,147 @@
+"""Sparse NDArray + sparse training tests (reference:
+tests/python/unittest/test_sparse_ndarray.py, test_sparse_operator.py,
+tests/python/train/test_sparse_fm.py shape)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.ndarray import sparse
+
+
+def _rand_rsp(shape=(10, 4), density=0.3):
+    dense = np.zeros(shape, np.float32)
+    nrows = max(1, int(shape[0] * density))
+    rows = np.random.choice(shape[0], nrows, replace=False)
+    dense[rows] = np.random.rand(nrows, *shape[1:]).astype(np.float32)
+    return dense
+
+
+def test_row_sparse_roundtrip():
+    dense = _rand_rsp()
+    rsp = sparse.cast_storage(nd.array(dense), "row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert rsp.shape == dense.shape
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    back = rsp.tostype("default")
+    assert back.stype == "default"
+    np.testing.assert_allclose(back.asnumpy(), dense)
+
+
+def test_row_sparse_array_constructor():
+    data = np.arange(8, dtype=np.float32).reshape(2, 4)
+    idx = np.array([1, 5], np.int32)
+    rsp = sparse.row_sparse_array((data, idx), shape=(7, 4))
+    want = np.zeros((7, 4), np.float32)
+    want[[1, 5]] = data
+    np.testing.assert_allclose(rsp.asnumpy(), want)
+
+
+def test_csr_roundtrip_and_dot():
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0], [4, 0, 0]],
+                     np.float32)
+    csr = sparse.cast_storage(nd.array(dense), "csr")
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    rhs = np.random.rand(3, 5).astype(np.float32)
+    out = sparse.dot(csr, nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-5)
+    outT = sparse.dot(csr, nd.array(np.random.rand(4, 2).astype(np.float32)),
+                      transpose_a=True)
+    assert outT.shape == (3, 2)
+
+
+def test_row_sparse_combine():
+    a = sparse.row_sparse_array((np.ones((2, 3), np.float32),
+                                 np.array([0, 2])), shape=(5, 3))
+    b = sparse.row_sparse_array((2 * np.ones((2, 3), np.float32),
+                                 np.array([2, 4])), shape=(5, 3))
+    c = a + b
+    want = np.zeros((5, 3), np.float32)
+    want[0] = 1
+    want[2] = 3
+    want[4] = 2
+    np.testing.assert_allclose(c.asnumpy(), want)
+
+
+def test_retain():
+    rsp = sparse.row_sparse_array((np.ones((3, 2), np.float32),
+                                   np.array([1, 3, 5])), shape=(6, 2))
+    kept = sparse.retain(rsp, nd.array(np.array([3, 5], np.float32)))
+    assert kept.indices.asnumpy().tolist() == [3, 5]
+
+
+def test_sparse_embedding_grad_is_row_sparse():
+    V, E = 50, 8
+    emb = nn.Embedding(V, E, sparse_grad=True)
+    emb.initialize()
+    x = nd.array(np.array([[1, 4], [4, 7]], np.float32))
+    with autograd.record():
+        out = emb(x)
+        loss = out.sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert g.stype == "row_sparse"
+    assert sorted(g.indices.asnumpy().tolist()) == [1, 4, 7]
+    # row 4 appears twice -> grad 2x
+    gd = g.asnumpy()
+    np.testing.assert_allclose(gd[4], 2 * np.ones(E), rtol=1e-6)
+    np.testing.assert_allclose(gd[1], np.ones(E), rtol=1e-6)
+    assert np.abs(gd[0]).sum() == 0
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+def test_sparse_embedding_training_converges(opt):
+    """Sparse-grad embedding trains: an embedding-classifier on token id
+    parity (reference sparse FM/embedding convergence tests)."""
+    V, E = 32, 16
+    rs = np.random.RandomState(0)
+    emb = nn.Embedding(V, E, sparse_grad=True)
+    dense = nn.Dense(2)
+    emb.initialize()
+    dense.initialize()
+    params = list(emb.collect_params().values()) + \
+        list(dense.collect_params().values())
+    trainer = mx.gluon.Trainer(
+        {p.name: p for p in params}, opt,
+        {"learning_rate": 0.5 if opt == "sgd" else 0.05})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    untouched_before = emb.weight.data().asnumpy().copy()
+    acc = 0
+    for step in range(60):
+        ids = rs.randint(0, V // 2, (32,))  # rows V//2.. never touched
+        y = (ids % 2).astype(np.float32)
+        x = nd.array(ids.astype(np.float32))
+        with autograd.record():
+            logits = dense(emb(x))
+            loss = loss_fn(logits, nd.array(y))
+        loss.backward()
+        trainer.step(32)
+        acc = float((logits.asnumpy().argmax(1) == y).mean())
+    assert acc > 0.9, acc
+    # lazy update: untouched rows identical
+    after = emb.weight.data().asnumpy()
+    np.testing.assert_allclose(after[V // 2:], untouched_before[V // 2:])
+    assert not np.allclose(after[:V // 2], untouched_before[:V // 2])
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("device")
+    V, E = 10, 4
+    w = nd.array(np.random.rand(V, E).astype(np.float32))
+    kv.init("emb", w)
+    out = nd.zeros((3, E))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array(np.array([0, 3, 7],
+                                                                 np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), w.asnumpy()[[0, 3, 7]])
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (5, 3))
+    assert z.stype == "row_sparse"
+    assert z.asnumpy().sum() == 0
+    zc = sparse.zeros("csr", (4, 4))
+    assert zc.stype == "csr"
+    assert zc.asnumpy().sum() == 0
