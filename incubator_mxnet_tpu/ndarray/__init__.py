@@ -14,6 +14,14 @@ from .ndarray import (NDArray, arange, array, concatenate, empty, eye, from_jax,
 from .utils import load, save
 from . import sparse
 from .sparse import cast_storage
+from . import contrib
+
+
+def Custom(*data, op_type, **kwargs):
+    """User-defined op dispatch (reference `Custom` op; framework in
+    incubator_mxnet_tpu/operator.py)."""
+    from ..operator import invoke_custom
+    return invoke_custom(*data, op_type=op_type, **kwargs)
 
 # trigger op registration
 from ..ops import registry as _registry
@@ -23,6 +31,8 @@ from ..ops import random_ops as _random_ops  # noqa: F401
 from ..ops import optimizer_ops as _optimizer_ops  # noqa: F401
 from ..ops import rnn_ops as _rnn_ops  # noqa: F401
 from ..ops import quantization_ops as _quantization_ops  # noqa: F401
+from ..ops import contrib_ops as _contrib_ops  # noqa: F401
+from ..ops import control_flow_ops as _control_flow_ops  # noqa: F401
 
 
 def _make_wrapper(opdef):

@@ -86,10 +86,6 @@ class NDArray:
     ctx = context
 
     @property
-    def stype(self):
-        return "default"
-
-    @property
     def grad(self):
         return self._grad
 
@@ -231,6 +227,15 @@ class NDArray:
     def tostype(self, stype):
         from .sparse import cast_storage
         return cast_storage(self, stype)
+
+    def as_np_ndarray(self):
+        """View as mxnet.numpy ndarray, preserving the autograd tape
+        (reference ndarray.py as_np_ndarray)."""
+        from ..numpy.multiarray import _rewrap, ndarray as _np_nd
+        return _rewrap(_np_nd, self)
+
+    def as_nd_ndarray(self):
+        return self
 
     # ---- indexing ---------------------------------------------------------
     def _index_data(self, key):
@@ -451,11 +456,6 @@ class NDArray:
     def split(self, num_outputs, axis=0):
         from .. import nd
         return nd.split(self, num_outputs=num_outputs, axis=axis)
-
-    def tostype(self, stype):
-        if stype != "default":
-            raise MXNetError("sparse storage conversion: use ndarray.sparse")
-        return self
 
 
 def _is_tracer(x):

@@ -1,0 +1,202 @@
+"""Contrib op tests: SSD family, NMS, ROI align
+(reference: tests/python/unittest/test_contrib_operator.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _np_iou(a, b):
+    ix1 = max(a[0], b[0])
+    iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2])
+    iy2 = min(a[3], b[3])
+    iw, ih = max(ix2 - ix1, 0), max(iy2 - iy1, 0)
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / max(ua, 1e-12)
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 8, 4, 4))
+    anchors = nd.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    # A = len(sizes) + len(ratios) - 1 = 3
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor at cell (0,0): size .5, ratio 1 centered at (.125, .125)
+    np.testing.assert_allclose(a[0], [0.125 - 0.25, 0.125 - 0.25,
+                                      0.125 + 0.25, 0.125 + 0.25], atol=1e-6)
+    # widths/heights positive and centered
+    w = a[:, 2] - a[:, 0]
+    h = a[:, 3] - a[:, 1]
+    assert (w > 0).all() and (h > 0).all()
+
+
+def test_multibox_target_matches_gt():
+    anchors = np.array([[[0.0, 0.0, 0.4, 0.4],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.6, 0.3, 0.9]]], np.float32)
+    # one gt overlapping anchor 0 strongly
+    label = np.array([[[1, 0.05, 0.05, 0.45, 0.42],
+                       [-1, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 3, 3), np.float32)
+    bt, bm, ct = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                   nd.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 2.0  # class 1 -> target 2 (0 is background)
+    assert ct[1] == 0.0 and ct[2] == 0.0
+    bm = bm.asnumpy()[0].reshape(3, 4)
+    assert bm[0].sum() == 4 and bm[1].sum() == 0
+
+
+def test_multibox_target_force_match_ignores_padding():
+    # anchor 0's best IoU is below threshold but it IS gt 0's best anchor ->
+    # must be force-matched; padding rows must not steal the scatter slot
+    anchors = np.array([[[0.0, 0.0, 0.3, 0.3],
+                         [0.6, 0.6, 1.0, 1.0]]], np.float32)
+    label = np.array([[[2, 0.0, 0.0, 0.6, 0.6],   # IoU w/ anchor0 = 0.25
+                       [-1, 0, 0, 0, 0],           # padding
+                       [-1, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 4, 2), np.float32)
+    bt, bm, ct = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                   nd.array(cls_pred), overlap_threshold=0.5)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 3.0  # class 2 -> target 3; forced match survived padding
+    bm = bm.asnumpy()[0].reshape(2, 4)
+    assert bm[0].sum() == 4
+
+
+def test_multibox_target_negative_mining():
+    anchors = np.tile(np.array([[0.0, 0.0, 0.1, 0.1]], np.float32),
+                      (8, 1))[None]
+    anchors[0, 0] = [0.0, 0.0, 0.5, 0.5]
+    label = np.array([[[0, 0.0, 0.0, 0.5, 0.5]]], np.float32)
+    cls_pred = np.zeros((1, 3, 8), np.float32)
+    cls_pred[0, 1, 3] = 5.0  # anchor 3 is a hard negative
+    cls_pred[0, 2, 5] = 4.0  # anchor 5 next-hardest
+    bt, bm, ct = nd.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred),
+        overlap_threshold=0.5, negative_mining_ratio=2.0,
+        negative_mining_thresh=0.5, ignore_label=-1.0)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 1.0                     # positive
+    assert ct[3] == 0.0 and ct[5] == 0.0    # 2 hard negatives kept
+    others = np.delete(ct, [0, 3, 5])
+    assert (others == -1.0).all()           # rest ignored
+
+
+def test_box_nms_topk_limits_candidates():
+    # reference: NMS runs over only the top-k scored boxes; the rest are
+    # suppressed outright even if they would survive NMS
+    data = np.array([[0, 0.9, 0.0, 0.0, 0.5, 0.5],
+                     [0, 0.8, 0.02, 0.02, 0.52, 0.52],  # overlaps top box
+                     [0, 0.7, 0.6, 0.6, 0.9, 0.9]],     # disjoint
+                    np.float32)[None]
+    out = nd.box_nms(nd.array(data), overlap_thresh=0.5, topk=2,
+                     coord_start=2, score_index=1).asnumpy()[0]
+    assert out[0, 1] == pytest.approx(0.9)
+    assert (out[1] == -1).all()  # suppressed by NMS within top-2
+    assert (out[2] == -1).all()  # outside top-2 candidates entirely
+
+
+def test_adaptive_avg_pooling_upsample_no_nan():
+    x = np.random.rand(1, 1, 2, 2).astype(np.float32)
+    out = nd.AdaptiveAvgPooling2D(nd.array(x), output_size=4).asnumpy()
+    assert out.shape == (1, 1, 4, 4)
+    assert np.isfinite(out).all()
+    # each output bin covers >= 1 input pixel; corners equal input corners
+    np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 3, 3], x[0, 0, 1, 1], rtol=1e-6)
+
+
+def test_box_nms_suppresses_overlaps():
+    # rows: [cls, score, x1, y1, x2, y2]
+    data = np.array([[0, 0.9, 0.0, 0.0, 0.5, 0.5],
+                     [0, 0.8, 0.02, 0.02, 0.52, 0.52],  # overlaps first
+                     [0, 0.7, 0.6, 0.6, 0.9, 0.9],
+                     [1, 0.6, 0.01, 0.01, 0.51, 0.51]],  # other class
+                    np.float32)[None]
+    out = nd.box_nms(nd.array(data), overlap_thresh=0.5, coord_start=2,
+                     score_index=1, id_index=0).asnumpy()[0]
+    assert out[0, 1] == pytest.approx(0.9)       # kept
+    assert (out[1] == -1).all()                  # suppressed
+    assert out[2, 1] == pytest.approx(0.7)       # disjoint, kept
+    assert out[3, 1] == pytest.approx(0.6)       # different class, kept
+
+    out_f = nd.box_nms(nd.array(data), overlap_thresh=0.5, coord_start=2,
+                       score_index=1, id_index=0,
+                       force_suppress=True).asnumpy()[0]
+    assert (out_f[3] == -1).all()                # class ignored -> suppressed
+
+
+def test_multibox_detection_decodes():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # cls_prob: background, class1; anchor0 -> class1 confident
+    cls_prob = np.array([[[0.1, 0.8], [0.9, 0.2]]], np.float32)
+    loc_pred = np.zeros((1, 8), np.float32)  # no offset: boxes = anchors
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                               nd.array(anchors),
+                               threshold=0.5).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 1
+    np.testing.assert_allclose(kept[0, 2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+    assert kept[0, 0] == 0.0  # class id 0 (first foreground class)
+    assert kept[0, 1] == pytest.approx(0.9, abs=1e-5)
+
+
+def test_roi_align_shapes_and_center():
+    # constant image -> every pooled value equals the constant
+    data = np.full((1, 2, 8, 8), 3.0, np.float32)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.ROIAlign(nd.array(data), nd.array(rois), pooled_size=(2, 2),
+                      spatial_scale=1.0)
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out.asnumpy(), 3.0, rtol=1e-6)
+
+    # gradient flows to data
+    from incubator_mxnet_tpu import autograd
+    x = nd.array(np.random.rand(1, 2, 8, 8).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.ROIAlign(x, nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0)
+    y.backward()
+    assert float(np.abs(x.grad.asnumpy()).sum()) > 0
+
+
+def test_roi_pooling_max():
+    img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = nd.ROIPooling(nd.array(img), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_adaptive_avg_pooling():
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    out = nd.AdaptiveAvgPooling2D(nd.array(x), output_size=4).asnumpy()
+    assert out.shape == (2, 3, 4, 4)
+    want = x.reshape(2, 3, 4, 2, 4, 2).mean((3, 5))
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+    # global (1,1) equals mean
+    g = nd.AdaptiveAvgPooling2D(nd.array(x), output_size=1).asnumpy()
+    np.testing.assert_allclose(g[..., 0, 0], x.mean((2, 3)), rtol=1e-5)
+
+
+def test_index_copy():
+    old = nd.zeros((5, 3))
+    new = nd.array(np.ones((2, 3), np.float32))
+    idx = nd.array(np.array([1, 3], np.float32))
+    out = nd.index_copy(old, idx, new).asnumpy()
+    assert out[1].sum() == 3 and out[3].sum() == 3
+    assert out[0].sum() == 0
+
+
+def test_box_iou():
+    a = nd.array(np.array([[0, 0, 1, 1]], np.float32))
+    b = nd.array(np.array([[0.5, 0.5, 1.5, 1.5], [2, 2, 3, 3]], np.float32))
+    out = nd.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(out[0, 0], 0.25 / 1.75, rtol=1e-5)
+    assert out[0, 1] == 0

@@ -1,0 +1,172 @@
+"""Control-flow sugar + custom op framework (reference:
+tests/python/unittest/test_contrib_control_flow.py, test_operator.py
+test_custom_op)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu import operator as op_mod
+from incubator_mxnet_tpu.base import MXNetError
+
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+
+    def body(x, states):
+        acc = states[0] + x
+        return acc, [acc]
+
+    outs, final = nd.contrib.foreach(body, data, [nd.zeros((3,))])
+    want = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), want)
+    np.testing.assert_allclose(final[0].asnumpy(), want[-1])
+
+
+def test_foreach_grad_flows():
+    data = nd.array(np.random.rand(3, 2).astype(np.float32))
+    w = nd.array(np.random.rand(2).astype(np.float32))
+    w.attach_grad()
+
+    def body(x, states):
+        out = x * w
+        return out, states
+
+    with autograd.record():
+        outs, _ = nd.contrib.foreach(body, data, [nd.zeros((1,))])
+        loss = outs.sum()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(),
+                               data.asnumpy().sum(0), rtol=1e-5)
+
+
+def test_while_loop():
+    def cond(i, acc):
+        return i < 5
+
+    def func(i, acc):
+        return [acc + i], [i + 1, acc + i]
+
+    outs, final = nd.contrib.while_loop(
+        cond, func, [nd.array([0.0]), nd.array([0.0])], max_iterations=8)
+    # iterations: acc after each step: 0,1,3,6,10
+    np.testing.assert_allclose(outs.asnumpy()[:5, 0], [0, 1, 3, 6, 10])
+    np.testing.assert_allclose(outs.asnumpy()[5:], 0)  # padded
+    assert float(final[0].asnumpy()[0]) == 5
+
+
+def test_foreach_trace_unsafe_body_falls_back():
+    # body branches on concrete values -> not lax.scan-able -> eager loop
+    data = nd.array(np.array([[1.0], [-2.0], [3.0]], np.float32))
+
+    def body(x, states):
+        if float(x.asnumpy()[0]) > 0:  # concretizes; breaks tracing
+            out = x * 2
+        else:
+            out = x * 0
+        return out, states
+
+    outs, _ = nd.contrib.foreach(body, data, [nd.zeros((1,))])
+    np.testing.assert_allclose(outs.asnumpy().ravel(), [2.0, 0.0, 6.0])
+
+
+def test_while_loop_scan_path_matches_eager():
+    def cond(i, acc):
+        return i < 4
+
+    def func(i, acc):
+        return [acc * 2 + i], [i + 1, acc + 1]
+
+    outs, final = nd.contrib.while_loop(
+        cond, func, [nd.array([0.0]), nd.array([10.0])], max_iterations=6)
+    with autograd.record():  # forces the eager unrolled path
+        outs2, final2 = nd.contrib.while_loop(
+            cond, func, [nd.array([0.0]), nd.array([10.0])],
+            max_iterations=6)
+    np.testing.assert_allclose(outs.asnumpy(), outs2.asnumpy())
+    np.testing.assert_allclose(final[0].asnumpy(), final2[0].asnumpy())
+    np.testing.assert_allclose(final[1].asnumpy(), final2[1].asnumpy())
+
+
+def test_cond():
+    x = nd.array([3.0])
+    out = nd.contrib.cond(x.sum() > 2,
+                          lambda: x * 2,
+                          lambda: x - 1)
+    np.testing.assert_allclose(out.asnumpy(), [6.0])
+    out2 = nd.contrib.cond(x.sum() > 5,
+                           lambda: x * 2,
+                           lambda: x - 1)
+    np.testing.assert_allclose(out2.asnumpy(), [2.0])
+
+
+def test_contrib_namespace_resolves_contrib_ops():
+    x = nd.zeros((1, 4, 2, 2))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.5,))
+    assert anchors.shape[2] == 4
+
+
+# -- custom op --------------------------------------------------------------
+
+@op_mod.register("scale2")
+class Scale2Prop(op_mod.CustomOpProp):
+    def __init__(self, factor=2.0):
+        super().__init__(need_top_grad=True)
+        self.factor = float(factor)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        factor = self.factor
+
+        class _Op(op_mod.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * factor)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0], out_grad[0] * factor)
+
+        return _Op()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="scale2")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.ones((3, 4)),
+                               rtol=1e-6)
+
+
+def test_custom_op_kwargs():
+    x = nd.array(np.ones((2, 2), np.float32))
+    y = nd.Custom(x, op_type="scale2", factor=5.0)
+    np.testing.assert_allclose(y.asnumpy(), 5 * np.ones((2, 2)))
+
+
+def test_custom_op_composes_with_registry_ops():
+    x = nd.array(np.random.rand(4).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(nd.exp(x), op_type="scale2")
+        loss = (y * y).sum()
+    loss.backward()
+    ex = np.exp(x.asnumpy())
+    # d/dx (2 e^x)^2 = 8 e^{2x}
+    np.testing.assert_allclose(x.grad.asnumpy(), 8 * ex * ex, rtol=1e-4)
+
+
+def test_custom_op_unknown_type_raises():
+    with pytest.raises(MXNetError):
+        nd.Custom(nd.zeros((1,)), op_type="no_such_op")
