@@ -104,6 +104,11 @@ def __getattr__(name):
         "amp": ".contrib.amp",
         "engine": ".engine",
         "executor": ".executor",
+        "operator": ".operator",
+        "np": ".numpy",
+        "numpy": ".numpy",
+        "npx": ".numpy_extension",
+        "numpy_extension": ".numpy_extension",
     }
     if name in lazy:
         m = importlib.import_module(lazy[name], __name__)

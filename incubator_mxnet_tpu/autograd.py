@@ -93,7 +93,8 @@ class Node:
     """One recorded op application (reference: AGInfo attached to NDArrays,
     src/imperative/imperative.cc RecordOp)."""
 
-    __slots__ = ("vjp_fn", "inputs", "out_refs", "out_avals", "name")
+    __slots__ = ("vjp_fn", "inputs", "out_refs", "out_avals", "out_aliases",
+                 "name")
 
     def __init__(self, vjp_fn, inputs, name=""):
         self.vjp_fn = vjp_fn     # cotangents-tuple -> input-cotangents tuple
@@ -101,6 +102,21 @@ class Node:
         self.name = name
         self.out_refs = None     # list of weakrefs to output NDArrays
         self.out_avals = None    # list of (shape, dtype) for dead outputs
+        self.out_aliases = None  # slot -> extra weakrefs (rewrapped views)
+
+    def add_alias(self, orig, view):
+        """Register `view` as another identity of output `orig` so backward
+        routes cotangents arriving via either object (as_np_ndarray/
+        as_nd_ndarray re-class arrays without copying)."""
+        import weakref
+        if not self.out_refs:
+            return
+        for i, ref in enumerate(self.out_refs):
+            if ref() is orig:
+                if self.out_aliases is None:
+                    self.out_aliases = {}
+                self.out_aliases.setdefault(i, []).append(weakref.ref(view))
+                return
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -163,9 +179,17 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     for node in order:
         cts = []
         missing_all = True
-        for ref, (shp, dt) in zip(node.out_refs, node.out_avals):
-            arr = ref()
-            c = cot.pop(id(arr), None) if arr is not None else None
+        for i, (ref, (shp, dt)) in enumerate(zip(node.out_refs,
+                                                 node.out_avals)):
+            refs = [ref]
+            if node.out_aliases:
+                refs += node.out_aliases.get(i, [])
+            c = None
+            for r in refs:
+                arr = r()
+                cc = cot.pop(id(arr), None) if arr is not None else None
+                if cc is not None:
+                    c = cc if c is None else _add_ct(c, cc)
             if c is None:
                 c = jnp.zeros(shp, dt)
             else:

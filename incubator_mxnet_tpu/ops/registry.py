@@ -82,7 +82,11 @@ class OpDef:
         # reference's ctx.is_train threading (include/mxnet/op_attr_types.h
         # OpContext::is_train).
         self.train_aware = train_aware
+        # bounded FIFO: params may embed user callables (control-flow
+        # bodies) whose identity changes per call-site — an unbounded dict
+        # would leak every compiled executable + captured closure
         self._jit_cache = {}
+        self._jit_cache_max = 256
 
     def vjp_jitted(self, **params):
         """Cached jitted backward: (cts, *primals) -> input cotangents.
@@ -109,8 +113,13 @@ class OpDef:
                 return vjp_fn(_match_ct_dtypes(cts, out))
 
             f = jax.jit(bwd)
-            self._jit_cache[key] = f
+            self._cache_put(key, f)
         return f
+
+    def _cache_put(self, key, f):
+        if len(self._jit_cache) >= self._jit_cache_max:
+            self._jit_cache.pop(next(iter(self._jit_cache)))
+        self._jit_cache[key] = f
 
     def jitted(self, **params):
         """A jax.jit specialization of this op for the given params.
@@ -131,7 +140,7 @@ class OpDef:
                 f = jax.jit(f_rng)
             else:
                 f = jax.jit(functools.partial(self.fn, **params))
-            self._jit_cache[key] = f
+            self._cache_put(key, f)
         return f
 
     def __call__(self, *args, **kwargs):
@@ -231,7 +240,11 @@ def apply_op(op: OpDef, *args, out=None, **params):
             vjp_fn = lambda cts, _b=bwd, _s=saved: _b(cts, *_s)
 
     multi = isinstance(out_data, (tuple, list))
-    outs = [NDArray(o) for o in (out_data if multi else (out_data,))]
+    # Class-preserving wrap: an mxnet.numpy ndarray input propagates its
+    # class through every op (the reference instead duplicates the whole op
+    # surface as _np_* registrations, src/operator/numpy/).
+    out_cls = type(nd_inputs[0]) if nd_inputs else NDArray
+    outs = [out_cls(o) for o in (out_data if multi else (out_data,))]
 
     if recording:
         off = 1 if op.stateful else 0

@@ -1,0 +1,241 @@
+"""mxnet.numpy namespace tests (reference:
+tests/python/unittest/test_numpy_op.py, test_numpy_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu import np as mnp
+from incubator_mxnet_tpu import npx
+
+
+def test_creation_and_class():
+    x = mnp.ones((2, 3))
+    assert isinstance(x, mnp.ndarray)
+    assert x.shape == (2, 3)
+    onp.testing.assert_allclose(x.asnumpy(), onp.ones((2, 3)))
+    z = mnp.zeros((2,), dtype="int32")
+    assert z.dtype == onp.int32
+    a = mnp.arange(5)
+    onp.testing.assert_allclose(a.asnumpy(), onp.arange(5))
+    f = mnp.full((2, 2), 7.0)
+    assert float(f[0, 0].asnumpy()) == 7.0
+
+
+def test_arithmetic_preserves_np_class():
+    x = mnp.ones((3,))
+    y = x + x * 2 - 1
+    assert isinstance(y, mnp.ndarray)
+    onp.testing.assert_allclose(y.asnumpy(), [2, 2, 2])
+    # scalar ops, both directions
+    z = 2.0 / (x + 1)
+    assert isinstance(z, mnp.ndarray)
+    onp.testing.assert_allclose(z.asnumpy(), [1, 1, 1])
+    m = x[None, :] @ mnp.ones((3, 2))
+    assert m.shape == (1, 2)
+
+
+def test_unary_binary_reductions_match_numpy():
+    rng = onp.random.RandomState(0)
+    a = rng.rand(3, 4).astype(onp.float32)
+    b = rng.rand(3, 4).astype(onp.float32) + 0.5
+    ma, mb = mnp.array(a), mnp.array(b)
+    onp.testing.assert_allclose(mnp.exp(ma).asnumpy(), onp.exp(a), rtol=1e-6)
+    onp.testing.assert_allclose(mnp.log(mb).asnumpy(), onp.log(b), rtol=1e-6)
+    onp.testing.assert_allclose(mnp.maximum(ma, mb).asnumpy(),
+                                onp.maximum(a, b))
+    onp.testing.assert_allclose(mnp.sum(ma, axis=1).asnumpy(), a.sum(1),
+                                rtol=1e-6)
+    onp.testing.assert_allclose(mnp.mean(ma).asnumpy(), a.mean(), rtol=1e-6)
+    onp.testing.assert_allclose(mnp.std(ma, axis=0).asnumpy(), a.std(0),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(
+        mnp.argmax(ma, axis=1).asnumpy(), a.argmax(1))
+    onp.testing.assert_allclose(mnp.cumsum(ma, axis=1).asnumpy(),
+                                a.cumsum(1), rtol=1e-6)
+
+
+def test_manipulation():
+    a = mnp.arange(12).reshape(3, 4)
+    assert a.shape == (3, 4)
+    t = a.transpose()
+    assert t.shape == (4, 3)
+    c = mnp.concatenate([a, a], axis=0)
+    assert c.shape == (6, 4)
+    s = mnp.stack([a, a], axis=0)
+    assert s.shape == (2, 3, 4)
+    parts = mnp.split(a, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+    e = mnp.expand_dims(a, 0)
+    assert e.shape == (1, 3, 4)
+    sq = mnp.squeeze(e, 0)
+    assert sq.shape == (3, 4)
+    onp.testing.assert_allclose(mnp.flip(mnp.arange(3), 0).asnumpy(),
+                                [2, 1, 0])
+    onp.testing.assert_allclose(
+        mnp.tile(mnp.arange(2), 3).asnumpy(), onp.tile(onp.arange(2), 3))
+
+
+def test_indexing_numpy_semantics():
+    a = mnp.arange(10, dtype="float32")
+    # boolean mask
+    m = a[a > 5]
+    onp.testing.assert_allclose(m.asnumpy(), [6, 7, 8, 9])
+    # fancy indexing
+    idx = mnp.array([0, 3, 4], dtype="int32")
+    onp.testing.assert_allclose(a[idx].asnumpy(), [0, 3, 4])
+    # 0-d result
+    s = a[3]
+    assert s.shape == ()
+    assert float(s.asnumpy()) == 3.0
+
+
+def test_autograd_through_np_ops():
+    x = mnp.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mnp.sum(mnp.exp(x) * 2)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * onp.exp([1, 2, 3]),
+                                rtol=1e-5)
+    assert isinstance(x.grad, mx.nd.NDArray)
+
+
+def test_linalg():
+    a = onp.array([[4.0, 1.0], [1.0, 3.0]], onp.float32)
+    ma = mnp.array(a)
+    onp.testing.assert_allclose(mnp.linalg.norm(ma).asnumpy(),
+                                onp.linalg.norm(a), rtol=1e-6)
+    onp.testing.assert_allclose(mnp.linalg.det(ma).asnumpy(),
+                                onp.linalg.det(a), rtol=1e-5)
+    inv = mnp.linalg.inv(ma)
+    onp.testing.assert_allclose((ma @ inv).asnumpy(), onp.eye(2), atol=1e-5)
+    L = mnp.linalg.cholesky(ma)
+    onp.testing.assert_allclose((L @ L.transpose()).asnumpy(), a, rtol=1e-5)
+    w, v = mnp.linalg.eigh(ma)
+    onp.testing.assert_allclose(onp.sort(w.asnumpy()),
+                                onp.sort(onp.linalg.eigh(a)[0]), rtol=1e-5)
+
+
+def test_random():
+    mnp.random.seed(42)
+    u = mnp.random.uniform(0.0, 1.0, size=(100,))
+    assert isinstance(u, mnp.ndarray)
+    assert u.shape == (100,)
+    assert 0 <= float(u.asnumpy().min()) and float(u.asnumpy().max()) <= 1
+    n = mnp.random.normal(5.0, 0.1, size=(200,))
+    assert abs(float(n.asnumpy().mean()) - 5.0) < 0.1
+    r = mnp.random.randint(0, 10, size=(50,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    # seed reproducibility
+    mnp.random.seed(7)
+    a = mnp.random.uniform(size=(5,)).asnumpy()
+    mnp.random.seed(7)
+    b = mnp.random.uniform(size=(5,)).asnumpy()
+    onp.testing.assert_allclose(a, b)
+    p = mnp.random.permutation(8).asnumpy()
+    assert sorted(p.tolist()) == list(range(8))
+
+
+def test_where_take_sort():
+    a = mnp.array([3.0, 1.0, 2.0])
+    onp.testing.assert_allclose(mnp.sort(a).asnumpy(), [1, 2, 3])
+    onp.testing.assert_allclose(mnp.argsort(a).asnumpy(), [1, 2, 0])
+    w = mnp.where(a > 1.5, a, mnp.zeros((3,)))
+    onp.testing.assert_allclose(w.asnumpy(), [3, 0, 2])
+    t = mnp.take(a, mnp.array([2, 0], dtype="int32"))
+    onp.testing.assert_allclose(t.asnumpy(), [2, 3])
+    u = mnp.unique(mnp.array([1.0, 2.0, 1.0]))
+    onp.testing.assert_allclose(u.asnumpy(), [1, 2])
+
+
+def test_einsum_tensordot():
+    a = mnp.arange(6, dtype="float32").reshape(2, 3)
+    b = mnp.arange(12, dtype="float32").reshape(3, 4)
+    c = mnp.einsum("ij,jk->ik", a, b)
+    onp.testing.assert_allclose(
+        c.asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-6)
+    d = mnp.tensordot(a, b, axes=([1], [0]))
+    onp.testing.assert_allclose(
+        d.asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-6)
+
+
+def test_nd_np_interop():
+    x = mx.nd.array([1.0, 2.0])
+    n = x.as_np_ndarray()
+    assert isinstance(n, mnp.ndarray)
+    back = n.as_nd_ndarray()
+    assert type(back) is mx.nd.NDArray
+    # tape survives the view change
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mnp.sum(x.as_np_ndarray() * 3)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [3, 3])
+
+
+def test_rewrap_recorded_intermediate_keeps_grad():
+    # converting a *recorded intermediate* (not a leaf) must not orphan the
+    # cotangent: out_refs alias registration in autograd.Node
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 2).as_np_ndarray()   # y is an intermediate, re-classed
+        loss = mnp.sum(y)
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0])
+
+    # and the other direction: np intermediate viewed as nd
+    w = mnp.ones((3,))
+    w.attach_grad()
+    with autograd.record():
+        z = mnp.exp(w).as_nd_ndarray()
+        total = z.sum()
+    total.backward()
+    onp.testing.assert_allclose(w.grad.asnumpy(), onp.exp([1.0, 1, 1]),
+                                rtol=1e-6)
+
+
+def test_random_no_array_input_returns_np_class():
+    r = mnp.random.randint(0, 10, size=(3,))
+    assert isinstance(r, mnp.ndarray)
+    p = mnp.random.permutation(5)
+    assert isinstance(p, mnp.ndarray)
+
+
+def test_astype_accepts_dtype_class():
+    x = mnp.ones((2,))
+    y = x.astype(mnp.float16)
+    assert y.dtype == onp.float16
+    z = x.astype("int32")
+    assert z.dtype == onp.int32
+
+
+def test_npx_nn_ops():
+    x = mnp.array([[1.0, 2.0, 3.0]])
+    s = npx.softmax(x)
+    assert isinstance(s, mnp.ndarray)
+    onp.testing.assert_allclose(s.asnumpy().sum(), 1.0, rtol=1e-6)
+    r = npx.relu(mnp.array([-1.0, 2.0]))
+    onp.testing.assert_allclose(r.asnumpy(), [0, 2])
+    g = npx.sigmoid(mnp.zeros((2,)))
+    onp.testing.assert_allclose(g.asnumpy(), [0.5, 0.5])
+    oh = npx.one_hot(mnp.array([0, 2], dtype="int32"), 3)
+    onp.testing.assert_allclose(oh.asnumpy(),
+                                [[1, 0, 0], [0, 0, 1]])
+
+
+def test_npx_set_np_switches():
+    npx.set_np()
+    assert npx.is_np_array() and npx.is_np_shape()
+    npx.reset_np()
+    assert not npx.is_np_array()
+
+
+def test_np_save_load(tmp_path):
+    f = str(tmp_path / "arrs.npz")
+    npx.save(f, {"a": mnp.ones((2, 2))})
+    out = npx.load(f)
+    assert isinstance(out["a"], mnp.ndarray)
+    onp.testing.assert_allclose(out["a"].asnumpy(), onp.ones((2, 2)))
