@@ -349,7 +349,20 @@ round_ = round
 
 
 def clip(a, a_min=None, a_max=None, out=None):
-    op = _op("clip", lambda x, a_min, a_max: _jnp().clip(x, a_min, a_max))
+    if isinstance(a_min, NDArray) or isinstance(a_max, NDArray):
+        # array bounds become op inputs (broadcastable, differentiable)
+        op3 = _op("clip_arr",
+                  lambda x, lo, hi: _jnp().clip(x, lo, hi))
+        lo = _as_np(0.0 if a_min is None else a_min)
+        hi = _as_np(_onp.inf if a_max is None else a_max)
+        if a_min is None:
+            lo = _as_np(-_onp.inf)
+        return apply_op(op3, _as_np(a), lo, hi, out=out)
+    # scalar bounds stay static params; keep the input dtype like numpy
+    op = _op("clip", lambda x, a_min, a_max:
+             _jnp().clip(x,
+                         None if a_min is None else _jnp().asarray(a_min, x.dtype),
+                         None if a_max is None else _jnp().asarray(a_max, x.dtype)))
     return apply_op(op, _as_np(a), out=out,
                     a_min=None if a_min is None else float(a_min),
                     a_max=None if a_max is None else float(a_max))
@@ -509,7 +522,9 @@ def tile(A, reps):
 
 def repeat(a, repeats, axis=None):
     op = _op("repeat", lambda x, repeats, axis: _jnp().repeat(x, repeats, axis))
-    return apply_op(op, _as_np(a), repeats=int(repeats),
+    reps = tuple(int(r) for r in repeats) \
+        if isinstance(repeats, (list, tuple, _onp.ndarray)) else int(repeats)
+    return apply_op(op, _as_np(a), repeats=reps,
                     axis=None if axis is None else int(axis))
 
 

@@ -115,8 +115,13 @@ def reshape_like(lhs, rhs):
 
 
 def arange_like(data, start=0.0, step=1.0, axis=None):
+    """Reference npx.arange_like: values laid out over data's full shape
+    (row-major) when axis is None, else a 1-D ramp of data.shape[axis]."""
     import jax.numpy as jnp
-    n = data.size if axis is None else data.shape[axis]
+    if axis is None:
+        ramp = jnp.arange(data.size, dtype="float32") * step + start
+        return ndarray(ramp.reshape(data.shape))
+    n = data.shape[axis]
     return ndarray(jnp.arange(n, dtype="float32") * step + start)
 
 

@@ -25,39 +25,47 @@ __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
 _MAGIC = 0xCED7230A
 
 
-def _load_native():
-    here = os.path.dirname(os.path.abspath(__file__))
-    so = os.path.join(here, "native", "libmxtpu.so")
-    if os.path.exists(so):
-        try:
-            lib = ctypes.CDLL(so)
-            lib.mxtpu_recordio_index.restype = ctypes.c_longlong
-            return lib
-        except OSError:
-            return None
-    return None
-
-
-_NATIVE = _load_native()
+def _native():
+    """The C++ codec (native/recordio.cc), None if g++/load unavailable."""
+    if os.environ.get("MXTPU_NO_NATIVE"):
+        return None
+    try:
+        from . import native
+        return native if native.load() is not None else None
+    except Exception:
+        return None
 
 
 class MXRecordIO:
-    """Sequential record file reader/writer (reference recordio.py:34)."""
+    """Sequential record file reader/writer (reference recordio.py:34).
+
+    Uses the native C++ codec when available (multipart framing + buffered
+    IO in C), transparently falling back to the pure-python path."""
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.pid = None
         self.is_open = False
+        self._nat = None
         self.open()
 
     def open(self):
+        nat = _native()
         if self.flag == "w":
-            self.record = open(self.uri, "wb")
             self.writable = True
+            if nat is not None:
+                self._nat = nat.NativeRecordWriter(self.uri)
+                self.record = None
+            else:
+                self.record = open(self.uri, "wb")
         elif self.flag == "r":
-            self.record = open(self.uri, "rb")
             self.writable = False
+            if nat is not None:
+                self._nat = nat.NativeRecordReader(self.uri)
+                self.record = None
+            else:
+                self.record = open(self.uri, "rb")
         else:
             raise MXNetError(f"invalid flag {self.flag}")
         self.pid = os.getpid()
@@ -65,7 +73,11 @@ class MXRecordIO:
 
     def close(self):
         if self.is_open:
-            self.record.close()
+            if self._nat is not None:
+                self._nat.close()
+                self._nat = None
+            else:
+                self.record.close()
             self.is_open = False
             self.pid = None
 
@@ -78,6 +90,7 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["record"] = None
+        d["_nat"] = None          # ctypes handles don't pickle
         d["is_open"] = False
         return d
 
@@ -112,6 +125,9 @@ class MXRecordIO:
     def write(self, buf):
         assert self.writable
         data = bytes(buf)
+        if self._nat is not None:
+            self._nat.write(data)
+            return
         if len(data) <= self._LEN_MASK:
             self._write_one(0, data)
             return
@@ -140,6 +156,8 @@ class MXRecordIO:
     def read(self):
         assert not self.writable
         self._check_pid()
+        if self._nat is not None:
+            return self._nat.read()
         cflag, data = self._read_one()
         if data is None:
             return None
@@ -161,12 +179,17 @@ class MXRecordIO:
                                  "multipart record")
 
     def tell(self):
+        if self._nat is not None:
+            return self._nat.tell()
         return self.record.tell()
 
     def seek(self, pos):
         assert not self.writable
         self._check_pid()
-        self.record.seek(pos)
+        if self._nat is not None:
+            self._nat.seek(pos)
+        else:
+            self.record.seek(pos)
 
 
 class MXIndexedRecordIO(MXRecordIO):

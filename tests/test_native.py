@@ -1,0 +1,151 @@
+"""Native C++ recordio codec + prefetcher (native/recordio.cc), including
+binary compatibility with the pure-python path (reference: dmlc-core
+recordio framing, python/mxnet/recordio.py)."""
+import os
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import recordio
+from incubator_mxnet_tpu import native
+
+
+needs_native = pytest.mark.skipif(native.load() is None,
+                                  reason="native toolchain unavailable")
+
+
+@needs_native
+def test_native_roundtrip(tmp_path):
+    p = str(tmp_path / "a.rec")
+    w = native.NativeRecordWriter(p)
+    recs = [b"hello", b"", b"x" * 1000, b"tail"]
+    for r in recs:
+        w.write(r)
+    w.close()
+    r = native.NativeRecordReader(p)
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    r.close()
+    assert got == recs
+
+
+@needs_native
+def test_native_python_cross_compat(tmp_path, monkeypatch):
+    """Records written natively must read back through the pure-python
+    decoder and vice versa (same on-disk framing)."""
+    p1 = str(tmp_path / "nat.rec")
+    w = native.NativeRecordWriter(p1)
+    w.write(b"abc")
+    w.write(b"d" * 77)
+    w.close()
+
+    monkeypatch.setenv("MXTPU_NO_NATIVE", "1")
+    rio = recordio.MXRecordIO(p1, "r")
+    assert rio._nat is None  # really the python path
+    assert rio.read() == b"abc"
+    assert rio.read() == b"d" * 77
+    assert rio.read() is None
+    rio.close()
+
+    p2 = str(tmp_path / "py.rec")
+    wio = recordio.MXRecordIO(p2, "w")
+    wio.write(b"from-python")
+    wio.close()
+    monkeypatch.delenv("MXTPU_NO_NATIVE")
+    r = native.NativeRecordReader(p2)
+    assert r.read() == b"from-python"
+    r.close()
+
+
+@needs_native
+def test_native_prefetcher(tmp_path):
+    p = str(tmp_path / "many.rec")
+    w = native.NativeRecordWriter(p)
+    n = 500
+    for i in range(n):
+        w.write(f"record-{i}".encode() * 10)
+    w.close()
+    r = native.NativeRecordReader(p, prefetch=8)
+    count = 0
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        assert rec == f"record-{count}".encode() * 10
+        count += 1
+    r.close()
+    assert count == n
+
+
+@needs_native
+def test_native_index_builder(tmp_path):
+    p = str(tmp_path / "x.rec")
+    w = native.NativeRecordWriter(p)
+    for i in range(10):
+        w.write(bytes([i]) * (i + 1))
+    w.close()
+    idx = str(tmp_path / "x.idx")
+    count = native.build_index(p, idx)
+    assert count == 10
+    # offsets usable by the indexed reader
+    rio = recordio.MXIndexedRecordIO(idx, p, "r")
+    assert rio.read_idx(3) == bytes([3]) * 4
+    assert rio.read_idx(9) == bytes([9]) * 10
+    rio.close()
+
+
+@needs_native
+def test_native_reader_reassembles_multipart(tmp_path, monkeypatch):
+    """A multipart file (python writer, shrunk chunk bound) reads back as
+    one logical record through the C++ reassembly path."""
+    monkeypatch.setenv("MXTPU_NO_NATIVE", "1")
+    monkeypatch.setattr(recordio.MXRecordIO, "_LEN_MASK", (1 << 10) - 1)
+    monkeypatch.setattr(recordio.MXRecordIO, "_CHUNK", (1 << 10) - 4)
+    p = str(tmp_path / "mp.rec")
+    big = os.urandom(5000)
+    w = recordio.MXRecordIO(p, "w")
+    w.write(b"pre")
+    w.write(big)
+    w.write(b"post")
+    w.close()
+    monkeypatch.delenv("MXTPU_NO_NATIVE")
+    r = native.NativeRecordReader(p)
+    assert r.read() == b"pre"
+    assert r.read() == big
+    assert r.read() == b"post"
+    assert r.read() is None
+    r.close()
+
+
+@needs_native
+def test_mxrecordio_uses_native(tmp_path):
+    p = str(tmp_path / "n.rec")
+    w = recordio.MXRecordIO(p, "w")
+    assert w._nat is not None
+    w.write(b"one")
+    w.close()
+    r = recordio.MXRecordIO(p, "r")
+    assert r._nat is not None
+    assert r.read() == b"one"
+    r.close()
+
+
+@needs_native
+def test_native_seek_tell(tmp_path):
+    p = str(tmp_path / "s.rec")
+    w = recordio.MXRecordIO(p, "w")
+    positions = []
+    for i in range(5):
+        positions.append(w.tell())
+        w.write(f"rec{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(p, "r")
+    r.seek(positions[3])
+    assert r.read() == b"rec3"
+    r.seek(positions[0])
+    assert r.read() == b"rec0"
+    r.close()

@@ -1,0 +1,98 @@
+"""tools/ CLI suite (reference tools/: launch.py, im2rec.py, rec2idx.py,
+parse_log.py, diagnose.py, flakiness_checker.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _run(args, **kw):
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, cwd=REPO, **kw)
+
+
+def test_launch_local_spawns_workers(tmp_path):
+    out = str(tmp_path / "out")
+    script = str(tmp_path / "w.py")
+    with open(script, "w") as f:
+        f.write(
+            "import os\n"
+            f"open(r'{out}' + os.environ['MXTPU_WORKER_ID'], 'w')"
+            ".write(os.environ['JAX_NUM_PROCESSES'])\n")
+    r = _run([os.path.join(TOOLS, "launch.py"), "-n", "3",
+              sys.executable, script])
+    assert r.returncode == 0, r.stderr
+    for i in range(3):
+        with open(out + str(i)) as f:
+            assert f.read() == "3"
+
+
+def test_im2rec_list_and_pack_roundtrip(tmp_path):
+    from PIL import Image
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = np.random.randint(0, 255, (8, 10, 3), np.uint8)
+            Image.fromarray(arr).save(root / cls / f"{i}.png")
+    prefix = str(tmp_path / "data")
+    r = _run([os.path.join(TOOLS, "im2rec.py"), "--list", prefix, str(root)])
+    assert r.returncode == 0, r.stderr
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    labels = {line.split("\t")[1] for line in lines}
+    assert labels == {"0", "1"}
+
+    r = _run([os.path.join(TOOLS, "im2rec.py"), prefix, str(root)])
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+
+    from incubator_mxnet_tpu import recordio
+    rio = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(rio.keys) == 6
+    header, img = recordio.unpack_img(rio.read_idx(rio.keys[0]))
+    assert img.shape[2] == 3 and img.shape[0] == 8
+    rio.close()
+
+
+def test_rec2idx_rebuilds_index(tmp_path):
+    from incubator_mxnet_tpu import recordio
+    prefix = str(tmp_path / "x")
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(7):
+        w.write_idx(i, f"payload-{i}".encode())
+    w.close()
+    orig = open(prefix + ".idx").read()
+    os.remove(prefix + ".idx")
+    r = _run([os.path.join(TOOLS, "rec2idx.py"), prefix + ".rec",
+              prefix + ".idx"])
+    assert r.returncode == 0, r.stderr
+    assert open(prefix + ".idx").read() == orig
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Batch [50] Speed: 1234.5 samples/sec\n"
+        "INFO:root:Epoch[0] Train-accuracy=0.71\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.65\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.82\n"
+        "INFO:root:Epoch[1] Validation-accuracy=0.74\n")
+    r = _run([os.path.join(TOOLS, "parse_log.py"), str(log)])
+    assert r.returncode == 0, r.stderr
+    assert "train-accuracy" in r.stdout
+    assert "0.82" in r.stdout and "0.74" in r.stdout
+    assert "1234.5" in r.stdout
+
+
+def test_diagnose_runs():
+    r = _run([os.path.join(TOOLS, "diagnose.py")],
+             env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    assert "Python Info" in r.stdout
+    assert "incubator_mxnet_tpu" in r.stdout

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Environment diagnostics (reference tools/diagnose.py: python/platform/
+library versions, build flags, network checks for the PS cluster).
+
+TPU edition: jax/device/mesh facts replace the CUDA and ps-lite sections."""
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("Arch         :", platform.machine(), platform.architecture()[0])
+
+    print("----------System Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("release      :", platform.release())
+
+    print("----------Framework Info----------")
+    try:
+        import incubator_mxnet_tpu as mx
+        print("incubator_mxnet_tpu:", mx.__version__,
+              "at", os.path.dirname(mx.__file__))
+        from incubator_mxnet_tpu import runtime
+        feats = runtime.feature_list()
+        on = [f.name for f in feats if f.enabled]
+        print("Features     :", ", ".join(on) if on else "(none)")
+    except Exception as e:
+        print("incubator_mxnet_tpu import FAILED:", e)
+
+    print("----------JAX / Device Info----------")
+    try:
+        import jax
+        import jaxlib
+        print("jax          :", jax.__version__)
+        print("jaxlib       :", jaxlib.__version__)
+        devs = jax.devices()
+        print("device count :", len(devs))
+        for d in devs[:8]:
+            print(f"  [{d.id}] {d.device_kind} ({d.platform})")
+        print("process      :", jax.process_index(), "/", jax.process_count())
+    except Exception as e:
+        print("jax probe FAILED:", e)
+
+    print("----------Environment----------")
+    for k in sorted(os.environ):
+        if k.startswith(("MXTPU_", "MXNET_", "JAX_", "XLA_", "DMLC_", "TPU_")):
+            print(f"{k}={os.environ[k]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Rerun a test many times with different seeds to expose flakiness
+(reference tools/flakiness_checker.py: N trials under random MXNET_TEST_SEED).
+
+Usage:
+    python tools/flakiness_checker.py tests/test_operator.py::test_topk -n 50
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("test", help="pytest node id (file[::test])")
+    ap.add_argument("-n", "--trials", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="fixed base seed (default: random per trial)")
+    ap.add_argument("--stop-on-fail", action="store_true")
+    args = ap.parse_args()
+
+    failures = []
+    for i in range(args.trials):
+        seed = args.seed if args.seed is not None else \
+            random.randint(1, 2**31 - 1)
+        env = dict(os.environ, MXTPU_TEST_SEED=str(seed))
+        r = subprocess.run([sys.executable, "-m", "pytest", args.test,
+                            "-x", "-q"], env=env, capture_output=True,
+                           text=True)
+        status = "PASS" if r.returncode == 0 else "FAIL"
+        print(f"[{i + 1}/{args.trials}] seed={seed} {status}")
+        if r.returncode != 0:
+            failures.append(seed)
+            sys.stderr.write(r.stdout[-2000:] + "\n")
+            if args.stop_on_fail:
+                break
+    print(f"\n{len(failures)}/{args.trials} trials failed"
+          + (f"; failing seeds: {failures}" if failures else ""))
+    print("reproduce with: MXTPU_TEST_SEED=<seed> python -m pytest", args.test)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

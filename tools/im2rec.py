@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Pack an image folder/list into RecordIO (reference tools/im2rec.py:
+--list generation + multi-worker packing over OpenCV; here PIL + the
+native C++ record codec).
+
+Usage:
+    # 1) make a list file (label from folder structure)
+    python tools/im2rec.py --list data/train data/imgs
+    # 2) pack it
+    python tools/im2rec.py data/train data/imgs --quality 95
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root, recursive=True, train_ratio=1.0, shuffle=True,
+              chunks=1):
+    """Write prefix.lst lines: <index>\t<label>\t<relpath> (reference
+    im2rec.py make_list)."""
+    images = []
+    classes = {}
+    if recursive:
+        for dirpath, _, files in sorted(os.walk(root)):
+            rel = os.path.relpath(dirpath, root)
+            for fn in sorted(files):
+                if fn.lower().endswith(EXTS):
+                    if rel not in classes:
+                        classes[rel] = len(classes)
+                    images.append((os.path.join(rel, fn), classes[rel]))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(EXTS):
+                images.append((fn, 0))
+    if shuffle:
+        random.seed(100)
+        random.shuffle(images)
+    n_train = int(len(images) * train_ratio)
+    splits = [("", images[:n_train])]
+    if train_ratio < 1.0:
+        splits = [("_train", images[:n_train]), ("_val", images[n_train:])]
+    for suffix, imgs in splits:
+        with open(f"{prefix}{suffix}.lst", "w") as f:
+            for i, (path, label) in enumerate(imgs):
+                f.write(f"{i}\t{label}\t{path}\n")
+    return classes
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, quality=95, resize=0, color=1):
+    from incubator_mxnet_tpu import recordio
+    from incubator_mxnet_tpu.image.image import imread, imencode, resize_short
+
+    lst = prefix + ".lst"
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, relpath in read_list(lst):
+        full = os.path.join(root, relpath)
+        try:
+            img = imread(full, to_rgb=color)
+        except Exception as e:
+            print(f"[im2rec] skip {relpath}: {e}", file=sys.stderr)
+            continue
+        if resize:
+            img = resize_short(img, resize)
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        payload = recordio.pack(header, imencode(img, quality=quality))
+        rec.write_idx(idx, payload)
+        count += 1
+    rec.close()
+    print(f"[im2rec] packed {count} images into {prefix}.rec")
+    return count
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="prefix of .lst/.rec/.idx files")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst file instead of packing")
+    ap.add_argument("--no-recursive", dest="recursive",
+                    action="store_false", default=True,
+                    help="flat listing with label 0 (no class subfolders)")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--color", type=int, default=1)
+    args = ap.parse_args()
+    if args.list:
+        classes = make_list(args.prefix, args.root, args.recursive,
+                            args.train_ratio, not args.no_shuffle)
+        print(f"[im2rec] wrote {args.prefix}.lst ({len(classes)} classes)")
+        return 0
+    pack(args.prefix, args.root, args.quality, args.resize, args.color)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (reference tools/parse_log.py: extracts
+epoch train/val accuracy and speed from Module.fit/Speedometer output)."""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+EPOCH_METRIC = re.compile(
+    r"Epoch\[(\d+)\].*?(Train|Validation)-([\w-]+)=([0-9.eE+-]+)")
+SPEED = re.compile(r"Epoch\[(\d+)\].*?Speed:\s*([0-9.]+)\s*samples/sec")
+
+
+def parse(lines):
+    rows = {}
+    for line in lines:
+        m = EPOCH_METRIC.search(line)
+        if m:
+            ep, kind, metric, val = int(m.group(1)), m.group(2), \
+                m.group(3), float(m.group(4))
+            rows.setdefault(ep, {})[f"{kind.lower()}-{metric}"] = val
+        m = SPEED.search(line)
+        if m:
+            ep, sp = int(m.group(1)), float(m.group(2))
+            r = rows.setdefault(ep, {})
+            r["speed"] = max(r.get("speed", 0.0), sp)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile", help="training log ('-' for stdin)")
+    ap.add_argument("--format", choices=["table", "markdown", "csv"],
+                    default="table")
+    args = ap.parse_args()
+    f = sys.stdin if args.logfile == "-" else open(args.logfile)
+    rows = parse(f)
+    if args.logfile != "-":
+        f.close()
+    if not rows:
+        print("no epoch records found", file=sys.stderr)
+        return 1
+    cols = sorted({k for r in rows.values() for k in r})
+    sep = {"table": "  ", "markdown": " | ", "csv": ","}[args.format]
+    header = sep.join(["epoch"] + cols)
+    if args.format == "markdown":
+        header = "| " + header + " |"
+    print(header)
+    if args.format == "markdown":
+        print("|" + "|".join(["---"] * (len(cols) + 1)) + "|")
+    for ep in sorted(rows):
+        vals = [f"{rows[ep].get(c, ''):{'.6g' if c in rows[ep] else ''}}"
+                if c in rows[ep] else "" for c in cols]
+        line = sep.join([str(ep)] + vals)
+        print("| " + line + " |" if args.format == "markdown" else line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Rebuild the .idx sidecar for a .rec file (reference tools/rec2idx.py).
+
+Uses the native C++ scanner (native/recordio.cc mxtpu_recordio_index) when
+available, else a pure-python scan."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("record", help="path to .rec file")
+    ap.add_argument("index", nargs="?", default=None,
+                    help="output .idx (default: record with .idx suffix)")
+    args = ap.parse_args()
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+
+    from incubator_mxnet_tpu import native, recordio
+    n = None
+    try:
+        n = native.build_index(args.record, idx)
+    except Exception:
+        n = None
+    if n is None:  # python fallback
+        os.environ["MXTPU_NO_NATIVE"] = "1"
+        r = recordio.MXRecordIO(args.record, "r")
+        with open(idx, "w") as f:
+            n = 0
+            while True:
+                pos = r.tell()
+                if r.read() is None:
+                    break
+                f.write(f"{n}\t{pos}\n")
+                n += 1
+        r.close()
+    print(f"[rec2idx] {n} records -> {idx}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
