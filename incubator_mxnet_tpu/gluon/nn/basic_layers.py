@@ -274,16 +274,17 @@ class GroupNorm(HybridBlock):
         super().__init__(**kwargs)
         self._num_groups = num_groups
         self._epsilon = epsilon
-        self.gamma = self.params.get("gamma", shape=(in_channels,),
+        # gamma/beta are per-GROUP (reference basic_layers.py:690-695:
+        # shape=(num_groups,)) and applied in the grouped view by the op
+        self.gamma = self.params.get("gamma", shape=(num_groups,),
                                      init=gamma_initializer, allow_deferred_init=True)
-        self.beta = self.params.get("beta", shape=(in_channels,),
+        self.beta = self.params.get("beta", shape=(num_groups,),
                                     init=beta_initializer, allow_deferred_init=True)
         self._reg_params.update({"gamma": self.gamma, "beta": self.beta})
 
     def infer_shape(self, x, *args):
-        ch = int(x.shape[1])
-        self.gamma._infer_shape((ch,))
-        self.beta._infer_shape((ch,))
+        self.gamma._infer_shape((self._num_groups,))
+        self.beta._infer_shape((self._num_groups,))
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups,

@@ -120,7 +120,8 @@ class NativeRecordReader:
     def seek(self, pos):
         if self._pf:
             raise IOError("seek() unsupported on prefetching reader")
-        self._lib.mxtpu_rio_seek(self._h, pos)
+        if self._lib.mxtpu_rio_seek(self._h, pos) != 0:
+            raise IOError(f"seek to {pos} failed")
 
     def close(self):
         if self._h:

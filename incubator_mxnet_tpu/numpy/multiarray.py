@@ -351,13 +351,20 @@ round_ = round
 def clip(a, a_min=None, a_max=None, out=None):
     if isinstance(a_min, NDArray) or isinstance(a_max, NDArray):
         # array bounds become op inputs (broadcastable, differentiable)
+        # None bounds pass straight through so integer inputs keep their
+        # dtype (an inf array bound would promote the result to float)
         op3 = _op("clip_arr",
-                  lambda x, lo, hi: _jnp().clip(x, lo, hi))
-        lo = _as_np(0.0 if a_min is None else a_min)
-        hi = _as_np(_onp.inf if a_max is None else a_max)
-        if a_min is None:
-            lo = _as_np(-_onp.inf)
-        return apply_op(op3, _as_np(a), lo, hi, out=out)
+                  lambda x, lo=None, hi=None: _jnp().clip(x, lo, hi))
+        args3 = [_as_np(a)]
+        if a_min is not None:
+            args3.append(_as_np(a_min))
+            if a_max is not None:
+                args3.append(_as_np(a_max))
+            return apply_op(op3, *args3, out=out)
+        if a_max is not None:
+            op_hi = _op("clip_arr_hi", lambda x, hi: _jnp().clip(x, None, hi))
+            return apply_op(op_hi, _as_np(a), _as_np(a_max), out=out)
+        return apply_op(op3, _as_np(a), out=out)
     # scalar bounds stay static params; keep the input dtype like numpy
     op = _op("clip", lambda x, a_min, a_max:
              _jnp().clip(x,

@@ -228,9 +228,12 @@ def group_norm(data, gamma, beta, *, num_groups=1, eps=1e-5):
     mean = jnp.mean(x, axis=red, keepdims=True)
     var = jnp.var(x, axis=red, keepdims=True)
     x = (x - mean) * lax.rsqrt(var + eps)
-    x = jnp.reshape(x, data.shape)
-    shape = (1, -1) + (1,) * (data.ndim - 2)
-    return x * jnp.reshape(gamma, shape) + jnp.reshape(beta, shape)
+    # gamma/beta are per-GROUP, shape (num_groups,), applied in the grouped
+    # view (reference group_norm-inl.h:163-171 reshapes gamma to
+    # (1, num_groups, 1, ...) against the temp grouped data shape)
+    pshape = (1, num_groups) + (1,) * (x.ndim - 2)
+    x = x * jnp.reshape(gamma, pshape) + jnp.reshape(beta, pshape)
+    return jnp.reshape(x, data.shape)
 
 
 @register(name="LRN", aliases=("lrn",))

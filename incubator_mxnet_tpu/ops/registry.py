@@ -71,12 +71,15 @@ class OpDef:
     """
 
     def __init__(self, name, fn, aliases=(), stateful=False, nondiff=False,
-                 train_aware=False):
+                 train_aware=False, eager_only=False):
         self.name = name
         self.fn = fn
         self.aliases = aliases
         self.stateful = stateful
         self.nondiff = nondiff
+        # eager_only: dynamic output shape (boolean_mask) — never jit; XLA
+        # needs static shapes, so these run op-by-op with concrete inputs
+        self.eager_only = eager_only
         # train_aware ops (BatchNorm, Dropout) get `training=` injected from the
         # autograd train-mode flag when the caller didn't pass it — mirrors the
         # reference's ctx.is_train threading (include/mxnet/op_attr_types.h
@@ -150,13 +153,14 @@ class OpDef:
         return f"<Op {self.name}>"
 
 
-def register(name=None, aliases=(), stateful=False, nondiff=False, train_aware=False):
+def register(name=None, aliases=(), stateful=False, nondiff=False, train_aware=False,
+             eager_only=False):
     """Decorator: @register() on `def op_name(x, y, *, param): ...`."""
 
     def _do(fn):
         opname = name or fn.__name__
         op = OpDef(opname, fn, aliases=aliases, stateful=stateful, nondiff=nondiff,
-                   train_aware=train_aware)
+                   train_aware=train_aware, eager_only=eager_only)
         OPS.register(op, name=opname, aliases=aliases)
         return op
 
@@ -214,7 +218,7 @@ def apply_op(op: OpDef, *args, out=None, **params):
     # dispatch path (reference: engine op bulking, graph_executor.cc:1288).
     import jax.core as _core
     traced = any(isinstance(a, _core.Tracer) for a in arrs)
-    if traced:
+    if traced or op.eager_only:
         if op.stateful:
             fn = lambda rng, *xs, _p=params: op.fn(*xs, rng=rng, **_p)
         else:
@@ -227,6 +231,19 @@ def apply_op(op: OpDef, *args, out=None, **params):
         out_data, _raw_vjp = jax.vjp(fn, *arrs)
         vjp_fn = lambda cts, _v=_raw_vjp, _o=out_data: \
             _v(_match_ct_dtypes(cts, _o))
+    elif recording and op.eager_only:
+        # dynamic-shape op: the jit-cached vjp would re-trace op.fn with
+        # abstract inputs, defeating eager_only. Differentiate only arg 0
+        # (data); the rest (masks/indices) stay concrete python values so
+        # op.fn can inspect them, and get zero cotangents.
+        rest = tuple(arrs[1:])
+        out_data, _raw_vjp = jax.vjp(
+            lambda d, _r=rest, _p=params: op.fn(d, *_r, **_p), arrs[0])
+
+        def vjp_fn(cts, _v=_raw_vjp, _o=out_data, _r=rest):
+            gd = _v(_match_ct_dtypes(cts, _o))
+            import jax.numpy as _jnp
+            return (gd[0],) + tuple(_jnp.zeros_like(r) for r in _r)
     else:
         if PROFILER_HOOK is not None and not traced:
             out_data = PROFILER_HOOK(op.name, fn, arrs)

@@ -111,7 +111,7 @@ _unary("rint", jnp.rint, nondiff=True)
 _unary("ceil", jnp.ceil, nondiff=True)
 _unary("floor", jnp.floor, nondiff=True)
 _unary("trunc", jnp.trunc, nondiff=True)
-_unary("fix", jnp.fix, nondiff=True)
+_unary("fix", jnp.trunc, nondiff=True)
 _unary("exp", jnp.exp)
 _unary("log", jnp.log)
 _unary("log2", jnp.log2)
@@ -636,7 +636,7 @@ def embedding(data, weight, *, input_dim=0, output_dim=0, dtype="float32",
     return weight[data.astype(jnp.int32)]
 
 
-@register(name="boolean_mask")
+@register(name="boolean_mask", eager_only=True)
 def boolean_mask(data, index, *, axis=0):
     """Reference src/operator/contrib/boolean_mask.cc. Dynamic output shape —
     eager-only (XLA needs static shapes; inside jit use `where`)."""
@@ -791,7 +791,7 @@ def l2_normalization(data, *, eps=1e-10, mode="instance"):
     return data / nrm
 
 
-@register(name="_histogram", nondiff=True)
+@register(name="_histogram", aliases=("histogram",), nondiff=True)
 def _histogram(data, *, bin_cnt=10, range=None):
     lo, hi = range if range is not None else (float(data.min()), float(data.max()))
     hist, edges = jnp.histogram(data, bins=bin_cnt, range=(lo, hi))

@@ -167,7 +167,10 @@ INFER_PARAM_SHAPES = {
     "SyncBatchNorm": _infer_norm,
     "InstanceNorm": _infer_lnorm,
     "LayerNorm": _infer_lnorm,
-    "GroupNorm": lambda a, s: _infer_lnorm({"axis": 1}, s),
+    # gamma/beta are per-GROUP: shape (num_groups,), reference
+    # group_norm-inl.h:163 + gluon basic_layers.py:690-695
+    "GroupNorm": lambda a, s: {"gamma": (int(a.get("num_groups", 1)),),
+                               "beta": (int(a.get("num_groups", 1)),)},
     "Embedding": _infer_embedding,
 }
 

@@ -50,8 +50,7 @@ def main():
     if args.format == "markdown":
         print("|" + "|".join(["---"] * (len(cols) + 1)) + "|")
     for ep in sorted(rows):
-        vals = [f"{rows[ep].get(c, ''):{'.6g' if c in rows[ep] else ''}}"
-                if c in rows[ep] else "" for c in cols]
+        vals = [f"{rows[ep][c]:.6g}" if c in rows[ep] else "" for c in cols]
         line = sep.join([str(ep)] + vals)
         print("| " + line + " |" if args.format == "markdown" else line)
     return 0
