@@ -4,7 +4,7 @@
 
 def __getattr__(name):
     import importlib
-    lazy = {"amp": ".amp", "quantization": ".quantization"}
+    lazy = {"amp": ".amp", "quantization": ".quantization", "onnx": ".onnx"}
     if name in lazy:
         m = importlib.import_module(lazy[name], __name__)
         globals()[name] = m

@@ -361,10 +361,10 @@ def clip(a, a_min=None, a_max=None, out=None):
             if a_max is not None:
                 args3.append(_as_np(a_max))
             return apply_op(op3, *args3, out=out)
-        if a_max is not None:
-            op_hi = _op("clip_arr_hi", lambda x, hi: _jnp().clip(x, None, hi))
-            return apply_op(op_hi, _as_np(a), _as_np(a_max), out=out)
-        return apply_op(op3, _as_np(a), out=out)
+        # a_min is None here, and a_max must be set (the enclosing branch
+        # requires one array bound)
+        op_hi = _op("clip_arr_hi", lambda x, hi: _jnp().clip(x, None, hi))
+        return apply_op(op_hi, _as_np(a), _as_np(a_max), out=out)
     # scalar bounds stay static params; keep the input dtype like numpy
     op = _op("clip", lambda x, a_min, a_max:
              _jnp().clip(x,

@@ -1,0 +1,185 @@
+"""ONNX export/import (reference: tests/python-pytest/onnx/ backend tests;
+here the oracle is an exact export->import round trip plus wire-format
+checks, since the onnx runtime isn't a dependency)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib.onnx import (export_model,
+                                              get_model_metadata,
+                                              import_model)
+
+
+def _eval1(sym, bindings):
+    out = sym.eval_dict(bindings)
+    if isinstance(out, list):
+        out = out[0]
+    return out.asnumpy()
+
+
+def _fill_params(sym, data_shape, rng):
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=data_shape)
+    params = {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n != "data":
+            params[n] = nd.array(rng.uniform(-0.1, 0.1, s).astype(np.float32))
+    for n, s in zip(sym.list_auxiliary_states(), aux_shapes):
+        arr = np.zeros(s, np.float32) if "mean" in n else np.ones(s, np.float32)
+        params[n] = nd.array(arr)
+    return params
+
+
+def _roundtrip(sym, data_shape, tmp_path, rtol=1e-4, atol=1e-5):
+    rng = np.random.RandomState(0)
+    params = _fill_params(sym, data_shape, rng)
+    x = rng.randn(*data_shape).astype(np.float32)
+    ref = _eval1(sym, {**params, "data": nd.array(x)})
+    path = export_model(sym, params, data_shape,
+                        onnx_file_path=str(tmp_path / "m.onnx"))
+    sym2, arg2, aux2 = import_model(path)
+    got = _eval1(sym2, {**arg2, **aux2, "data": nd.array(x)})
+    np.testing.assert_allclose(got, ref, rtol=rtol, atol=atol)
+    return path
+
+
+def test_cnn_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, name="conv1", kernel=(3, 3), num_filter=8,
+                            pad=(1, 1))
+    b1 = mx.sym.BatchNorm(c1, name="bn1")
+    a1 = mx.sym.Activation(b1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f1 = mx.sym.Flatten(p1)
+    fc = mx.sym.FullyConnected(f1, name="fc1", num_hidden=10)
+    out = mx.sym.softmax(fc, axis=-1)
+    path = _roundtrip(out, (2, 3, 8, 8), tmp_path)
+    meta = get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 3, 8, 8))]
+    assert meta["output_tensor_data"][0][1] == (2, 10)
+
+
+def test_mlp_elemwise_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    t = mx.sym.tanh(fc1)
+    s = mx.sym.sigmoid(fc1)
+    mixed = t * s + (fc1 * 0.5) - 1.0
+    clipped = mx.sym.clip(mixed, a_min=-0.8, a_max=0.8)
+    out = mx.sym.FullyConnected(clipped, name="fc2", num_hidden=4)
+    _roundtrip(out, (3, 10), tmp_path)
+
+
+def test_reshape_transpose_reduce_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    r = mx.sym.reshape(data, shape=(2, 12))
+    e = mx.sym.expand_dims(r, axis=1)
+    tr = mx.sym.transpose(e, axes=(1, 0, 2))
+    m = mx.sym.mean(tr, axis=2, keepdims=True)
+    out = mx.sym.broadcast_add(tr, m)
+    _roundtrip(out, (2, 3, 4), tmp_path)
+
+
+def test_pool_variants_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    p1 = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    p2 = mx.sym.Pooling(p1, global_pool=True, pool_type="avg")
+    out = mx.sym.Flatten(p2)
+    _roundtrip(out, (2, 4, 8, 8), tmp_path)
+
+
+def test_deconv_leaky_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    d = mx.sym.Deconvolution(data, name="dc1", kernel=(2, 2), num_filter=3,
+                             stride=(2, 2), no_bias=True)
+    out = mx.sym.LeakyReLU(d, act_type="leaky", slope=0.1)
+    _roundtrip(out, (1, 2, 4, 4), tmp_path)
+
+
+def test_embedding_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("embed_weight")
+    e = mx.sym.Embedding(data, w, name="embed", input_dim=12, output_dim=6)
+    out = mx.sym.sum(e, axis=1)
+
+    rng = np.random.RandomState(0)
+    params = {"embed_weight": nd.array(
+        rng.uniform(-1, 1, (12, 6)).astype(np.float32))}
+    x = np.array([[0, 3, 7], [11, 2, 2]], np.float32)
+    ref = _eval1(out, {**params, "data": nd.array(x)})
+    path = export_model(out, params, (2, 3),
+                        onnx_file_path=str(tmp_path / "e.onnx"))
+    sym2, arg2, aux2 = import_model(path)
+    got = _eval1(sym2, {**arg2, "data": nd.array(x)})
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_gluon_export_to_onnx(tmp_path):
+    """Gluon -> HybridBlock.export -> symbol+params -> ONNX (the serving
+    chain, reference mx2onnx consumes Module checkpoints the same way)."""
+    from incubator_mxnet_tpu import gluon
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1), gluon.nn.Activation("relu"),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+            gluon.nn.Dense(5))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.RandomState(0).randn(2, 3, 6, 6)
+                 .astype(np.float32))
+    ref = net(x).asnumpy()
+    net.export(str(tmp_path / "g"), epoch=0)
+
+    sym = mx.sym.load(str(tmp_path / "g-symbol.json"))
+    saved = nd.load(str(tmp_path / "g-0000.params"))
+    params = {k.split(":", 1)[-1]: v for k, v in saved.items()}
+    path = export_model(sym, params, (2, 3, 6, 6),
+                        onnx_file_path=str(tmp_path / "g.onnx"))
+    sym2, arg2, aux2 = import_model(path)
+    got = _eval1(sym2, {**arg2, **aux2, "data": x})
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_metadata_only(tmp_path):
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc", num_hidden=3)
+    rng = np.random.RandomState(0)
+    params = _fill_params(out, (4, 7), rng)
+    path = export_model(out, params, (4, 7),
+                        onnx_file_path=str(tmp_path / "meta.onnx"))
+    meta = get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (4, 7))]
+    assert meta["output_tensor_data"][0][1] == (4, 3)
+
+
+def test_export_rejects_unsupported(tmp_path):
+    data = mx.sym.Variable("data")
+    out = mx.sym.erf(data)
+    with pytest.raises(mx.MXNetError):
+        export_model(out, {}, (2, 2),
+                     onnx_file_path=str(tmp_path / "bad.onnx"))
+
+
+def test_proto_tensor_codec():
+    from incubator_mxnet_tpu.contrib.onnx import _proto as P
+    for arr in [np.random.randn(3, 4).astype(np.float32),
+                np.arange(6, dtype=np.int64).reshape(2, 3),
+                np.array([True, False]),
+                np.random.randn(2, 2).astype(np.float16)]:
+        blob = P.tensor("t", arr)
+        name, back = P.tensor_to_array(P.parse(blob))
+        assert name == "t"
+        np.testing.assert_array_equal(back, arr)
+
+
+def test_proto_attribute_codec():
+    from incubator_mxnet_tpu.contrib.onnx import _proto as P
+    cases = [("i", 5), ("f", 2.5), ("s", "hello"), ("ints", [1, 2, 3]),
+             ("neg", -4)]
+    for name, val in cases:
+        blob = P.attribute(name, val)
+        n2, v2 = P.attr_value(P.parse(blob))
+        assert n2 == name
+        if isinstance(val, float):
+            assert abs(v2 - val) < 1e-6
+        else:
+            assert v2 == val or list(v2) == list(val)
