@@ -4,7 +4,8 @@
 
 def __getattr__(name):
     import importlib
-    lazy = {"amp": ".amp", "quantization": ".quantization", "onnx": ".onnx"}
+    lazy = {"amp": ".amp", "quantization": ".quantization", "onnx": ".onnx",
+            "text": ".text"}
     if name in lazy:
         m = importlib.import_module(lazy[name], __name__)
         globals()[name] = m

@@ -33,6 +33,7 @@ class _Builder:
         self.initializers = []   # encoded TensorProto bytes
         self.init_names = set()
         self.inputs = []         # (name, shape) graph inputs (non-param vars)
+        self.shapes = {}         # tensor name -> inferred shape (best effort)
         self._uid = 0
 
     def uniq(self, hint):
@@ -196,17 +197,56 @@ def _transpose(b, n, ins, out):
     b.add_node("Transpose", ins[:1], [out], **kw)
 
 
+def _softmax_decomposed(b, x, out, axis, log=False):
+    """Spec-correct softmax for any rank/axis: opset-9 Softmax coerces to
+    2D after `axis`, which matches mxnet semantics only for 2D inputs —
+    everything else is emitted as max/sub/exp/sum/div."""
+    mx_ = b.uniq("smax_max")
+    sub = b.uniq("smax_sub")
+    ex = b.uniq("smax_exp")
+    sm = b.uniq("smax_sum")
+    b.add_node("ReduceMax", [x], [mx_], axes=[axis], keepdims=1)
+    b.add_node("Sub", [x, mx_], [sub])
+    b.add_node("Exp", [sub], [ex])
+    b.add_node("ReduceSum", [ex], [sm], axes=[axis], keepdims=1)
+    if log:
+        lg = b.uniq("smax_logsum")
+        b.add_node("Log", [sm], [lg])
+        b.add_node("Sub", [sub, lg], [out])
+    else:
+        b.add_node("Div", [ex, sm], [out])
+
+
+def _softmax_axis(b, n, ins, default_axis=-1):
+    axis = int(n.attrs.get("axis", default_axis))
+    shp = b.shapes.get(ins[0])
+    if shp:
+        axis = axis % len(shp)
+    return axis, (len(shp) if shp else None)
+
+
 def _softmax(b, n, ins, out):
-    b.add_node("Softmax", ins[:1], [out], axis=int(n.attrs.get("axis", -1)))
+    axis, nd_ = _softmax_axis(b, n, ins)
+    if nd_ == 2 and axis == 1:
+        b.add_node("Softmax", ins[:1], [out], axis=1)
+    else:
+        _softmax_decomposed(b, ins[0], out, axis)
 
 
 def _log_softmax(b, n, ins, out):
-    b.add_node("LogSoftmax", ins[:1], [out],
-               axis=int(n.attrs.get("axis", -1)))
+    axis, nd_ = _softmax_axis(b, n, ins)
+    if nd_ == 2 and axis == 1:
+        b.add_node("LogSoftmax", ins[:1], [out], axis=1)
+    else:
+        _softmax_decomposed(b, ins[0], out, axis, log=True)
 
 
 def _softmax_output(b, n, ins, out):
-    b.add_node("Softmax", ins[:1], [out], axis=1)
+    shp = b.shapes.get(ins[0])
+    if shp is None or len(shp) == 2:
+        b.add_node("Softmax", ins[:1], [out], axis=1)
+    else:
+        _softmax_decomposed(b, ins[0], out, 1)
 
 
 def _concat(b, n, ins, out):
@@ -402,6 +442,21 @@ def export_model(sym, params, input_shapes, input_dtype=np.float32,
     for v, shp in zip(data_vars, in_shapes):
         graph_inputs.append(P.value_info(
             v.name, P.NP_TO_ONNX[np.dtype(input_dtype)], shp))
+
+    # best-effort per-tensor shapes so rank-sensitive translators
+    # (softmax family) can canonicalize axes
+    shape_kwargs0 = {v.name: tuple(shp)
+                     for v, shp in zip(data_vars, in_shapes)}
+    try:
+        internals = sym.get_internals()
+        _, int_shapes, _ = internals.infer_shape_partial(**shape_kwargs0)
+        for (node, oi), shp in zip(internals._outputs, int_shapes):
+            if shp:
+                b.shapes[tname(node, oi)] = tuple(shp)
+    except Exception:
+        pass
+    for name, arr in np_params.items():
+        b.shapes.setdefault(name, arr.shape)
 
     for n in order:
         if n.op is None:

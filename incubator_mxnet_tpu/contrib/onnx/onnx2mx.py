@@ -146,8 +146,9 @@ def _pool(mx_type, global_pool):
                       stride=tuple(n.attrs.get("strides", (1,) * nd_)),
                       pad=_pads2mx(n.attrs.get("pads"), nd_))
             if mx_type == "avg":
+                # ONNX spec default is 0 (exclude padding from the mean)
                 kw["count_include_pad"] = \
-                    bool(n.attrs.get("count_include_pad", 1))
+                    bool(n.attrs.get("count_include_pad", 0))
         return sym_mod._create(g.op("Pooling"), tuple(ins[:1]), kw)
     return cv
 

@@ -1,0 +1,280 @@
+"""gluon.contrib: estimator, extra nn layers, conv/variational RNN cells
+(reference tests/python/unittest/test_gluon_contrib.py +
+test_gluon_estimator.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon import contrib
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+# ------------------------------------------------------------------
+# nn layers
+# ------------------------------------------------------------------
+
+def test_concurrent():
+    layer = contrib.nn.HybridConcurrent(axis=1)
+    layer.add(gluon.nn.Dense(4), gluon.nn.Dense(3), contrib.nn.Identity())
+    layer.initialize()
+    x = nd.array(_rand(2, 5))
+    out = layer(x)
+    assert out.shape == (2, 4 + 3 + 5)
+    np.testing.assert_allclose(out.asnumpy()[:, 7:], x.asnumpy(), rtol=1e-6)
+
+    eager = contrib.nn.Concurrent(axis=-1)
+    eager.add(contrib.nn.Identity(), contrib.nn.Identity())
+    eager.initialize()
+    out = eager(x)
+    np.testing.assert_allclose(out.asnumpy(), np.concatenate([x.asnumpy()] * 2,
+                                                             axis=-1))
+
+
+def test_pixelshuffle1d():
+    layer = contrib.nn.PixelShuffle1D(2)
+    x = np.arange(12, dtype=np.float32).reshape(1, 4, 3)
+    out = layer(nd.array(x)).asnumpy()
+    assert out.shape == (1, 2, 6)
+    # out[n,c,w*f+i] == x[n, c*f+i, w]
+    for c in range(2):
+        for w in range(3):
+            for i in range(2):
+                assert out[0, c, w * 2 + i] == x[0, c * 2 + i, w]
+
+
+def test_pixelshuffle2d():
+    layer = contrib.nn.PixelShuffle2D((2, 2))
+    x = np.random.randn(2, 8, 3, 3).astype(np.float32)
+    out = layer(nd.array(x)).asnumpy()
+    assert out.shape == (2, 2, 6, 6)
+    for c in range(2):
+        for h in range(3):
+            for w in range(3):
+                for i in range(2):
+                    for j in range(2):
+                        assert out[0, c, h * 2 + i, w * 2 + j] == \
+                            x[0, c * 4 + i * 2 + j, h, w]
+
+
+def test_pixelshuffle3d():
+    layer = contrib.nn.PixelShuffle3D((1, 2, 2))
+    x = np.random.randn(1, 8, 2, 2, 2).astype(np.float32)
+    out = layer(nd.array(x)).asnumpy()
+    assert out.shape == (1, 2, 2, 4, 4)
+
+
+def test_sync_batchnorm_layer():
+    layer = contrib.nn.SyncBatchNorm(num_devices=8)
+    layer.initialize()
+    x = nd.array(_rand(4, 3, 2, 2))
+    with autograd.record():
+        out = layer(x)
+    assert out.shape == x.shape
+
+
+def test_sparse_embedding():
+    layer = contrib.nn.SparseEmbedding(10, 4)
+    layer.initialize()
+    idx = nd.array([1.0, 3.0, 1.0])
+    out = layer(idx)
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out.asnumpy()[0], out.asnumpy()[2])
+
+
+# ------------------------------------------------------------------
+# RNN cells
+# ------------------------------------------------------------------
+
+def test_conv2d_lstm_cell():
+    cell = contrib.rnn.Conv2DLSTMCell((3, 8, 8), 5, i2h_kernel=3,
+                                      h2h_kernel=3)
+    cell.initialize()
+    x = nd.array(_rand(2, 3, 8, 8))
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 5, 8, 8)
+    assert len(new_states) == 2 and new_states[1].shape == (2, 5, 8, 8)
+
+
+def test_conv1d_rnn_and_gru_cells():
+    for cls, n_states in [(contrib.rnn.Conv1DRNNCell, 1),
+                          (contrib.rnn.Conv1DGRUCell, 1)]:
+        cell = cls((4, 10), 6, i2h_kernel=3, h2h_kernel=3)
+        cell.initialize()
+        x = nd.array(_rand(2, 4, 10))
+        out, states = cell(x, cell.begin_state(batch_size=2))
+        assert out.shape == (2, 6, 10)
+        assert len(states) == n_states
+
+
+def test_conv_rnn_unroll():
+    cell = contrib.rnn.Conv2DRNNCell((2, 4, 4), 3, i2h_kernel=3, h2h_kernel=1)
+    cell.initialize()
+    seq = nd.array(_rand(2, 5, 2, 4, 4))  # NTC...
+    outs, states = cell.unroll(5, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 3, 4, 4)
+
+
+def test_conv_cell_even_h2h_rejected():
+    with pytest.raises(mx.MXNetError):
+        contrib.rnn.Conv2DRNNCell((2, 4, 4), 3, i2h_kernel=3, h2h_kernel=2)
+
+
+def test_variational_dropout_cell():
+    base = gluon.rnn.LSTMCell(8)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.3,
+                                              drop_states=0.3)
+    cell.initialize()
+    x = nd.array(_rand(4, 6, 5))
+    with autograd.record():
+        outs, states = cell.unroll(6, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (4, 6, 8)
+    # same mask each timestep: the input mask zeroes the same input columns
+    # for every t, so unrolling twice with reset gives different masks
+    m1 = cell._input_mask.asnumpy()
+    cell.reset()
+    with autograd.record():
+        cell.unroll(6, x, layout="NTC", merge_outputs=True)
+    m2 = cell._input_mask.asnumpy()
+    assert m1.shape == m2.shape
+    assert not np.allclose(m1, m2)  # fresh mask per unroll
+
+
+def test_variational_dropout_inference_identity():
+    base = gluon.rnn.RNNCell(4)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = nd.array(_rand(2, 3, 4))
+    # outside record(): no masks are drawn, so two unrolls are identical
+    outs, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    assert cell._input_mask is None
+    outs2, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    np.testing.assert_allclose(outs.asnumpy(), outs2.asnumpy(), rtol=1e-6)
+
+
+def test_lstmp_cell():
+    cell = contrib.rnn.LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize()
+    x = nd.array(_rand(2, 5))
+    out, states = cell(x, cell.begin_state(batch_size=2))
+    assert out.shape == (2, 3)
+    assert states[0].shape == (2, 3) and states[1].shape == (2, 8)
+
+
+# ------------------------------------------------------------------
+# Estimator
+# ------------------------------------------------------------------
+
+def _toy_data(n=64, d=8, classes=3, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, classes).astype(np.float32)
+    x = rng.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.randn(n, classes), axis=1)
+    batches = []
+    for i in range(0, n, batch):
+        batches.append((nd.array(x[i:i + batch]),
+                        nd.array(y[i:i + batch].astype(np.float32))))
+    return batches
+
+
+def test_estimator_fit_improves():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    data = _toy_data()
+    est = contrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        train_metrics=mx.metric.Accuracy())
+    est.fit(data, epochs=5)
+    name, acc = est.train_metrics[0].get()
+    assert acc > 0.5, acc
+
+
+def test_estimator_early_stopping_and_handlers():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(3))
+    net.initialize()
+    data = _toy_data(n=32)
+    est = contrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss())
+    # mode="max" on a decreasing loss: no "improvement" is ever seen, so
+    # early stopping must fire after `patience` epochs
+    stopper = contrib.estimator.EarlyStoppingHandler(
+        monitor=est.train_loss_metric, patience=1, mode="max")
+
+    seen = {"train_begin": 0, "epoch_end": 0, "train_end": 0}
+
+    class Spy(contrib.estimator.TrainBegin, contrib.estimator.EpochEnd,
+              contrib.estimator.TrainEnd):
+        def train_begin(self, estimator):
+            seen["train_begin"] += 1
+
+        def epoch_end(self, estimator):
+            seen["epoch_end"] += 1
+
+        def train_end(self, estimator):
+            seen["train_end"] += 1
+
+    est.fit(data, epochs=50, event_handlers=[stopper, Spy()])
+    assert seen["train_begin"] == 1 and seen["train_end"] == 1
+    assert seen["epoch_end"] < 50  # early stopping fired
+
+
+def test_estimator_validation_and_checkpoint(tmp_path):
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(3))
+    net.initialize()
+    data = _toy_data(n=32)
+    val = _toy_data(n=16, seed=1)
+    est = contrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss())
+    ckpt = contrib.estimator.CheckpointHandler(str(tmp_path), epoch_period=1,
+                                               max_checkpoints=2)
+    est.fit(data, val_data=val, epochs=3, event_handlers=[ckpt])
+    import os
+    files = sorted(os.listdir(tmp_path))
+    assert len([f for f in files if f.endswith(".params")]) == 2  # capped
+    scores = est.evaluate(val)
+    assert "val_loss" in scores and "accuracy" in scores
+
+
+def test_estimator_max_batches():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(3))
+    net.initialize()
+    data = _toy_data(n=64)
+    est = contrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss())
+    counted = []
+
+    class Count(contrib.estimator.BatchEnd):
+        def batch_end(self, estimator, batch, pred, label, loss):
+            counted.append(batch)
+
+    est.fit(data, batches=3, event_handlers=[Count()])
+    assert len(counted) == 3
+
+
+def test_estimator_validation_runs_before_user_handlers():
+    """ValidationHandler must refresh val metrics before user handlers at
+    epoch_end, so early stopping on a val metric sees the CURRENT epoch."""
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(3))
+    net.initialize()
+    data = _toy_data(n=32)
+    val = _toy_data(n=16, seed=1)
+    est = contrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss())
+    seen = []
+
+    class Probe(contrib.estimator.EpochEnd):
+        def epoch_end(self, estimator):
+            seen.append(estimator.val_loss_metric.get()[1])
+
+    est.fit(data, val_data=val, epochs=2, event_handlers=[Probe()])
+    assert len(seen) == 2
+    assert all(v == v for v in seen), seen  # no NaN: val already ran

@@ -1,0 +1,107 @@
+"""contrib.text: Vocabulary + embeddings (reference
+tests/python/unittest/test_contrib_text.py)."""
+import collections
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.contrib import text
+
+
+def test_count_tokens():
+    c = text.utils.count_tokens_from_str("a b b\nc c c", to_lower=False)
+    assert c == collections.Counter({"c": 3, "b": 2, "a": 1})
+    c2 = text.utils.count_tokens_from_str("A a", to_lower=True)
+    assert c2["a"] == 2
+
+
+def test_vocabulary_basic():
+    counter = collections.Counter(["b", "b", "a", "c", "c", "c"])
+    v = text.Vocabulary(counter)
+    assert len(v) == 4  # <unk> + 3
+    assert v.idx_to_token[0] == "<unk>"
+    assert v.to_indices("c") == 1  # most frequent first
+    assert v.to_indices(["zzz", "a"])[0] == 0  # unknown -> 0
+    assert v.to_tokens(1) == "c"
+    with pytest.raises(mx.MXNetError):
+        v.to_tokens(99)
+
+
+def test_vocabulary_limits_and_reserved():
+    counter = collections.Counter({"a": 5, "b": 4, "c": 1})
+    v = text.Vocabulary(counter, most_freq_count=1, min_freq=2,
+                        reserved_tokens=["<pad>"])
+    assert v.idx_to_token[:2] == ["<unk>", "<pad>"]
+    assert len(v) == 3  # unk, pad, a
+    assert "c" not in v.token_to_idx
+
+
+def _write_vec_file(path, table):
+    with open(path, "w") as f:
+        for tok, vec in table.items():
+            f.write(tok + " " + " ".join(str(x) for x in vec) + "\n")
+
+
+def test_custom_embedding(tmp_path):
+    table = {"hello": [1.0, 2.0, 3.0], "world": [4.0, 5.0, 6.0]}
+    p = str(tmp_path / "emb.txt")
+    _write_vec_file(p, table)
+    emb = text.embedding.CustomEmbedding(p)
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+    out = emb.get_vecs_by_tokens(["hello", "nope"])
+    np.testing.assert_allclose(out.asnumpy()[0], [1, 2, 3])
+    np.testing.assert_allclose(out.asnumpy()[1], [0, 0, 0])  # unk -> zeros
+    emb.update_token_vectors("hello", mx.nd.array([9.0, 9.0, 9.0]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+    with pytest.raises(mx.MXNetError):
+        emb.update_token_vectors("nope", mx.nd.array([1.0, 1.0, 1.0]))
+
+
+def test_embedding_with_vocabulary(tmp_path):
+    table = {"a": [1.0, 1.0], "b": [2.0, 2.0], "c": [3.0, 3.0]}
+    p = str(tmp_path / "emb.txt")
+    _write_vec_file(p, table)
+    vocab = text.Vocabulary(collections.Counter(["b", "b", "x"]))
+    emb = text.embedding.CustomEmbedding(p, vocabulary=vocab)
+    assert len(emb) == len(vocab)
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("b").asnumpy(), [2, 2])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("x").asnumpy(), [0, 0])  # not in file
+
+
+def test_composite_embedding(tmp_path):
+    t1 = {"a": [1.0], "b": [2.0]}
+    t2 = {"a": [10.0, 20.0], "c": [30.0, 40.0]}
+    p1, p2 = str(tmp_path / "e1.txt"), str(tmp_path / "e2.txt")
+    _write_vec_file(p1, t1)
+    _write_vec_file(p2, t2)
+    e1 = text.embedding.CustomEmbedding(p1)
+    e2 = text.embedding.CustomEmbedding(p2)
+    vocab = text.Vocabulary(collections.Counter(["a", "b", "c"]))
+    comp = text.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 3
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("a").asnumpy(), [1, 10, 20])
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("b").asnumpy(), [2, 0, 0])
+
+
+def test_registry_create():
+    assert text.embedding.get_pretrained_file_names("glove")
+    with pytest.raises(mx.MXNetError):
+        text.embedding.get_pretrained_file_names("nope")
+    with pytest.raises(mx.MXNetError):
+        text.embedding.create("glove")  # no local path -> gated error
+
+
+def test_glove_local_file(tmp_path):
+    p = str(tmp_path / "glove.6B.50d.txt")
+    _write_vec_file(p, {"king": [0.1, 0.2], "queen": [0.3, 0.4]})
+    emb = text.embedding.create("glove", pretrained_file_path=p)
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("queen").asnumpy(), [0.3, 0.4])

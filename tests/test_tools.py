@@ -96,3 +96,16 @@ def test_diagnose_runs():
     assert r.returncode == 0, r.stderr
     assert "Python Info" in r.stdout
     assert "incubator_mxnet_tpu" in r.stdout
+
+
+def test_measure_bandwidth_harness():
+    """tools/measure.py (reference tools/bandwidth/measure.py): allreduce
+    bandwidth of kvstore pushpull on the virtual mesh."""
+    import json
+    r = _run([os.path.join(TOOLS, "measure.py"), "--devices", "4",
+              "--rounds", "2", "--network", "inception-v3"])
+    assert r.returncode == 0, r.stderr
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["unit"] == "GB/s"
+    assert payload["value"] > 0
+    assert payload["devices"] == 4
