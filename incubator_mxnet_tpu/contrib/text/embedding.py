@@ -60,6 +60,7 @@ class _TokenEmbedding(_vocab.Vocabulary):
                         init_unknown_vec=None, encoding="utf-8"):
         """Parse `token<delim>v1<delim>v2...` lines (reference :232)."""
         tokens, vecs = [], []
+        seen: set = set()
         vec_len = None
         with io.open(pretrained_file_path, "r", encoding=encoding) as f:
             for line_num, line in enumerate(f):
@@ -79,8 +80,11 @@ class _TokenEmbedding(_vocab.Vocabulary):
                 elif len(elems) != vec_len:
                     raise MXNetError(
                         f"line {line_num + 1}: dim {len(elems)} != {vec_len}")
-                if token in self._token_to_idx:
+                # keep the FIRST occurrence; real files (GloVe 840B) contain
+                # duplicate tokens (reference embedding.py:268 does the same)
+                if token in self._token_to_idx or token in seen:
                     continue
+                seen.add(token)
                 tokens.append(token)
                 vecs.append([float(e) for e in elems])
         if vec_len is None:
@@ -126,13 +130,12 @@ class _TokenEmbedding(_vocab.Vocabulary):
             if t not in self._token_to_idx:
                 raise MXNetError(f"token {t!r} is unknown; cannot update")
         idxs = [self._token_to_idx[t] for t in toks]
-        arr = self._idx_to_vec.asnumpy().copy()
-        nv = new_vectors.asnumpy() if isinstance(new_vectors, nd.NDArray) \
-            else _np.asarray(new_vectors, _np.float32)
+        nv = new_vectors if isinstance(new_vectors, nd.NDArray) \
+            else nd.array(_np.asarray(new_vectors, _np.float32))
         if single:
-            nv = nv.reshape(1, -1)
-        arr[idxs] = nv
-        self._idx_to_vec = nd.array(arr)
+            nv = nv.reshape((1, -1))
+        # device-side row scatter — O(rows), not O(vocab x dim)
+        self._idx_to_vec[_np.asarray(idxs)] = nv
 
     def _build_for_vocabulary(self, vocabulary, source):
         """Restrict `source` embedding to `vocabulary`'s tokens

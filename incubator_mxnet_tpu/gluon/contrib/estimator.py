@@ -62,15 +62,17 @@ class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
     def train_begin(self, estimator):
         self.current_batch = 0
         self.current_epoch = 0
+        if self.max_batch == 0 or self.max_epoch == 0:
+            estimator.stop_training = True
 
     def batch_end(self, estimator, batch, pred, label, loss):
         self.current_batch += 1
-        if self.max_batch and self.current_batch >= self.max_batch:
+        if self.max_batch is not None and self.current_batch >= self.max_batch:
             estimator.stop_training = True
 
     def epoch_end(self, estimator):
         self.current_epoch += 1
-        if self.max_epoch and self.current_epoch >= self.max_epoch:
+        if self.max_epoch is not None and self.current_epoch >= self.max_epoch:
             estimator.stop_training = True
 
 

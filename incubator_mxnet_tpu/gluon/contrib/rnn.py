@@ -8,8 +8,7 @@ from __future__ import annotations
 
 from ... import ndarray as nd
 from ...base import MXNetError
-from ..rnn.rnn_cell import (ModifierCell, RecurrentCell, _BaseRNNCell,
-                            _format_sequence, _merge_outputs)
+from ..rnn.rnn_cell import ModifierCell, RecurrentCell
 
 __all__ = ["VariationalDropoutCell", "LSTMPCell", "Conv1DRNNCell",
            "Conv2DRNNCell", "Conv3DRNNCell", "Conv1DLSTMCell",

@@ -117,16 +117,36 @@ def _scalar_eval(fn, arrays, ai, perturbed):
         arrays[ai]._data = saved
 
 
-def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-5):
-    """Run fn on several contexts/dtypes and compare (the reference's key
-    cross-device oracle, test_utils.py:1304 — CPU-vs-GPU there, cpu-vs-tpu/
-    bf16 here)."""
+def check_consistency(fn, inputs, ctx_list=None, dtype_list=None, rtol=None,
+                      atol=None, ref_dtype="float32"):
+    """Run fn across a (context x dtype) matrix and compare every run
+    against the highest-precision one — the reference's cross-device
+    oracle (test_utils.py:1304), which validates GPU kernels against CPU
+    there and bf16/f16 TPU paths against fp32 here.
+
+    Each entry of the matrix gets dtype-aware tolerances unless rtol/atol
+    are forced. Returns {(ctx, dtype): np output}.
+    """
     ctx_list = ctx_list or [cpu(0)]
-    outs = []
+    dtype_list = dtype_list or [ref_dtype]
+    results = {}
     for ctx in ctx_list:
-        arrs = [nd.array(x, ctx=ctx) for x in inputs]
-        out = fn(*arrs)
-        outs.append(_to_np(out if not isinstance(out, (list, tuple)) else out[0]))
-    for o in outs[1:]:
-        _np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
-    return outs
+        for dt in dtype_list:
+            arrs = [nd.array(_np.asarray(x), ctx=ctx).astype(dt)
+                    for x in inputs]
+            out = fn(*arrs)
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            results[(str(ctx), str(dt))] = _to_np(out)
+    ref_key = next((k for k in results if k[1] == str(ref_dtype)),
+                   next(iter(results)))
+    ref = results[ref_key].astype(_np.float64)
+    for key, o in results.items():
+        if key == ref_key:
+            continue
+        drt, dat = _dtype_tol(o.dtype)
+        _np.testing.assert_allclose(
+            o.astype(_np.float64), ref,
+            rtol=rtol if rtol is not None else drt,
+            atol=atol if atol is not None else dat,
+            err_msg=f"{key} inconsistent with {ref_key}")
+    return results

@@ -889,3 +889,27 @@ def test_higher_order_not_required_but_chain():
     sp = np.log1p(np.exp(x))
     ref = s * s + sp * s * (1 - s)
     np.testing.assert_allclose(a.grad.asnumpy(), ref, rtol=1e-3, atol=1e-5)
+
+
+def test_check_consistency_dtype_matrix():
+    """Cross-dtype oracle (reference test_utils.py:1304): the same op run
+    in float32/float16/bfloat16 must agree within dtype tolerance."""
+    from incubator_mxnet_tpu.test_utils import check_consistency
+
+    def f(a, b):
+        return nd.dot(a, b)
+
+    res = check_consistency(
+        f, [_rand(8, 8), _rand(8, 8)],
+        dtype_list=["float32", "float16", "bfloat16"])
+    assert len(res) == 3
+
+    # and it catches real divergence
+    def broken(a):
+        if a.dtype == np.float16:
+            return a * 1.5
+        return a
+
+    with pytest.raises(AssertionError):
+        check_consistency(broken, [_rand(4, 4)],
+                          dtype_list=["float32", "float16"])
