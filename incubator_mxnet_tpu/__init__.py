@@ -103,6 +103,7 @@ def __getattr__(name):
         "contrib": ".contrib",
         "amp": ".contrib.amp",
         "engine": ".engine",
+        "fault": ".fault",
         "executor": ".executor",
         "operator": ".operator",
         "np": ".numpy",

@@ -134,7 +134,15 @@ class _TokenEmbedding(_vocab.Vocabulary):
             else nd.array(_np.asarray(new_vectors, _np.float32))
         if single:
             nv = nv.reshape((1, -1))
-        # device-side row scatter — O(rows), not O(vocab x dim)
+        # dedup keeping the LAST row per token (jax scatter with repeated
+        # indices is implementation-defined), then device-side row scatter
+        last = {}
+        for pos, i in enumerate(idxs):
+            last[i] = pos
+        keep = sorted(last.values())
+        if len(keep) != len(idxs):
+            nv = nd.take(nv, nd.array(_np.asarray(keep, _np.float32)))
+            idxs = [idxs[p] for p in keep]
         self._idx_to_vec[_np.asarray(idxs)] = nv
 
     def _build_for_vocabulary(self, vocabulary, source):

@@ -133,7 +133,7 @@ def test_image_iter_from_rec(tmp_path):
     it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
                          path_imgrec=rec_path, path_imgidx=idx_path,
                          shuffle=False)
-    batch = next(iter([it.next()]))
+    batch = it.next()
     assert batch.data[0].shape == (4, 3, 16, 16)
     assert batch.label[0].shape == (4,)
     it.reset()
