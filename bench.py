@@ -39,10 +39,18 @@ PEAK_TFLOPS = {
 
 
 def _sync(x):
+    """Wait for x AND force a one-element host readback: through tunneled
+    backends block_until_ready can resolve before device completion, which
+    would time dispatch instead of compute."""
     import jax
-    jax.tree_util.tree_map(
-        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
-        else a, x)
+    leaves = [a for a in jax.tree_util.tree_leaves(x)
+              if hasattr(a, "block_until_ready")]
+    for a in leaves:
+        a.block_until_ready()
+    if leaves:
+        last = leaves[-1]
+        raw = getattr(last, "_data", last)
+        np.asarray(raw.reshape(-1)[:1])
 
 
 def _device_peak():

@@ -50,12 +50,22 @@ def _jax_devices_for(device_typename: str):
     import jax
     plats = {"tpu": _TPU_PLATFORMS, "gpu": _GPU_PLATFORMS}.get(
         device_typename, (device_typename,))
+    # local_devices: under a multi-process (pod) runtime jax.devices() is
+    # GLOBAL and placing eager arrays on another process's device is
+    # invalid — a Context always names a process-local device (the
+    # reference's Context is likewise node-local)
     out = []
-    for d in jax.devices():
+    for d in jax.local_devices():
         if d.platform.lower() in plats:
             out.append(d)
     if device_typename == "cpu" and not out:
-        out = jax.devices("cpu")
+        # default-backend local_devices may be TPU-only; ask the cpu
+        # backend for ITS process-local devices (never the global list —
+        # placing eager arrays on another process's device is invalid)
+        try:
+            out = jax.local_devices(backend="cpu")
+        except RuntimeError:
+            out = jax.devices("cpu")
     return out
 
 

@@ -71,7 +71,19 @@ class CheckpointManager:
         os.close(fd)
         try:
             writer(tmp)
+            # flush DATA before the rename: a journaled rename without a
+            # data fsync can survive power loss pointing at torn content
+            fd2 = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd2)
+            finally:
+                os.close(fd2)
             os.replace(tmp, path)  # atomic on POSIX
+            dirfd = os.open(self.directory, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
         finally:
             if os.path.exists(tmp):
                 os.remove(tmp)
@@ -101,8 +113,6 @@ class CheckpointManager:
         def write_manifest(tmp):
             with open(tmp, "w") as f:
                 f.write(json.dumps(man, indent=1))
-                f.flush()
-                os.fsync(f.fileno())
 
         self._write_atomic(self._manifest_path(), write_manifest)
         return ppath

@@ -60,6 +60,26 @@ def _sum_fn(n):
 
 
 @functools.lru_cache(maxsize=1)
+def _flat_collective_mesh():
+    """One flat mesh over every global device, reserved for kvstore
+    cross-process collectives (axis '_kvall')."""
+    import jax
+    from .parallel.mesh import make_mesh
+    return make_mesh({"_kvall": len(jax.devices())})
+
+
+@functools.lru_cache(maxsize=4)
+def _axis0_mean_fn(mesh):
+    """Cached jitted `sum(a, axis=0) / d` with replicated output on `mesh`
+    — ONE compile per (mesh, shape, dtype), not one per push."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.jit(lambda a, d: jnp.sum(a, axis=0) / d,
+                   out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.lru_cache(maxsize=1)
 def _two_bit_fn():
     import jax
     import jax.numpy as jnp
@@ -119,12 +139,46 @@ class KVStore:
     def _replicate(self, arr):
         """Place a jax array replicated over the mesh (tpu type) so every
         device holds the authoritative value — the role of the reference's
-        broadcast stage in comm.h (2-stage reduce/bcast)."""
+        broadcast stage in comm.h (2-stage reduce/bcast).
+
+        Multi-process (a pod / the dist_* types): a plain device_put to a
+        global sharding would try to copy into non-addressable devices, so
+        the value travels through the cross-process reducer instead (every
+        process is required to call push/init collectively with the same
+        keys, like the reference's dist_sync protocol)."""
         if self._mesh is None:
             return arr
         import jax
+        if jax.process_count() > 1:
+            return self._cross_process_mean(arr)
         from jax.sharding import NamedSharding, PartitionSpec as P
         return jax.device_put(arr, NamedSharding(self._mesh, P()))
+
+    def _cross_process_mean(self, arr, scale_to_sum=False):
+        """All-reduce `arr` across processes; returns a fully-replicated
+        global array every process can address.
+
+        Each local device contributes the process-local value on the lead
+        axis of a dedicated flat mesh (NOT self._mesh — a user tp/sp mesh
+        has no reserved axis for this); a cached jitted sum over that axis
+        lowers to an ICI/DCN all-reduce (SURVEY §5.8: the dist_sync server
+        aggregation, minus the server). scale_to_sum=True returns the SUM
+        over processes (gradient push).
+        """
+        import jax
+        import numpy as _onp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = _flat_collective_mesh()
+        n_local = jax.local_device_count()
+        n_total = len(mesh.devices.flat)
+        host = _onp.asarray(jax.device_get(arr))
+        local = _onp.broadcast_to(host, (n_local,) + host.shape)
+        g = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("_kvall")), local,
+            (n_total,) + host.shape)
+        denom = float(n_local if scale_to_sum else n_total)
+        return _axis0_mean_fn(mesh)(g, denom)
 
     def _merge(self, key, value):
         vals = value if isinstance(value, (list, tuple)) else [value]
@@ -188,6 +242,11 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k!r} not initialized")
             merged = self._merge(k, v)
+            import jax
+            if self._mesh is not None and jax.process_count() > 1:
+                # dist_sync aggregation: SUM over workers (reference
+                # kvstore_dist_server.h ApplyUpdates waits for all pushes)
+                merged = self._cross_process_mean(merged, scale_to_sum=True)
             stored = self._store[k]
             if self._updater is not None:
                 self._updater(self._updater_key(k), NDArray(merged), stored)
@@ -207,14 +266,23 @@ class KVStore:
                 raise MXNetError(f"key {k!r} not initialized")
             tgts = o if isinstance(o, (list, tuple)) else [o]
             for t in tgts:
-                val = self._store[k]._data.astype(t.dtype)
+                val = self._store[k]._data
                 # land on the out array's own devices (reference pull copies
                 # into each device's buffer) so eager ops downstream don't
-                # mix single-device and mesh-replicated operands
+                # mix single-device and mesh-replicated operands. NOTE: no
+                # eager ops (astype!) on `val` before the addressability
+                # check — jax rejects eager ops on non-fully-addressable
+                # arrays.
                 tgt_sharding = getattr(t._data, "sharding", None)
-                if tgt_sharding is not None and val.sharding != tgt_sharding:
+                if not val.is_fully_addressable:
+                    # global replicated -> local copy via host (a direct
+                    # device_put/astype would touch non-addressable devices)
+                    val = jax.device_get(val)
+                    val = jax.device_put(val, tgt_sharding) \
+                        if tgt_sharding is not None else jax.numpy.asarray(val)
+                elif tgt_sharding is not None and val.sharding != tgt_sharding:
                     val = jax.device_put(val, tgt_sharding)
-                t._data = val
+                t._data = val.astype(t.dtype)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused push+pull (reference kvstore.py pushpull): the gradient

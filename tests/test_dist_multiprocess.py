@@ -1,0 +1,75 @@
+"""TRUE multi-process distributed kvstore (reference
+tests/nightly/dist_sync_kvstore.py, launched as local processes by
+tools/launch.py — SURVEY §4.5). Spawns two OS processes that join a
+jax.distributed CPU cluster; push/pull aggregates ACROSS processes over
+gloo collectives."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                               num_processes=nproc, process_id=pid)
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == pid and kv.num_workers == nproc
+
+    # 1) push different values from each worker -> everyone pulls the SUM
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.push("w", mx.nd.array(np.full((4,), float(pid + 1), np.float32)))
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    expect = sum(range(1, nproc + 1))
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+    # 2) second round: push replaces (no updater), sum again
+    kv.push("w", mx.nd.array(np.full((4,), 10.0 * (pid + 1), np.float32)))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 10.0 * expect)
+
+    # 3) barrier is a real cross-process rendezvous
+    kv.barrier()
+    print("WORKER_OK", pid, flush=True)
+""")
+
+
+@pytest.mark.timeout(300)
+def test_dist_sync_two_processes(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), "2", port],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed workers timed out")
+        outs.append((p.returncode, out, err))
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"worker {i} failed:\n{err[-2000:]}"
+        assert f"WORKER_OK {i}" in out
