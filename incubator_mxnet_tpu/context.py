@@ -65,7 +65,9 @@ def _jax_devices_for(device_typename: str):
         try:
             out = jax.local_devices(backend="cpu")
         except RuntimeError:
-            out = jax.devices("cpu")
+            out = [d for d in jax.devices("cpu")
+                   if d.process_index == jax.process_index()] or \
+                jax.devices("cpu")
     return out
 
 

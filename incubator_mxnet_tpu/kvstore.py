@@ -150,7 +150,12 @@ class KVStore:
             return arr
         import jax
         if jax.process_count() > 1:
-            return self._cross_process_mean(arr)
+            # multi-process: the authoritative copy is process-LOCAL (all
+            # processes hold identical values after each collective) so
+            # every downstream eager op — updater, astype, pull — runs on
+            # fully-addressable arrays. No global-sharded storage.
+            return jax.numpy.asarray(jax.device_get(arr)) \
+                if not getattr(arr, "is_fully_addressable", True) else arr
         from jax.sharding import NamedSharding, PartitionSpec as P
         return jax.device_put(arr, NamedSharding(self._mesh, P()))
 
@@ -178,7 +183,9 @@ class KVStore:
             NamedSharding(mesh, P("_kvall")), local,
             (n_total,) + host.shape)
         denom = float(n_local if scale_to_sum else n_total)
-        return _axis0_mean_fn(mesh)(g, denom)
+        out = _axis0_mean_fn(mesh)(g, denom)
+        # hand back a process-LOCAL copy so callers can run eager ops on it
+        return jax.numpy.asarray(jax.device_get(out))
 
     def _merge(self, key, value):
         vals = value if isinstance(value, (list, tuple)) else [value]
@@ -245,7 +252,9 @@ class KVStore:
             import jax
             if self._mesh is not None and jax.process_count() > 1:
                 # dist_sync aggregation: SUM over workers (reference
-                # kvstore_dist_server.h ApplyUpdates waits for all pushes)
+                # kvstore_dist_server.h ApplyUpdates waits for all pushes).
+                # The ONE collective of the push; result is process-local,
+                # so the updater/astype below are plain eager ops.
                 merged = self._cross_process_mean(merged, scale_to_sum=True)
             stored = self._store[k]
             if self._updater is not None:

@@ -35,6 +35,9 @@ class TransformerConfig:
     max_len: int = 2048
     dtype: str = "bfloat16"
     remat: bool = True          # jax.checkpoint each block (HBM for FLOPs)
+    # Pallas blocked flash attention for the non-sp path (O(T) memory,
+    # parallel/flash_attention.py); the sp path always uses ring attention
+    flash_attention: bool = False
 
 
 class TransformerLM:
@@ -96,6 +99,9 @@ class TransformerLM:
         v = (h @ params[prefix + "wv"]).reshape(B, T, h_local, hd)
         if sp_axis is not None:
             attn = ring_attention(q, kk, v, sp_axis, causal=True)
+        elif self.cfg.flash_attention:
+            from ..parallel.flash_attention import flash_attention
+            attn = flash_attention(q, kk, v, causal=True)
         else:
             attn = attention_reference(q, kk, v, causal=True)
         attn_out = attn.reshape(B, T, d_local) @ params[prefix + "wo"]

@@ -17,10 +17,11 @@ from .mesh import make_mesh, local_mesh_axis_sizes
 from .functional import functionalize
 from .train import TrainStep, shard_batch
 from .ring_attention import ring_attention, ring_attention_sharded
+from .flash_attention import flash_attention
 from .tensor_parallel import (column_parallel_spec, row_parallel_spec,
                               transformer_param_specs)
 
 __all__ = ["make_mesh", "local_mesh_axis_sizes", "functionalize", "TrainStep",
            "shard_batch", "ring_attention", "ring_attention_sharded",
-           "column_parallel_spec", "row_parallel_spec",
+           "flash_attention", "column_parallel_spec", "row_parallel_spec",
            "transformer_param_specs"]

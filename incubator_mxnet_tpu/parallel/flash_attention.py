@@ -1,0 +1,229 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op the reference implements as fused CUDA matmuls
+(src/operator/contrib/transformer.cc interleaved-matmul attention) —
+here a real blocked online-softmax kernel: one grid instance per
+(batch*head, q_block), K/V streamed block-by-block from VMEM with running
+(max, sumexp, acc) statistics, so the full (Tq, Tk) score matrix never
+materializes in HBM. O(T) memory instead of O(T^2), the standard
+flash-attention recurrence (Dao et al.; same math as
+ring_attention._block_attn).
+
+Public entry `flash_attention(q, k, v, causal, sm_scale)` uses the
+reference layout (B, T, H, D) and falls back to `attention_reference`
+when the shape doesn't tile (tiny heads / ragged lengths). Off-TPU the
+kernel runs in Pallas interpret mode, so the same code path is tested on
+the CPU mesh. Backward is recompute-based via jax.custom_vjp (flash
+backward kernels trade FLOPs for memory the same way).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "pallas_available"]
+
+_NEG_INF = -1e30
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_available():
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        from jax.experimental.pallas import tpu  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc, *,
+               block_q, block_k, causal, sm_scale):
+    """One (batch*head, q_block, kv_block) grid step. The kv axis is the
+    innermost ('arbitrary') grid dimension, so Pallas double-buffers the
+    K/V block DMAs while this step computes; running (max, sumexp, acc)
+    stats live in VMEM scratch that persists across kv steps.
+
+    Refs: q (1, block_q, d) | kt (1, d, block_k) | v (1, block_k, d)
+    | o (1, block_q, d); scratch m,l (block_q, 128) acc (block_q, d)."""
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    iq = pl.program_id(1)
+    q_offset = iq * block_q
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    # causal: a kv block strictly above the diagonal contributes nothing
+    run = (j * block_k <= q_offset + block_q - 1) if causal else (j < n_k)
+
+    @pl.when(run)
+    def _step():
+        # matmuls stay in bf16 (full MXU rate; fp32 operands would force
+        # 3-pass emulation) with f32 accumulation via
+        # preferred_element_type; precision must stay DEFAULT — HIGHEST
+        # lowers to contract_precision<fp32>, rejected for bf16 operands
+        q = q_ref[0] * jnp.asarray(sm_scale, q_ref.dtype)
+        kt = k_ref[0]                      # (d, block_k), pre-transposed
+        v = v_ref[0]                       # (block_k, d)
+        s = lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+                            precision=lax.Precision.DEFAULT,
+                            preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_offset + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_prev = m_sc[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[:, 0] = l_sc[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_sc[:] = acc_sc[:] * alpha[:, None] + lax.dot(
+            p.astype(v.dtype), v, precision=lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)
+        m_sc[:, 0] = m_new
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        l = l_sc[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows -> zeros
+        o_ref[0] = (acc_sc[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """q,k,v: (BH, T, D)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    kt = k.transpose(0, 2, 1)   # (BH, D, Tk) for the kernel's matmul
+    grid = (bh, tq // block_q, tk // block_k)
+    kern = functools.partial(_fa_kernel, block_q=block_q, block_k=block_k,
+                             causal=causal, sm_scale=sm_scale)
+    try:
+        params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except TypeError:
+        params = None
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, d, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sumexp
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(q, kt, v)
+
+
+def _pick_block(t, preferred):
+    for b in (preferred, 256, 128, 64, 32, 16, 8):
+        if b <= t and t % b == 0:
+            return b
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, sm_scale):
+    return _flash_fwd_impl(q, k, v, causal, sm_scale)
+
+
+def _flash_fwd_impl(q, k, v, causal, sm_scale):
+    from .ring_attention import attention_reference
+
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    bq = _pick_block(Tq, 256)
+    bk = _pick_block(Tk, 512)
+    if not pallas_available() or bq is None or bk is None or D % 8:
+        return attention_reference(q, k, v, causal=causal,
+                                   sm_scale=sm_scale)
+    interpret = jax.default_backend() != "tpu"
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, -1, D)
+    out = _fa_forward(to_bh(q), to_bh(k), to_bh(v), causal, sm_scale,
+                      bq, bk, interpret)
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale):
+    return _flash_fwd_impl(q, k, v, causal, sm_scale), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, sm_scale, res, g):
+    """Blocked backward: lax.scan over q blocks, recomputing each block's
+    scores — peak memory O(block_q * T) like the forward, NOT the dense
+    O(T^2) vjp. Same trade as flash-attention backward kernels."""
+    from jax import lax
+    q, k, v = res
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    bq = _pick_block(Tq, 256)
+    if bq is None or bq == Tq:
+        # tiny/ragged: dense vjp of the reference is fine at this size
+        from .ring_attention import attention_reference
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: attention_reference(
+                q_, k_, v_, causal=causal, sm_scale=sm_scale), q, k, v)
+        return vjp(g)
+
+    f32 = jnp.float32
+    n = Tq // bq
+    k32, v32 = k.astype(f32), v.astype(f32)
+    qs = q.reshape(B, n, bq, H, D).transpose(1, 0, 2, 3, 4)
+    gs = g.reshape(B, n, bq, H, D).transpose(1, 0, 2, 3, 4)
+    cols = jnp.arange(Tk)
+
+    def step(carry, inp):
+        dk, dv = carry
+        i, qb, gb = inp
+        qb32, gb32 = qb.astype(f32), gb.astype(f32)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qb32, k32) * sm_scale
+        if causal:
+            rows = i * bq + jnp.arange(bq)
+            s = jnp.where((rows[:, None] >= cols[None, :])[None, None],
+                          s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        dv_new = dv + jnp.einsum("bhqk,bqhd->bkhd", p, gb32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gb32, v32)
+        delta = jnp.sum(dp * p, axis=-1, keepdims=True)
+        ds = p * (dp - delta)
+        dqb = jnp.einsum("bhqk,bkhd->bqhd", ds, k32) * sm_scale
+        dk_new = dk + jnp.einsum("bhqk,bqhd->bkhd", ds, qb32) * sm_scale
+        return (dk_new, dv_new), dqb
+
+    (dk, dv), dqs = lax.scan(
+        step, (jnp.zeros_like(k32), jnp.zeros_like(v32)),
+        (jnp.arange(n), qs, gs))
+    dq = dqs.transpose(1, 0, 2, 3, 4).reshape(B, Tq, H, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None):
+    """Blocked flash attention. q,k,v: (B, T, H, D) (the layout of
+    attention_reference / the transformer flagship). Differentiable."""
+    if sm_scale is None:
+        import math
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, bool(causal), float(sm_scale))

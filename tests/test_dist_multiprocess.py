@@ -42,7 +42,27 @@ WORKER = textwrap.dedent("""
     kv.pull("w", out=out)
     np.testing.assert_allclose(out.asnumpy(), 10.0 * expect)
 
-    # 3) barrier is a real cross-process rendezvous
+    # 3) mixed dtype: bf16 gradient pushed into an fp32 store
+    kv.init("mix", mx.nd.zeros((4,)))
+    kv.push("mix", mx.nd.array(np.full((4,), float(pid + 1),
+                                       np.float32)).astype("bfloat16"))
+    outm = mx.nd.zeros((4,))
+    kv.pull("mix", out=outm)
+    np.testing.assert_allclose(outm.asnumpy(), expect, rtol=1e-2)
+
+    # 4) server-side optimizer (set_optimizer): updater runs on the
+    # cross-process summed gradient
+    import incubator_mxnet_tpu.optimizer as opt
+    kv2 = mx.kv.create("dist_sync")
+    kv2.init("w2", mx.nd.ones((4,)))
+    kv2.set_optimizer(opt.create("sgd", learning_rate=0.1))
+    kv2.push("w2", mx.nd.array(np.full((4,), 1.0, np.float32)))
+    out2 = mx.nd.zeros((4,))
+    kv2.pull("w2", out=out2)
+    # grad sum = nproc -> w = 1 - 0.1 * nproc
+    np.testing.assert_allclose(out2.asnumpy(), 1.0 - 0.1 * nproc, rtol=1e-5)
+
+    # 5) barrier is a real cross-process rendezvous
     kv.barrier()
     print("WORKER_OK", pid, flush=True)
 """)
