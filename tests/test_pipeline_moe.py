@@ -1,0 +1,187 @@
+"""Pipeline (pp) and expert (ep) parallelism on the virtual mesh — the
+two parallelism axes the reference lacks entirely (SURVEY §2.3 marks
+both as TPU-native goals beyond parity)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu.parallel import (init_moe_params, make_mesh,
+                                          moe_apply, moe_sharded,
+                                          pipeline_sharded)
+
+
+def _stage(params, h):
+    W, b = params
+    return jnp.tanh(h @ W + b)
+
+
+def _stacked_stages(S, d, seed=0):
+    rng = np.random.RandomState(seed)
+    Ws = jnp.asarray(rng.randn(S, d, d).astype(np.float32) * 0.3)
+    bs = jnp.asarray(rng.randn(S, d).astype(np.float32) * 0.1)
+    return Ws, bs
+
+
+def _seq_ref(Ws, bs, x):
+    h = x
+    for s in range(Ws.shape[0]):
+        h = jnp.tanh(h @ Ws[s] + bs[s])
+    return h
+
+
+@pytest.mark.parametrize("n_micro", [4, 8])
+def test_pipeline_matches_sequential(n_micro):
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    Ws, bs = _stacked_stages(4, 16)
+    x = jnp.asarray(np.random.RandomState(1).randn(8, 16).astype(np.float32))
+    out = pipeline_sharded(_stage, (Ws, bs), x, mesh, n_microbatches=n_micro)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_seq_ref(Ws, bs, x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_grads():
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    Ws, bs = _stacked_stages(4, 8, seed=2)
+    x = jnp.asarray(np.random.RandomState(3).randn(8, 8).astype(np.float32))
+
+    def loss_pp(Ws, bs):
+        return (pipeline_sharded(_stage, (Ws, bs), x, mesh,
+                                 n_microbatches=4) ** 2).sum()
+
+    def loss_ref(Ws, bs):
+        return (_seq_ref(Ws, bs, x) ** 2).sum()
+
+    g1 = jax.grad(loss_pp, argnums=(0, 1))(Ws, bs)
+    g2 = jax.grad(loss_ref, argnums=(0, 1))(Ws, bs)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_composes_with_dp():
+    """pp x dp on the same mesh: the pipeline runs per dp shard."""
+    from incubator_mxnet_tpu.parallel._compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    mesh = make_mesh({"dp": 2, "pp": 4})
+    Ws, bs = _stacked_stages(4, 8, seed=4)
+    x = jnp.asarray(np.random.RandomState(5).randn(16, 8).astype(np.float32))
+
+    from incubator_mxnet_tpu.parallel.pipeline import pipeline_apply
+
+    def inner(Ws, bs, xx):
+        local = (Ws[0], bs[0])
+        return pipeline_apply(_stage, local, xx, "pp", 4)
+
+    out = shard_map(inner, mesh,
+                    in_specs=(P("pp"), P("pp"), P("dp")),
+                    out_specs=P("dp"))(Ws, bs, x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_seq_ref(Ws, bs, x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_rejects_ragged_microbatches():
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    Ws, bs = _stacked_stages(4, 8)
+    x = jnp.zeros((6, 8), jnp.float32)
+    with pytest.raises(Exception):
+        pipeline_sharded(_stage, (Ws, bs), x, mesh, n_microbatches=4)
+
+
+# ------------------------------------------------------------------
+# MoE / expert parallelism
+# ------------------------------------------------------------------
+
+def _moe_setup(E=8, d=16, dff=32, N=64, seed=0):
+    params = init_moe_params(jax.random.PRNGKey(seed), d, dff, E)
+    x = jnp.asarray(np.random.RandomState(seed + 1).randn(N, d)
+                    .astype(np.float32))
+    return params, x
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_moe_ep_matches_dense(k):
+    params, x = _moe_setup()
+    y_ref, aux_ref = moe_apply(x, params, k=k)
+    mesh = make_mesh({"ep": 4}, devices=jax.devices()[:4])
+    y_ep, aux_ep = moe_sharded(x, params, mesh, k=k)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux_ep), float(aux_ref), rtol=1e-5)
+
+
+def test_moe_routes_to_multiple_experts():
+    params, x = _moe_setup()
+    from incubator_mxnet_tpu.parallel.moe import moe_gate
+    dispatch, combine, aux = moe_gate(x, params["wg"], k=1)
+    used = np.asarray(dispatch.any(axis=(0, 2)))
+    assert used.sum() >= 2  # routing actually spreads tokens
+    # every dispatched token has a matching combine weight
+    assert float(combine[np.asarray(dispatch)].min()) > 0
+
+
+def test_moe_capacity_drops_overflow():
+    params, x = _moe_setup(E=2, N=32)
+    from incubator_mxnet_tpu.parallel.moe import moe_gate
+    dispatch, _, _ = moe_gate(x, params["wg"], k=1, capacity_factor=0.25)
+    C = dispatch.shape[-1]
+    assert C == 4  # 0.25 * 32 / 2
+    per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+    assert (per_expert <= C).all()
+
+
+def test_moe_grads_flow():
+    params, x = _moe_setup(E=4, N=32)
+
+    def loss(params):
+        y, aux = moe_apply(x, params, k=1)
+        return (y ** 2).sum() + 0.01 * aux
+
+    g = jax.grad(loss)(params)
+    for name in ("wg", "w1", "w2"):
+        assert float(jnp.abs(g[name]).max()) > 0, name
+
+
+def test_moe_in_train_loop_converges():
+    """Tiny regression task through the ep-sharded layer."""
+    mesh = make_mesh({"ep": 2}, devices=jax.devices()[:2])
+    params, x = _moe_setup(E=4, d=8, dff=16, N=32, seed=7)
+    target = jnp.asarray(np.random.RandomState(9).randn(32, 8)
+                         .astype(np.float32))
+
+    @jax.jit
+    def step(params):
+        def loss(p):
+            y, aux = moe_sharded(x, p, mesh)
+            return ((y - target) ** 2).mean() + 0.01 * aux
+        l, g = jax.value_and_grad(loss)(params)
+        params = jax.tree_util.tree_map(lambda p, gg: p - 0.1 * gg,
+                                        params, g)
+        return params, l
+
+    losses = []
+    for _ in range(100):
+        params, l = step(params)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.9, losses[::20]
+
+
+def test_moe_topk_no_slot_collision():
+    """k=2: the second round must continue each expert's queue, never
+    re-assign occupied (expert, slot) pairs."""
+    params, x = _moe_setup(E=8, N=64)
+    from incubator_mxnet_tpu.parallel.moe import moe_gate
+    dispatch, _, _ = moe_gate(x, params["wg"], k=2)
+    per_slot = np.asarray(dispatch.sum(axis=0))      # tokens per (E, C)
+    assert per_slot.max() <= 1, per_slot.max()
+
+
+def test_pipeline_rejects_stage_mismatch():
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    Ws, bs = _stacked_stages(8, 8)   # 8 layers on a 4-stage pipeline
+    x = jnp.zeros((8, 8), jnp.float32)
+    with pytest.raises(ValueError):
+        pipeline_sharded(_stage, (Ws, bs), x, mesh, n_microbatches=4)
