@@ -70,8 +70,11 @@ def moe_apply(x, params, axis_name=None, k=1, capacity_factor=1.25,
     """One MoE FFN layer. x: (N, d). params: dict with
     wg (d, E), w1 (E_local, d, dff), w2 (E_local, dff, d).
 
-    With axis_name (inside shard_map): E = E_local * ep_size; tokens move
-    shard->expert with all_to_all and back. Without: E = E_local (dense
+    With axis_name (inside shard_map): E = E_local * ep_size; each shard
+    builds only ITS experts' input queues (gating is replicated, the
+    dispatch tensor is sliced to the local expert block before the queue
+    einsum), runs its expert FFNs, and all-gathers the expert outputs for
+    the replicated combine. Without axis_name: E = E_local (dense
     single-shard MoE, the numeric oracle)."""
     wg, w1, w2 = params["wg"], params["w1"], params["w2"]
     N, d = x.shape
@@ -82,22 +85,19 @@ def moe_apply(x, params, axis_name=None, k=1, capacity_factor=1.25,
     dispatch, combine, aux = moe_gate(x, wg, k=k,
                                       capacity_factor=capacity_factor)
     C = dispatch.shape[-1]
-    # expert input queues: (E, C, d) — computed identically on every ep
-    # shard (x and wg are replicated across ep; token sharding composes
-    # via a separate dp axis)
-    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
     if axis_name is not None:
-        # each shard runs ITS slice of experts, then the outputs are
-        # all-gathered so every shard can combine (see module docstring
-        # for the sharding contract)
+        # slice dispatch to the local expert block FIRST so the queue
+        # einsum costs O(N * e_local * C * d) per shard, not O(N * E * C * d)
         r = lax.axis_index(axis_name)
-        local_in = lax.dynamic_slice_in_dim(expert_in, r * e_local,
-                                            e_local, axis=0)
+        local_disp = lax.dynamic_slice_in_dim(dispatch, r * e_local,
+                                              e_local, axis=1)  # (N, e_l, C)
+        local_in = jnp.einsum("nec,nd->ecd", local_disp.astype(x.dtype), x)
         h = activation(jnp.einsum("ecd,edf->ecf", local_in, w1))
         local_out = jnp.einsum("ecf,efd->ecd", h, w2)   # (e_local, C, d)
         out = lax.all_gather(local_out, axis_name, axis=0,
                              tiled=True)                # (E, C, d)
     else:
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
         h = activation(jnp.einsum("ecd,edf->ecf",
                                   expert_in.reshape(e_local, C, d), w1))
         out = jnp.einsum("ecf,efd->ecd", h, w2).reshape(E, C, d)
@@ -119,7 +119,8 @@ def init_moe_params(key, d, dff, n_experts, dtype=jnp.float32):
 
 def moe_sharded(x, params, mesh, axis="ep", k=1, capacity_factor=1.25):
     """Whole-layer entry: w1/w2 sharded over `axis` on their expert dim,
-    wg and x replicated. One compiled program with the all_to_all pair."""
+    wg and x replicated. One compiled program; the only collective is the
+    expert-output all_gather before the combine (see module docstring)."""
     from jax.sharding import PartitionSpec as P
 
     spec_p = {"wg": P(), "w1": P(axis), "w2": P(axis)}

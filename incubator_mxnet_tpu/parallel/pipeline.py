@@ -32,6 +32,11 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
     pp axis). x: the FULL batch (replicated across pp), split into
     `n_microbatches` along axis 0. Returns the full batch of final-stage
     outputs (replicated across pp ranks via a psum broadcast).
+
+    Constraint: every stage must map a (mb, ...) activation to the SAME
+    shape and dtype — the ring buffer that carries activations between
+    stages (and the collected outputs) has one static shape. Put any
+    projection to a different width inside a stage, not between stages.
     """
     S = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
@@ -44,6 +49,12 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
     total = n_microbatches + S - 1     # fill + steady + drain
     out0 = jnp.zeros_like(micro)
     carry0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    aval = jax.eval_shape(stage_fn, stage_params, carry0)
+    if aval.shape != carry0.shape or aval.dtype != carry0.dtype:
+        raise ValueError(
+            f"pipeline stage must preserve activation shape/dtype: got "
+            f"{aval.shape}/{aval.dtype} from {carry0.shape}/{carry0.dtype}; "
+            "move width changes inside a stage")
 
     def step(carry, t):
         h_prev, outs = carry
