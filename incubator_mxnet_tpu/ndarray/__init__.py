@@ -33,6 +33,7 @@ from ..ops import rnn_ops as _rnn_ops  # noqa: F401
 from ..ops import quantization_ops as _quantization_ops  # noqa: F401
 from ..ops import contrib_ops as _contrib_ops  # noqa: F401
 from ..ops import control_flow_ops as _control_flow_ops  # noqa: F401
+from ..ops import spatial_ops as _spatial_ops  # noqa: F401
 
 
 def _make_wrapper(opdef):

@@ -421,3 +421,429 @@ def box_iou(lhs, rhs, *, format="corner"):
     shape_r = rhs.shape[:-1]
     out = _iou_corner(lhs.reshape(-1, 4), rhs.reshape(-1, 4))
     return out.reshape(shape_l + shape_r)
+
+# ---------------------------------------------------------------------------
+# RPN Proposal / MultiProposal (reference proposal.cc, multi_proposal.cc):
+# anchors + bbox deltas -> clip -> min-size filter -> top-pre_nms -> NMS ->
+# top-post_nms. Static-shape: scores of filtered boxes are -inf, output is
+# always (N*post_nms, 5) padded by repeating the best box (reference pads
+# from the kept list).
+# ---------------------------------------------------------------------------
+
+def _base_anchors(scales, ratios, stride):
+    """Anchor boxes around (0,0) cell of size `stride` (reference
+    proposal-inl.h GenerateAnchors: ratio enumeration then scales,
+    base_size=stride)."""
+    base = float(stride)
+    cx = (base - 1) / 2.0
+    cy = (base - 1) / 2.0
+    anchors = []
+    for r in ratios:
+        size = base * base
+        size_ratio = size / r
+        ws = round(size_ratio ** 0.5)
+        hs = round(ws * r)
+        for s in scales:
+            w = ws * s
+            h = hs * s
+            anchors.append([cx - (w - 1) / 2.0, cy - (h - 1) / 2.0,
+                            cx + (w - 1) / 2.0, cy + (h - 1) / 2.0])
+    return jnp.asarray(anchors, jnp.float32)          # (A, 4)
+
+
+def _proposal_impl(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n,
+                   rpn_post_nms_top_n, threshold, rpn_min_size, scales,
+                   ratios, feature_stride, output_score):
+    N, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    base = _base_anchors(tuple(scales), tuple(ratios), feature_stride)
+    sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    shift = jnp.stack(jnp.broadcast_arrays(
+        sx[None, :, None], sy[:, None, None]), -1)    # (H, W, 1, 2)? build 4
+    # anchor grid: (H, W, A, 4)
+    shifts = jnp.concatenate([shift, shift], -1)      # x1 y1 x2 y2 shifts
+    anchors = base[None, None] + shifts
+    total = H * W * A
+    pre = min(int(rpn_pre_nms_top_n), total) if rpn_pre_nms_top_n > 0 else total
+    post = int(rpn_post_nms_top_n)
+
+    def per_image(scores_fg, deltas, info):
+        # scores_fg: (A, H, W); deltas: (4A, H, W)
+        sc = jnp.transpose(scores_fg, (1, 2, 0)).reshape(-1)       # HWA
+        dl = jnp.transpose(deltas.reshape(A, 4, H, W), (2, 3, 0, 1)
+                           ).reshape(-1, 4)
+        anc = anchors.reshape(-1, 4)
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + 0.5 * (aw - 1.0)
+        acy = anc[:, 1] + 0.5 * (ah - 1.0)
+        cx = dl[:, 0] * aw + acx
+        cy = dl[:, 1] * ah + acy
+        w = jnp.exp(dl[:, 2]) * aw
+        h = jnp.exp(dl[:, 3]) * ah
+        boxes = jnp.stack([cx - 0.5 * (w - 1.0), cy - 0.5 * (h - 1.0),
+                           cx + 0.5 * (w - 1.0), cy + 0.5 * (h - 1.0)], -1)
+        im_h, im_w, im_scale = info[0], info[1], info[2]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_w - 1.0),
+                           jnp.clip(boxes[:, 1], 0, im_h - 1.0),
+                           jnp.clip(boxes[:, 2], 0, im_w - 1.0),
+                           jnp.clip(boxes[:, 3], 0, im_h - 1.0)], -1)
+        min_sz = rpn_min_size * im_scale
+        bw = boxes[:, 2] - boxes[:, 0] + 1.0
+        bh = boxes[:, 3] - boxes[:, 1] + 1.0
+        valid = (bw >= min_sz) & (bh >= min_sz)
+        sc = jnp.where(valid, sc, -jnp.inf)
+        # top-pre_nms candidates only
+        top_sc, top_idx = lax.top_k(sc, pre)
+        top_boxes = boxes[top_idx]
+        keep = _greedy_nms_keep(top_boxes, top_sc,
+                                jnp.isfinite(top_sc), threshold, None)
+        # order kept boxes first (stable by score: top_k already sorted)
+        kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        slot = jnp.where(keep, kept_rank, pre)
+        out_boxes = jnp.zeros((pre + 1, 4), boxes.dtype)
+        out_sc = jnp.full((pre + 1,), -jnp.inf, sc.dtype)
+        out_boxes = out_boxes.at[slot].set(top_boxes)
+        out_sc = out_sc.at[slot].set(jnp.where(keep, top_sc, -jnp.inf))
+        n_kept = jnp.sum(keep.astype(jnp.int32))
+        idx = jnp.arange(post)
+        # pad by repeating the first (best) kept box, reference-style
+        src = jnp.where(idx < n_kept, idx, 0)
+        return out_boxes[src], out_sc[src]
+
+    fg = cls_prob[:, A:]
+    boxes, scores = jax.vmap(per_image)(fg, bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(N, dtype=boxes.dtype), post)[:, None]
+    rois = jnp.concatenate([bidx, boxes.reshape(N * post, 4)], -1)
+    if output_score:
+        return rois, scores.reshape(N * post, 1)
+    return rois
+
+
+@register(name="_contrib_Proposal",
+          aliases=("Proposal", "_contrib_MultiProposal", "MultiProposal"),
+          nondiff=True)
+def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """RPN proposals (reference proposal.cc; multi_proposal.cc is the same
+    math vmapped over the batch — this implementation is batched already,
+    so MultiProposal is an alias)."""
+    if iou_loss:
+        raise MXNetError("iou_loss Proposal variant is not implemented")
+    return _proposal_impl(
+        cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=rpn_pre_nms_top_n,
+        rpn_post_nms_top_n=rpn_post_nms_top_n, threshold=threshold,
+        rpn_min_size=rpn_min_size, scales=scales, ratios=ratios,
+        feature_stride=feature_stride, output_score=output_score)
+
+
+# ---------------------------------------------------------------------------
+# Position-sensitive ROI pooling (reference psroi_pooling.cc) and the
+# deformable variant (deformable_psroi_pooling.cc). Bins are averaged over a
+# fixed sample grid (the deformable reference itself uses sample_per_part
+# fixed samples; for plain PSROI the reference averages integer pixels —
+# the fixed-grid average is the static-shape equivalent).
+# ---------------------------------------------------------------------------
+
+def _psroi_impl(data, rois, trans, *, spatial_scale, output_dim, pooled_size,
+                group_size, part_size=0, sample_per_part=2, trans_std=0.0):
+    B, C, H, W = data.shape
+    P = int(pooled_size)
+    G = int(group_size) or P
+    part = int(part_size) or P
+    sp = max(1, int(sample_per_part))
+    n_cls = 1 if trans is None else trans.shape[1] // 2
+    ch_per_cls = output_dim // n_cls
+
+    def per_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        img = data[bidx]
+        # reference: round then offset by 0.5 pixel, width/height >= 0.1
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / P
+        bin_h = rh / P
+        iy = jnp.arange(P, dtype=jnp.float32)
+        ix = jnp.arange(P, dtype=jnp.float32)
+        ss = (jnp.arange(sp, dtype=jnp.float32) + 0.5) / sp
+        # per output bin (ph, pw): sample grid, per-class trans offsets
+        gy = jnp.clip((iy * G / P).astype(jnp.int32), 0, G - 1)     # (P,)
+        gx = jnp.clip((ix * G / P).astype(jnp.int32), 0, G - 1)
+        py = jnp.clip((iy * part / P).astype(jnp.int32), 0, part - 1)
+        px = jnp.clip((ix * part / P).astype(jnp.int32), 0, part - 1)
+
+        def one_class(cls_id):
+            if trans is None:
+                tx = jnp.zeros((P, P))
+                ty = jnp.zeros((P, P))
+            else:
+                # per-bin (part_h, part_w) offsets, like the reference's
+                # bottom_trans[...part_h...part_w] read
+                tx = tr[2 * cls_id][py[:, None], px[None, :]] * trans_std
+                ty = tr[2 * cls_id + 1][py[:, None], px[None, :]] * trans_std
+            # full per-bin sample grids (P, P, sp): the trans offset varies
+            # with BOTH bin indices, so the grid is not separable
+            ys = (y1 + iy[:, None, None] * bin_h
+                  + ss[None, None, :] * bin_h + ty[:, :, None] * rh)
+            xs = (x1 + ix[None, :, None] * bin_w
+                  + ss[None, None, :] * bin_w + tx[:, :, None] * rw)
+            ys = jnp.clip(ys, 0.0, H - 1.0)                     # (P, P, sp)
+            xs = jnp.clip(xs, 0.0, W - 1.0)
+            y0 = jnp.floor(ys).astype(jnp.int32)
+            x0 = jnp.floor(xs).astype(jnp.int32)
+            wy = ys - y0
+            wx = xs - x0
+            y1i = jnp.minimum(y0 + 1, H - 1)
+            x1i = jnp.minimum(x0 + 1, W - 1)
+            # channel map per bin: c = (cls*ch_per_cls + k)*G*G + gy*G + gx
+            k = jnp.arange(ch_per_cls)
+            cidx = (cls_id * ch_per_cls + k)[:, None, None] * (G * G) \
+                + (gy[:, None] * G + gx[None, :])[None]        # (K, P, P)
+
+            def gather(yi, xi):
+                # channels (K,P,P); y (P,P,sp); x (P,P,sp) -> (K,P,P,sp,sp)
+                return img[cidx[:, :, :, None, None],
+                           yi[None, :, :, :, None],
+                           xi[None, :, :, None, :]]
+            wy_ = wy[None, :, :, :, None]
+            wx_ = wx[None, :, :, None, :]
+            v = (gather(y0, x0) * (1 - wy_) * (1 - wx_) +
+                 gather(y0, x1i) * (1 - wy_) * wx_ +
+                 gather(y1i, x0) * wy_ * (1 - wx_) +
+                 gather(y1i, x1i) * wy_ * wx_)
+            # v: (K, P, P, sp, sp) -> mean over samples
+            return v.mean((-1, -2))
+
+        outs = [one_class(c) for c in range(n_cls)]
+        return jnp.concatenate(outs, 0)                         # (output_dim, P, P)
+
+    if trans is None:
+        return jax.vmap(lambda r: per_roi(r, None))(rois)
+    return jax.vmap(per_roi)(rois, trans)
+
+
+@register(name="_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size,
+                  group_size=0):
+    """data (B, output_dim*G*G, H, W), rois (R,5) -> (R, output_dim, P, P)
+    (reference psroi_pooling.cc; R-FCN head)."""
+    return _psroi_impl(data, rois, None, spatial_scale=spatial_scale,
+                       output_dim=output_dim, pooled_size=pooled_size,
+                       group_size=group_size)
+
+
+@register(name="_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",))
+def deformable_psroi_pooling(data, rois, trans, *, spatial_scale, output_dim,
+                             pooled_size, group_size, part_size=0,
+                             sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    """Deformable R-FCN pooling (reference deformable_psroi_pooling.cc):
+    trans (R, 2*n_cls, part, part) shifts each bin by trans*roi_size."""
+    return _psroi_impl(data, rois, None if no_trans else trans,
+                       spatial_scale=spatial_scale, output_dim=output_dim,
+                       pooled_size=pooled_size, group_size=group_size,
+                       part_size=part_size, sample_per_part=sample_per_part,
+                       trans_std=trans_std)
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution v1 (reference deformable_convolution.cc): bilinear
+# sampling of the input at offset kernel-tap positions, then a dense
+# contraction. The im2col+offset CUDA kernel becomes a static python loop
+# over the kh*kw taps of gather-based bilinear samples — XLA fuses the taps;
+# the contraction is one einsum on the MXU.
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, *, kernel,
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=0, num_group=1, num_deformable_group=1,
+                           workspace=1024, no_bias=False, layout=None):
+    from .spatial_ops import _bilinear_gather
+    N, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride if stride else (1, 1)
+    dh, dw = dilate if dilate else (1, 1)
+    ph, pw = pad if pad else (0, 0)
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = int(num_deformable_group)
+    Cg = C // dg
+
+    oy = jnp.arange(Ho, dtype=jnp.float32) * sh - ph
+    ox = jnp.arange(Wo, dtype=jnp.float32) * sw - pw
+    taps = []
+    for ki in range(kh):
+        for kj in range(kw):
+            tap = ki * kw + kj
+            per_dg = []
+            for g in range(dg):
+                off_y = offset[:, 2 * (g * kh * kw + tap)]        # (N,Ho,Wo)
+                off_x = offset[:, 2 * (g * kh * kw + tap) + 1]
+                gy = oy[None, :, None] + ki * dh + off_y
+                gx = ox[None, None, :] + kj * dw + off_x
+                sub = data[:, g * Cg:(g + 1) * Cg]
+                per_dg.append(_bilinear_gather(sub, gx, gy))      # (N,Cg,Ho,Wo)
+            taps.append(jnp.concatenate(per_dg, 1))               # (N,C,Ho,Wo)
+    col = jnp.stack(taps, 2)                                      # (N,C,K,Ho,Wo)
+    G = int(num_group)
+    O = weight.shape[0]
+    colg = col.reshape(N, G, C // G, kh * kw, Ho, Wo)
+    wg = weight.reshape(G, O // G, C // G, kh * kw)
+    out = jnp.einsum("ngckhw,gock->ngohw", colg, wg).reshape(N, O, Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + bias[None, :, None, None]
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Misc contrib ops
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_count_sketch", aliases=("count_sketch",))
+def count_sketch(data, h, s, *, out_dim, processing_batch_size=32):
+    """Count-sketch projection (reference count_sketch.cc): out[:, h[i]] +=
+    s[i] * data[:, i]. h, s: (1, in_dim)."""
+    N, d = data.shape
+    hh = jnp.clip(h.reshape(-1).astype(jnp.int32), 0, out_dim - 1)
+    ss = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros((N, int(out_dim)), data.dtype)
+    return out.at[:, hh].add(data * ss[None, :])
+
+
+@register(name="_contrib_fft", aliases=("fft",))
+def fft(data, *, compute_size=128):
+    """Real-to-complex FFT along the last axis; output interleaves re/im
+    (reference fft.cc packs cuFFT output the same way): (..., d) -> (..., 2d)."""
+    c = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([c.real, c.imag], -1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@register(name="_contrib_ifft", aliases=("ifft",))
+def ifft(data, *, compute_size=128):
+    """Inverse of _contrib_fft, UNNORMALIZED like cuFFT/the reference
+    (ifft(fft(x)) == d * x): (..., 2d) -> (..., d) real part."""
+    d = data.shape[-1] // 2
+    pairs = data.astype(jnp.float32).reshape(data.shape[:-1] + (d, 2))
+    c = lax.complex(pairs[..., 0], pairs[..., 1])
+    return (jnp.fft.ifft(c, axis=-1).real * d).astype(data.dtype)
+
+
+@register(name="_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c (reference quadratic_op.cc — the tutorial op)."""
+    return a * data * data + b * data + c
+
+
+@register(name="_contrib_gradientmultiplier",
+          aliases=("gradientmultiplier", "GradientMultiplier"))
+def gradient_multiplier(data, *, scalar=1.0):
+    """Identity forward; backward scales the gradient by `scalar`
+    (reference gradient_multiplier_op.cc — gradient-reversal layers use
+    scalar=-lambda)."""
+    sc = float(scalar)
+
+    @jax.custom_vjp
+    def _gm(x):
+        return x
+
+    _gm.defvjp(lambda x: (x, None), lambda _, g: (g * sc,))
+    return _gm(data)
+
+
+@register(name="_contrib_index_array", aliases=("index_array",), nondiff=True)
+def index_array(data, *, axes=None):
+    """Coordinate tensor: out[i1..in, k] = i_{axes[k]} (reference
+    index_array.cc). Output dtype int64 in the reference; int32 here (XLA
+    x64 is globally disabled)."""
+    shape = data.shape
+    nd_ = len(shape)
+    sel = list(range(nd_)) if axes is None else [a % nd_ for a in axes]
+    comps = [lax.broadcasted_iota(jnp.int32, shape, a) for a in sel]
+    return jnp.stack(comps, -1)
+
+
+@register(name="khatri_rao", aliases=("_contrib_khatri_rao",))
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product (reference krprod.cc): inputs (n_i, k)
+    -> (prod n_i, k)."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        k = out.shape[1]
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, k)
+    return out
+
+
+@register(name="_contrib_getnnz", aliases=("getnnz",), nondiff=True)
+def getnnz(data, *, axis=None):
+    """Number of stored/nonzero values (reference nnz.cc, defined for CSR).
+    Dense inputs count exact nonzeros; axis=0/1 supported for 2-D."""
+    nz = (data != 0).astype(jnp.int32)
+    if axis is None:
+        return jnp.sum(nz)
+    return jnp.sum(nz, axis=int(axis))
+
+
+@register(name="_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def div_sqrt_dim(data):
+    """data / sqrt(d_last) (reference transformer.cc:33 — attention scaling)."""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Hawkes process log-likelihood (reference hawkes_ll.cc): exponential-kernel
+# multivariate Hawkes, one lax.scan over the sequence replaces the per-sample
+# C++ loop; gradients w.r.t. mu/alpha/beta come from autodiff instead of the
+# reference's hand-written backward kernel.
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_hawkesll", aliases=("hawkesll",))
+def hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+    """mu (N,K), alpha (K,), beta (K,), state (N,K), lags (N,T),
+    marks (N,T) int, valid_length (N,), max_time (N,) ->
+    (loglik (N,), out_state (N,K))."""
+    N, T = lags.shape
+    K = mu.shape[1]
+    marks_i = marks.astype(jnp.int32)
+
+    def per_sample(mu_i, state_i, lag_i, mark_i, vl, mt):
+        def step(carry, inp):
+            ll, t, st, last = carry
+            lag_j, m_j, j = inp
+            t2 = t + lag_j
+            oh = jax.nn.one_hot(m_j, K, dtype=mu_i.dtype)
+            d = t2 - last
+            ed = jnp.exp(-beta * d)
+            lda = mu_i + alpha * beta * st * ed
+            comp = mu_i * d + alpha * st * (1.0 - ed)
+            contrib = jnp.sum(oh * (jnp.log(jnp.maximum(lda, 1e-30)) - comp))
+            active = (j < vl).astype(mu_i.dtype)
+            ll2 = ll + active * contrib
+            st2 = jnp.where((oh > 0) & (j < vl), 1.0 + st * ed, st)
+            last2 = jnp.where((oh > 0) & (j < vl), t2, last)
+            t3 = jnp.where(j < vl, t2, t)
+            return (ll2, t3, st2, last2), None
+
+        init = (jnp.zeros((), mu_i.dtype), jnp.zeros((), mu_i.dtype),
+                state_i, jnp.zeros((K,), mu_i.dtype))
+        (ll, _, st, last), _ = lax.scan(
+            step, init, (lag_i, mark_i, jnp.arange(T)))
+        # remaining compensator to max_time + state decay (reference
+        # hawkesll_forward_compensator)
+        d = mt - last
+        ed = jnp.exp(-beta * d)
+        ll = ll - jnp.sum(mu_i * d + alpha * st * (1.0 - ed))
+        return ll, st * ed
+
+    return jax.vmap(per_sample)(mu, state, lags, marks_i, valid_length,
+                                max_time)

@@ -206,7 +206,10 @@ class TrainStep:
                 new_params[k] = v.astype(params[k].dtype)
             return new_params, new_opt, loss
 
+        self._step_fn = step_fn
+        self._donate = donate
         self._jit_step = jax.jit(step_fn, donate_argnums=(0, 1) if donate else ())
+        self._jit_multi = {}
 
     def __call__(self, *batch):
         import jax
@@ -224,6 +227,56 @@ class TrainStep:
             self.params, self.opt_state, rng, self._step_count, *arrs)
         self._step_count += 1
         return loss
+
+    def run_steps(self, n, *batch):
+        """Run `n` optimizer steps on ONE batch inside a single XLA program
+        (lax.scan over the step, params/opt-state carried on device).
+
+        The whole loop is one dispatch: no host round-trip per step, which
+        is what makes steady-state throughput on a remote/tunneled device
+        match on-chip compute (the reference gets the same effect from
+        engine op-bulking, graph_executor.cc:1288 InitOpSegs). Per-step RNG
+        is fold_in(step_index). Returns the per-step losses as an NDArray.
+        """
+        import jax
+        from jax import lax
+        from ..ndarray.ndarray import NDArray
+        from ..ndarray import random as _rnd
+
+        arrs = []
+        for b in batch:
+            a = b._data if isinstance(b, NDArray) else jax.numpy.asarray(b)
+            if self._data_sharding is not None:
+                a = jax.device_put(a, self._data_sharding)
+            arrs.append(a)
+
+        fn = self._jit_multi.get(n)
+        if fn is None:
+            step_fn = self._step_fn
+
+            def multi(params, opt_state, rng, step0, *batch_):
+                def body(carry, i):
+                    p, o = carry
+                    r = jax.random.fold_in(rng, i)
+                    p, o, loss = step_fn(p, o, r, step0 + i, *batch_)
+                    return (p, o), loss
+                (p, o), losses = lax.scan(body, (params, opt_state),
+                                          jnp.arange(n))
+                return p, o, losses
+
+            fn = jax.jit(multi,
+                         donate_argnums=(0, 1) if self._donate else ())
+            # bounded FIFO, like OpDef._jit_cache: each entry retains a
+            # whole compiled n-step executable
+            if len(self._jit_multi) >= 8:
+                self._jit_multi.pop(next(iter(self._jit_multi)))
+            self._jit_multi[n] = fn
+
+        rng = _rnd.next_key()
+        self.params, self.opt_state, losses = fn(
+            self.params, self.opt_state, rng, self._step_count, *arrs)
+        self._step_count += n
+        return NDArray(losses)
 
     def sync(self):
         """Write the compiled-step params back into the Gluon Parameters so

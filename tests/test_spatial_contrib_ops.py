@@ -1,0 +1,301 @@
+"""Spatial-transform family + second contrib-op batch.
+
+Reference coverage model: tests/python/unittest/test_operator.py
+(test_stn, test_correlation, test_svmoutput), test_contrib_operator.py
+(proposal/psroi/deformable/fft/count_sketch/hawkesll/krprod oracles are
+numpy brute-force here, like the reference's .py reference impls).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+
+nd = mx.nd
+
+
+def test_spatial_transformer_identity():
+    x = nd.array(np.random.randn(2, 3, 8, 8).astype(np.float32))
+    theta = nd.array(np.tile([1, 0, 0, 0, 1, 0], (2, 1)).astype(np.float32))
+    out = nd.SpatialTransformer(x, theta, target_shape=(8, 8))
+    assert np.allclose(out.asnumpy(), x.asnumpy(), atol=1e-5)
+
+
+def test_spatial_transformer_translation():
+    x = nd.array(np.random.randn(1, 1, 8, 8).astype(np.float32))
+    # tx = 2/(W-1) shifts sampling one pixel right
+    theta = nd.array(np.array([[1, 0, 2.0 / 7, 0, 1, 0]], np.float32))
+    o = nd.SpatialTransformer(x, theta, target_shape=(8, 8)).asnumpy()
+    assert np.allclose(o[..., :7], x.asnumpy()[..., 1:], atol=1e-5)
+
+
+def test_grid_generator_warp_identity():
+    x = nd.array(np.random.randn(2, 3, 6, 6).astype(np.float32))
+    flow = nd.array(np.zeros((2, 2, 6, 6), np.float32))
+    g = nd.GridGenerator(flow, transform_type="warp")
+    out = nd.BilinearSampler(x, g)
+    assert np.allclose(out.asnumpy(), x.asnumpy(), atol=1e-5)
+
+
+def test_bilinear_sampler_zero_padding_and_grad():
+    x = nd.array(np.ones((1, 1, 4, 4), np.float32))
+    x.attach_grad()
+    # grid entirely outside the image -> zeros
+    far = nd.array(np.full((1, 2, 2, 2), 3.0, np.float32))
+    assert np.allclose(nd.BilinearSampler(x, far).asnumpy(), 0.0)
+    grid = nd.array(np.random.uniform(-0.8, 0.8, (1, 2, 3, 3))
+                    .astype(np.float32))
+    grid.attach_grad()
+    with autograd.record():
+        y = nd.BilinearSampler(x, grid)
+    y.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_correlation_center_channel():
+    a = np.random.randn(1, 4, 10, 10).astype(np.float32)
+    c = nd.Correlation(nd.array(a), nd.array(a), kernel_size=1,
+                       max_displacement=2, stride1=1, stride2=1,
+                       pad_size=2).asnumpy()
+    assert c.shape == (1, 25, 10, 10)
+    # zero-displacement channel is mean over C of elementwise square
+    assert np.allclose(c[0, 12], (a[0] ** 2).mean(axis=0), atol=1e-5)
+
+
+def test_svm_output_l1_grad():
+    s = nd.array(np.array([[2.0, -0.5, 0.3]], np.float32))
+    s.attach_grad()
+    lab = nd.array(np.array([0], np.float32))
+    with autograd.record():
+        o = nd.SVMOutput(s, lab, use_linear=True)
+    o.backward()
+    assert np.allclose(o.asnumpy(), s.asnumpy())
+    assert np.allclose(s.grad.asnumpy(), [[0.0, 1.0, 1.0]])
+
+
+def test_fft_ifft_roundtrip():
+    x = nd.array(np.random.randn(3, 8).astype(np.float32))
+    f = nd.fft(x)
+    assert f.shape == (3, 16)
+    ref = np.fft.fft(x.asnumpy(), axis=-1)
+    inter = np.stack([ref.real, ref.imag], -1).reshape(3, 16)
+    assert np.allclose(f.asnumpy(), inter, atol=1e-4)
+    # unnormalized inverse, like cuFFT: ifft(fft(x)) = d * x
+    assert np.allclose(nd.ifft(f).asnumpy(), 8 * x.asnumpy(), atol=1e-4)
+
+
+def test_quadratic_and_gradient_multiplier():
+    q = nd.array(np.array([1.0, 2.0], np.float32))
+    q.attach_grad()
+    with autograd.record():
+        y = nd.quadratic(q, a=2.0, b=3.0, c=1.0)
+    y.backward()
+    assert np.allclose(y.asnumpy(), [6.0, 15.0])
+    assert np.allclose(q.grad.asnumpy(), [7.0, 11.0])
+
+    g = nd.array(np.array([1.0, 2.0], np.float32))
+    g.attach_grad()
+    with autograd.record():
+        y = nd.gradientmultiplier(g, scalar=-0.5)
+    y.backward()
+    assert np.allclose(y.asnumpy(), g.asnumpy())
+    assert np.allclose(g.grad.asnumpy(), [-0.5, -0.5])
+
+
+def test_index_array_and_axes():
+    ia = nd.index_array(nd.array(np.zeros((2, 3), np.float32))).asnumpy()
+    assert ia.shape == (2, 3, 2)
+    assert (ia[1, 2] == [1, 2]).all()
+    ax = nd.index_array(nd.array(np.zeros((2, 3, 4), np.float32)),
+                        axes=(2, 0)).asnumpy()
+    assert ax.shape == (2, 3, 4, 2)
+    assert (ax[1, 0, 3] == [3, 1]).all()
+
+
+def test_khatri_rao():
+    A = np.array([[1., 2.], [3., 4.]], np.float32)
+    B = np.array([[1., 0.], [0., 1.], [2., 3.]], np.float32)
+    kr = nd.khatri_rao(nd.array(A), nd.array(B)).asnumpy()
+    expect = np.stack([np.kron(A[:, k], B[:, k]) for k in range(2)], 1)
+    assert np.allclose(kr, expect)
+
+
+def test_count_sketch():
+    d, od = 6, 4
+    h = np.array([[0, 1, 1, 3, 2, 0]], np.float32)
+    s = np.array([[1, -1, 1, 1, -1, 1]], np.float32)
+    data = np.random.randn(2, d).astype(np.float32)
+    cs = nd.count_sketch(nd.array(data), nd.array(h), nd.array(s),
+                         out_dim=od).asnumpy()
+    expect = np.zeros((2, od), np.float32)
+    for i in range(d):
+        expect[:, int(h[0, i])] += s[0, i] * data[:, i]
+    assert np.allclose(cs, expect, atol=1e-5)
+
+
+def test_getnnz():
+    m = nd.array(np.array([[1., 0., 2.], [0., 0., 3.]], np.float32))
+    assert int(nd.getnnz(m).asnumpy()) == 3
+    assert (nd.getnnz(m, axis=0).asnumpy() == [1, 0, 2]).all()
+
+
+def test_hawkesll_vs_bruteforce():
+    N, T, K = 2, 5, 3
+    rng = np.random.RandomState(0)
+    mu = rng.uniform(0.5, 1.5, (N, K)).astype(np.float32)
+    alpha = rng.uniform(0.1, 0.5, (K,)).astype(np.float32)
+    beta = rng.uniform(0.5, 2.0, (K,)).astype(np.float32)
+    state = np.zeros((N, K), np.float32)
+    lags = rng.exponential(1.0, (N, T)).astype(np.float32)
+    marks = rng.randint(0, K, (N, T))
+    vl = np.array([5, 3], np.float32)
+    mt = np.array([10.0, 8.0], np.float32)
+
+    def brute(i):
+        ll, t = 0.0, 0.0
+        st = state[i].copy()
+        last = np.zeros(K)
+        for j in range(int(vl[i])):
+            ci = marks[i, j]
+            t += lags[i, j]
+            dd = t - last[ci]
+            ed = np.exp(-beta[ci] * dd)
+            ll += np.log(mu[i, ci] + alpha[ci] * beta[ci] * st[ci] * ed) \
+                - (mu[i, ci] * dd + alpha[ci] * st[ci] * (1 - ed))
+            st[ci] = 1 + st[ci] * ed
+            last[ci] = t
+        dd = mt[i] - last
+        ed = np.exp(-beta * dd)
+        return ll - (mu[i] * dd + alpha * st * (1 - ed)).sum(), st * ed
+
+    out = nd.hawkesll(nd.array(mu), nd.array(alpha), nd.array(beta),
+                      nd.array(state), nd.array(lags),
+                      nd.array(marks.astype(np.float32)), nd.array(vl),
+                      nd.array(mt))
+    for i in range(N):
+        bll, bst = brute(i)
+        assert abs(float(out[0].asnumpy()[i]) - bll) < 1e-4
+        assert np.allclose(out[1].asnumpy()[i], bst, atol=1e-5)
+
+
+def test_psroi_pooling_group_channels():
+    B, od, G, P = 1, 2, 2, 2
+    C = od * G * G
+    data = np.zeros((B, C, 8, 8), np.float32)
+    for c in range(C):
+        data[:, c] = c
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.PSROIPooling(nd.array(data), nd.array(rois), spatial_scale=1.0,
+                          output_dim=od, pooled_size=P,
+                          group_size=G).asnumpy()
+    expect = np.zeros((1, od, P, P), np.float32)
+    for c in range(od):
+        for ph in range(P):
+            for pw in range(P):
+                expect[0, c, ph, pw] = c * G * G + ph * G + pw
+    assert np.allclose(out, expect)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    x = np.random.randn(1, 4, 6, 6).astype(np.float32)
+    wt = np.random.randn(8, 4, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 4, 4), np.float32)
+    dc = nd.DeformableConvolution(nd.array(x), nd.array(off), nd.array(wt),
+                                  kernel=(3, 3), num_filter=8,
+                                  no_bias=True).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(wt), kernel=(3, 3),
+                         num_filter=8, no_bias=True).asnumpy()
+    assert np.allclose(dc, ref, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shift():
+    # constant offset (+1, +1) equals sampling a shifted input
+    x = np.random.randn(1, 2, 8, 8).astype(np.float32)
+    wt = np.random.randn(3, 2, 1, 1).astype(np.float32)
+    off = np.ones((1, 2, 8, 8), np.float32)
+    dc = nd.DeformableConvolution(nd.array(x), nd.array(off), nd.array(wt),
+                                  kernel=(1, 1), num_filter=3,
+                                  no_bias=True).asnumpy()
+    shifted = np.zeros_like(x)
+    shifted[:, :, :7, :7] = x[:, :, 1:, 1:]
+    ref = nd.Convolution(nd.array(shifted), nd.array(wt), kernel=(1, 1),
+                         num_filter=3, no_bias=True).asnumpy()
+    assert np.allclose(dc, ref, atol=1e-4)
+
+
+def test_proposal_shapes_and_bounds():
+    A, H, W = 3, 4, 4
+    rng = np.random.RandomState(1)
+    cls_prob = rng.uniform(0, 1, (1, 2 * A, H, W)).astype(np.float32)
+    bbox = np.zeros((1, 4 * A, H, W), np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = nd.Proposal(nd.array(cls_prob), nd.array(bbox), nd.array(im_info),
+                       rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5,
+                       feature_stride=16, scales=(8,), ratios=(0.5, 1, 2),
+                       rpn_min_size=1).asnumpy()
+    assert rois.shape == (5, 5)
+    assert (rois[:, 0] == 0).all()
+    assert (rois[:, 1:] >= 0).all()
+    assert (rois[:, 3] <= 63).all() and (rois[:, 4] <= 63).all()
+    # batched variant with scores
+    out = nd.MultiProposal(nd.array(np.tile(cls_prob, (2, 1, 1, 1))),
+                           nd.array(np.tile(bbox, (2, 1, 1, 1))),
+                           nd.array(np.tile(im_info, (2, 1))),
+                           rpn_pre_nms_top_n=12, rpn_post_nms_top_n=4,
+                           feature_stride=16, scales=(8,),
+                           ratios=(0.5, 1, 2), rpn_min_size=1,
+                           output_score=True)
+    assert out[0].shape == (8, 5) and out[1].shape == (8, 1)
+    assert (out[0].asnumpy()[4:, 0] == 1).all()
+
+
+def test_deformable_psroi_trans_varies_per_bin():
+    # linear image => bilinear sampling is exact, so the expected pooled
+    # value per bin is the mean of (y + 10x) over that bin's sample grid,
+    # shifted by its OWN trans offset (catches separable-grid bugs)
+    P, G, od, sp = 2, 2, 1, 2
+    C = od * G * G
+    H = W = 12
+    yy, xx = np.meshgrid(np.arange(H, dtype=np.float32),
+                         np.arange(W, dtype=np.float32), indexing="ij")
+    img = (yy + 10 * xx)[None, None].repeat(C, axis=1)   # (1, C, H, W)
+    rois = np.array([[0, 1, 1, 8, 8]], np.float32)
+    trans_std = 0.1
+    rng = np.random.RandomState(3)
+    trans = rng.uniform(-1, 1, (1, 2, P, P)).astype(np.float32)
+
+    out = nd.DeformablePSROIPooling(
+        nd.array(img), nd.array(rois), nd.array(trans), spatial_scale=1.0,
+        output_dim=od, pooled_size=P, group_size=G, part_size=P,
+        sample_per_part=sp, trans_std=trans_std).asnumpy()
+
+    # numpy oracle following deformable_psroi_pooling.cc's coordinate math
+    x1 = round(1) * 1.0 - 0.5
+    y1 = round(1) * 1.0 - 0.5
+    x2 = (round(8) + 1) * 1.0 - 0.5
+    y2 = (round(8) + 1) * 1.0 - 0.5
+    rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+    bin_w, bin_h = rw / P, rh / P
+    ss = (np.arange(sp) + 0.5) / sp
+    expect = np.zeros((1, od, P, P), np.float32)
+    for ph in range(P):
+        for pw in range(P):
+            tx = trans[0, 0, ph, pw] * trans_std
+            ty = trans[0, 1, ph, pw] * trans_std
+            ys = np.clip(y1 + ph * bin_h + ss * bin_h + ty * rh, 0, H - 1)
+            xs = np.clip(x1 + pw * bin_w + ss * bin_w + tx * rw, 0, W - 1)
+            vals = ys[:, None] + 10 * xs[None, :]
+            expect[0, 0, ph, pw] = vals.mean()
+    assert np.allclose(out, expect, atol=1e-4), (out, expect)
+
+
+def test_correlation_subtract_variant():
+    a = np.random.randn(1, 2, 8, 8).astype(np.float32)
+    b = np.random.randn(1, 2, 8, 8).astype(np.float32)
+    c = nd.Correlation(nd.array(a), nd.array(b), kernel_size=1,
+                       max_displacement=1, stride1=1, stride2=1, pad_size=1,
+                       is_multiply=False).asnumpy()
+    # zero-displacement channel accumulates |a-b| (reference sign), mean
+    # over channels
+    assert np.allclose(c[0, 4], np.abs(a[0] - b[0]).mean(axis=0), atol=1e-5)
+    assert (c >= 0).all()
