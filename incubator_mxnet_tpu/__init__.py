@@ -110,6 +110,8 @@ def __getattr__(name):
         "numpy": ".numpy",
         "npx": ".numpy_extension",
         "numpy_extension": ".numpy_extension",
+        "torch": ".torch",
+        "rtc": ".rtc",
     }
     if name in lazy:
         m = importlib.import_module(lazy[name], __name__)

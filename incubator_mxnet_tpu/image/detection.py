@@ -1,0 +1,153 @@
+"""Detection image iterator.
+
+Reference: python/mxnet/image/detection.py (ImageDetIter + det augmenters)
+and src/io/iter_image_det_recordio.cc. Label wire format per image is the
+reference's: a flat float vector [A, B, <A-2 extras>, obj0 .. objN-1] where
+A = header width (>= 2), B = per-object width (>= 5: class, x1, y1, x2, y2
+in normalized [0,1] coords). Batches pad the object dimension with
+`label_pad_value` (-1) so shapes stay static — exactly what MultiBoxTarget
+expects downstream.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .image import ImageIter, imdecode, imresize
+from .. import ndarray as nd
+
+
+class DetHorizontalFlipAug:
+    """Mirror image + boxes with probability p (reference
+    DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, img, label):
+        if _np.random.uniform() < self.p:
+            arr = img.asnumpy() if isinstance(img, nd.NDArray) else img
+            img = nd.array(arr[:, ::-1, :].copy())
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return img, label
+
+
+class DetBorrowAug:
+    """Adapt a plain image augmenter (no label change) to the det
+    interface (reference DetBorrowAug)."""
+
+    def __init__(self, aug):
+        self.aug = aug
+
+    def __call__(self, img, label):
+        return self.aug(img), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_mirror=False, mean=None,
+                       std=None, **kwargs):
+    """Basic det augmenter list (reference CreateDetAugmenter; the random
+    IoU-constrained crop/pad family can be appended by users as callables
+    with the (img, label) -> (img, label) contract)."""
+    from .image import CreateAugmenter
+    augs = []
+    for a in CreateAugmenter(data_shape, resize=resize, mean=mean, std=std):
+        augs.append(DetBorrowAug(a))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    return augs
+
+
+class ImageDetIter(ImageIter):
+    """Detection batches: data (B, C, H, W), label (B, max_objs, obj_width)
+    padded with label_pad_value (reference ImageDetIter)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, label_pad_width=None,
+                 label_pad_value=-1.0, data_name="data",
+                 label_name="label", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_mirror", "mean", "std")})
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, shuffle=shuffle,
+                         aug_list=[], imglist=imglist, data_name=data_name,
+                         label_name=label_name, **{
+                             k: v for k, v in kwargs.items()
+                             if k not in ("resize", "rand_mirror", "mean",
+                                          "std")})
+        self.det_auglist = aug_list
+        self.label_pad_value = float(label_pad_value)
+        # scan the dataset once to size the padded label tensor (reference
+        # ImageDetIter._estimate_label_shape)
+        if label_pad_width is None:
+            max_objs, obj_w = 1, 5
+            for lab, _ in self._iter_labels():
+                objs = self._parse_det_label(lab)
+                max_objs = max(max_objs, objs.shape[0])
+                obj_w = max(obj_w, objs.shape[1])
+            self.reset()
+            label_pad_width = max_objs
+            self._obj_width = obj_w
+        else:
+            self._obj_width = int(kwargs.get("obj_width", 5))
+        self.label_shape = (label_pad_width, self._obj_width)
+        from ..io.io import DataDesc
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size,) + self.label_shape)]
+
+    def _iter_labels(self):
+        while True:
+            try:
+                yield self.next_sample()
+            except StopIteration:
+                return
+
+    @staticmethod
+    def _parse_det_label(label):
+        lab = _np.asarray(label, _np.float32).reshape(-1)
+        if lab.size < 2:
+            raise MXNetError("det label needs [header_width, obj_width, ...]")
+        A = int(lab[0])
+        B = int(lab[1])
+        if A < 2 or B < 5:
+            raise MXNetError(f"bad det label header A={A} B={B}")
+        body = lab[A:]
+        n = body.size // B
+        return body[:n * B].reshape(n, B)
+
+    def next(self):
+        from ..io.io import DataBatch
+        B = self.batch_size
+        C, H, W = self.data_shape if len(self.data_shape) == 3 \
+            else (1,) + tuple(self.data_shape)
+        batch_data = _np.zeros((B, C, H, W), _np.float32)
+        batch_label = _np.full((B,) + self.label_shape,
+                               self.label_pad_value, _np.float32)
+        i = 0
+        try:
+            while i < B:
+                label, buf = self.next_sample()
+                img = imdecode(buf)
+                objs = self._parse_det_label(label)
+                for aug in self.det_auglist:
+                    img, objs = aug(img, objs)
+                arr = img.asnumpy() if isinstance(img, nd.NDArray) else img
+                if arr.shape[:2] != (H, W):
+                    arr2 = imresize(nd.array(arr), W, H)
+                    arr = arr2.asnumpy()
+                batch_data[i] = _np.transpose(arr, (2, 0, 1))
+                n = min(objs.shape[0], self.label_shape[0])
+                w = min(objs.shape[1], self.label_shape[1])
+                batch_label[i, :n, :w] = objs[:n, :w]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return DataBatch(data=[nd.array(batch_data)],
+                         label=[nd.array(batch_label)], pad=B - i)

@@ -310,12 +310,146 @@ class PrefetchingIter(DataIter):
         return self.iter.provide_label
 
 
+class MNISTIter(DataIter):
+    """IDX-format MNIST reader (reference src/io/iter_mnist.cc): parses the
+    ubyte image/label files directly, normalizes to [0,1] when flat=False
+    per the reference's input_scale, supports shuffle/partitioning."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=False,
+                 flat=False, silent=True, seed=0, part_index=0, num_parts=1,
+                 **kwargs):
+        super().__init__(batch_size)
+        import gzip
+        import struct
+
+        def _open(path):
+            return gzip.open(path, "rb") if path.endswith(".gz") \
+                else open(path, "rb")
+
+        with _open(image) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise MXNetError(f"{image}: bad MNIST image magic {magic}")
+            imgs = _np.frombuffer(f.read(n * rows * cols), _np.uint8)
+            imgs = imgs.reshape(n, rows, cols)
+        with _open(label) as f:
+            magic, n2 = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise MXNetError(f"{label}: bad MNIST label magic {magic}")
+            labs = _np.frombuffer(f.read(n2), _np.uint8).astype(_np.float32)
+        if num_parts > 1:
+            step = (n + num_parts - 1) // num_parts
+            sl = slice(part_index * step, min(n, (part_index + 1) * step))
+            imgs, labs = imgs[sl], labs[sl]
+        if shuffle:
+            perm = _np.random.RandomState(seed).permutation(len(imgs))
+            imgs, labs = imgs[perm], labs[perm]
+        data = imgs.astype(_np.float32) / 255.0
+        data = data.reshape(len(imgs), -1) if flat \
+            else data[:, None, :, :]
+        self._inner = NDArrayIter(data, labs, batch_size=batch_size,
+                                  last_batch_handle="pad")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class LibSVMIter(DataIter):
+    """libsvm text reader (reference src/io/iter_libsvm.cc). Rows become
+    CSR storage; batches are returned as CSRNDArray data + dense labels
+    (the reference's sparse batch path, iter_sparse_batchloader.h)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        ncol = int(data_shape[0] if hasattr(data_shape, "__len__")
+                   else data_shape)
+        indptr, indices, values, labels = [0], [], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    indices.append(int(i))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        self._indptr = _np.asarray(indptr, _np.int32)
+        self._indices = _np.asarray(indices, _np.int32)
+        self._values = _np.asarray(values, _np.float32)
+        self._labels = _np.asarray(labels, _np.float32)
+        if label_libsvm is not None:
+            ext = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    if line.split():
+                        ext.append(float(line.split()[0]))
+            self._labels = _np.asarray(ext, _np.float32)
+        self._ncol = ncol
+        self._n = len(self._labels)
+        self._round = round_batch
+        self.cursor = 0
+
+    def reset(self):
+        self.cursor = 0
+
+    def next(self):
+        from ..ndarray.sparse import csr_matrix
+        if self.cursor >= self._n:
+            raise StopIteration
+        lo = self.cursor
+        hi = min(lo + self.batch_size, self._n)
+        self.cursor += self.batch_size
+        nrow = hi - lo
+        if nrow < self.batch_size and not self._round:
+            # keep batches a fixed shape (provide_data's contract): without
+            # round_batch the trailing partial batch is discarded
+            raise StopIteration
+        # rows are stored contiguously, so a batch is one slice of the CSR
+        # buffers plus a rebased indptr — no per-element python loop
+        s, e = int(self._indptr[lo]), int(self._indptr[hi])
+        ptr = (self._indptr[lo:hi + 1] - self._indptr[lo]).astype(_np.int32)
+        pad = self.batch_size - nrow
+        if pad:
+            ptr = _np.concatenate(
+                [ptr, _np.full(pad, ptr[-1], _np.int32)])
+        data = csr_matrix((self._values[s:e], self._indices[s:e], ptr),
+                          shape=(self.batch_size, self._ncol))
+        lab = self._labels[lo:hi]
+        if pad:
+            lab = _np.concatenate([lab, _np.zeros(pad, _np.float32)])
+        from ..ndarray.ndarray import NDArray
+        return DataBatch(data=[data], label=[NDArray(lab)], pad=pad)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._ncol))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+
 def MXDataIter(name, **kwargs):
     """Factory matching the reference's C++-registered iterators
     (reference io.py:790 MXDataIter; MXListDataIters)."""
     from ..image.image_iter import ImageRecordIter as _IRI
     table = {"ImageRecordIter": _IRI, "CSVIter": CSVIter,
-             "NDArrayIter": NDArrayIter}
+             "NDArrayIter": NDArrayIter, "MNISTIter": MNISTIter,
+             "LibSVMIter": LibSVMIter}
     if name not in table:
         raise MXNetError(f"unknown data iter {name}")
     return table[name](**kwargs)

@@ -20,7 +20,8 @@ import numpy as _np
 from ..base import MXNetError
 from .ndarray import NDArray
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "from_dlpack", "to_dlpack_for_read",
+           "to_dlpack_for_write"]
 
 _MAGIC_KEY = "__mxtpu_ndarray_container__"
 _LIST_PREFIX = "__list__:"
@@ -57,3 +58,37 @@ def load(fname: str):
         if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
             return [NDArray(z[k]) for k in sorted(keys)]
         return {k: NDArray(z[k]) for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# DLPack interchange (reference MXNDArrayToDLPack/MXNDArrayFromDLPack,
+# include/mxnet/c_api.h; python mxnet.ndarray to_dlpack_for_read/
+# to_dlpack_for_write/from_dlpack). jax.Array speaks the dlpack protocol
+# natively, so these are thin shims kept for API parity — they are the
+# zero-copy bridge to torch/cupy/numpy consumers.
+# ---------------------------------------------------------------------------
+
+def from_dlpack(ext):
+    """Wrap any object exporting __dlpack__ (torch tensor, numpy array,
+    another framework's array) as an NDArray, zero-copy when the producer
+    is on a compatible device."""
+    import jax.numpy as jnp
+    return NDArray(jnp.from_dlpack(ext))
+
+
+def to_dlpack_for_read(arr):
+    """Export an NDArray as a DLPack capsule (read intent; XLA arrays are
+    immutable so read/write intent coincide — both names kept for parity).
+    Backends without PJRT external-reference support (e.g. tunneled TPU)
+    fall back to a host copy's capsule."""
+    try:
+        return arr._data.__dlpack__()
+    except Exception:
+        return _np.asarray(arr._data).__dlpack__()
+
+
+def to_dlpack_for_write(arr):
+    """See to_dlpack_for_read — XLA buffers are immutable; a consumer that
+    mutates must copy (the reference's write capsule relied on the engine
+    write-var lock, which has no XLA analog)."""
+    return to_dlpack_for_read(arr)

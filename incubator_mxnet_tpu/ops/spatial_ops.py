@@ -101,7 +101,6 @@ def correlation(data1, data2, *, kernel_size=1, max_displacement=1,
     """
     N, C, H, W = data1.shape
     d = int(max_displacement)
-    D = 2 * (d // int(stride2)) + 1
     pad = int(pad_size)
     p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
     p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))

@@ -211,17 +211,20 @@ class TrainStep:
         self._jit_step = jax.jit(step_fn, donate_argnums=(0, 1) if donate else ())
         self._jit_multi = {}
 
-    def __call__(self, *batch):
+    def _to_device(self, batch):
         import jax
-        import numpy as _np
         from ..ndarray.ndarray import NDArray
-        from ..ndarray import random as _rnd
         arrs = []
         for b in batch:
             a = b._data if isinstance(b, NDArray) else jax.numpy.asarray(b)
             if self._data_sharding is not None:
                 a = jax.device_put(a, self._data_sharding)
             arrs.append(a)
+        return arrs
+
+    def __call__(self, *batch):
+        from ..ndarray import random as _rnd
+        arrs = self._to_device(batch)
         rng = _rnd.next_key()
         self.params, self.opt_state, loss = self._jit_step(
             self.params, self.opt_state, rng, self._step_count, *arrs)
@@ -243,12 +246,7 @@ class TrainStep:
         from ..ndarray.ndarray import NDArray
         from ..ndarray import random as _rnd
 
-        arrs = []
-        for b in batch:
-            a = b._data if isinstance(b, NDArray) else jax.numpy.asarray(b)
-            if self._data_sharding is not None:
-                a = jax.device_put(a, self._data_sharding)
-            arrs.append(a)
+        arrs = self._to_device(batch)
 
         fn = self._jit_multi.get(n)
         if fn is None:

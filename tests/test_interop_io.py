@@ -1,0 +1,146 @@
+"""DLPack/torch interop, rtc (Pallas runtime kernels), and the
+MNIST/LibSVM/ImageDet iterators.
+
+Reference: python/mxnet/torch.py (torch bridge), python/mxnet/rtc.py
+(CudaModule -> PallasModule here), src/io/iter_mnist.cc, iter_libsvm.cc,
+python/mxnet/image/detection.py.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+nd = mx.nd
+
+
+def test_dlpack_roundtrip_numpy():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = nd.to_dlpack_for_read(a)
+    assert "PyCapsule" in type(cap).__name__
+    b = nd.from_dlpack(a._data)
+    assert np.allclose(b.asnumpy(), a.asnumpy())
+
+
+def test_torch_bridge():
+    torch = pytest.importorskip("torch")
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    a = nd.from_dlpack(t)
+    assert a.shape == (2, 3)
+    assert float(a.asnumpy().sum()) == 15.0
+    back = mx.torch.to_torch(a)
+    assert isinstance(back, torch.Tensor)
+    assert float(back.sum()) == 15.0
+    mse = mx.torch.torch_function(
+        lambda x, y: torch.nn.functional.mse_loss(x, y))
+    out = mse(nd.array(np.ones((2, 2), np.float32)),
+              nd.array(np.zeros((2, 2), np.float32)))
+    assert float(out.asnumpy()) == 1.0
+
+
+def test_rtc_pallas_module():
+    src = """
+def scale_add(x_ref, y_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+"""
+    mod = mx.rtc.PallasModule(src, exports=["scale_add"])
+    x = nd.array(np.random.randn(8, 128).astype(np.float32))
+    y = nd.array(np.random.randn(8, 128).astype(np.float32))
+    k = mod.get_kernel("scale_add", out_like=x)
+    o = k.launch([x, y])
+    assert np.allclose(o.asnumpy(), 2 * x.asnumpy() + y.asnumpy(),
+                       atol=1e-6)
+    with pytest.raises(mx.base.MXNetError):
+        mx.rtc.CudaModule("__global__ void k() {}")
+    with pytest.raises(mx.base.MXNetError):
+        mod.get_kernel("nope", out_like=x)
+
+
+def test_mnist_iter(tmp_path):
+    imgs = (np.random.rand(20, 28, 28) * 255).astype(np.uint8)
+    labs = np.random.randint(0, 10, 20).astype(np.uint8)
+    ip = str(tmp_path / "img")
+    lp = str(tmp_path / "lab")
+    with open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 20, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 20))
+        f.write(labs.tobytes())
+    it = mx.io.MNISTIter(image=ip, label=lp, batch_size=8, shuffle=True)
+    b = it.next()
+    assert b.data[0].shape == (8, 1, 28, 28)
+    assert b.label[0].shape == (8,)
+    assert float(b.data[0].asnumpy().max()) <= 1.0
+    flat = mx.io.MNISTIter(image=ip, label=lp, batch_size=4, flat=True)
+    assert flat.next().data[0].shape == (4, 784)
+    # bad magic raises
+    bad = str(tmp_path / "bad")
+    with open(bad, "wb") as f:
+        f.write(struct.pack(">IIII", 1234, 1, 28, 28))
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.MNISTIter(image=bad, label=lp, batch_size=1)
+
+
+def test_libsvm_iter(tmp_path):
+    p = str(tmp_path / "train.libsvm")
+    with open(p, "w") as f:
+        f.write("1 0:1.5 3:2.0\n0 1:1.0\n1 2:0.5 3:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(4,), batch_size=2)
+    b = it.next()
+    assert type(b.data[0]).__name__ == "CSRNDArray"
+    assert b.data[0].shape == (2, 4)
+    assert np.allclose(b.label[0].asnumpy(), [1.0, 0.0])
+    dense = b.data[0].tostype("default")
+    assert np.allclose(dense.asnumpy(), [[1.5, 0, 0, 2.0], [0, 1.0, 0, 0]])
+    b2 = it.next()          # short batch, round_batch pads
+    assert b2.pad == 1
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().data[0].shape == (2, 4)
+
+
+def _write_jpegs(tmp_path, n, size=32):
+    PIL = pytest.importorskip("PIL.Image")
+    files = []
+    for i in range(n):
+        im = PIL.fromarray((np.random.rand(size, size, 3) * 255)
+                           .astype(np.uint8))
+        p = str(tmp_path / f"img{i}.jpg")
+        im.save(p)
+        files.append(f"img{i}.jpg")
+    return files
+
+
+def test_image_det_iter(tmp_path):
+    files = _write_jpegs(tmp_path, 4)
+
+    def mklabel(nobj):
+        objs = []
+        for k in range(nobj):
+            objs += [float(k % 3), 0.1, 0.1, 0.6, 0.7]
+        return [4, 5, 0.0, 0.0] + objs
+
+    imglist = [(mklabel(2), files[0]), (mklabel(1), files[1]),
+               (mklabel(3), files[2]), (mklabel(1), files[3])]
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                               imglist=imglist, path_root=str(tmp_path))
+    b = it.next()
+    assert b.data[0].shape == (2, 3, 32, 32)
+    # max objects across the list is 3 -> label (B, 3, 5)
+    assert b.label[0].shape == (2, 3, 5)
+    lab = b.label[0].asnumpy()
+    # img0 has 2 objects, third row is padding
+    assert (lab[0, 2] == -1).all()
+    assert np.allclose(lab[0, 0], [0, 0.1, 0.1, 0.6, 0.7], atol=1e-5)
+
+
+def test_det_horizontal_flip_boxes():
+    aug = mx.image.DetHorizontalFlipAug(p=1.0)
+    img = nd.array(np.random.rand(8, 8, 3).astype(np.float32))
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.8]], np.float32)
+    img2, lab2 = aug(img, label)
+    assert np.allclose(lab2[0], [0, 0.6, 0.2, 0.9, 0.8], atol=1e-5)
+    assert np.allclose(img2.asnumpy(), img.asnumpy()[:, ::-1, :])
