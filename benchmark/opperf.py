@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Per-operator throughput harness.
+
+Reference: benchmark/opperf/ (opperf.py + rules/default_params.py) — runs
+every registered op with standard input shapes and reports per-op
+forward/backward latency. TPU-native: each op is timed through its
+jit-cached eager path (the same dispatch users hit), batched k runs per
+measurement with a device sync only at the ends, so the number reflects
+op kernel time, not host round-trips.
+
+usage:
+  python benchmark/opperf.py                   # curated core set
+  python benchmark/opperf.py --ops dot,Convolution --shape-size large
+  python benchmark/opperf.py --json out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _profiles(size):
+    s = {"small": 1, "default": 4, "large": 16}[size]
+    n = 64 * s
+    return {
+        "elemwise": [((n, n), (n, n))],
+        "reduce": [((n, n),)],
+        "dot": [((n, n), (n, n))],
+        "conv": [((8, 32, 28, 28), (64, 32, 3, 3))],
+        "fc": [((32, n), (256, n), (256,))],
+        "norm": [((8, 32, 28, 28),)],
+        "softmax": [((32, 1000),)],
+    }
+
+
+# curated op set: name -> (profile, param dict, positional arg builder)
+CORE_OPS = {
+    "broadcast_add": ("elemwise", {}),
+    "broadcast_mul": ("elemwise", {}),
+    "elemwise_add": ("elemwise", {}),
+    "exp": ("reduce", {}),
+    "relu": ("reduce", {}),
+    "sigmoid": ("reduce", {}),
+    "sum": ("reduce", {}),
+    "mean": ("reduce", {}),
+    "max": ("reduce", {}),
+    "dot": ("dot", {}),
+    "transpose": ("reduce", {}),
+    "Convolution": ("conv", {"kernel": (3, 3), "num_filter": 64,
+                             "no_bias": True}),
+    "Pooling": ("norm", {"kernel": (2, 2), "pool_type": "max",
+                         "stride": (2, 2)}),
+    "FullyConnected": ("fc", {"num_hidden": 256}),
+    "BatchNorm": ("norm", {}),
+    "LayerNorm": ("softmax", {}),
+    "softmax": ("softmax", {}),
+    "log_softmax": ("softmax", {}),
+    "Activation": ("reduce", {"act_type": "relu"}),
+    "Dropout": ("reduce", {"p": 0.5}),
+}
+
+
+def _build_args(op_name, profile, shapes, nd):
+    arrs = [nd.array(np.random.uniform(-1, 1, s).astype(np.float32))
+            for s in shapes]
+    if op_name == "BatchNorm":
+        c = shapes[0][1]
+        extra = [nd.array(np.random.uniform(0.5, 1.5, c).astype(np.float32)),
+                 nd.array(np.zeros(c, np.float32)),
+                 nd.array(np.zeros(c, np.float32)),
+                 nd.array(np.ones(c, np.float32))]
+        return arrs + extra
+    if op_name == "LayerNorm":
+        c = shapes[0][-1]
+        return arrs + [nd.array(np.ones(c, np.float32)),
+                       nd.array(np.zeros(c, np.float32))]
+    return arrs
+
+
+def _sync(out):
+    leaves = out if isinstance(out, (list, tuple)) else [out]
+    for o in leaves:
+        d = getattr(o, "_data", o)
+        if hasattr(d, "block_until_ready"):
+            d.block_until_ready()
+
+
+def bench_op(op_name, profile, params, size, runs, warmup, with_backward):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    nd = mx.nd
+    shapes = _profiles(size)[profile][0]
+    args = _build_args(op_name, profile, shapes, nd)
+    op = getattr(nd, op_name)
+
+    for _ in range(warmup):
+        _sync(op(*args, **params))
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = op(*args, **params)
+    _sync(out)
+    fwd_ms = (time.perf_counter() - t0) / runs * 1e3
+
+    bwd_ms = None
+    if with_backward:
+        try:
+            for a in args:
+                a.attach_grad()
+            with autograd.record():
+                out = op(*args, **params)
+                head = out[0] if isinstance(out, (list, tuple)) else out
+            head.backward()           # warms the cached vjp executable
+            t0 = time.perf_counter()
+            for _ in range(runs):
+                with autograd.record():
+                    out = op(*args, **params)
+                    head = out[0] if isinstance(out, (list, tuple)) else out
+                head.backward()
+            _sync(args[0].grad)
+            bwd_ms = (time.perf_counter() - t0) / runs * 1e3
+        except Exception:
+            bwd_ms = None
+    return {"op": op_name, "shapes": [list(s) for s in shapes],
+            "fwd_ms": round(fwd_ms, 4),
+            "fwd_bwd_ms": round(bwd_ms, 4) if bwd_ms else None}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset (default: curated core set)")
+    ap.add_argument("--shape-size", default="default",
+                    choices=["small", "default", "large"])
+    ap.add_argument("--runs", type=int, default=25)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--no-backward", action="store_true")
+    ap.add_argument("--json", default=None, help="write results to file")
+    args = ap.parse_args()
+
+    names = args.ops.split(",") if args.ops else list(CORE_OPS)
+    results = []
+    for name in names:
+        if name not in CORE_OPS:
+            print(f"[opperf] skip {name}: no profile", file=sys.stderr)
+            continue
+        profile, params = CORE_OPS[name]
+        try:
+            r = bench_op(name, profile, params, args.shape_size, args.runs,
+                         args.warmup, not args.no_backward)
+        except Exception as e:
+            print(f"[opperf] {name} FAILED: {e!r}", file=sys.stderr)
+            continue
+        results.append(r)
+        bwd = f"  fwd+bwd {r['fwd_bwd_ms']:9.3f} ms" if r["fwd_bwd_ms"] \
+            else ""
+        print(f"[opperf] {name:20s} fwd {r['fwd_ms']:9.3f} ms{bwd}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0 if results else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,8 @@
 def __getattr__(name):
     import importlib
     lazy = {"amp": ".amp", "quantization": ".quantization", "onnx": ".onnx",
-            "text": ".text"}
+            "text": ".text", "svrg": ".svrg", "svrg_optimization": ".svrg",
+            "tensorboard": ".tensorboard"}
     if name in lazy:
         m = importlib.import_module(lazy[name], __name__)
         globals()[name] = m

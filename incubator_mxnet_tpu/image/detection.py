@@ -13,7 +13,7 @@ from __future__ import annotations
 import numpy as _np
 
 from ..base import MXNetError
-from .image import ImageIter, imdecode, imresize
+from .image import ImageIter, imdecode
 from .. import ndarray as nd
 
 
@@ -36,8 +36,10 @@ class DetHorizontalFlipAug:
 
 
 class DetBorrowAug:
-    """Adapt a plain image augmenter (no label change) to the det
-    interface (reference DetBorrowAug)."""
+    """Adapt a plain image augmenter to the det interface (reference
+    DetBorrowAug). ONLY valid for geometry-preserving augs (cast,
+    normalize, color jitter) — a crop/resize-with-crop borrowed this way
+    would leave boxes pointing at the wrong region."""
 
     def __init__(self, aug):
         self.aug = aug
@@ -46,17 +48,50 @@ class DetBorrowAug:
         return self.aug(img), label
 
 
+class DetForceResizeAug:
+    """Resize the image EXACTLY to (w, h), no cropping. Boxes are in
+    normalized [0,1] coordinates, so a pure resize leaves them unchanged
+    (reference ForceResizeAug wrapped by CreateDetAugmenter)."""
+
+    def __init__(self, size, interp=1):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, img, label):
+        arr = img.asnumpy() if isinstance(img, nd.NDArray) else img
+        if arr.shape[1] != self.size[0] or arr.shape[0] != self.size[1]:
+            from .image import imresize
+            img = imresize(nd.array(arr), self.size[0], self.size[1],
+                           self.interp)
+        return img, label
+
+
 def CreateDetAugmenter(data_shape, resize=0, rand_mirror=False, mean=None,
-                       std=None, **kwargs):
-    """Basic det augmenter list (reference CreateDetAugmenter; the random
-    IoU-constrained crop/pad family can be appended by users as callables
-    with the (img, label) -> (img, label) contract)."""
-    from .image import CreateAugmenter
-    augs = []
-    for a in CreateAugmenter(data_shape, resize=resize, mean=mean, std=std):
-        augs.append(DetBorrowAug(a))
+                       std=None, brightness=0, contrast=0, saturation=0,
+                       **kwargs):
+    """Det augmenter list (reference CreateDetAugmenter). Geometry is
+    handled ONLY by box-aware augs (exact resize, label-aware flip); the
+    plain-image crop family is deliberately excluded. Color/cast augs run
+    AFTER resize so the resize sees uint8 pixels. Users can append custom
+    (img, label) -> (img, label) callables (e.g. IoU-constrained crops)."""
+    from .image import CastAug, ColorJitterAug, ColorNormalizeAug
+    augs = [DetForceResizeAug((data_shape[2], data_shape[1]))]
     if rand_mirror:
         augs.append(DetHorizontalFlipAug(0.5))
+    augs.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        augs.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
+                                                saturation)))
+    if mean is True:
+        mean = nd.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = nd.array(mean)
+    if std is True:
+        std = nd.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = nd.array(std)
+    if mean is not None or std is not None:
+        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
     return augs
 
 
@@ -84,10 +119,16 @@ class ImageDetIter(ImageIter):
         self.det_auglist = aug_list
         self.label_pad_value = float(label_pad_value)
         # scan the dataset once to size the padded label tensor (reference
-        # ImageDetIter._estimate_label_shape)
+        # ImageDetIter._estimate_label_shape). When labels are in memory
+        # (imglist), read them directly — next_sample() would read every
+        # image file just to discard the bytes.
         if label_pad_width is None:
             max_objs, obj_w = 1, 5
-            for lab, _ in self._iter_labels():
+            if self.imglist is not None:
+                labels = (self.imglist[i][0] for i in self.seq)
+            else:
+                labels = (lab for lab, _ in self._iter_labels())
+            for lab in labels:
                 objs = self._parse_det_label(lab)
                 max_objs = max(max_objs, objs.shape[0])
                 obj_w = max(obj_w, objs.shape[1])
@@ -139,8 +180,12 @@ class ImageDetIter(ImageIter):
                     img, objs = aug(img, objs)
                 arr = img.asnumpy() if isinstance(img, nd.NDArray) else img
                 if arr.shape[:2] != (H, W):
-                    arr2 = imresize(nd.array(arr), W, H)
-                    arr = arr2.asnumpy()
+                    # DetForceResizeAug runs first in the default pipeline;
+                    # landing here means a custom aug_list dropped it
+                    raise MXNetError(
+                        f"det image is {arr.shape[:2]} but data_shape wants "
+                        f"{(H, W)}; include DetForceResizeAug (it must run "
+                        "before cast/normalize augs)")
                 batch_data[i] = _np.transpose(arr, (2, 0, 1))
                 n = min(objs.shape[0], self.label_shape[0])
                 w = min(objs.shape[1], self.label_shape[1])

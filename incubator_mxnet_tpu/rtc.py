@@ -39,7 +39,7 @@ class Kernel:
         self._grid = grid
         self._in_specs = in_specs
         self._out_specs = out_specs
-        self._compiled = None
+        self._compiled = {}       # keyed by effective grid
 
     def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
                shared_mem=0):
@@ -51,14 +51,16 @@ class Kernel:
 
         arrs = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
                 for a in args]
-        if self._compiled is None:
+        grid = tuple(grid_dims) if grid_dims is not None else \
+            (tuple(self._grid) if self._grid is not None else None)
+        fn = self._compiled.get(grid)
+        if fn is None:
             from jax.experimental import pallas as pl
 
             out_shape = [jax.ShapeDtypeStruct(s, d) for s, d in
                          zip(self._out_shapes, self._out_dtypes)]
             single = len(out_shape) == 1
             kwargs = {}
-            grid = grid_dims or self._grid
             if grid is not None:
                 kwargs["grid"] = grid
             if self._in_specs is not None:
@@ -70,8 +72,9 @@ class Kernel:
             call = pl.pallas_call(
                 self._fn, out_shape=out_shape[0] if single else out_shape,
                 interpret=interpret, **kwargs)
-            self._compiled = jax.jit(call)
-        out = self._compiled(*arrs)
+            fn = jax.jit(call)
+            self._compiled[grid] = fn
+        out = fn(*arrs)
         if isinstance(out, (list, tuple)):
             return [NDArray(o) for o in out]
         return NDArray(out)
@@ -92,8 +95,10 @@ class PallasModule:
             import jax
             import jax.numpy as jnp
             from jax.experimental import pallas as pl
-            glb = {"pl": pl, "jnp": jnp, "jax": jax}
-            exec(compile(source, "<rtc>", "exec"), glb, self._ns)
+            # ONE namespace as both globals and locals, so kernels can call
+            # helper functions / constants defined in the same source
+            self._ns.update({"pl": pl, "jnp": jnp, "jax": jax})
+            exec(compile(source, "<rtc>", "exec"), self._ns)
             missing = [e for e in self.exports if e not in self._ns]
             if missing:
                 raise MXNetError(f"exported kernels not defined: {missing}")

@@ -52,7 +52,9 @@ def torch_function(fn):
     """
     def wrapped(*args, **kwargs):
         conv = [to_torch(a) if isinstance(a, NDArray) else a for a in args]
-        out = fn(*conv, **kwargs)
+        kw = {k: to_torch(v) if isinstance(v, NDArray) else v
+              for k, v in kwargs.items()}
+        out = fn(*conv, **kw)
         torch = _torch()
         if isinstance(out, torch.Tensor):
             return from_torch(out)

@@ -17,10 +17,19 @@ nd = mx.nd
 
 def test_dlpack_roundtrip_numpy():
     a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
-    cap = nd.to_dlpack_for_read(a)
-    assert "PyCapsule" in type(cap).__name__
     b = nd.from_dlpack(a._data)
     assert np.allclose(b.asnumpy(), a.asnumpy())
+
+
+def test_dlpack_capsule_consumed_by_torch():
+    torch = pytest.importorskip("torch")
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = nd.to_dlpack_for_read(a)
+    t = torch.utils.dlpack.from_dlpack(cap)
+    assert np.allclose(t.numpy(), a.asnumpy())
+    cap2 = nd.to_dlpack_for_write(a)
+    t2 = torch.utils.dlpack.from_dlpack(cap2)
+    assert np.allclose(t2.numpy(), a.asnumpy())
 
 
 def test_torch_bridge():
@@ -37,6 +46,11 @@ def test_torch_bridge():
     out = mse(nd.array(np.ones((2, 2), np.float32)),
               nd.array(np.zeros((2, 2), np.float32)))
     assert float(out.asnumpy()) == 1.0
+    # kwargs get converted too
+    mse_kw = mx.torch.torch_function(torch.nn.functional.mse_loss)
+    out2 = mse_kw(nd.array(np.ones((2, 2), np.float32)),
+                  target=nd.array(np.zeros((2, 2), np.float32)))
+    assert float(out2.asnumpy()) == 1.0
 
 
 def test_rtc_pallas_module():
@@ -55,6 +69,23 @@ def scale_add(x_ref, y_ref, o_ref):
         mx.rtc.CudaModule("__global__ void k() {}")
     with pytest.raises(mx.base.MXNetError):
         mod.get_kernel("nope", out_like=x)
+
+
+def test_rtc_kernel_uses_source_helpers():
+    # kernels resolve same-source helper functions and constants
+    src = """
+SCALE = 3.0
+
+def _helper(v):
+    return v * SCALE
+
+def k(x_ref, o_ref):
+    o_ref[...] = _helper(x_ref[...])
+"""
+    mod = mx.rtc.PallasModule(src, exports=["k"])
+    x = nd.array(np.random.randn(4, 128).astype(np.float32))
+    o = mod.get_kernel("k", out_like=x).launch([x])
+    assert np.allclose(o.asnumpy(), 3.0 * x.asnumpy(), atol=1e-6)
 
 
 def test_mnist_iter(tmp_path):
@@ -135,6 +166,26 @@ def test_image_det_iter(tmp_path):
     # img0 has 2 objects, third row is padding
     assert (lab[0, 2] == -1).all()
     assert np.allclose(lab[0, 0], [0, 0.1, 0.1, 0.6, 0.7], atol=1e-5)
+
+
+def test_image_det_iter_resizes_not_crops(tmp_path):
+    # a 64x32 source image must be RESIZED to data_shape (boxes stay
+    # valid in normalized coords), never center-cropped
+    PIL = pytest.importorskip("PIL.Image")
+    arr = np.zeros((32, 64, 3), np.uint8)
+    arr[:, :16] = 255          # bright left quarter, box covers it
+    PIL.fromarray(arr).save(str(tmp_path / "wide.jpg"))
+    label = [4, 5, 0.0, 0.0, 1.0, 0.0, 0.0, 0.25, 1.0]
+    it = mx.image.ImageDetIter(batch_size=1, data_shape=(3, 32, 32),
+                               imglist=[(label, "wide.jpg")],
+                               path_root=str(tmp_path))
+    b = it.next()
+    img = b.data[0].asnumpy()[0]          # (3, 32, 32)
+    lab = b.label[0].asnumpy()[0, 0]
+    # the left quarter of the RESIZED image is still bright: box aligned
+    assert img[:, :, :8].mean() > 200
+    assert img[:, :, 16:].mean() < 50
+    assert np.allclose(lab, [1.0, 0.0, 0.0, 0.25, 1.0], atol=1e-5)
 
 
 def test_det_horizontal_flip_boxes():
