@@ -58,12 +58,15 @@ CORE_OPS = {
     "Pooling": ("norm", {"kernel": (2, 2), "pool_type": "max",
                          "stride": (2, 2)}),
     "FullyConnected": ("fc", {"num_hidden": 256}),
-    "BatchNorm": ("norm", {}),
+    # train_aware ops get training=True explicitly — outside
+    # autograd.record() they would otherwise run their inference paths
+    # (Dropout = identity) and the timing would be meaningless
+    "BatchNorm": ("norm", {"training": True}),
     "LayerNorm": ("softmax", {}),
     "softmax": ("softmax", {}),
     "log_softmax": ("softmax", {}),
     "Activation": ("reduce", {"act_type": "relu"}),
-    "Dropout": ("reduce", {"p": 0.5}),
+    "Dropout": ("reduce", {"p": 0.5, "training": True}),
 }
 
 

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""MNIST training via the Module API (reference
+example/image-classification/train_mnist.py — BASELINE config 1).
+
+Uses MNISTIter over idx/ubyte files when --data-dir has them, else a
+synthetic digit stream so the script runs anywhere.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def mlp_symbol(sym, num_classes):
+    data = sym.var("data")
+    net = sym.flatten(data)
+    net = sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = sym.Activation(net, act_type="relu", name="relu2")
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc3")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def lenet_symbol(sym, num_classes):
+    data = sym.var("data")
+    net = sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    net = sym.Activation(net, act_type="tanh", name="tanh1")
+    net = sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = sym.Convolution(net, kernel=(5, 5), num_filter=50, name="conv2")
+    net = sym.Activation(net, act_type="tanh", name="tanh2")
+    net = sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = sym.flatten(net)
+    net = sym.FullyConnected(net, num_hidden=500, name="fc1")
+    net = sym.Activation(net, act_type="tanh", name="tanh3")
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def get_iters(args):
+    import incubator_mxnet_tpu as mx
+    tr_img = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    tr_lab = os.path.join(args.data_dir, "train-labels-idx1-ubyte")
+    if os.path.exists(tr_img):
+        train = mx.io.MNISTIter(image=tr_img, label=tr_lab,
+                                batch_size=args.batch_size, shuffle=True,
+                                flat=args.network == "mlp")
+        val_img = os.path.join(args.data_dir, "t10k-images-idx3-ubyte")
+        val = mx.io.MNISTIter(image=val_img,
+                              label=os.path.join(
+                                  args.data_dir, "t10k-labels-idx1-ubyte"),
+                              batch_size=args.batch_size,
+                              flat=args.network == "mlp")
+        return train, val
+    # synthetic fallback: each class is a noisy template so the model can
+    # actually learn
+    rs = np.random.RandomState(7)
+    templates = (rs.rand(10, 28, 28) > 0.5).astype(np.float32)
+    n = args.num_examples
+    ys = rs.randint(0, 10, n)
+    xs = templates[ys] + rs.normal(0, 0.3, (n, 28, 28)).astype(np.float32)
+    if args.network == "mlp":
+        xs = xs.reshape(n, 784)
+    else:
+        xs = xs[:, None]
+    split = int(0.9 * n)
+    train = mx.io.NDArrayIter({"data": xs[:split]},
+                              {"softmax_label": ys[:split].astype(np.float32)},
+                              batch_size=args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter({"data": xs[split:]},
+                            {"softmax_label": ys[split:].astype(np.float32)},
+                            batch_size=args.batch_size)
+    return train, val
+
+
+def main():
+    p = argparse.ArgumentParser(description="train mnist")
+    p.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    p.add_argument("--data-dir", default="./mnist")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=8)
+    p.add_argument("--num-examples", type=int, default=2000)
+    p.add_argument("--lr", type=float, default=0.5)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import symbol as sym
+    from incubator_mxnet_tpu.module import Module
+
+    net = (mlp_symbol if args.network == "mlp" else lenet_symbol)(sym, 10)
+    train, val = get_iters(args)
+    mod = Module(net)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd", optimizer_params={"learning_rate": args.lr},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    score = mod.score(val, "acc")
+    logging.info("final validation accuracy: %.4f", dict(score)["accuracy"])
+
+
+if __name__ == "__main__":
+    main()

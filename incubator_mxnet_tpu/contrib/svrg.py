@@ -73,14 +73,16 @@ class SVRGModule(Module):
                 g = self._mod_aux._exec.grad_dict.get(name)
                 if g is None:
                     continue
-                gn = g.asnumpy()
-                sums[name] = gn if name not in sums else sums[name] + gn
+                # accumulate ON DEVICE (XLA async adds) — a host asnumpy()
+                # per param per batch would serialize the whole pass
+                gd = g._data
+                sums[name] = gd if name not in sums else sums[name] + gd
             n += 1
         train_data.reset()
         if n == 0:
             raise MXNetError("update_full_grads: empty train_data")
-        from .. import nd
-        self._mu = {k: nd.array(v / n) for k, v in sums.items()}
+        from ..ndarray.ndarray import NDArray
+        self._mu = {k: NDArray(v / n) for k, v in sums.items()}
 
     def forward(self, data_batch, is_train=None):
         self._last_batch = data_batch
@@ -117,17 +119,26 @@ class SVRGModule(Module):
         from ..metric import create as _metric_create
         metric = _metric_create(eval_metric) if isinstance(eval_metric, str) \
             else eval_metric
+        from ..model import BatchEndParam
         for epoch in range(begin_epoch, num_epoch):
             if (epoch - begin_epoch) % self.update_freq == 0:
                 self._take_snapshot()
                 self.update_full_grads(train_data)
             metric.reset()
             train_data.reset()
-            for batch in train_data:
+            for nbatch, batch in enumerate(train_data):
                 self.forward(batch, is_train=True)
                 self.backward()
                 self.update()
                 self.update_metric(metric, batch.label)
+                if batch_end_callback is not None:
+                    batch_end_callback(BatchEndParam(
+                        epoch=epoch, nbatch=nbatch, eval_metric=metric,
+                        locals=None))
             if epoch_end_callback is not None:
                 epoch_end_callback(epoch, self.symbol, *self.get_params())
+            if eval_data is not None:
+                res = self.score(eval_data, eval_metric)
+                self.logger.info("Epoch[%d] validation: %s", epoch,
+                                 dict(res))
         return metric

@@ -74,8 +74,13 @@ def CreateDetAugmenter(data_shape, resize=0, rand_mirror=False, mean=None,
     plain-image crop family is deliberately excluded. Color/cast augs run
     AFTER resize so the resize sees uint8 pixels. Users can append custom
     (img, label) -> (img, label) callables (e.g. IoU-constrained crops)."""
-    from .image import CastAug, ColorJitterAug, ColorNormalizeAug
-    augs = [DetForceResizeAug((data_shape[2], data_shape[1]))]
+    from .image import CastAug, ColorJitterAug, ColorNormalizeAug, ResizeAug
+    augs = []
+    if resize > 0:
+        # shorter-edge resize scales both dims by the same factor, so
+        # normalized boxes are unaffected — safe to borrow
+        augs.append(DetBorrowAug(ResizeAug(resize)))
+    augs.append(DetForceResizeAug((data_shape[2], data_shape[1])))
     if rand_mirror:
         augs.append(DetHorizontalFlipAug(0.5))
     augs.append(DetBorrowAug(CastAug()))
@@ -104,18 +109,18 @@ class ImageDetIter(ImageIter):
                  aug_list=None, imglist=None, label_pad_width=None,
                  label_pad_value=-1.0, data_name="data",
                  label_name="label", **kwargs):
+        _aug_keys = ("resize", "rand_mirror", "mean", "std", "brightness",
+                     "contrast", "saturation")
         if aug_list is None:
             aug_list = CreateDetAugmenter(data_shape, **{
-                k: v for k, v in kwargs.items()
-                if k in ("resize", "rand_mirror", "mean", "std")})
+                k: v for k, v in kwargs.items() if k in _aug_keys})
         super().__init__(batch_size, data_shape, label_width=1,
                          path_imgrec=path_imgrec, path_imglist=path_imglist,
                          path_root=path_root, shuffle=shuffle,
                          aug_list=[], imglist=imglist, data_name=data_name,
                          label_name=label_name, **{
                              k: v for k, v in kwargs.items()
-                             if k not in ("resize", "rand_mirror", "mean",
-                                          "std")})
+                             if k not in _aug_keys})
         self.det_auglist = aug_list
         self.label_pad_value = float(label_pad_value)
         # scan the dataset once to size the padded label tensor (reference

@@ -160,8 +160,33 @@ def block_grad(data):
 
 @register(name="make_loss", aliases=("MakeLoss",))
 def make_loss(data, *, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
-    """Reference src/operator/make_loss.cc — identity forward, head-grad source."""
-    return data
+    """Reference src/operator/make_loss.cc — identity forward; the backward
+    injects grad_scale (normalized by batch size or by the count of
+    elements above valid_thresh), applied multiplicatively to the head
+    gradient so terminal use (head grad 1) matches the reference."""
+    import jax as _jax
+
+    gs = float(grad_scale)
+
+    @_jax.custom_vjp
+    def _ml(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(x, g):
+        scale = gs
+        if normalization == "batch":
+            scale = gs / x.shape[0]
+        elif normalization == "valid":
+            nvalid = jnp.maximum(jnp.sum((x > valid_thresh).astype(
+                jnp.float32)), 1.0)
+            return (g * (gs / nvalid),)
+        return (g * scale,)
+
+    _ml.defvjp(fwd, bwd)
+    return _ml(data)
 
 
 @register()

@@ -64,7 +64,10 @@ WORKER = textwrap.dedent("""
 
     # 5) barrier is a real cross-process rendezvous
     kv.barrier()
-    print("WORKER_OK", pid, flush=True)
+    # ONE write: print("WORKER_OK", pid) issues separate writes per arg,
+    # which interleave with gloo's own stdout chatter and split the token
+    sys.stdout.write("WORKER_OK_%d\\n" % pid)
+    sys.stdout.flush()
 """)
 
 
@@ -92,4 +95,4 @@ def test_dist_sync_two_processes(tmp_path):
         outs.append((p.returncode, out, err))
     for i, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"worker {i} failed:\n{err[-2000:]}"
-        assert f"WORKER_OK {i}" in out
+        assert f"WORKER_OK_{i}" in out

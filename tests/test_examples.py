@@ -1,0 +1,67 @@
+"""Example entry points run end-to-end with tiny settings.
+
+Reference coverage model: tests/tutorials + the CI smoke runs of
+example/image-classification (the examples ARE the user-facing contract;
+a framework whose train_imagenet.py crashes is broken regardless of unit
+tests).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=600):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, os.path.join(REPO, script), *args],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-2500:])
+    return r.stdout + r.stderr
+
+
+def test_train_mnist_learns():
+    out = _run("example/image-classification/train_mnist.py",
+               "--num-epochs", "6", "--num-examples", "1200",
+               "--batch-size", "50")
+    acc = float(out.rsplit("final validation accuracy:", 1)[1].strip())
+    assert acc > 0.8
+
+
+def test_train_imagenet_compiled_path():
+    out = _run("example/image-classification/train_imagenet.py",
+               "--network", "resnet18_v1", "--batch-size", "16",
+               "--num-batches", "3", "--image-shape", "3,32,32",
+               "--num-classes", "10", "--kv-store", "tpu",
+               "--dtype", "float32", "--disp-batches", "1")
+    assert "epoch 0 done" in out
+
+
+def test_train_imagenet_trainer_path():
+    out = _run("example/image-classification/train_imagenet.py",
+               "--network", "resnet18_v1", "--batch-size", "8",
+               "--num-batches", "2", "--image-shape", "3,32,32",
+               "--num-classes", "10", "--kv-store", "local",
+               "--disp-batches", "1")
+    assert "epoch 0 done" in out
+
+
+def test_benchmark_score():
+    out = _run("example/image-classification/benchmark_score.py",
+               "--networks", "resnet18_v1", "--batch-sizes", "2",
+               "--steps", "2")
+    assert "images/sec" in out
+
+
+def test_lstm_ptb_perplexity_improves():
+    out = _run("example/rnn/lstm_ptb.py", "--num-epochs", "2",
+               "--num-tokens", "4000", "--vocab", "40",
+               "--batch-size", "8", "--bptt", "16")
+    ppls = [float(line.split("perplexity")[1].split()[0])
+            for line in out.splitlines() if "perplexity" in line]
+    assert len(ppls) == 2
+    assert ppls[-1] < ppls[0]
+    assert ppls[-1] < 40          # below uniform

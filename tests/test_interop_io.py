@@ -19,6 +19,11 @@ def test_dlpack_roundtrip_numpy():
     a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
     b = nd.from_dlpack(a._data)
     assert np.allclose(b.asnumpy(), a.asnumpy())
+    # export must produce a capsule even without torch installed
+    cap = nd.to_dlpack_for_read(a)
+    assert "PyCapsule" in type(cap).__name__
+    cap2 = nd.to_dlpack_for_write(a)
+    assert "PyCapsule" in type(cap2).__name__
 
 
 def test_dlpack_capsule_consumed_by_torch():

@@ -1,0 +1,233 @@
+"""Coverage for op families the main operator suites didn't reach:
+scalar arithmetic, loss-shaping ops, resize/pool extras, fused optimizer
+updates, misc indexing.
+
+Reference coverage model: tests/python/unittest/test_operator.py's numpy
+reference-check pattern (check_symbolic_forward/backward analogs inline).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+
+nd = mx.nd
+
+
+def test_scalar_arith_family():
+    x = nd.array(np.array([[1.0, -2.0], [4.0, 0.5]], np.float32))
+    xn = x.asnumpy()
+    assert np.allclose((x + 3).asnumpy(), xn + 3)
+    assert np.allclose((3 + x).asnumpy(), 3 + xn)
+    assert np.allclose((x - 3).asnumpy(), xn - 3)
+    assert np.allclose((3 - x).asnumpy(), 3 - xn)
+    assert np.allclose((x * 2).asnumpy(), xn * 2)
+    assert np.allclose((x / 2).asnumpy(), xn / 2)
+    assert np.allclose((2 / x).asnumpy(), 2 / xn)
+    assert np.allclose((x ** 2).asnumpy(), xn ** 2)
+    assert np.allclose((2 ** x).asnumpy(), 2.0 ** xn)
+    assert np.allclose((x % 2).asnumpy(), xn % 2)
+    assert np.allclose(nd.maximum(x, 1.0).asnumpy(), np.maximum(xn, 1.0))
+    assert np.allclose(nd.minimum(x, 1.0).asnumpy(), np.minimum(xn, 1.0))
+
+
+def test_scalar_compare_family():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    assert np.allclose((x > 2).asnumpy(), [0, 0, 1])
+    assert np.allclose((x >= 2).asnumpy(), [0, 1, 1])
+    assert np.allclose((x < 2).asnumpy(), [1, 0, 0])
+    assert np.allclose((x <= 2).asnumpy(), [1, 1, 0])
+    assert np.allclose((x == 2).asnumpy(), [0, 1, 0])
+    assert np.allclose((x != 2).asnumpy(), [1, 0, 1])
+    y = nd.array(np.array([3.0, 2.0, 1.0], np.float32))
+    assert np.allclose((x < y).asnumpy(), [1, 0, 0])
+    assert np.allclose((x <= y).asnumpy(), [1, 1, 0])
+
+
+def test_scalar_grad():
+    x = nd.array(np.array([2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 3 + 1) / 2
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [1.5, 1.5])
+
+
+def test_make_loss_and_blockgrad():
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        l = nd.make_loss(x * x, grad_scale=2.0)
+    l.backward()
+    # d(x^2)/dx * grad_scale
+    assert np.allclose(x.grad.asnumpy(), [4.0, 8.0])
+    with autograd.record():
+        z = nd.BlockGrad(x * x) * x
+    z.backward()
+    # gradient flows only through the outer x
+    assert np.allclose(x.grad.asnumpy(), [1.0, 4.0])
+
+
+def test_moments():
+    x = np.random.randn(3, 4, 5).astype(np.float32)
+    m, v = nd.Moments(nd.array(x), axes=(0, 2))
+    assert np.allclose(m.asnumpy(), x.mean(axis=(0, 2)), atol=1e-5)
+    assert np.allclose(v.asnumpy(), x.var(axis=(0, 2)), atol=1e-5)
+    m2, v2 = nd.Moments(nd.array(x), axes=(1,), keepdims=True)
+    assert m2.shape == (3, 1, 5)
+
+
+def test_pad_modes():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    pw = (0, 0, 0, 0, 1, 1, 1, 1)
+    out = nd.pad(nd.array(x), mode="constant", pad_width=pw,
+                 constant_value=7.0).asnumpy()
+    assert out.shape == (1, 1, 6, 6)
+    assert out[0, 0, 0, 0] == 7.0
+    assert np.allclose(out[0, 0, 1:5, 1:5], x[0, 0])
+    ref = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="edge")
+    oe = nd.pad(nd.array(x), mode="edge", pad_width=pw).asnumpy()
+    assert np.allclose(oe, ref)
+    rf = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="reflect")
+    orf = nd.pad(nd.array(x), mode="reflect", pad_width=pw).asnumpy()
+    assert np.allclose(orf, rf)
+
+
+def test_swapaxis_and_broadcast_axes():
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    assert nd.SwapAxis(nd.array(x), dim1=0, dim2=2).shape == (4, 3, 2)
+    y = np.random.randn(1, 3, 1).astype(np.float32)
+    out = nd.broadcast_axis(nd.array(y), axis=(0, 2), size=(2, 4))
+    assert out.shape == (2, 3, 4)
+    assert np.allclose(out.asnumpy(), np.broadcast_to(y, (2, 3, 4)))
+
+
+def test_bilinear_resize_2d():
+    x = np.random.randn(2, 3, 4, 4).astype(np.float32)
+    out = nd.BilinearResize2D(nd.array(x), height=8, width=8)
+    assert out.shape == (2, 3, 8, 8)
+    out2 = nd.BilinearResize2D(nd.array(x), scale_height=2.0,
+                               scale_width=2.0)
+    assert out2.shape == (2, 3, 8, 8)
+
+
+def test_adaptive_avg_pooling():
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    out = nd.contrib_AdaptiveAvgPooling2D(nd.array(x), output_size=2) \
+        if hasattr(nd, "contrib_AdaptiveAvgPooling2D") else \
+        mx.ops.invoke("_contrib_AdaptiveAvgPooling2D", nd.array(x),
+                      output_size=2)
+    assert out.shape == (2, 3, 2, 2)
+    # each output bin is the mean of its 4x4 block
+    expect = x.reshape(2, 3, 2, 4, 2, 4).mean(axis=(3, 5))
+    assert np.allclose(out.asnumpy(), expect, atol=1e-5)
+
+
+def test_index_copy():
+    old = nd.array(np.zeros((5, 3), np.float32))
+    new = nd.array(np.ones((2, 3), np.float32))
+    idx = nd.array(np.array([1, 3], np.float32))
+    out = nd.index_copy(old, idx, new).asnumpy()
+    assert np.allclose(out[[1, 3]], 1.0)
+    assert np.allclose(out[[0, 2, 4]], 0.0)
+
+
+def test_ctc_loss_smoke():
+    # perfect prediction of a short sequence has near-zero loss
+    T, B, C = 8, 2, 4
+    data = np.full((T, B, C), -10.0, np.float32)
+    labels = np.array([[1, 2], [3, 1]], np.float32)
+    # emit label[0] for first half, label[1] for second half
+    for b in range(B):
+        for t in range(T):
+            c = int(labels[b, 0] if t < T // 2 else labels[b, 1])
+            data[t, b, c] = 10.0
+    loss = nd.CTCLoss(nd.array(data), nd.array(labels)).asnumpy()
+    assert loss.shape == (B,)
+    assert (loss < 1.0).all()
+    # random logits give a clearly larger loss
+    rnd = np.random.randn(T, B, C).astype(np.float32)
+    loss2 = nd.CTCLoss(nd.array(rnd), nd.array(labels)).asnumpy()
+    assert (loss2 > loss).all()
+
+
+def _as_nd(*arrays):
+    return [nd.array(a) for a in arrays]
+
+
+def test_ftml_and_rmspropalex_updates():
+    rs = np.random.RandomState(0)
+    w = rs.randn(4).astype(np.float32)
+    g = rs.randn(4).astype(np.float32)
+    # ftml (Zheng & Kwok 2017) numpy oracle, t=1
+    lr, b1, b2, eps = 0.1, 0.6, 0.999, 1e-8
+    v = (1 - b2) * g * g
+    d = (1 - b1 ** 1) / lr * (np.sqrt(v / (1 - b2 ** 1)) + eps)
+    sigma = d - b1 * 0.0
+    z = (1 - b1) * g - sigma * w
+    expect_w = -z / d
+    wn, dn, vn, zn = nd.ftml_update(
+        *_as_nd(w, g, np.zeros(4, np.float32), np.zeros(4, np.float32),
+                np.zeros(4, np.float32)), lr=lr, t=1)
+    assert np.allclose(wn.asnumpy(), expect_w, atol=1e-5)
+    assert np.allclose(vn.asnumpy(), v, atol=1e-6)
+
+    # rmspropalex (Graves 2013) numpy oracle
+    g1, g2 = 0.95, 0.9
+    n_new = (1 - g1) * g * g
+    g_new = (1 - g1) * g
+    delta = -lr * g / np.sqrt(n_new - g_new ** 2 + eps)
+    wn, nn_, gn, dn = nd.rmspropalex_update(
+        *_as_nd(w, g, np.zeros(4, np.float32), np.zeros(4, np.float32),
+                np.zeros(4, np.float32)), lr=lr)
+    assert np.allclose(wn.asnumpy(), w + delta, atol=1e-5)
+
+
+def test_mp_sgd_and_multi_sgd_updates():
+    rs = np.random.RandomState(1)
+    w16 = rs.randn(4).astype(np.float16)
+    w32 = w16.astype(np.float32)
+    g16 = rs.randn(4).astype(np.float16)
+    wn, mom, w32n = nd.mp_sgd_mom_update(
+        nd.array(w16), nd.array(g16), nd.array(np.zeros(4, np.float32)),
+        nd.array(w32), lr=0.1, momentum=0.9)
+    expect32 = w32 - 0.1 * g16.astype(np.float32)
+    assert np.allclose(w32n.asnumpy(), expect32, atol=1e-3)
+    assert wn.asnumpy().dtype == np.float16
+
+    # fused multi-weight sgd: two (w, g, m) triples in one call
+    ws = [rs.randn(3).astype(np.float32) for _ in range(2)]
+    gs = [rs.randn(3).astype(np.float32) for _ in range(2)]
+    ms = [np.zeros(3, np.float32) for _ in range(2)]
+    flat = []
+    for i in range(2):
+        flat += [ws[i], gs[i], ms[i]]
+    outs = nd.multi_sgd_mom_update(*_as_nd(*flat), lrs=(0.1, 0.2),
+                                   wds=(0.0, 0.0), momentum=0.9,
+                                   num_weights=2)
+    # outputs flatten to (w0, m0, w1, m1)
+    for i, lr in enumerate((0.1, 0.2)):
+        assert np.allclose(outs[2 * i].asnumpy(), ws[i] - lr * gs[i],
+                           atol=1e-5)
+
+
+def test_scatter_set_nd_and_getitem():
+    x = nd.array(np.zeros((3, 3), np.float32))
+    x[1, 2] = 5.0                     # routes through _scatter_set_nd
+    assert x.asnumpy()[1, 2] == 5.0
+    x[0] = 2.0
+    assert np.allclose(x.asnumpy()[0], 2.0)
+    sub = x[0:2]                      # _getitem_static
+    assert sub.shape == (2, 3)
+
+
+def test_sample_unique_zipfian():
+    out = nd.invoke_op("_sample_unique_zipfian", range_max=100,
+                       shape=(200,)) \
+        if hasattr(nd, "invoke_op") else \
+        mx.ops.invoke("_sample_unique_zipfian", range_max=100, shape=(200,))
+    o = out.asnumpy()
+    assert o.shape == (200,)
+    assert o.min() >= 0 and o.max() < 100
+    # zipfian: small ids much more frequent
+    assert (o < 10).sum() > (o >= 90).sum()
