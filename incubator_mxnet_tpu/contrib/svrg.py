@@ -132,9 +132,13 @@ class SVRGModule(Module):
                 self.update()
                 self.update_metric(metric, batch.label)
                 if batch_end_callback is not None:
-                    batch_end_callback(BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=metric,
-                        locals=None))
+                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                          eval_metric=metric, locals=None)
+                    cbs = batch_end_callback if isinstance(
+                        batch_end_callback, (list, tuple)) \
+                        else [batch_end_callback]
+                    for cb in cbs:
+                        cb(param)
             if epoch_end_callback is not None:
                 epoch_end_callback(epoch, self.symbol, *self.get_params())
             if eval_data is not None:

@@ -164,11 +164,9 @@ def make_loss(data, *, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
     injects grad_scale (normalized by batch size or by the count of
     elements above valid_thresh), applied multiplicatively to the head
     gradient so terminal use (head grad 1) matches the reference."""
-    import jax as _jax
-
     gs = float(grad_scale)
 
-    @_jax.custom_vjp
+    @jax.custom_vjp
     def _ml(x):
         return x
 

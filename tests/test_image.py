@@ -145,3 +145,69 @@ def test_image_iter_from_rec(tmp_path):
     except StopIteration:
         pass
     assert count >= n - 4  # last partial batch policy may drop
+
+
+def test_image_record_iter_native_path(tmp_path):
+    """The native (C++ libjpeg) decode path yields batches equivalent to
+    the python path (reference iter_image_recordio_2.cc decode threads)."""
+    from incubator_mxnet_tpu import recordio
+    from incubator_mxnet_tpu import native as mxnative
+    from incubator_mxnet_tpu.image.image_iter import ImageRecordIter
+
+    import io as _io
+    from PIL import Image as PILImage
+    rng = np.random.RandomState(1)
+    rec_path = str(tmp_path / "n.rec")
+    idx_path = str(tmp_path / "n.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    imgs = []
+    for i in range(6):
+        arr = rng.randint(0, 255, (28, 36, 3), dtype=np.uint8)
+        buf = _io.BytesIO()
+        PILImage.fromarray(arr).save(buf, format="JPEG", quality=95)
+        # the oracle is the DECODED jpeg (jpeg itself mangles noise images)
+        imgs.append(np.asarray(PILImage.open(_io.BytesIO(buf.getvalue()))))
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), arr, quality=95))
+    rec.close()
+
+    it = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 16, 16),
+                         batch_size=3, shuffle=False)
+    lib = mxnative.load()
+    if lib is not None and getattr(lib, "has_jpeg", False):
+        assert it._native is not None    # fast path really engaged
+    b = it.next()
+    assert b.data[0].shape == (3, 3, 16, 16)
+    assert np.allclose(b.label[0].asnumpy(), [0, 1, 2])
+    d = b.data[0].asnumpy()
+    # both decode paths center-crop (CenterCropAug semantics): source is
+    # 28x36, so the target-aspect crop is the centered 16x16 window
+    ref = np.stack([im[6:22, 10:26] for im in imgs[:3]]).transpose(0, 3, 1, 2)
+    assert np.abs(d - ref.astype(np.float32)).mean() < 12   # JPEG noise
+    # second batch continues the stream
+    b2 = it.next()
+    assert np.allclose(b2.label[0].asnumpy(), [3, 4, 5])
+
+
+def test_native_decode_batch_direct():
+    from incubator_mxnet_tpu import native as mxnative
+    lib = mxnative.load()
+    if lib is None or not getattr(lib, "has_jpeg", False):
+        import pytest
+        pytest.skip("native jpeg unavailable")
+    import io as _io
+    from PIL import Image as PILImage
+    rng = np.random.RandomState(2)
+    bufs = []
+    for h, w in [(40, 60), (32, 32)]:
+        a = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+        b = _io.BytesIO()
+        PILImage.fromarray(a).save(b, format="JPEG", quality=95)
+        bufs.append(b.getvalue())
+    out = mxnative.decode_jpeg_batch(bufs, 24, 24, mirrors=[0, 1])
+    assert out.shape == (2, 24, 24, 3) and out.dtype == np.uint8
+    # mirror flag flips horizontally
+    out2 = mxnative.decode_jpeg_batch([bufs[1]], 24, 24)
+    assert (out[1] == out2[0][:, ::-1]).all()
+    # corrupt input returns None (caller falls back to PIL)
+    assert mxnative.decode_jpeg_batch([b"notajpeg"], 8, 8) is None
