@@ -53,11 +53,47 @@ _worker_dataset = None
 
 def _worker_init(dataset_bytes):
     global _worker_dataset
+    # jax is NOT fork-safe: a forked child touching the parent's XLA
+    # client deadlocks. Workers run in host mode — datasets return numpy
+    # (dataset.IN_WORKER) and _as_numpy is a no-op on those.
+    from . import dataset as _dataset_mod
+    _dataset_mod.IN_WORKER = True
     _worker_dataset = pickle.loads(dataset_bytes)
 
 
 def _worker_fn(indices):
     return [_as_numpy(_worker_dataset[i]) for i in indices]
+
+
+def _worker_fn_shm(indices):
+    """Batchify in the worker and return the batch through POSIX shared
+    memory (descriptors over the pipe, payload zero-copy) — the analog of
+    the reference's cpu_shared-storage ForkingPickler path
+    (dataloader.py:55-98). Falls back to the pickled-samples protocol for
+    ragged/non-array samples."""
+    from multiprocessing import shared_memory
+    samples = [_as_numpy(_worker_dataset[i]) for i in indices]
+    first = samples[0]
+    try:
+        fields = list(zip(*samples)) if isinstance(first, tuple) \
+            else [samples]
+        descs = []
+        for f in fields:
+            arrs = _np.stack(f, 0) if isinstance(f[0], _np.ndarray) \
+                else _np.asarray(f)
+            if arrs.dtype == object:
+                raise ValueError("ragged")
+            if arrs.dtype == _np.float64:
+                arrs = arrs.astype(_np.float32)
+            shm = shared_memory.SharedMemory(create=True,
+                                             size=max(arrs.nbytes, 1))
+            view = _np.ndarray(arrs.shape, arrs.dtype, buffer=shm.buf)
+            view[...] = arrs
+            descs.append((shm.name, arrs.shape, str(arrs.dtype)))
+            shm.close()
+        return ("shm", descs, isinstance(first, tuple))
+    except Exception:
+        return ("raw", samples, isinstance(first, tuple))
 
 
 class DataLoader:
@@ -93,22 +129,36 @@ class DataLoader:
             self._start_pool()
 
     def _start_pool(self):
+        self._uses_threads = bool(self._thread_pool)
         try:
             payload = pickle.dumps(self._dataset)
         except Exception:
             # unpicklable dataset: degrade to single-process
             self._num_workers = 0
             return
-        if self._thread_pool:
-            from multiprocessing.pool import ThreadPool
-            global _worker_dataset
-            _worker_dataset = self._dataset
-            self._pool = ThreadPool(self._num_workers)
-        else:
-            ctx = multiprocessing.get_context("fork") if sys.platform != "win32" \
-                else multiprocessing.get_context()
-            self._pool = ctx.Pool(self._num_workers, initializer=_worker_init,
-                                  initargs=(payload,))
+        if not self._thread_pool:
+            # spawn, not fork: the parent's XLA runtime is multithreaded
+            # and fork'd children segfault/deadlock in it. Spawned workers
+            # import fresh and never initialize a device backend — they
+            # run in host mode (dataset.IN_WORKER) and only touch numpy.
+            # Spawn requires the script's `if __name__ == "__main__"`
+            # guard; without it we fall back to a thread pool.
+            try:
+                ctx = multiprocessing.get_context("spawn")
+                self._pool = ctx.Pool(self._num_workers,
+                                      initializer=_worker_init,
+                                      initargs=(payload,))
+                return
+            except RuntimeError:
+                import warnings
+                warnings.warn(
+                    "DataLoader(num_workers>0) needs the __main__ guard "
+                    "for process workers (spawn); using threads instead")
+                self._uses_threads = True
+        from multiprocessing.pool import ThreadPool
+        global _worker_dataset
+        _worker_dataset = self._dataset
+        self._pool = ThreadPool(self._num_workers)
 
     def __iter__(self):
         if self._num_workers == 0 or self._pool is None:
@@ -116,8 +166,13 @@ class DataLoader:
                 yield self._batchify_fn([self._dataset[i] for i in batch_idx])
             return
 
-        # pipelined async fetch through the pool
+        # pipelined async fetch through the pool; workers return batches
+        # via shared memory when the default batchify applies (stacking
+        # happened in the worker), else pickled samples
         import collections
+        use_shm = (self._batchify_fn is default_batchify_fn
+                   and not self._uses_threads)
+        fn = _worker_fn_shm if use_shm else _worker_fn
         pending = collections.deque()
         it = iter(self._batch_sampler)
         exhausted = False
@@ -128,11 +183,36 @@ class DataLoader:
                 except StopIteration:
                     exhausted = True
                     break
-                pending.append(self._pool.apply_async(_worker_fn, (idx,)))
+                pending.append(self._pool.apply_async(fn, (idx,)))
             if not pending:
                 return
-            samples = pending.popleft().get()
+            result = pending.popleft().get()
+            if use_shm:
+                kind, payload, is_tuple = result
+                if kind == "shm":
+                    yield self._from_shm(payload, is_tuple)
+                    continue
+                samples = payload
+            else:
+                samples = result
             yield self._batchify_fn([_renumpy(s) for s in samples])
+
+    @staticmethod
+    def _from_shm(descs, is_tuple):
+        from multiprocessing import shared_memory
+        outs = []
+        for name, shape, dtype in descs:
+            shm = shared_memory.SharedMemory(name=name)
+            try:
+                view = _np.ndarray(shape, _np.dtype(dtype), buffer=shm.buf)
+                # MUST copy before unlink: on the CPU backend jnp.asarray
+                # aliases the numpy buffer zero-copy, and reading an
+                # NDArray whose shm segment was unmapped segfaults
+                outs.append(nd.array(view.copy()))
+            finally:
+                shm.close()
+                shm.unlink()
+        return tuple(outs) if is_tuple else outs[0]
 
     def __len__(self):
         return len(self._batch_sampler)

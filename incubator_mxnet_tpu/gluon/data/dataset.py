@@ -10,6 +10,18 @@ from ...base import MXNetError
 
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
 
+# Set True inside DataLoader worker processes (dataloader._worker_init):
+# workers must stay jax-free — a forked child touching the parent's XLA
+# client deadlocks — so datasets store HOST (numpy) arrays and only wrap
+# into device-backed NDArrays on access in the main process.
+IN_WORKER = False
+
+
+def _maybe_nd(a, dtype=None):
+    if IN_WORKER or not isinstance(a, _np.ndarray):
+        return a
+    return nd.array(a, dtype=dtype)
+
 
 class Dataset:
     def __getitem__(self, idx):
@@ -72,17 +84,22 @@ class ArrayDataset(Dataset):
         for a in args:
             if len(a) != self._length:
                 raise MXNetError("all arrays must have the same length")
-            if isinstance(a, _np.ndarray):
-                a = nd.array(a) if a.dtype != _np.object_ else a
+            # NDArray inputs are snapshotted to host so the dataset stays
+            # picklable + fork-safe for DataLoader workers; access re-wraps
+            if isinstance(a, nd.NDArray):
+                a = a.asnumpy()
             self._data.append(a)
 
     def __len__(self):
         return self._length
 
+    def _one(self, col, idx):
+        return _maybe_nd(self._data[col][idx])
+
     def __getitem__(self, idx):
         if len(self._data) == 1:
-            return self._data[0][idx]
-        return tuple(d[idx] for d in self._data)
+            return self._one(0, idx)
+        return tuple(self._one(c, idx) for c in range(len(self._data)))
 
 
 class RecordFileDataset(Dataset):

@@ -16,7 +16,7 @@ import numpy as _np
 
 from .... import nd
 from ....base import MXNetError
-from ..dataset import Dataset
+from ..dataset import Dataset, _maybe_nd
 
 
 class _DownloadedDataset(Dataset):
@@ -30,7 +30,10 @@ class _DownloadedDataset(Dataset):
         self._get_data()
 
     def __getitem__(self, idx):
-        data = self._data[idx]
+        # host (numpy) storage; wrapped to NDArray on access in the main
+        # process, left as numpy inside fork'd DataLoader workers (jax is
+        # not fork-safe — see dataset.IN_WORKER)
+        data = _maybe_nd(self._data[idx])
         label = self._label[idx]
         if self._transform is not None:
             return self._transform(data, label)
@@ -73,13 +76,13 @@ class MNIST(_DownloadedDataset):
             base = rng.rand(n, *self._SHAPE) * 0.1
             imgs = ((base + labels[:, None, None, None] / self._N_CLASS) *
                     255).astype(_np.uint8)
-            self._data = nd.array(imgs, dtype="uint8")
+            self._data = imgs
             self._label = labels
             return
         imgf, lblf = self._train_files if self._train else self._test_files
         self._label = self._read_idx(os.path.join(self._root, lblf))
         data = self._read_idx(os.path.join(self._root, imgf))
-        self._data = nd.array(data.reshape(-1, 28, 28, 1), dtype="uint8")
+        self._data = data.reshape(-1, 28, 28, 1)
 
     @staticmethod
     def _read_idx(path):
@@ -124,7 +127,7 @@ class CIFAR10(_DownloadedDataset):
             imgs = ((rng.rand(n, *self._SHAPE) * 0.2 +
                      labels[:, None, None, None] / self._N_CLASS) * 255
                     ).astype(_np.uint8)
-            self._data = nd.array(imgs, dtype="uint8")
+            self._data = imgs
             self._label = labels
             return
         files = [f"data_batch_{i}.bin" for i in range(1, 6)] if self._train \
@@ -135,7 +138,7 @@ class CIFAR10(_DownloadedDataset):
                 raw = _np.frombuffer(f.read(), _np.uint8).reshape(-1, 3073)
             label.append(raw[:, 0].astype(_np.int32))
             data.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
-        self._data = nd.array(_np.concatenate(data), dtype="uint8")
+        self._data = _np.concatenate(data)
         self._label = _np.concatenate(label)
 
 
