@@ -61,8 +61,34 @@ def _worker_init(dataset_bytes):
     _worker_dataset = pickle.loads(dataset_bytes)
 
 
+def _worker_ping():
+    return "pong"
+
+
+def _fetch_samples(indices):
+    try:
+        return [_as_numpy(_worker_dataset[i]) for i in indices]
+    except AttributeError as e:
+        raise RuntimeError(
+            "dataset raised inside a process worker — note that workers "
+            "run in host mode (samples/transforms see numpy arrays, not "
+            "NDArrays); write transforms against numpy or use "
+            "DataLoader(..., thread_pool=True)") from e
+
+
 def _worker_fn(indices):
-    return [_as_numpy(_worker_dataset[i]) for i in indices]
+    return _fetch_samples(indices)
+
+
+def _unlink_descs(descs):
+    from multiprocessing import shared_memory
+    for name, _, _ in descs:
+        try:
+            s = shared_memory.SharedMemory(name=name)
+            s.close()
+            s.unlink()
+        except Exception:
+            pass
 
 
 def _worker_fn_shm(indices):
@@ -72,27 +98,44 @@ def _worker_fn_shm(indices):
     (dataloader.py:55-98). Falls back to the pickled-samples protocol for
     ragged/non-array samples."""
     from multiprocessing import shared_memory
-    samples = [_as_numpy(_worker_dataset[i]) for i in indices]
+    samples = _fetch_samples(indices)
     first = samples[0]
+    descs = []
     try:
         fields = list(zip(*samples)) if isinstance(first, tuple) \
             else [samples]
-        descs = []
         for f in fields:
-            arrs = _np.stack(f, 0) if isinstance(f[0], _np.ndarray) \
-                else _np.asarray(f)
-            if arrs.dtype == object:
-                raise ValueError("ragged")
-            if arrs.dtype == _np.float64:
-                arrs = arrs.astype(_np.float32)
-            shm = shared_memory.SharedMemory(create=True,
-                                             size=max(arrs.nbytes, 1))
-            view = _np.ndarray(arrs.shape, arrs.dtype, buffer=shm.buf)
-            view[...] = arrs
-            descs.append((shm.name, arrs.shape, str(arrs.dtype)))
+            if isinstance(f[0], _np.ndarray):
+                shape = (len(f),) + f[0].shape
+                dtype = f[0].dtype
+                if dtype == object:
+                    raise ValueError("ragged")
+                if dtype == _np.float64:
+                    f = [a.astype(_np.float32) for a in f]
+                    dtype = _np.dtype(_np.float32)
+                shm = shared_memory.SharedMemory(
+                    create=True,
+                    size=max(int(_np.prod(shape)) * dtype.itemsize, 1))
+                view = _np.ndarray(shape, dtype, buffer=shm.buf)
+                # stack straight into the shared buffer: no batch-sized
+                # temporary, single write
+                _np.stack(f, 0, out=view)
+            else:
+                arrs = _np.asarray(f)
+                if arrs.dtype == object:
+                    raise ValueError("ragged")
+                if arrs.dtype == _np.float64:
+                    arrs = arrs.astype(_np.float32)
+                shape, dtype = arrs.shape, arrs.dtype
+                shm = shared_memory.SharedMemory(
+                    create=True, size=max(arrs.nbytes, 1))
+                view = _np.ndarray(shape, dtype, buffer=shm.buf)
+                view[...] = arrs
+            descs.append((shm.name, shape, str(dtype)))
             shm.close()
         return ("shm", descs, isinstance(first, tuple))
     except Exception:
+        _unlink_descs(descs)      # don't leak segments of earlier fields
         return ("raw", samples, isinstance(first, tuple))
 
 
@@ -142,18 +185,23 @@ class DataLoader:
             # import fresh and never initialize a device backend — they
             # run in host mode (dataset.IN_WORKER) and only touch numpy.
             # Spawn requires the script's `if __name__ == "__main__"`
-            # guard; without it we fall back to a thread pool.
+            # guard; WITHOUT it the failure happens in the CHILD (which
+            # re-executes the script), so a parent-side health check with
+            # a timeout is the only reliable detection — on timeout the
+            # pool is torn down and we fall back to threads.
+            ctx = multiprocessing.get_context("spawn")
+            pool = ctx.Pool(self._num_workers, initializer=_worker_init,
+                            initargs=(payload,))
             try:
-                ctx = multiprocessing.get_context("spawn")
-                self._pool = ctx.Pool(self._num_workers,
-                                      initializer=_worker_init,
-                                      initargs=(payload,))
+                pool.apply_async(_worker_ping).get(timeout=60)
+                self._pool = pool
                 return
-            except RuntimeError:
+            except Exception:
                 import warnings
+                pool.terminate()
                 warnings.warn(
-                    "DataLoader(num_workers>0) needs the __main__ guard "
-                    "for process workers (spawn); using threads instead")
+                    "DataLoader process workers failed to start (missing "
+                    "`if __name__ == '__main__'` guard?); using threads")
                 self._uses_threads = True
         from multiprocessing.pool import ThreadPool
         global _worker_dataset
@@ -176,26 +224,38 @@ class DataLoader:
         pending = collections.deque()
         it = iter(self._batch_sampler)
         exhausted = False
-        while True:
-            while not exhausted and len(pending) < max(self._prefetch, 1):
-                try:
-                    idx = next(it)
-                except StopIteration:
-                    exhausted = True
-                    break
-                pending.append(self._pool.apply_async(fn, (idx,)))
-            if not pending:
-                return
-            result = pending.popleft().get()
+        try:
+            while True:
+                while not exhausted and len(pending) < max(self._prefetch, 1):
+                    try:
+                        idx = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    pending.append(self._pool.apply_async(fn, (idx,)))
+                if not pending:
+                    return
+                result = pending.popleft().get()
+                if use_shm:
+                    kind, payload, is_tuple = result
+                    if kind == "shm":
+                        yield self._from_shm(payload, is_tuple)
+                        continue
+                    samples = payload
+                else:
+                    samples = result
+                yield self._batchify_fn([_renumpy(s) for s in samples])
+        finally:
+            # abandoning the iterator early (break / partial validation)
+            # must not leak the prefetched batches' shm segments
             if use_shm:
-                kind, payload, is_tuple = result
-                if kind == "shm":
-                    yield self._from_shm(payload, is_tuple)
-                    continue
-                samples = payload
-            else:
-                samples = result
-            yield self._batchify_fn([_renumpy(s) for s in samples])
+                for r in pending:
+                    try:
+                        kind, payload, _ = r.get(timeout=30)
+                        if kind == "shm":
+                            _unlink_descs(payload)
+                    except Exception:
+                        pass
 
     @staticmethod
     def _from_shm(descs, is_tuple):

@@ -84,17 +84,33 @@ class ArrayDataset(Dataset):
         for a in args:
             if len(a) != self._length:
                 raise MXNetError("all arrays must have the same length")
-            # NDArray inputs are snapshotted to host so the dataset stays
-            # picklable + fork-safe for DataLoader workers; access re-wraps
-            if isinstance(a, nd.NDArray):
-                a = a.asnumpy()
             self._data.append(a)
+        # main-process access uses device-resident columns (one upload,
+        # device-side indexing); numpy copies only materialize when the
+        # dataset is pickled to workers (__getstate__)
+        self._nd_cache = [a if isinstance(a, nd.NDArray) else None
+                          for a in self._data]
+
+    def __getstate__(self):
+        # ship HOST arrays to workers: device handles don't pickle and
+        # workers must stay jax-free
+        host = [a.asnumpy() if isinstance(a, nd.NDArray) else a
+                for a in self._data]
+        return {"_length": self._length, "_data": host,
+                "_nd_cache": [None] * len(host)}
 
     def __len__(self):
         return self._length
 
     def _one(self, col, idx):
-        return _maybe_nd(self._data[col][idx])
+        if IN_WORKER:
+            return self._data[col][idx]
+        cache = self._nd_cache[col]
+        if cache is None and isinstance(self._data[col], _np.ndarray) \
+                and self._data[col].dtype != _np.object_:
+            cache = self._nd_cache[col] = nd.array(self._data[col])
+        src = cache if cache is not None else self._data[col]
+        return src[idx]
 
     def __getitem__(self, idx):
         if len(self._data) == 1:

@@ -16,7 +16,7 @@ import numpy as _np
 
 from .... import nd
 from ....base import MXNetError
-from ..dataset import Dataset, _maybe_nd
+from ..dataset import Dataset
 
 
 class _DownloadedDataset(Dataset):
@@ -30,14 +30,26 @@ class _DownloadedDataset(Dataset):
         self._get_data()
 
     def __getitem__(self, idx):
-        # host (numpy) storage; wrapped to NDArray on access in the main
-        # process, left as numpy inside fork'd DataLoader workers (jax is
-        # not fork-safe — see dataset.IN_WORKER)
-        data = _maybe_nd(self._data[idx])
+        # host (numpy) storage for picklability; main-process access goes
+        # through a lazily-built device-resident copy (one upload, indexed
+        # on device); workers stay on numpy (dataset.IN_WORKER — jax is
+        # not fork/multi-client safe)
+        from .. import dataset as _ds
+        if _ds.IN_WORKER:
+            data = self._data[idx]
+        else:
+            if getattr(self, "_data_nd", None) is None:
+                self._data_nd = nd.array(self._data)
+            data = self._data_nd[idx]
         label = self._label[idx]
         if self._transform is not None:
             return self._transform(data, label)
         return data, label
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_data_nd", None)       # device handles don't pickle
+        return state
 
     def __len__(self):
         return len(self._label)

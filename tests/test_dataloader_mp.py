@@ -60,3 +60,28 @@ def test_thread_pool_loader():
         assert np.allclose(xb.asnumpy(), X[seen:seen + xb.shape[0]])
         seen += xb.shape[0]
     assert seen == 40
+
+
+def test_mp_loader_early_break_no_shm_leak():
+    import glob
+    X, Y = _toy(96)
+    dl = DataLoader(ArrayDataset(X, Y), batch_size=8, num_workers=2)
+    it = iter(dl)
+    next(it)
+    it.close()          # abandon with prefetched batches pending
+    before = set(glob.glob("/dev/shm/psm_*"))
+    # a second full pass still works and cleans up after itself
+    n = sum(x.shape[0] for x, y in dl)
+    assert n == 96
+    after = set(glob.glob("/dev/shm/psm_*"))
+    assert len(after - before) == 0
+
+
+def test_dataset_device_resident_main_process():
+    from incubator_mxnet_tpu.gluon.data import dataset as ds_mod
+    X = np.random.randn(10, 4).astype(np.float32)
+    ds = ArrayDataset(X, np.arange(10).astype(np.float32))
+    x0, y0 = ds[0]
+    assert isinstance(x0, mx.nd.NDArray)       # main process: device
+    state = ds.__getstate__()
+    assert isinstance(state["_data"][0], np.ndarray)   # workers: host
