@@ -94,7 +94,7 @@ class Node:
     src/imperative/imperative.cc RecordOp)."""
 
     __slots__ = ("vjp_fn", "inputs", "out_refs", "out_avals", "out_aliases",
-                 "name")
+                 "name", "bwd_info")
 
     def __init__(self, vjp_fn, inputs, name=""):
         self.vjp_fn = vjp_fn     # cotangents-tuple -> input-cotangents tuple
@@ -103,6 +103,9 @@ class Node:
         self.out_refs = None     # list of weakrefs to output NDArrays
         self.out_avals = None    # list of (shape, dtype) for dead outputs
         self.out_aliases = None  # slot -> extra weakrefs (rewrapped views)
+        # (op, params, saved_args, ndarray_positions) for replaying this
+        # node's backward as a recorded op (create_graph higher-order path)
+        self.bwd_info = None
 
     def add_alias(self, orig, view):
         """Register `view` as another identity of output `orig` so backward
@@ -146,13 +149,74 @@ def _collect_tape(heads):
     return order[::-1]
 
 
-def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+_BWD_OPDEFS = {}
+
+
+def _record_bwd(node, cts):
+    """Replay `node`'s backward as a RECORDED op so the produced input
+    cotangents are themselves differentiable (create_graph=True). The
+    replayed op recomputes the node's forward under jax.vjp, taking the
+    cotangents AND the original input NDArrays as positional arguments —
+    second derivatives flow through both."""
+    import jax
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+    from .ops import registry as _R
+
+    op, params, saved, nd_pos = node.bwd_info
+    ncts = len(cts)
+    nd_pos_t = tuple(nd_pos)
+
+    def bwd_replay(*args, _op=op, _p=params):
+        cts_ = args[:ncts]
+        primals = args[ncts:]
+        if _op.stateful:
+            def fwd(rng, *xs):
+                return _op.fn(*xs, rng=rng, **_p)
+        else:
+            def fwd(*xs):
+                return _op.fn(*xs, **_p)
+        out, vjp = jax.vjp(fwd, *primals)
+        ct = tuple(_R._match_ct_dtypes(cts_, out)) \
+            if isinstance(out, (tuple, list)) else \
+            _R._match_ct_dtypes(cts_[0], out)
+        gin = vjp(ct)
+        sel = tuple(gin[i] for i in nd_pos_t)
+        # single cotangent returns bare (everywhere else a 1-tuple output
+        # and a single output use different cotangent conventions)
+        return sel[0] if len(sel) == 1 else sel
+
+    key = (id(op), _R._hashable(params), ncts, nd_pos_t)
+    bdef = _BWD_OPDEFS.get(key)
+    if bdef is None:
+        bdef = _R.OpDef(f"_backward_{op.name}", bwd_replay)
+        if len(_BWD_OPDEFS) > 256:
+            _BWD_OPDEFS.pop(next(iter(_BWD_OPDEFS)))
+        _BWD_OPDEFS[key] = bdef
+    args = [NDArray(c) if not isinstance(c, NDArray) else c for c in cts]
+    # primal slots: live NDArray inputs where available (tape-linked),
+    # the saved raw value otherwise (rng keys, non-diff args)
+    prim = list(saved)
+    for j, p in enumerate(nd_pos):
+        prim[p] = node.inputs[j]
+    with record():
+        outs = _R.apply_op(bdef, *args, *prim)
+    # bwd_replay returns cotangents already ordered like node.inputs
+    return outs if isinstance(outs, list) else [outs]
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             create_graph=False):
     """Compute gradients of heads w.r.t. marked variables.
 
     Reference python/mxnet/autograd.py:246 -> Imperative::Backward
     (src/imperative/imperative.cc:280). Gradients accumulate per the variable's
     grad_req ('write' overwrites, 'add' accumulates, 'null' skips) — the
     reference's OpReqType semantics (include/mxnet/op_attr_types.h:46-60).
+
+    With create_graph=True each node's backward is replayed as a recorded
+    op (_record_bwd), so the produced gradients carry their own tape and
+    can be differentiated again (reference higher-order autograd).
     """
     import jax.numpy as jnp
     from .ndarray import NDArray
@@ -164,11 +228,15 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     elif isinstance(head_grads, NDArray):
         head_grads = [head_grads]
 
-    # cotangent accumulator keyed by id(NDArray)
+    # cotangent accumulator keyed by id(NDArray); in create_graph mode the
+    # accumulated values are NDArrays (recorded adds), else raw jax arrays
     cot: dict[int, object] = {}
     keep = {}
     for h, hg in zip(heads, head_grads):
-        g = hg._data if hg is not None else jnp.ones(h.shape, h.dtype)
+        if create_graph:
+            g = hg if hg is not None else NDArray(jnp.ones(h.shape, h.dtype))
+        else:
+            g = hg._data if hg is not None else jnp.ones(h.shape, h.dtype)
         _accum(cot, keep, h, g)
 
     order = _collect_tape(heads)
@@ -176,34 +244,16 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         if not any(getattr(h, "_grad", None) is not None for h in heads):
             raise MXNetError("backward() called on arrays with no recorded graph")
 
-    for node in order:
-        cts = []
-        missing_all = True
-        for i, (ref, (shp, dt)) in enumerate(zip(node.out_refs,
-                                                 node.out_avals)):
-            refs = [ref]
-            if node.out_aliases:
-                refs += node.out_aliases.get(i, [])
-            c = None
-            for r in refs:
-                arr = r()
-                cc = cot.pop(id(arr), None) if arr is not None else None
-                if cc is not None:
-                    c = cc if c is None else _add_ct(c, cc)
-            if c is None:
-                c = jnp.zeros(shp, dt)
-            else:
-                missing_all = False
-            cts.append(c)
-        if missing_all or node.vjp_fn is None:
-            continue
-        in_cts = node.vjp_fn(tuple(cts) if len(cts) > 1 else cts[0])
-        for inp, ict in zip(node.inputs, in_cts):
-            if ict is not None:
-                _accum(cot, keep, inp, ict)
+    # create_graph must record the ENTIRE backward walk — including
+    # cotangent fan-in adds and grad_req='add' accumulation — regardless
+    # of whether the caller is inside a record() scope
+    scope = record() if create_graph else _RecordScope(None, None)
+    with scope:
+        _backward_walk(order, cot, keep, create_graph)
 
     # write into .grad buffers per grad_req
     from .ndarray.sparse import RowSparseNDArray, row_sparse_combine
+    from .ndarray import NDArray as _ND
     for arr_id, (arr, g) in keep.items():
         req = getattr(arr, "_grad_req", None)
         if req in (None, "null"):
@@ -225,11 +275,18 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             # dense cotangent into a row_sparse buffer (e.g. a hybridized
             # step after eager sparse steps): buffer stays row_sparse
             from .ndarray.sparse import cast_storage
-            from .ndarray import NDArray as _ND
-            dense_g = _ND(jnp.asarray(g))
+            dense_g = _ND(jnp.asarray(g._data if isinstance(g, _ND) else g))
             rs = cast_storage(dense_g, "row_sparse")
             arr._grad = rs if req != "add" else \
                 row_sparse_combine(arr._grad, rs)
+        elif isinstance(g, _ND):
+            # create_graph path: keep the recorded NDArray (with its tape)
+            # as the grad so it can be differentiated again
+            if req == "add":
+                with record():
+                    arr._grad = g + arr._grad
+            else:
+                arr._grad = g
         elif req == "add":
             arr._grad._data = arr._grad._data + g
         else:
@@ -240,6 +297,47 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             node.vjp_fn = None
         for h in heads:
             h._ag_node = None
+
+
+def _backward_walk(order, cot, keep, create_graph):
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+
+    for node in order:
+        cts = []
+        missing_all = True
+        for i, (ref, (shp, dt)) in enumerate(zip(node.out_refs,
+                                                 node.out_avals)):
+            refs = [ref]
+            if node.out_aliases:
+                refs += node.out_aliases.get(i, [])
+            c = None
+            for r in refs:
+                arr = r()
+                cc = cot.pop(id(arr), None) if arr is not None else None
+                if cc is not None:
+                    c = cc if c is None else _add_ct(c, cc)
+            if c is None:
+                z = jnp.zeros(shp, dt)
+                c = NDArray(z) if create_graph else z
+            else:
+                missing_all = False
+            cts.append(c)
+        if missing_all or node.vjp_fn is None:
+            continue
+        if create_graph and node.bwd_info is not None:
+            in_cts = _record_bwd(node, cts)
+        else:
+            raw = [c._data if isinstance(c, NDArray) else c for c in cts]
+            in_cts = node.vjp_fn(tuple(raw) if len(raw) > 1 else raw[0])
+            if create_graph:
+                # node lacks replay context (custom Function): gradients
+                # are correct but not differentiable further
+                in_cts = [NDArray(g) if g is not None else None
+                          for g in in_cts]
+        for inp, ict in zip(node.inputs, in_cts):
+            if ict is not None:
+                _accum(cot, keep, inp, ict)
 
 
 def _accum(cot, keep, arr, g):
@@ -286,7 +384,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         v._grad_req = "write"
     try:
         backward(heads, head_grads, retain_graph=bool(retain_graph) or create_graph,
-                 train_mode=train_mode)
+                 train_mode=train_mode, create_graph=create_graph)
         outs = [v.grad for v in variables]
     finally:
         for v, (g, req) in zip(variables, saved):

@@ -69,6 +69,9 @@ def _fetch_samples(indices):
     try:
         return [_as_numpy(_worker_dataset[i]) for i in indices]
     except AttributeError as e:
+        from . import dataset as _ds
+        if not _ds.IN_WORKER:
+            raise     # thread workers see NDArrays; not a host-mode issue
         raise RuntimeError(
             "dataset raised inside a process worker — note that workers "
             "run in host mode (samples/transforms see numpy arrays, not "

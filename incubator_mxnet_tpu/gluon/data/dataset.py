@@ -109,8 +109,10 @@ class ArrayDataset(Dataset):
         if cache is None and isinstance(self._data[col], _np.ndarray) \
                 and self._data[col].dtype != _np.object_:
             cache = self._nd_cache[col] = nd.array(self._data[col])
-        src = cache if cache is not None else self._data[col]
-        return src[idx]
+        if cache is not None:
+            return cache[idx]
+        # list / ragged columns: wrap each item on access
+        return _maybe_nd(self._data[col][idx])
 
     def __getitem__(self, idx):
         if len(self._data) == 1:

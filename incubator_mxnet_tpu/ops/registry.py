@@ -226,6 +226,7 @@ def apply_op(op: OpDef, *args, out=None, **params):
     else:
         fn = op.jitted(**params)
 
+    bwd_info = None
     if recording and traced:
         # inside an outer trace the vjp is part of that trace; no caching issue
         out_data, _raw_vjp = jax.vjp(fn, *arrs)
@@ -255,6 +256,7 @@ def apply_op(op: OpDef, *args, out=None, **params):
             bwd = op.vjp_jitted(**params)
             saved = list(arrs)
             vjp_fn = lambda cts, _b=bwd, _s=saved: _b(cts, *_s)
+            bwd_info = (op, dict(params), saved)
 
     multi = isinstance(out_data, (tuple, list))
     # Class-preserving wrap: an mxnet.numpy ndarray input propagates its
@@ -274,6 +276,11 @@ def apply_op(op: OpDef, *args, out=None, **params):
         node = autograd.Node(node_vjp, nd_inputs, op.name)
         node.out_refs = [weakref.ref(o) for o in outs]
         node.out_avals = [(o.shape, o.dtype) for o in outs]
+        # create_graph (higher-order) support: enough context to replay
+        # this node's backward as a RECORDED op (autograd._record_bwd)
+        if bwd_info is not None:
+            node.bwd_info = (bwd_info[0], bwd_info[1], bwd_info[2],
+                             list(ndarray_positions))
         for o in outs:
             o._ag_node = node
 

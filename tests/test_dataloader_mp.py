@@ -66,10 +66,12 @@ def test_mp_loader_early_break_no_shm_leak():
     import glob
     X, Y = _toy(96)
     dl = DataLoader(ArrayDataset(X, Y), batch_size=8, num_workers=2)
+    before = set(glob.glob("/dev/shm/psm_*"))
     it = iter(dl)
     next(it)
     it.close()          # abandon with prefetched batches pending
-    before = set(glob.glob("/dev/shm/psm_*"))
+    leaked = set(glob.glob("/dev/shm/psm_*")) - before
+    assert not leaked, leaked
     # a second full pass still works and cleans up after itself
     n = sum(x.shape[0] for x, y in dl)
     assert n == 96

@@ -1,0 +1,74 @@
+"""Higher-order gradients through autograd.grad(create_graph=True).
+
+Reference: tests/python/unittest/test_higher_order_grad.py (sin/cos/log
+second derivatives checked against closed forms).
+"""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+
+
+def _second_order(fn, d1, d2, xs):
+    x = nd.array(xs.astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = fn(x)
+        g1 = autograd.grad(y, x, create_graph=True, retain_graph=True)
+    assert np.allclose(g1.asnumpy(), d1(xs), atol=1e-4), fn
+    g1.backward()
+    assert np.allclose(x.grad.asnumpy(), d2(xs), atol=1e-4), fn
+
+
+def test_second_order_sin_cos():
+    xs = np.array([0.3, 1.1, -0.7])
+    _second_order(nd.sin, np.cos, lambda v: -np.sin(v), xs)
+    _second_order(nd.cos, lambda v: -np.sin(v), lambda v: -np.cos(v), xs)
+
+
+def test_second_order_log_exp():
+    xs = np.array([0.5, 1.5, 3.0])
+    _second_order(nd.log, lambda v: 1 / v, lambda v: -1 / v ** 2, xs)
+    _second_order(nd.exp, np.exp, np.exp, xs)
+
+
+def test_second_order_polynomial():
+    xs = np.array([1.0, 2.0, -1.5])
+    _second_order(lambda x: x * x * x,
+                  lambda v: 3 * v ** 2, lambda v: 6 * v, xs)
+
+
+def test_second_order_sigmoid():
+    xs = np.array([0.0, 0.8, -1.2])
+    s = 1 / (1 + np.exp(-xs))
+    _second_order(nd.sigmoid,
+                  lambda v: s * (1 - s),
+                  lambda v: s * (1 - s) * (1 - 2 * s), xs)
+
+
+def test_grad_of_grad_sum_mixed():
+    # d/dx [x * dy/dx] with y = x^2: dy/dx = 2x, x*2x = 2x^2, d/dx = 4x
+    x = nd.array(np.array([1.5, -2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        z = (x * gx).sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 4 * np.array([1.5, -2.0]),
+                       atol=1e-4)
+
+
+def test_create_graph_outside_record_scope():
+    # grad(create_graph=True) called AFTER the record block must still
+    # produce a differentiable gradient (fan-in adds are recorded too)
+    x = nd.array(np.array([0.4, 1.2], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) + nd.sin(x)
+    g1 = autograd.grad(y, x, create_graph=True, retain_graph=True)
+    assert np.allclose(g1.asnumpy(),
+                       np.exp([0.4, 1.2]) + np.cos([0.4, 1.2]), atol=1e-4)
+    g1.backward()
+    assert np.allclose(x.grad.asnumpy(),
+                       np.exp([0.4, 1.2]) - np.sin([0.4, 1.2]), atol=1e-4)

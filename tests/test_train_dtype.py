@@ -1,0 +1,76 @@
+"""Low-precision end-to-end training convergence.
+
+Reference: tests/python/train/test_dtype.py (fp16 cifar consistency) —
+here bf16 (the TPU-native half type) via net.cast and via AMP, asserting
+convergence matches fp32 on a learnable synthetic task.
+"""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+def _toy(n=256, dim=16, classes=4, seed=3):
+    rs = np.random.RandomState(seed)
+    X = rs.normal(0, 1, (n, dim)).astype(np.float32)
+    W = rs.normal(0, 1, (dim, classes)).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+    return X, Y
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(4))
+    return net
+
+
+def _train(net, X, Y, dtype, epochs=30, lr=0.5):
+    net.initialize(mx.init.Xavier())
+    if dtype != "float32":
+        net.cast(dtype)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    xb = mx.nd.array(X).astype(dtype)
+    yb = mx.nd.array(Y)
+    for _ in range(epochs):
+        with autograd.record():
+            loss = loss_fn(net(xb), yb).mean()
+        loss.backward()
+        trainer.step(1)     # loss is already a mean
+    out = net(xb).asnumpy()
+    return (out.argmax(1) == Y).mean()
+
+
+def test_bf16_training_converges_like_fp32():
+    X, Y = _toy()
+    acc32 = _train(_mlp(), X, Y, "float32")
+    acc16 = _train(_mlp(), X, Y, "bfloat16")
+    assert acc32 > 0.95
+    assert acc16 > 0.9          # bf16 rounding tolerated, must still learn
+
+
+def test_amp_training_converges():
+    from incubator_mxnet_tpu.contrib import amp
+    X, Y = _toy(seed=5)
+    net = _mlp()
+    net.initialize(mx.init.Xavier())
+    amp.init()
+    try:
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.5})
+        amp.init_trainer(trainer)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        xb, yb = mx.nd.array(X), mx.nd.array(Y)
+        for _ in range(30):
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+                with amp.scale_loss(loss, trainer) as scaled:
+                    scaled.backward()
+            trainer.step(1)
+        acc = (net(xb).asnumpy().argmax(1) == Y).mean()
+        assert acc > 0.9
+    finally:
+        amp.amp._off()     # don't leak the AMP hook into other tests
