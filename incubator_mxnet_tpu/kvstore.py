@@ -79,19 +79,34 @@ def _axis0_mean_fn(mesh):
                    out_shardings=NamedSharding(mesh, P()))
 
 
+@functools.lru_cache(maxsize=4)
+def _axis0_packed_mean_fn(mesh, threshold):
+    """Quantized-wire variant of _axis0_mean_fn: each device 2-bit-packs
+    its block and the collective moves 1/16 of the float bytes
+    (parallel/compression.py quantized_psum; reference: the compressed PS
+    wire, kvstore_dist_server.h DataHandleCompressed). Values arriving
+    here are ALREADY quantized to {0, +/-threshold} by the push-side
+    error-feedback pass, so the re-quantization is lossless."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from .parallel._compat import shard_map
+    from .parallel.compression import quantized_psum
+
+    def inner(a, d):
+        x = a[0]
+        s, _ = quantized_psum(x, "_kvall", threshold, jnp.zeros_like(x))
+        return s / d[0]
+
+    return jax.jit(shard_map(inner, mesh,
+                             in_specs=(P("_kvall"), P()), out_specs=P()))
+
+
 @functools.lru_cache(maxsize=1)
 def _two_bit_fn():
     import jax
-    import jax.numpy as jnp
-
-    def _q(g, residual, threshold):
-        c = g + residual
-        q = jnp.where(c >= threshold, threshold,
-                      jnp.where(c <= -threshold, -threshold, 0.0)
-                      ).astype(g.dtype)
-        return q, c - q
-
-    return jax.jit(_q)
+    from .parallel.compression import quantize
+    return jax.jit(quantize)
 
 
 class KVStore:
@@ -159,7 +174,8 @@ class KVStore:
         from jax.sharding import NamedSharding, PartitionSpec as P
         return jax.device_put(arr, NamedSharding(self._mesh, P()))
 
-    def _cross_process_mean(self, arr, scale_to_sum=False):
+    def _cross_process_mean(self, arr, scale_to_sum=False,
+                            packed_wire=False):
         """All-reduce `arr` across processes; returns a fully-replicated
         global array every process can address.
 
@@ -183,7 +199,12 @@ class KVStore:
             NamedSharding(mesh, P("_kvall")), local,
             (n_total,) + host.shape)
         denom = float(n_local if scale_to_sum else n_total)
-        out = _axis0_mean_fn(mesh)(g, denom)
+        if packed_wire and self._compression is not None:
+            thr = float(self._compression.get("threshold", 0.5))
+            out = _axis0_packed_mean_fn(mesh, thr)(
+                g, jax.numpy.asarray([denom], g.dtype))
+        else:
+            out = _axis0_mean_fn(mesh)(g, denom)
         # hand back a process-LOCAL copy so callers can run eager ops on it
         return jax.numpy.asarray(jax.device_get(out))
 
@@ -251,11 +272,18 @@ class KVStore:
             merged = self._merge(k, v)
             import jax
             if self._mesh is not None and jax.process_count() > 1:
+                self._heartbeat()
                 # dist_sync aggregation: SUM over workers (reference
                 # kvstore_dist_server.h ApplyUpdates waits for all pushes).
                 # The ONE collective of the push; result is process-local,
                 # so the updater/astype below are plain eager ops.
-                merged = self._cross_process_mean(merged, scale_to_sum=True)
+                # 2-bit wire only when the pushed value was a single grad:
+                # a locally-summed list holds multiples of the threshold,
+                # which re-quantization at +/-threshold would clip
+                single = not isinstance(v, (list, tuple)) or len(v) == 1
+                merged = self._cross_process_mean(
+                    merged, scale_to_sum=True,
+                    packed_wire=single and self._compression is not None)
             stored = self._store[k]
             if self._updater is not None:
                 self._updater(self._updater_key(k), NDArray(merged), stored)
@@ -326,12 +354,87 @@ class KVStore:
         import jax
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
+            self._heartbeat()
             KVStore._barrier_seq += 1
             multihost_utils.sync_global_devices(
                 f"kvstore_barrier_{KVStore._barrier_seq}")
         else:
             for v in self._store.values():
                 v._data.block_until_ready()
+
+    # -- liveness (reference ps-lite heartbeats, kvstore_dist.h:121) -------
+    @staticmethod
+    def _dist_client():
+        try:
+            from jax._src import distributed
+            return distributed.global_state.client
+        except Exception:
+            return None
+
+    _hb_seq = 0
+
+    def _heartbeat(self):
+        """Bump this worker's liveness GENERATION in the coordination
+        service. Called from barrier() and every dist push (the natural
+        cadences); cheap no-op when single-process. The value is a
+        sequence number, not a timestamp — staleness is judged by the
+        OBSERVER's monotonic clock watching for generation changes, so
+        cross-host wall-clock skew cannot corrupt liveness."""
+        if self.num_workers <= 1:
+            return
+        c = self._dist_client()
+        if c is None:
+            return
+        KVStore._hb_seq += 1
+        key = f"mxtpu_hb/{self.rank}"
+        val = str(KVStore._hb_seq)
+        try:
+            c.key_value_set(key, val, allow_overwrite=True)
+        except TypeError:
+            # older client: insert-only set; delete first so every
+            # heartbeat lands, not just the first
+            try:
+                c.key_value_delete(key)
+            except Exception:
+                pass
+            try:
+                c.key_value_set(key, val)
+            except Exception:
+                pass
+        except Exception:
+            pass
+
+    def get_dead_nodes(self, timeout=60):
+        """Ranks whose heartbeat generation has not CHANGED for `timeout`
+        seconds of this process's monotonic clock (or that never checked
+        in). Reference: ps-lite node timeouts surfaced as
+        kv.get_dead_nodes (src/kvstore/kvstore_dist.h:121). Note the
+        cadence contract: workers heartbeat at pushes and barriers, so
+        `timeout` must exceed the longest push-free phase (checkpointing,
+        eval) or live workers will be misreported."""
+        if self.num_workers <= 1:
+            return []
+        c = self._dist_client()
+        if c is None:
+            return []
+        import time
+        self._heartbeat()
+        now = time.monotonic()
+        if not hasattr(self, "_hb_seen"):
+            self._hb_seen = {}
+        dead = []
+        for r in range(self.num_workers):
+            try:
+                v = c.blocking_key_value_get(f"mxtpu_hb/{r}", 2000)
+            except Exception:
+                dead.append(r)      # never heartbeated within the wait
+                continue
+            prev = self._hb_seen.get(r)
+            if prev is None or prev[0] != v:
+                self._hb_seen[r] = (v, now)
+            if now - self._hb_seen[r][1] > float(timeout):
+                dead.append(r)
+        return dead
 
     # -- optimizer-on-store ------------------------------------------------
     def set_optimizer(self, optimizer):

@@ -22,10 +22,15 @@ from .pipeline import pipeline_apply, pipeline_sharded
 from .moe import moe_apply, moe_sharded, init_moe_params
 from .tensor_parallel import (column_parallel_spec, row_parallel_spec,
                               transformer_param_specs)
+from .compression import (quantized_allreduce, quantized_psum,
+                          quantize_pack, quantize_pack_pallas,
+                          two_bit_pack, two_bit_unpack)
 
 __all__ = ["make_mesh", "local_mesh_axis_sizes", "functionalize", "TrainStep",
            "shard_batch", "ring_attention", "ring_attention_sharded",
            "flash_attention", "pipeline_apply", "pipeline_sharded",
            "moe_apply", "moe_sharded", "init_moe_params",
            "column_parallel_spec", "row_parallel_spec",
-           "transformer_param_specs"]
+           "transformer_param_specs", "quantized_allreduce",
+           "quantized_psum", "quantize_pack", "quantize_pack_pallas",
+           "two_bit_pack", "two_bit_unpack"]

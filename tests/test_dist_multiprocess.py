@@ -62,8 +62,24 @@ WORKER = textwrap.dedent("""
     # grad sum = nproc -> w = 1 - 0.1 * nproc
     np.testing.assert_allclose(out2.asnumpy(), 1.0 - 0.1 * nproc, rtol=1e-5)
 
-    # 5) barrier is a real cross-process rendezvous
+    # 5) 2-bit compressed push: the cross-process wire moves PACKED
+    # uint32 (parallel/compression.py); each worker quantizes with
+    # threshold 0.5 and error feedback, sum over workers
+    kv3 = mx.kv.create("dist_sync")
+    kv3.set_gradient_compression({{"type": "2bit", "threshold": 0.5}})
+    kv3.init("c", mx.nd.zeros((4,)))
+    kv3.push("c", mx.nd.array(np.array([1.0, -2.0, 0.1, 0.0], np.float32)))
+    outc = mx.nd.zeros((4,))
+    kv3.pull("c", out=outc)
+    np.testing.assert_allclose(outc.asnumpy(),
+                               nproc * np.array([0.5, -0.5, 0.0, 0.0]),
+                               atol=1e-6)
+
+    # 6) barrier is a real cross-process rendezvous
     kv.barrier()
+
+    # 7) liveness: both workers just heartbeated at the barrier
+    assert kv.get_dead_nodes(timeout=120) == [], "false dead nodes"
     # ONE write: print("WORKER_OK", pid) issues separate writes per arg,
     # which interleave with gloo's own stdout chatter and split the token
     sys.stdout.write("WORKER_OK_%d\\n" % pid)
