@@ -62,7 +62,7 @@ def _tup(v, n, default):
     return tuple(int(x) for x in v)
 
 
-@register(name="Convolution", aliases=("convolution",))
+@register(name="Convolution", aliases=("convolution", "Convolution_v1"))
 def convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(), pad=(),
                 num_filter=0, num_group=1, workspace=1024, no_bias=False,
                 cudnn_tune=None, cudnn_off=False, layout=None):
@@ -117,7 +117,7 @@ def deconvolution(data, weight, bias=None, *, kernel, stride=(), dilate=(), pad=
 # Pooling (reference src/operator/nn/pooling.cc, pool.h/pool.cuh)
 # --------------------------------------------------------------------------
 
-@register(name="Pooling", aliases=("pooling",))
+@register(name="Pooling", aliases=("pooling", "Pooling_v1"))
 def pooling(data, *, kernel=(), pool_type="max", global_pool=False, stride=(),
             pad=(), pooling_convention="valid", count_include_pad=True,
             cudnn_off=False, p_value=2, layout=None):
@@ -174,7 +174,7 @@ def pooling(data, *, kernel=(), pool_type="max", global_pool=False, stride=(),
 # group_norm.cc, instance_norm.cc, lrn.cc)
 # --------------------------------------------------------------------------
 
-@register(name="BatchNorm", aliases=("batch_norm",), train_aware=True)
+@register(name="BatchNorm", aliases=("batch_norm", "BatchNorm_v1"), train_aware=True)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
                output_mean_var=False, axis=1, cudnn_off=False, training=False):

@@ -142,6 +142,7 @@ _unary("erf", lambda x: jax.scipy.special.erf(x))
 _unary("erfinv", lambda x: jax.scipy.special.erfinv(x))
 _unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
 _unary("gammaln", lambda x: jax.scipy.special.gammaln(x))
+_unary("digamma", lambda x: jax.scipy.special.digamma(x))
 _unary("relu", lambda x: jnp.maximum(x, 0))
 _unary("sigmoid", jax.nn.sigmoid)
 _unary("softsign", lambda x: x / (1 + jnp.abs(x)))
@@ -564,7 +565,7 @@ def stack(*data, axis=0, num_args=None):
     return jnp.stack(data, axis=axis)
 
 
-@register(name="split", aliases=("SliceChannel",))
+@register(name="split", aliases=("SliceChannel", "slice_channel"))
 def split(data, *, num_outputs, axis=1, squeeze_axis=False):
     """Reference src/operator/slice_channel.cc."""
     outs = jnp.split(data, num_outputs, axis=axis)
@@ -842,3 +843,64 @@ def smooth_l1(data, *, scalar=1.0):
     s2 = scalar * scalar
     absd = jnp.abs(data)
     return jnp.where(absd < 1.0 / s2, 0.5 * s2 * jnp.square(data), absd - 0.5 / s2)
+
+
+@register(name="cumsum", aliases=("_np_cumsum",))
+def cumsum(a, *, axis=None, dtype=None):
+    """Reference src/operator/numpy/np_cumsum.cc."""
+    return jnp.cumsum(a, axis=axis,
+                      dtype=dtype_np(dtype) if dtype else None)
+
+
+@register(name="Crop")
+def crop_op(*data, num_args=None, offset=(0, 0), h_w=(0, 0),
+            center_crop=False):
+    """Legacy v0 Crop (reference src/operator/crop.cc): crop data (N,C,H,W)
+    to h_w (or to the second input's spatial size), at `offset` or
+    centered. NOTE: lowercase `crop` stays the slice alias, as in the
+    reference; num_args defaults to the number of inputs (the C API
+    infers it)."""
+    x = data[0]
+    if num_args is None:
+        num_args = len(data)
+    if num_args == 2 and len(data) > 1:
+        th, tw = data[1].shape[2], data[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = x.shape[2], x.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    return x[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register(name="IdentityAttachKLSparseReg",
+          aliases=("identity_attach_kl_sparse_reg",))
+def identity_attach_kl_sparse_reg(data, *, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """Identity forward; backward ADDS the KL-sparsity penalty gradient
+    on mean activations (reference
+    src/operator/identity_attach_KL_sparse_reg.cc — sparse-autoencoder
+    regularizer). The running-average momentum state of the reference is
+    folded into the per-batch mean (stateless functional form)."""
+    rho = float(sparseness_target)
+    pen = float(penalty)
+
+    @jax.custom_vjp
+    def _kl(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(x, g):
+        rho_hat = jnp.clip(jnp.mean(x, axis=0, keepdims=True), 1e-6,
+                           1 - 1e-6)
+        # NO 1/N factor: the reference adds the raw penalty per element
+        # (identity_attach_KL_sparse_reg-inl.h Backward)
+        kl_grad = pen * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return (g + kl_grad.astype(g.dtype),)
+
+    _kl.defvjp(fwd, bwd)
+    return _kl(data)

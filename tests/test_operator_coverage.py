@@ -231,3 +231,48 @@ def test_sample_unique_zipfian():
     assert o.min() >= 0 and o.max() < 100
     # zipfian: small ids much more frequent
     assert (o < 10).sum() > (o >= 90).sum()
+
+
+def test_legacy_crop_and_v1_aliases():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = nd.Crop(x, h_w=(2, 2), offset=(1, 1)).asnumpy()
+    assert np.allclose(out.ravel(), [5, 6, 9, 10])
+    assert nd.Crop(x, h_w=(2, 2), center_crop=True).shape == (1, 1, 2, 2)
+    # crop_like: second input supplies the spatial size
+    like = nd.array(np.zeros((1, 1, 2, 2), np.float32))
+    assert nd.Crop(x, like, num_args=2).shape == (1, 1, 2, 2)
+    # num_args inferred from inputs, like the reference C API
+    assert nd.Crop(x, like).shape == (1, 1, 2, 2)
+    # lowercase crop remains the slice alias
+    sl = nd.crop(x, begin=(0, 0, 0, 0), end=(1, 1, 2, 2))
+    assert sl.shape == (1, 1, 2, 2)
+    # v1 compat aliases resolve to the modern kernels
+    w = nd.array(np.random.randn(2, 1, 3, 3).astype(np.float32))
+    o = nd.Convolution_v1(x, w, kernel=(3, 3), num_filter=2, no_bias=True)
+    assert o.shape == (1, 2, 2, 2)
+    assert nd.Pooling_v1(x, kernel=(2, 2), pool_type="max",
+                         stride=(2, 2)).shape == (1, 1, 2, 2)
+
+
+def test_digamma_cumsum():
+    assert abs(float(nd.digamma(nd.array(np.array([1.0]))).asnumpy()[0])
+               + 0.5772157) < 1e-4
+    c = nd.cumsum(nd.array(np.array([[1., 2.], [3., 4.]])), axis=1)
+    assert np.allclose(c.asnumpy(), [[1, 3], [3, 7]])
+    flat = nd.cumsum(nd.array(np.array([[1., 2.], [3., 4.]])))
+    assert np.allclose(flat.asnumpy(), [1, 3, 6, 10])
+
+
+def test_identity_attach_kl_sparse_reg():
+    rng = np.random.RandomState(0)
+    a = nd.array(rng.uniform(0.05, 0.95, (8, 3)).astype(np.float32))
+    a.attach_grad()
+    with autograd.record():
+        y = nd.IdentityAttachKLSparseReg(a, sparseness_target=0.2,
+                                         penalty=0.01)
+    assert np.allclose(y.asnumpy(), a.asnumpy())
+    y.backward()
+    rho_hat = a.asnumpy().mean(0, keepdims=True)
+    # reference adds the raw penalty per element (no 1/N)
+    expect = 1.0 + 0.01 * (-0.2 / rho_hat + 0.8 / (1 - rho_hat))
+    assert np.allclose(a.grad.asnumpy(), expect, atol=1e-5)
