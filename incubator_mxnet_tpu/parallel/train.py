@@ -144,6 +144,7 @@ class TrainStep:
         self._wd = float(opt_kwargs.pop("wd", 0.0))
         self._opt_name = optimizer
 
+        self._dtype = dtype
         params, apply_fn = functionalize(net, example_inputs, training=True)
         if dtype is not None:
             params = OrderedDict((k, v.astype(dtype) if
@@ -217,6 +218,11 @@ class TrainStep:
         arrs = []
         for b in batch:
             a = b._data if isinstance(b, NDArray) else jax.numpy.asarray(b)
+            # with a compute dtype set, float inputs follow it (params were
+            # cast in __init__; mixed conv dtypes are an XLA error)
+            if self._dtype is not None and \
+                    jnp.issubdtype(a.dtype, jnp.floating):
+                a = a.astype(self._dtype)
             if self._data_sharding is not None:
                 a = jax.device_put(a, self._data_sharding)
             arrs.append(a)
