@@ -207,8 +207,9 @@ class DataLoader:
                     "`if __name__ == '__main__'` guard?); using threads")
                 self._uses_threads = True
         from multiprocessing.pool import ThreadPool
-        global _worker_dataset
-        _worker_dataset = self._dataset
+        # thread workers share the address space: fetch directly from THIS
+        # loader's dataset (a module global would be clobbered by a second
+        # concurrently-iterated thread-pool loader)
         self._pool = ThreadPool(self._num_workers)
 
     def __iter__(self):
@@ -223,7 +224,11 @@ class DataLoader:
         import collections
         use_shm = (self._batchify_fn is default_batchify_fn
                    and not self._uses_threads)
-        fn = _worker_fn_shm if use_shm else _worker_fn
+        if self._uses_threads:
+            dataset = self._dataset
+            fn = lambda idx: [_as_numpy(dataset[i]) for i in idx]  # noqa: E731
+        else:
+            fn = _worker_fn_shm if use_shm else _worker_fn
         pending = collections.deque()
         it = iter(self._batch_sampler)
         exhausted = False

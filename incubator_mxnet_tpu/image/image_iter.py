@@ -95,11 +95,13 @@ class ImageRecordIter(DataIter):
                                              center_crop=True,
                                              nthreads=self._threads)
         if out is None:
-            # corrupt record or non-JPEG payload: PIL path per item
-            from .image import imdecode, imresize
+            # corrupt record or non-JPEG payload: PIL path per item — use the
+            # same center-crop-then-resize framing as the native decoder so
+            # decoder availability never changes the pixel statistics
+            from .image import imdecode, center_crop
             arrs = []
             for i, b in enumerate(bufs):
-                img = imresize(imdecode(b), W, H).asnumpy()
+                img = center_crop(imdecode(b), (W, H))[0].asnumpy()
                 if mirrors is not None and mirrors[i]:
                     img = img[:, ::-1]
                 arrs.append(img)

@@ -88,19 +88,29 @@ _TILE = 2048
 
 
 def _qp_kernel(g_ref, r_ref, thr_ref, packed_ref, newr_ref):
+    # blocks are (rows, 16, 128): plane k holds code bit-pair k of each of
+    # the row's 128 packed words. Packing is a static 16-step loop over
+    # full-lane (rows, 128) slices — no reshape, no minor-dim reduction,
+    # no unsigned arithmetic, all of which Mosaic refuses to lower.
     g = g_ref[...]
     r = r_ref[...]
-    t = thr_ref[0]
+    t = thr_ref[0, 0]
     _, newr_ref[...] = quantize(g, r, t)
     c = g + r
-    codes = _codes(c, t).reshape(c.shape[0], -1, _GROUP)
-    shifts = (jnp.arange(_GROUP, dtype=jnp.uint32) * 2)[None, None, :]
-    packed_ref[...] = jnp.sum(codes << shifts, axis=-1, dtype=jnp.uint32)
+    acc = jnp.zeros(c.shape[:1] + c.shape[2:], jnp.int32)
+    for k in range(_GROUP):
+        ck = c[:, k, :]
+        code = jnp.where(ck >= t, 1, jnp.where(ck <= -t, 2, 0))
+        acc = acc | (code << (2 * k))
+    packed_ref[...] = acc.astype(jnp.uint32)
 
 
 def quantize_pack_pallas(g, residual, threshold, block_rows=8):
-    """Pallas version of quantize_pack (interpret mode off-TPU). Pads the
-    flat input to a (rows, 2048) layout internally."""
+    """Pallas version of quantize_pack (interpret mode off-TPU); the packed
+    wire bytes are identical to two_bit_pack's. Internally the flat input is
+    padded to (rows, 2048) tiles and pre-transposed (by XLA, outside the
+    kernel) to (rows, 16, 128) so that element [i, k, l] is flat
+    [i*2048 + l*16 + k] — the kernel then packs lane-wise."""
     from jax.experimental import pallas as pl
 
     shape = g.shape
@@ -112,27 +122,34 @@ def quantize_pack_pallas(g, residual, threshold, block_rows=8):
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
         res = jnp.concatenate([res, jnp.zeros((pad,), res.dtype)])
     rows = flat.shape[0] // _TILE
-    gr = flat.reshape(rows, _TILE)
-    rr = res.reshape(rows, _TILE)
+    lanes = _TILE // _GROUP
+    gr = flat.reshape(rows, lanes, _GROUP).swapaxes(1, 2)
+    rr = res.reshape(rows, lanes, _GROUP).swapaxes(1, 2)
     grid = (max(1, (rows + block_rows - 1) // block_rows),)
     br = min(block_rows, rows)
-    thr = jnp.asarray([threshold], gr.dtype)
+    thr = jnp.asarray([[threshold]], gr.dtype)
     interpret = jax.default_backend() != "tpu"
+    if interpret:
+        thr_spec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    else:
+        # scalar operands must live in SMEM on TPU — Mosaic cannot lower a
+        # direct load from an ANY-space ref
+        from jax.experimental.pallas import tpu as pltpu
+        thr_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     packed, newr = pl.pallas_call(
         _qp_kernel,
         grid=grid,
-        in_specs=[pl.BlockSpec((br, _TILE), lambda i: (i, 0)),
-                  pl.BlockSpec((br, _TILE), lambda i: (i, 0)),
-                  pl.BlockSpec(memory_space=pl.ANY)
-                  if not interpret else pl.BlockSpec((1,), lambda i: (0,))],
-        out_specs=[pl.BlockSpec((br, _TILE // _GROUP), lambda i: (i, 0)),
-                   pl.BlockSpec((br, _TILE), lambda i: (i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((rows, _TILE // _GROUP), jnp.uint32),
-                   jax.ShapeDtypeStruct((rows, _TILE), gr.dtype)],
+        in_specs=[pl.BlockSpec((br, _GROUP, lanes), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((br, _GROUP, lanes), lambda i: (i, 0, 0)),
+                  thr_spec],
+        out_specs=[pl.BlockSpec((br, lanes), lambda i: (i, 0)),
+                   pl.BlockSpec((br, _GROUP, lanes), lambda i: (i, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, lanes), jnp.uint32),
+                   jax.ShapeDtypeStruct((rows, _GROUP, lanes), gr.dtype)],
         interpret=interpret,
     )(gr, rr, thr)
-    return (packed.reshape(-1)[: (n + _GROUP - 1) // _GROUP],
-            newr.reshape(-1)[:n].reshape(shape))
+    newr = newr.swapaxes(1, 2).reshape(-1)[:n].reshape(shape)
+    return packed.reshape(-1)[: (n + _GROUP - 1) // _GROUP], newr
 
 
 # ---------------------------------------------------------------------------

@@ -216,11 +216,15 @@ class TrainStep:
         import jax
         from ..ndarray.ndarray import NDArray
         arrs = []
-        for b in batch:
+        for i, b in enumerate(batch):
             a = b._data if isinstance(b, NDArray) else jax.numpy.asarray(b)
-            # with a compute dtype set, float inputs follow it (params were
-            # cast in __init__; mixed conv dtypes are an XLA error)
-            if self._dtype is not None and \
+            # with a compute dtype set, float NETWORK inputs follow it
+            # (params were cast in __init__; mixed conv dtypes are an XLA
+            # error). The label (last position, consumed only by loss_fn) is
+            # never cast: float-encoded class indices above 256 are not
+            # representable in bfloat16, so casting would silently corrupt
+            # the training targets.
+            if self._dtype is not None and i < len(batch) - 1 and \
                     jnp.issubdtype(a.dtype, jnp.floating):
                 a = a.astype(self._dtype)
             if self._data_sharding is not None:
