@@ -176,13 +176,14 @@ class DataLoader:
 
     def _start_pool(self):
         self._uses_threads = bool(self._thread_pool)
-        try:
-            payload = pickle.dumps(self._dataset)
-        except Exception:
-            # unpicklable dataset: degrade to single-process
-            self._num_workers = 0
-            return
         if not self._thread_pool:
+            try:
+                payload = pickle.dumps(self._dataset)
+            except Exception:
+                # unpicklable dataset: degrade to single-process (thread
+                # workers never pickle — they share the address space)
+                self._num_workers = 0
+                return
             # spawn, not fork: the parent's XLA runtime is multithreaded
             # and fork'd children segfault/deadlock in it. Spawned workers
             # import fresh and never initialize a device backend — they
@@ -286,8 +287,11 @@ class DataLoader:
         return len(self._batch_sampler)
 
     def __del__(self):
-        if self._pool is not None:
-            self._pool.terminate()
+        if getattr(self, "_pool", None) is not None:
+            try:
+                self._pool.terminate()
+            except Exception:
+                pass  # interpreter shutdown: pool internals already torn down
 
 
 def _renumpy(s):
