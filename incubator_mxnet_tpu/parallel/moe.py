@@ -22,7 +22,8 @@ from jax import lax
 
 from ._compat import shard_map
 
-__all__ = ["moe_gate", "moe_apply", "moe_sharded", "init_moe_params"]
+__all__ = ["moe_gate", "moe_apply", "moe_apply_a2a", "moe_sharded",
+           "init_moe_params"]
 
 
 def moe_gate(x, wg, k=1, capacity_factor=1.25):
@@ -101,6 +102,65 @@ def moe_apply(x, params, axis_name=None, k=1, capacity_factor=1.25,
         h = activation(jnp.einsum("ecd,edf->ecf",
                                   expert_in.reshape(e_local, C, d), w1))
         out = jnp.einsum("ecf,efd->ecd", h, w2).reshape(E, C, d)
+    y = jnp.einsum("nec,ecd->nd", combine.astype(out.dtype), out)
+    return y, aux
+
+
+def moe_apply_a2a(x, params, axis_name, k=1, capacity_factor=1.25,
+                  activation=jax.nn.gelu):
+    """GShard-style token-sharded MoE — the all-to-all dispatch variant.
+
+    Run INSIDE shard_map with BOTH tokens and experts sharded over
+    `axis_name` (in a composed mesh this is the `ep` axis, or the `dp`
+    axis when experts ride the data-parallel groups, the GShard layout).
+
+    x: (N_local, d) — THIS shard's tokens. params as in moe_apply with
+    w1/w2 holding the local e_local = E/ep expert slices.
+
+    Wire pattern (all shapes static):
+      1. local top-k gating against the full E-expert router (wg is
+         replicated) with per-shard capacity C,
+      2. build per-(expert, slot) queues from local tokens:
+         (E, C, d) = dispatch^T @ x,
+      3. `all_to_all` over the EXPERT dim: each shard keeps its e_local
+         experts' queues from every peer -> (ep * C) slots per local
+         expert,
+      4. run the local expert FFNs,
+      5. `all_to_all` back (transpose of 3), combine locally.
+
+    The backward schedule is the transpose: autodiff turns each
+    all_to_all into the reverse all_to_all, so expert-weight grads stay
+    shard-local and token grads return to their home shard — no psum over
+    `axis_name` is needed for expert weights (and none must be applied:
+    they are sharded, not replicated, over this axis).
+
+    Returns (y (N_local, d), aux_loss). Numerics match moe_apply run
+    independently on each shard's tokens with the full expert set.
+    """
+    wg, w1, w2 = params["wg"], params["w1"], params["w2"]
+    N, d = x.shape
+    ep = lax.psum(1, axis_name)
+    e_local = w1.shape[0]
+    E = e_local * ep
+
+    dispatch, combine, aux = moe_gate(x, wg, k=k,
+                                      capacity_factor=capacity_factor)
+    C = dispatch.shape[-1]
+    # 2. per-expert queues of MY tokens: (E, C, d)
+    queues = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    # 3. exchange: split the E dim across shards, concat peers' blocks.
+    # After this, shard r holds (ep, e_local, C, d): peer p's queue for
+    # my experts [r*e_local, (r+1)*e_local).
+    queues = queues.reshape(ep, e_local, C, d)
+    queues = lax.all_to_all(queues, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)
+    # 4. local expert FFN over every peer's slots at once
+    h = activation(jnp.einsum("pecd,edf->pecf", queues, w1))
+    out = jnp.einsum("pecf,efd->pecd", h, w2)          # (ep, e_local, C, d)
+    # 5. route results back to the token-home shards (transpose of 3)
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)
+    out = out.reshape(E, C, d)
     y = jnp.einsum("nec,ecd->nd", combine.astype(out.dtype), out)
     return y, aux
 

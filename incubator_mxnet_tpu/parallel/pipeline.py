@@ -20,7 +20,7 @@ from jax import lax
 
 from ._compat import shard_map
 
-__all__ = ["pipeline_apply", "pipeline_sharded"]
+__all__ = ["pipeline_apply", "pipeline_train_apply", "pipeline_sharded"]
 
 
 def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
@@ -38,6 +38,27 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
     stages (and the collected outputs) has one static shape. Put any
     projection to a different width inside a stage, not between stages.
     """
+    outs, _ = pipeline_train_apply(
+        lambda p, h: (stage_fn(p, h), jnp.float32(0)),
+        stage_params, x, axis_name, n_microbatches)
+    return outs
+
+
+def pipeline_train_apply(stage_fn, stage_params, x, axis_name,
+                         n_microbatches):
+    """pipeline_apply for TRAINING stages: stage_fn(params, h) returns
+    (h_out, aux) where aux is a scalar auxiliary loss (e.g. MoE load
+    balancing). Differentiating through this function yields the pipeline
+    BACKWARD schedule automatically: the transpose of the forward scan
+    runs the stages in reverse with the ppermute ring inverted, microbatch
+    by microbatch, accumulating each stage's weight gradient across
+    microbatches in the scan-carry cotangent — the GPipe backward.
+
+    aux is only meaningful for steps where a stage holds a real microbatch
+    (during fill/drain, stages chew zeros); those contributions are masked
+    out. Returns (outputs (B, ...), aux_mean) with aux_mean the mean over
+    the S * M real (stage, microbatch) visits.
+    """
     S = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     B = x.shape[0]
@@ -49,7 +70,7 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
     total = n_microbatches + S - 1     # fill + steady + drain
     out0 = jnp.zeros_like(micro)
     carry0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
-    aval = jax.eval_shape(stage_fn, stage_params, carry0)
+    aval = jax.eval_shape(stage_fn, stage_params, carry0)[0]
     if aval.shape != carry0.shape or aval.dtype != carry0.dtype:
         raise ValueError(
             f"pipeline stage must preserve activation shape/dtype: got "
@@ -57,14 +78,14 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
             "move width changes inside a stage")
 
     def step(carry, t):
-        h_prev, outs = carry
-        # stage 0 injects microbatch t (when still in range); other
-        # stages consume what arrived from the left neighbor
+        h_prev, outs, aux_acc = carry
         mb_idx = jnp.clip(t, 0, n_microbatches - 1)
         inject = lax.dynamic_index_in_dim(micro, mb_idx, 0, keepdims=False)
         h_in = jnp.where(rank == 0, inject, h_prev)
-        h_out = stage_fn(stage_params, h_in)
-        # the microbatch leaving the LAST stage at step t is micro t-(S-1)
+        h_out, aux = stage_fn(stage_params, h_in)
+        # my microbatch at step t is t - rank; mask fill/drain visits
+        valid = jnp.logical_and(t - rank >= 0, t - rank < n_microbatches)
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         out_idx = jnp.clip(t - (S - 1), 0, n_microbatches - 1)
         take = jnp.logical_and(rank == S - 1, t >= S - 1)
         outs = lax.cond(
@@ -72,17 +93,16 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
             lambda o: lax.dynamic_update_index_in_dim(
                 o, h_out.astype(o.dtype), out_idx, 0),
             lambda o: o, outs)
-        # hand h_out to the right neighbor (ring; stage0's stale input is
-        # overwritten by the next inject)
         h_next = lax.ppermute(
             h_out, axis_name, [(i, (i + 1) % S) for i in range(S)])
-        return (h_next, outs), None
+        return (h_next, outs, aux_acc), None
 
-    (_, outs), _ = lax.scan(step, (carry0, out0), jnp.arange(total))
-    # broadcast the last stage's collected outputs to every pp rank
+    (_, outs, aux_acc), _ = lax.scan(
+        step, (carry0, out0, jnp.float32(0)), jnp.arange(total))
     outs = lax.psum(jnp.where(rank == S - 1, outs, jnp.zeros_like(outs)),
                     axis_name)
-    return outs.reshape((B,) + outs.shape[2:])
+    aux_mean = lax.psum(aux_acc, axis_name) / (S * n_microbatches)
+    return outs.reshape((B,) + outs.shape[2:]), aux_mean
 
 
 def pipeline_sharded(stage_fn, params_stacked, x, mesh, axis="pp",
