@@ -102,6 +102,30 @@ def _axis0_packed_mean_fn(mesh, threshold):
                              in_specs=(P("_kvall"), P()), out_specs=P()))
 
 
+@functools.lru_cache(maxsize=4)
+def _axis0_sharded_mean_fn(mesh):
+    """Big-array wire: ownership-sharded reduction. Each axis member
+    reduce-scatters so it owns 1/n of the summed vector, then the shards
+    are all-gathered back — no single hop ever carries the whole tensor,
+    the TPU-native analog of the reference sharding big arrays across
+    servers at `bigarray_bound` (src/kvstore/kvstore_dist.h:58
+    EncodeDefaultKey's server striping). Operands arrive flat and padded
+    to a multiple of the axis size."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from .parallel._compat import shard_map
+
+    def inner(a, d):
+        x = a[0]                     # (L,) flat, L % n == 0
+        own = lax.psum_scatter(x, "_kvall", scatter_dimension=0, tiled=True)
+        full = lax.all_gather(own, "_kvall", axis=0, tiled=True)
+        return full / d
+
+    return jax.jit(shard_map(inner, mesh,
+                             in_specs=(P("_kvall"), P()), out_specs=P()))
+
+
 @functools.lru_cache(maxsize=1)
 def _two_bit_fn():
     import jax
@@ -119,6 +143,7 @@ class KVStore:
     def __init__(self, kv_type="local", mesh=None):
         import jax
 
+        import os as _os
         self._type = kv_type
         self._store = {}           # key -> NDArray (the authoritative copy)
         self._updater = None
@@ -126,6 +151,11 @@ class KVStore:
         self._compression = None
         self._residuals = {}       # key -> list of error-feedback residuals
         self._mesh = mesh
+        # arrays at/above this element count take the ownership-sharded
+        # wire (reference env var + default, src/kvstore/kvstore_dist.h:58)
+        self._bigarray_bound = int(_os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", 1000 * 1000))
+        self._wire_stats = {"whole": 0, "sharded": 0, "packed": 0}
         if kv_type in _TPU_TYPES and mesh is None:
             # one flat axis over every visible device; callers doing real
             # tp/sp pass their own mesh
@@ -194,19 +224,39 @@ class KVStore:
         n_local = jax.local_device_count()
         n_total = len(mesh.devices.flat)
         host = _onp.asarray(jax.device_get(arr))
-        local = _onp.broadcast_to(host, (n_local,) + host.shape)
+        denom = float(n_local if scale_to_sum else n_total)
+        compressed = packed_wire and self._compression is not None
+        big = not compressed and host.size >= self._bigarray_bound
+        staged = host
+        if big:
+            # big-array wire: flat + padded so axis members can own
+            # equal shards (reference bigarray_bound server striping,
+            # kvstore_dist.h:58)
+            staged = host.reshape(-1)
+            pad = (-staged.size) % n_total
+            if pad:
+                staged = _onp.concatenate(
+                    [staged, _onp.zeros((pad,), staged.dtype)])
+        local = _onp.broadcast_to(staged, (n_local,) + staged.shape)
         g = jax.make_array_from_process_local_data(
             NamedSharding(mesh, P("_kvall")), local,
-            (n_total,) + host.shape)
-        denom = float(n_local if scale_to_sum else n_total)
-        if packed_wire and self._compression is not None:
+            (n_total,) + staged.shape)
+        if compressed:
             thr = float(self._compression.get("threshold", 0.5))
+            self._wire_stats["packed"] += 1
             out = _axis0_packed_mean_fn(mesh, thr)(
                 g, jax.numpy.asarray([denom], g.dtype))
+        elif big:
+            self._wire_stats["sharded"] += 1
+            out = _axis0_sharded_mean_fn(mesh)(g, denom)
         else:
+            self._wire_stats["whole"] += 1
             out = _axis0_mean_fn(mesh)(g, denom)
         # hand back a process-LOCAL copy so callers can run eager ops on it
-        return jax.numpy.asarray(jax.device_get(out))
+        out = jax.numpy.asarray(jax.device_get(out))
+        if big:
+            out = out[:host.size].reshape(host.shape)
+        return out
 
     def _merge(self, key, value):
         vals = value if isinstance(value, (list, tuple)) else [value]
@@ -488,4 +538,13 @@ def create(name="local", mesh=None):
     name = name.lower()
     if name not in ("local", "device") + _TPU_TYPES:
         raise MXNetError(f"unknown kvstore type {name!r}")
+    if name == "dist_async":
+        import warnings
+        warnings.warn(
+            "kvstore 'dist_async' runs with SYNCHRONOUS collectives on "
+            "this backend: there is no parameter-server process to apply "
+            "per-push updates without a barrier (reference "
+            "kvstore_dist_server.h:348 AsyncDefault). Convergence behavior "
+            "matches dist_sync, not the reference's async mode.",
+            stacklevel=2)
     return KVStore(name, mesh=mesh)

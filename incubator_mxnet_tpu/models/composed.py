@@ -69,7 +69,7 @@ class ComposedPipelineLM:
     def __init__(self, cfg: ComposedConfig):
         self.cfg = cfg
 
-    def _ffn_kind(self, j, layers_per_stage):
+    def _ffn_kind(self, j):
         if self.cfg.moe_every <= 0:
             return "dense"
         return "moe" if (j % self.cfg.moe_every == self.cfg.moe_every - 1) \
@@ -111,7 +111,7 @@ class ComposedPipelineLM:
             params[b + "wo"] = stacked(d, (d, d))
             params[b + "ln2_g"] = jnp.ones((n_stages, d), dt)
             params[b + "ln2_b"] = jnp.zeros((n_stages, d), dt)
-            if self._ffn_kind(j, lps) == "moe":
+            if self._ffn_kind(j) == "moe":
                 params[b + "wg"] = stacked(d, (d, E))
                 params[b + "w1"] = stacked(d, (E, d, f))
                 params[b + "w2"] = stacked(f, (E, f, d))
@@ -184,7 +184,7 @@ class ComposedPipelineLM:
             for s in ("wq", "wk", "wv"):       # column-parallel
                 specs[b + s] = P(pp, None, tp)
             specs[b + "wo"] = P(pp, tp, None)  # row-parallel
-            if self._ffn_kind(j, lps) == "moe":
+            if self._ffn_kind(j) == "moe":
                 specs[b + "wg"] = P(pp)
                 specs[b + "w1"] = P(pp, ep)
                 specs[b + "w2"] = P(pp, ep)
@@ -219,7 +219,7 @@ class ComposedPipelineLM:
             for j in range(lps):
                 h, aux = model._block(stage_p, f"b{j}_", h, sp_axis=sp,
                                       tp_axis=tp, ep_axis=ep,
-                                      kind=model._ffn_kind(j, lps))
+                                      kind=model._ffn_kind(j))
                 aux_total = aux_total + aux
             return h, aux_total
 
@@ -230,6 +230,13 @@ class ComposedPipelineLM:
             stage_p = {k: (v[0] if k.startswith("b") else v)
                        for k, v in params.items()}
             B_l, T_l = tokens.shape
+            n_sp = mesh.shape[sp] if sp else 1
+            if T_l * n_sp > cfg.max_len:
+                # shapes are static: fail at trace time, not by the silent
+                # index clamp a jit gather would apply past the table end
+                raise ValueError(
+                    f"sequence length {T_l * n_sp} exceeds max_len "
+                    f"{cfg.max_len}")
             sp_idx = lax.axis_index(sp) if sp else 0
             positions = sp_idx * T_l + jnp.arange(T_l)
             x = params["embed"][tokens] + params["pos_embed"][positions]
@@ -327,12 +334,12 @@ class ComposedPipelineLM:
         x = params["embed"][tokens] + params["pos_embed"][jnp.arange(T)]
 
         def run_blocks(xg):
-            aux_total, cnt = jnp.float32(0), 0
+            aux_total = jnp.float32(0)
             for s in range(S):
                 for j in range(lps):
                     p = {k: (v[s] if v.ndim and k.startswith("b") else v)
                          for k, v in params.items()}
-                    kind = self._ffn_kind(j, lps)
+                    kind = self._ffn_kind(j)
                     Bg, Tg, D = xg.shape
                     h = self._ln(xg, p[f"b{j}_ln1_g"], p[f"b{j}_ln1_b"])
                     hd = D // cfg.n_heads
@@ -359,7 +366,6 @@ class ComposedPipelineLM:
                             auxs.append(aux)
                         y = jnp.concatenate(flat_groups, axis=1)
                         aux_total = aux_total + jnp.mean(jnp.stack(auxs))
-                        cnt += 1
                     else:
                         y = jax.nn.gelu(h @ p[f"b{j}_w_in"]) @ \
                             p[f"b{j}_w_out"]
@@ -378,14 +384,13 @@ class ComposedPipelineLM:
                 xr = xg_all[r * per_round:(r + 1) * per_round]
                 tr = tg_all[r * per_round:(r + 1) * per_round]
                 mb = per_round // n_microbatches
-                aux_sum, cnt = jnp.float32(0), 0
+                aux_sum = jnp.float32(0)
                 outs = []
                 for m in range(n_microbatches):
                     xm = xr[m * mb:(m + 1) * mb]
                     o, aux = run_blocks(xm)
                     outs.append(o)
                     aux_sum = aux_sum + aux
-                    cnt += 1
                 h = jnp.concatenate(outs)
                 h = self._ln(h, params["lnf_g"], params["lnf_b"])
                 logits = (h @ params["embed"].T).astype(jnp.float32)

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Hand-assemble golden .onnx fixtures byte-by-byte from the public
+onnx.proto3 schema — deliberately WITHOUT contrib.onnx._proto, so the
+fixtures are external bytes the codec never produced. The encodings also
+exercise wire features our writer never emits:
+
+  * different field ordering (graph before ir_version, name fields last)
+  * NON-packed repeated int64 dims (proto3 writers pack; readers must
+    accept both encodings)
+  * float_data instead of raw_data in one initializer
+  * unknown fields (high field numbers, varint + 64-bit + length-delimited
+    wire types) that a conforming reader skips
+  * dim_param (symbolic batch) in the input ValueInfo
+
+Run from the repo root:  python tests/fixtures/make_onnx_golden.py
+"""
+import os
+import struct
+
+
+def vi(n):                      # varint
+    out = bytearray()
+    if n < 0:
+        n += 1 << 64
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def key(field, wire):
+    return vi((field << 3) | wire)
+
+
+def ld(field, payload):         # length-delimited
+    return key(field, 2) + vi(len(payload)) + payload
+
+
+def s(field, text):
+    return ld(field, text.encode())
+
+
+def iv(field, n):               # int varint field
+    return key(field, 0) + vi(n)
+
+
+def f32(field, v):
+    return key(field, 5) + struct.pack("<f", v)
+
+
+# ---- golden 1: Add(X, W) -> Relu -> Y --------------------------------------
+# TensorProto W: dims NON-packed (field 1 as repeated varints), float_data
+# (field 4, packed floats) instead of raw_data, name written BEFORE dims.
+w_vals = [0.5, -1.0, 2.0, -0.25]
+tensor_w = (
+    s(8, "W")                                   # name (out of order)
+    + key(1, 0) + vi(4)                         # dims: non-packed repeated
+    + iv(2, 1)                                  # data_type = FLOAT
+    + ld(4, b"".join(struct.pack("<f", v) for v in w_vals))  # float_data
+)
+
+node_add = (
+    s(1, "data") + s(1, "W")                    # inputs
+    + s(2, "sum0")                              # output
+    + s(4, "Add")                               # op_type
+    + s(3, "add_node")                          # name AFTER op_type
+)
+node_relu = s(1, "sum0") + s(2, "out") + s(4, "Relu")
+
+# ValueInfo data: float (1, 4) with a dim_param batch
+vi_data = (
+    s(1, "data")
+    + ld(2, ld(1, iv(1, 1)                       # TypeProto.tensor_type
+               + ld(2, ld(1, s(2, "N"))          # dim_param "N"
+                     + ld(1, iv(1, 4)))))        # dim_value 4
+)
+vi_out = (
+    s(1, "out")
+    + ld(2, ld(1, iv(1, 1)
+               + ld(2, ld(1, iv(1, 1)) + ld(1, iv(1, 4)))))
+)
+
+graph1 = (
+    s(2, "golden_add_relu")                      # graph.name FIRST
+    + ld(1, node_add) + ld(1, node_relu)         # nodes
+    + ld(5, tensor_w)                            # initializer
+    + ld(11, vi_data) + ld(12, vi_out)           # inputs/outputs
+    + ld(13, b"")                                # value_info: empty entry
+)
+
+model1 = (
+    ld(7, graph1)                                # graph BEFORE ir_version
+    + iv(1, 7)                                   # ir_version
+    + ld(8, s(1, "") + iv(2, 11))                # opset_import
+    + s(2, "hand-rolled")                        # producer_name
+    + key(99, 0) + vi(123456)                    # unknown varint field
+    + key(98, 1) + struct.pack("<d", 2.5)        # unknown 64-bit field
+    + ld(97, b"ignore me")                       # unknown length-delimited
+)
+
+# ---- golden 2: MatMul(data, W2) -> Y, raw_data initializer ------------------
+import numpy as np
+w2 = np.arange(12, dtype=np.float32).reshape(4, 3) * 0.1
+tensor_w2 = (
+    ld(1, vi(4) + vi(3))                         # dims: PACKED
+    + iv(2, 1)
+    + s(8, "W2")
+    + ld(9, w2.tobytes())                        # raw_data
+)
+node_mm = s(1, "data") + s(1, "W2") + s(2, "out") + s(4, "MatMul")
+vi_data2 = (
+    s(1, "data")
+    + ld(2, ld(1, iv(1, 1)
+               + ld(2, ld(1, iv(1, 2)) + ld(1, iv(1, 4)))))
+)
+vi_out2 = (
+    s(1, "out")
+    + ld(2, ld(1, iv(1, 1)
+               + ld(2, ld(1, iv(1, 2)) + ld(1, iv(1, 3)))))
+)
+graph2 = (
+    ld(1, node_mm)
+    + ld(5, tensor_w2)
+    + s(2, "golden_matmul")
+    + ld(11, vi_data2) + ld(12, vi_out2)
+)
+model2 = (
+    iv(1, 8)
+    + s(2, "hand-rolled")
+    + s(3, "1.0")                                # producer_version
+    + ld(8, s(1, "") + iv(2, 13))
+    + ld(7, graph2)
+)
+
+here = os.path.dirname(os.path.abspath(__file__))
+open(os.path.join(here, "golden_add_relu.onnx"), "wb").write(model1)
+open(os.path.join(here, "golden_matmul.onnx"), "wb").write(model2)
+print("wrote golden_add_relu.onnx (%d B), golden_matmul.onnx (%d B)"
+      % (len(model1), len(model2)))

@@ -78,7 +78,35 @@ WORKER = textwrap.dedent("""
     # 6) barrier is a real cross-process rendezvous
     kv.barrier()
 
-    # 7) liveness: both workers just heartbeated at the barrier
+    # 7) big-array sharded wire (reference bigarray_bound striping,
+    # tests/nightly/dist_sync_kvstore.py big_shape): bound lowered via env
+    # so a (130, 70) push takes the ownership-sharded reduce-scatter +
+    # all-gather path while (16,) stays on the whole-tensor wire
+    big = np.arange(130 * 70, dtype=np.float32).reshape(130, 70) * 1e-3
+    kv.init("big", mx.nd.zeros((130, 70)))
+    kv.push("big", mx.nd.array(big * (pid + 1)))
+    outb = mx.nd.zeros((130, 70))
+    kv.pull("big", out=outb)
+    np.testing.assert_allclose(outb.asnumpy(), big * expect, rtol=1e-5)
+    kv.init("small", mx.nd.zeros((16,)))
+    kv.push("small", mx.nd.array(np.ones(16, np.float32)))
+    assert kv._wire_stats["sharded"] >= 1, kv._wire_stats
+    assert kv._wire_stats["whole"] >= 1, kv._wire_stats
+
+    # 8) compression at scale: a (5000,) gradient crosses the wire PACKED
+    kv4 = mx.kv.create("dist_sync")
+    kv4.set_gradient_compression({{"type": "2bit", "threshold": 0.5}})
+    kv4.init("cbig", mx.nd.zeros((5000,)))
+    gbig = np.where(np.arange(5000) % 3 == 0, 1.0, -2.0).astype(np.float32)
+    kv4.push("cbig", mx.nd.array(gbig))
+    outcb = mx.nd.zeros((5000,))
+    kv4.pull("cbig", out=outcb)
+    np.testing.assert_allclose(
+        outcb.asnumpy(),
+        nproc * np.where(np.arange(5000) % 3 == 0, 0.5, -0.5), atol=1e-6)
+    assert kv4._wire_stats["packed"] >= 1, kv4._wire_stats
+
+    # 9) liveness: all workers just heartbeated
     assert kv.get_dead_nodes(timeout=120) == [], "false dead nodes"
     # ONE write: print("WORKER_OK", pid) issues separate writes per arg,
     # which interleave with gloo's own stdout chatter and split the token
@@ -87,28 +115,84 @@ WORKER = textwrap.dedent("""
 """)
 
 
-@pytest.mark.timeout(300)
-def test_dist_sync_two_processes(tmp_path):
+# worker-death: rank!=0 exits hard after the first barrier; rank 0 keeps
+# heartbeating and must see the dead rank via get_dead_nodes within the
+# observation window (reference: ps-lite node timeout surfacing)
+WORKER_KILL = textwrap.dedent("""
+    import os, sys, time
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                               num_processes=nproc, process_id=pid)
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.push("w", mx.nd.array(np.ones(4, np.float32)))
+    kv.barrier()
+    if pid == 1:
+        os._exit(0)      # simulated crash: no further heartbeats
+    assert kv.get_dead_nodes(timeout=120) == [], "premature dead report"
+    deadline = time.monotonic() + 90
+    dead = []
+    while time.monotonic() < deadline:
+        dead = kv.get_dead_nodes(timeout=4)
+        if 1 in dead:
+            break
+        time.sleep(2)
+    assert 1 in dead, f"rank 1 never reported dead: {{dead}}"
+    assert 0 not in dead, "live rank misreported"
+    sys.stdout.write("KILLTEST_OK\\n")
+    sys.stdout.flush()
+    # skip the jax.distributed atexit shutdown barrier: with a dead peer
+    # it can only raise (the coordination service is already in the error
+    # state that get_dead_nodes just surfaced)
+    os._exit(0)
+""")
+
+
+def _launch(tmp_path, script_text, nproc, timeout=240):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = str(s.getsockname()[1])
     script = tmp_path / "worker.py"
-    script.write_text(WORKER.format(repo=REPO))
+    script.write_text(script_text)
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    env["MXNET_KVSTORE_BIGARRAY_BOUND"] = "4096"
     procs = [subprocess.Popen(
-        [sys.executable, str(script), str(i), "2", port],
+        [sys.executable, str(script), str(i), str(nproc), port],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for i in range(2)]
+        for i in range(nproc)]
     outs = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=240)
+            out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             pytest.fail("distributed workers timed out")
         outs.append((p.returncode, out, err))
+    return outs
+
+
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_dist_sync_processes(tmp_path, nproc):
+    outs = _launch(tmp_path, WORKER.format(repo=REPO), nproc)
     for i, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"worker {i} failed:\n{err[-2000:]}"
         assert f"WORKER_OK_{i}" in out
+
+
+@pytest.mark.timeout(300)
+def test_dist_worker_death_detected(tmp_path):
+    outs = _launch(tmp_path, WORKER_KILL.format(repo=REPO), 2)
+    rc0, out0, err0 = outs[0]
+    assert rc0 == 0, f"survivor failed:\n{err0[-2000:]}"
+    assert "KILLTEST_OK" in out0

@@ -82,6 +82,25 @@ def test_convolution_stride_pad_groups():
     assert out.shape == (2, 6, 4, 4)
 
 
+def test_stem_s2d_conv_rewrite_exact():
+    """The TPU stem fast-path (ops/nn_ops.py _stem_s2d_conv: 2x2
+    space-to-depth + folded kernel) must match the plain stride-2 conv for
+    every shape the gate admits — it is applied transparently on TPU."""
+    import jax.numpy as jnp
+    from jax import lax
+    from incubator_mxnet_tpu.ops.nn_ops import _conv_dnums, _stem_s2d_conv
+    for k, c, h in ((7, 3, 224), (7, 4, 56), (11, 1, 44)):
+        x = jnp.asarray(_rand(2, c, h, h))
+        w = jnp.asarray(_rand(8, c, k, k))
+        ref = lax.conv_general_dilated(
+            x, w, (2, 2), [(k // 2, k // 2)] * 2,
+            dimension_numbers=_conv_dnums(2))
+        got = _stem_s2d_conv(x, w, k)
+        assert got.shape == ref.shape, (k, got.shape, ref.shape)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+
 def test_pooling():
     x = _rand(1, 2, 4, 4)
     mx_max = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max").asnumpy()
