@@ -94,7 +94,7 @@ class Node:
     src/imperative/imperative.cc RecordOp)."""
 
     __slots__ = ("vjp_fn", "inputs", "out_refs", "out_avals", "out_aliases",
-                 "name", "bwd_info")
+                 "name", "bwd_info", "replay")
 
     def __init__(self, vjp_fn, inputs, name=""):
         self.vjp_fn = vjp_fn     # cotangents-tuple -> input-cotangents tuple
@@ -106,6 +106,9 @@ class Node:
         # (op, params, saved_args, ndarray_positions) for replaying this
         # node's backward as a recorded op (create_graph higher-order path)
         self.bwd_info = None
+        # alternative replay hook for composite nodes (hybridized cached
+        # blocks): callable cts -> recorded input cotangents
+        self.replay = None
 
     def add_alias(self, orig, view):
         """Register `view` as another identity of output `orig` so backward
@@ -327,6 +330,8 @@ def _backward_walk(order, cot, keep, create_graph):
             continue
         if create_graph and node.bwd_info is not None:
             in_cts = _record_bwd(node, cts)
+        elif create_graph and node.replay is not None:
+            in_cts = node.replay(cts)
         else:
             raw = [c._data if isinstance(c, NDArray) else c for c in cts]
             in_cts = node.vjp_fn(tuple(raw) if len(raw) > 1 else raw[0])

@@ -139,34 +139,243 @@ def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
 
     if not mapping:
         return sym
-    # rebuild every downstream node whose inputs changed
+    new_outputs = [(e[0], e[1]) for e in
+                   (_rebuild_mapped(sym._outputs, mapping))]
+    return _propagate_int8(S.Symbol(new_outputs))
+
+
+def _rebuild_mapped(outputs, mapping):
+    """Rebuild a graph applying `mapping` {id(old) -> (new_node, shift)}
+    EVERYWHERE — including inside the replacement nodes' own input
+    subtrees (a replacement's inputs still reference original upstream
+    nodes that may themselves be mapped)."""
+    from ..symbol.symbol import _Node
+
     rebuilt = {}
 
     def rebuild(node):
-        if id(node) in mapping:
-            return mapping[id(node)][0]
         if id(node) in rebuilt:
             return rebuilt[id(node)]
-        if node.op is None:
-            rebuilt[id(node)] = node
-            return node
+        target = mapping[id(node)][0] if id(node) in mapping else node
+        if target.op is None:
+            rebuilt[id(node)] = target
+            return target
         new_ins = []
-        changed = False
-        for inp, oi in node.inputs:
+        for inp, oi in target.inputs:
             nb = rebuild(inp)
-            if nb is not inp:
-                changed = True
+            if id(inp) in mapping:
+                oi = oi + mapping[id(inp)][1]
             new_ins.append((nb, oi))
-        if not changed:
-            rebuilt[id(node)] = node
-            return node
-        nn = _Node(node.op, node.name, node.attrs, new_ins,
-                   extra=node.extra, arg_names=node.arg_names)
+        nn = _Node(target.op, target.name, target.attrs, new_ins,
+                   extra=target.extra, arg_names=target.arg_names)
         rebuilt[id(node)] = nn
         return nn
 
-    new_outputs = [(rebuild(n), i) for n, i in sym._outputs]
-    return S.Symbol(new_outputs)
+    return [(rebuild(n), i + (mapping[id(n)][1] if id(n) in mapping else 0))
+            for n, i in outputs]
+
+
+def _propagate_int8(sym):
+    """Push dequantize nodes DOWN through range-preserving ops: a
+    relu / max-pool / flatten / residual-add whose inputs all come from
+    dequantize nodes is replaced by its quantized form consuming the int
+    codes directly (reference: the quantize pass's avoid-dequantize
+    patterns across quantized_pooling.cc, quantized_activation.cc,
+    quantized_elemwise_add.cc). Repeats to a fixpoint so chains like
+    conv -> relu -> pool stay integer end to end."""
+    from .. import symbol as S
+    from ..symbol.symbol import _Node, _topo
+    from ..ops import registry as _registry
+
+    dq_op = _registry.get_op("_contrib_dequantize")
+    q_act = _registry.get_op("_contrib_quantized_act")
+    q_pool = _registry.get_op("_contrib_quantized_pooling")
+    q_flat = _registry.get_op("_contrib_quantized_flatten")
+    q_add = _registry.get_op("_contrib_quantized_elemwise_add")
+
+    def is_dq(entry):
+        node, oi = entry
+        return node.op is dq_op and oi == 0
+
+    for _ in range(32):          # fixpoint; each pass sinks one layer
+        order = _topo(sym._outputs)
+        mapping = {}
+
+        def conv(entry):
+            node, idx = entry
+            return (mapping[id(node)][0], idx + mapping[id(node)][1]) \
+                if id(node) in mapping else entry
+
+        changed = False
+        for node in order:
+            if node.op is None or id(node) in mapping:
+                continue
+            ins = [conv(e) for e in node.inputs]
+            name = node.op.name
+            new = None
+            if (name == "relu" or (name == "Activation" and
+                                   node.attrs.get("act_type") == "relu")) \
+                    and is_dq(ins[0]):
+                q, lo, hi = ins[0][0].inputs
+                new = _Node(q_act, f"quantized_{node.name}", {},
+                            [q, lo, hi],
+                            arg_names=["data", "min_range", "max_range"])
+            elif name == "Pooling" and is_dq(ins[0]) and \
+                    node.attrs.get("pool_type", "max") in ("max",):
+                q, lo, hi = ins[0][0].inputs
+                new = _Node(q_pool, f"quantized_{node.name}",
+                            dict(node.attrs), [q, lo, hi],
+                            arg_names=["data", "min_range", "max_range"])
+            elif name in ("Flatten", "flatten") and is_dq(ins[0]):
+                q, lo, hi = ins[0][0].inputs
+                new = _Node(q_flat, f"quantized_{node.name}", {},
+                            [q, lo, hi],
+                            arg_names=["data", "min_range", "max_range"])
+            elif name in ("elemwise_add", "broadcast_add", "_plus") and \
+                    len(ins) == 2 and is_dq(ins[0]) and is_dq(ins[1]):
+                lq, llo, lhi = ins[0][0].inputs
+                rq, rlo, rhi = ins[1][0].inputs
+                new = _Node(q_add, f"quantized_{node.name}", {},
+                            [lq, rq, llo, lhi, rlo, rhi],
+                            arg_names=["lhs", "rhs", "lhs_min", "lhs_max",
+                                       "rhs_min", "rhs_max"])
+            if new is not None:
+                dq = _Node(dq_op, f"{node.name}_dequantize", {},
+                           [(new, 0), (new, 1), (new, 2)],
+                           arg_names=["qdata", "min_range", "max_range"])
+                mapping[id(node)] = (dq, 0)
+                changed = True
+
+        if not changed:
+            return sym
+        sym = S.Symbol(_rebuild_mapped(sym._outputs, mapping))
+    return sym
+
+
+def fold_batchnorm(sym, arg_params, aux_params):
+    """Fold inference-mode BatchNorm into the preceding Convolution
+    (reference: the MKLDNN subgraph fuse pass's conv+BN folding) — an
+    EXACT transform with running stats:
+        W' = W * (gamma / sqrt(var + eps))    (per output channel)
+        b' = beta + (b - mean) * gamma / sqrt(var + eps)
+    Quantizing the folded conv avoids a separate int8 BN stage and its
+    extra requantization error. Returns (sym2, arg2, aux2)."""
+    import numpy as _np2
+    from .. import symbol as S
+    from ..symbol.symbol import _Node, _topo
+    from ..ndarray import NDArray
+    from ..ndarray import array as _nd_array
+
+    arg2 = dict(arg_params)
+    aux2 = dict(aux_params or {})
+    order = _topo(sym._outputs)
+    consumers = {}
+    for n in order:
+        if n.op is None:
+            continue
+        for (i, oi) in n.inputs:
+            consumers.setdefault(id(i), []).append(n)
+
+    mapping = {}
+
+    def conv_entry(entry):
+        node, idx = entry
+        return (mapping[id(node)], idx) if id(node) in mapping else entry
+
+    output_ids = {id(n) for n, _ in sym._outputs}
+    for node in order:
+        if node.op is None or node.op.name != "BatchNorm":
+            continue
+        (src, src_oi) = node.inputs[0]
+        if src.op is None or src.op.name != "Convolution" or src_oi != 0:
+            continue
+        if len(consumers.get(id(src), [])) != 1 or id(src) in output_ids:
+            continue   # conv output used elsewhere / exposed: keep BN
+            # (folding mutates the conv WEIGHTS, so every consumer of the
+            # raw conv output — including a graph output — must go)
+        names = dict(zip(node.arg_names, [i for i, _ in node.inputs]))
+        try:
+            gamma = arg2[names["gamma"].name].asnumpy()
+            beta = arg2[names["beta"].name].asnumpy()
+            mean = aux2[names["moving_mean"].name].asnumpy()
+            var = aux2[names["moving_var"].name].asnumpy()
+        except KeyError:
+            continue
+        eps = float(node.attrs.get("eps", 1e-3))
+        if node.attrs.get("fix_gamma", True) in (True, "True", "true", "1"):
+            gamma = _np2.ones_like(gamma)
+        scale = gamma / _np2.sqrt(var + eps)
+
+        w_name = None
+        b_name = None
+        for (inp, _), aname in zip(src.inputs, src.arg_names):
+            if aname == "weight":
+                w_name = inp.name
+            elif aname == "bias":
+                b_name = inp.name
+        if w_name is None or w_name not in arg2:
+            continue
+        w = arg2[w_name].asnumpy()
+        b = arg2[b_name].asnumpy() if b_name and b_name in arg2 else \
+            _np2.zeros(w.shape[0], w.dtype)
+        arg2[w_name] = _nd_array(
+            w * scale.reshape((-1,) + (1,) * (w.ndim - 1)))
+        nb = beta + (b - mean) * scale
+        # the folded conv always carries a bias
+        if b_name is None:
+            b_name = src.name + "_folded_bias"
+        arg2[b_name] = _nd_array(nb.astype(w.dtype))
+        new_attrs = dict(src.attrs)
+        new_attrs["no_bias"] = False
+        bias_var = _Node(None, b_name, {}, [])
+        new_inputs = []
+        new_argn = []
+        has_bias = False
+        for (inp, oi), aname in zip(src.inputs, src.arg_names):
+            e = conv_entry((inp, oi))
+            if aname == "bias":
+                new_inputs.append((bias_var, 0))
+                has_bias = True
+            else:
+                new_inputs.append(e)
+            new_argn.append(aname)
+        if not has_bias:
+            new_inputs.append((bias_var, 0))
+            new_argn.append("bias")
+        fused = _Node(src.op, src.name, new_attrs, new_inputs,
+                      extra=dict(src.extra), arg_names=new_argn)
+        mapping[id(node)] = fused
+
+    if not mapping:
+        return sym, arg2, aux2
+
+    rebuilt = {}
+
+    def rebuild(node):
+        """Replace mapped BNs with their fused conv AND rebuild the fused
+        node's own input subtree (a fused conv's inputs still reference
+        original upstream nodes containing earlier mapped BNs)."""
+        if id(node) in rebuilt:
+            return rebuilt[id(node)]
+        target = mapping.get(id(node), node)
+        if target.op is None:
+            rebuilt[id(node)] = target
+            return target
+        new_ins = []
+        for inp, oi in target.inputs:
+            nb = rebuild(inp)
+            # a mapped BatchNorm had 3 outputs; its fused conv exposes 1
+            new_ins.append((nb, 0 if id(inp) in mapping else oi))
+        nn = _Node(target.op, target.name, target.attrs, new_ins,
+                   extra=target.extra, arg_names=target.arg_names)
+        rebuilt[id(node)] = nn
+        return nn
+
+    new_outputs = []
+    for n, i in sym._outputs:
+        nb = rebuild(n)
+        new_outputs.append((nb, 0 if id(n) in mapping else i))
+    return S.Symbol(new_outputs), arg2, aux2
 
 
 def _calibrate_quantized_sym(sym, calib_data, data_names, num_batches,

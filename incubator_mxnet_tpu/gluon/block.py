@@ -347,7 +347,7 @@ class HybridBlock(Block):
         if entry is None:
             entry = self._build_cache(args, training)
             self._cached_graph[key] = entry
-        jit_fwd, jit_bwd, param_list, unflatten = entry
+        jit_fwd, jit_bwd, param_list, unflatten, replay_def = entry
 
         pf = [p.data()._data for p in param_list]
         rng = _rnd.next_key()
@@ -375,6 +375,18 @@ class HybridBlock(Block):
             node = autograd.Node(node_vjp, inputs_record, f"cachedop_{self.name}")
             node.out_refs = [weakref.ref(o) for o in outs]
             node.out_avals = [(o.shape, o.dtype) for o in outs]
+
+            def node_replay(cts, _args=args, _pl=param_list, _rng=rng,
+                            _rd=replay_def):
+                from ..ops import registry as _R
+                cargs = [c if isinstance(c, NDArray) else NDArray(c)
+                         for c in cts]
+                prim = [p.data() for p in _pl] + list(_args)
+                with autograd.record():
+                    o = _R.apply_op(_rd, *cargs, _rng, *prim)
+                return o if isinstance(o, list) else [o]
+
+            node.replay = node_replay
             for o in outs:
                 o._ag_node = node
 
@@ -441,12 +453,36 @@ class HybridBlock(Block):
         for p in param_list:
             d = p.data()._data
             pf0.append(jax.ShapeDtypeStruct(d.shape, d.dtype))
-        jax.eval_shape(fun, pf0, jax.ShapeDtypeStruct((2,), _np.uint32),
-                       *[jax.ShapeDtypeStruct(a._data.shape, a._data.dtype)
-                         for a in args])
+        res = jax.eval_shape(fun, pf0, jax.ShapeDtypeStruct((2,), _np.uint32),
+                             *[jax.ShapeDtypeStruct(a._data.shape, a._data.dtype)
+                               for a in args])
         rebuild = out_struct["rebuild"]
 
-        return jit_fwd, jit_bwd, param_list, rebuild
+        # create_graph replay: the block's backward expressed as ONE
+        # registry op over (cts..., rng, params..., inputs...) so
+        # apply_op's vjp-at-forward makes the produced cotangents
+        # differentiable — the CachedOp analog of autograd._record_bwd
+        n_out = len(res[0])
+        n_params = len(param_list)
+
+        def cached_bwd_replay(*flat):
+            from ..ops.registry import _match_ct_dtypes
+            cts = flat[:n_out]
+            rng_ = flat[n_out]
+            pf_ = list(flat[n_out + 1:n_out + 1 + n_params])
+            ins_ = flat[n_out + 1 + n_params:]
+            outs, vjp_fn = jax.vjp(
+                lambda p_, *i_: fun(p_, rng_, *i_)[0], pf_, *ins_)
+            grads = vjp_fn(_match_ct_dtypes(tuple(cts), tuple(outs)))
+            pf_g = grads[0]
+            sel = tuple(pf_g) + tuple(grads[1:])
+            return sel[0] if len(sel) == 1 else sel
+
+        from ..ops import registry as _R
+        replay_def = _R.OpDef(f"_backward_cachedop_{self.name}",
+                              cached_bwd_replay)
+
+        return jit_fwd, jit_bwd, param_list, rebuild, replay_def
 
     def _abstract_forward(self, xs):
         wrapped = [NDArray(t) for t in xs]

@@ -179,13 +179,19 @@ class BottleneckV2(_ResUnit):
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
                **kwargs):
-    """Reference model_zoo get_resnet signature; pretrained weights are not
-    shipped (zero-egress build) — load_parameters() from a local file."""
+    """Reference model_zoo get_resnet signature. pretrained=True resolves
+    `resnet{depth}_v{version}` through the sha1-verified model_store cache
+    (set MXNET_GLUON_REPO to a local file:// mirror in this zero-egress
+    build) and loads the reference-format .params via the role-sequence
+    compat mapper."""
     if version not in (1, 2):
         raise MXNetError(f"resnet version must be 1 or 2, got {version}")
     net = (ResNetV1 if version == 1 else ResNetV2)(num_layers, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights are not available in this build")
+        from ..compat import load_reference_parameters
+        from ..model_store import get_model_file
+        path = get_model_file(f"resnet{num_layers}_v{version}", root=root)
+        load_reference_parameters(net, path)
     return net
 
 

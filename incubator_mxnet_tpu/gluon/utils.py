@@ -83,7 +83,10 @@ def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
         return fname
     if url.startswith("file://"):
         import shutil
-        shutil.copyfile(url[7:], fname)
+        src = url[7:]
+        if not os.path.exists(src):
+            raise MXNetError(f"download source not found: {url}")
+        shutil.copyfile(src, fname)
         return fname
     raise MXNetError("network downloads unavailable (zero-egress environment); "
                      f"place the file at {fname} manually")

@@ -16,8 +16,15 @@ from __future__ import annotations
 from .. import autograd
 from ..base import MXNetError
 from .ndarray import NDArray
+from ..ops.dgl_ops import (dgl_csr_neighbor_uniform_sample,      # noqa: F401
+                           dgl_csr_neighbor_non_uniform_sample,  # noqa: F401
+                           dgl_subgraph, edge_id, dgl_adjacency,  # noqa: F401
+                           dgl_graph_compact)                     # noqa: F401
 
-__all__ = ["foreach", "while_loop", "cond"]
+__all__ = ["foreach", "while_loop", "cond",
+           "dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+           "edge_id", "dgl_adjacency", "dgl_graph_compact"]
 
 
 def _as_list(x):

@@ -248,6 +248,18 @@ class NDArray:
     def __getitem__(self, key):
         from ..ops.registry import invoke
         key = self._index_data(key)
+        if isinstance(key, (int, _np.integer)) and \
+                not isinstance(key, (bool, _np.bool_)) and self.ndim > 0:
+            # int index as an operand: one executable for ALL i (the
+            # Dataset[i] hot path; a static key would compile per index)
+            n = self.shape[0]
+            i = int(key) + n if key < 0 else int(key)
+            if not 0 <= i < n:
+                raise IndexError(f"index {key} out of bounds for axis 0 "
+                                 f"with size {n}")
+            import jax.numpy as jnp
+            return invoke("_index_axis0", self,
+                          NDArray(jnp.asarray(i, jnp.int32)))
         if _static_index(key):
             return invoke("_getitem_static", self, key=_freeze_index(key))
         # advanced indexing with array keys: route arrays as op inputs is

@@ -185,8 +185,10 @@ def pooling(data, *, kernel=(), pool_type="max", global_pool=False, stride=(),
     # NOTE: init values must be weak-typed python scalars — jax's
     # reduce_window autodiff rule does not linearize with array inits.
     if pool_type == "max":
+        # int pools (the quantized path) need a dtype-exact init scalar;
+        # float pools keep the weak python scalar (see NOTE above)
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
-            else int(jnp.iinfo(data.dtype).min)
+            else _np.dtype(data.dtype).type(jnp.iinfo(data.dtype).min)
         return lax.reduce_window(data, init, lax.max, window, strides, pads)
     if pool_type in ("avg", "sum"):
         s = lax.reduce_window(data, 0., lax.add, window, strides, pads)

@@ -547,6 +547,14 @@ def _getitem_static(data, *, key):
     return data[_thaw_index(key)]
 
 
+@register(name="_index_axis0")
+def _index_axis0(data, idx):
+    """x[i] for a python-int i, with the index as an OPERAND: one compiled
+    executable serves every i (x[i] as a static key would compile per
+    distinct index — pathological for Dataset[i] loops)."""
+    return jnp.take(data, idx, axis=0)
+
+
 def _thaw_index(key):
     if isinstance(key, tuple) and len(key) and key[0] == "slice":
         return slice(key[1], key[2], key[3])

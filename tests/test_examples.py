@@ -65,3 +65,23 @@ def test_lstm_ptb_perplexity_improves():
     assert len(ppls) == 2
     assert ppls[-1] < ppls[0]
     assert ppls[-1] < 40          # below uniform
+
+
+def test_gluon_mnist_learns():
+    out = _run("example/gluon/mnist.py", "--epochs", "3",
+               "--num-examples", "800", "--hybridize")
+    acc = float(out.rsplit("final validation accuracy:", 1)[1].split()[0])
+    assert acc > 0.8
+
+
+def test_gluon_word_lm_improves():
+    out = _run("example/gluon/word_lm.py", "--epochs", "3",
+               "--tokens", "20000")
+    tail = out.rsplit("perplexity: first", 1)[1]
+    first, last = float(tail.split()[0]), float(tail.split()[2])
+    assert last < first * 0.8, (first, last)
+
+
+def test_gluon_ssd_inference_decodes():
+    out = _run("example/gluon/ssd_inference.py")
+    assert "2 planted objects recovered" in out

@@ -1,0 +1,141 @@
+"""model_store sha1 cache + reference-params compat loading
+(reference python/mxnet/gluon/model_zoo/model_store.py; zero-egress here,
+so the repo is a local file:// mirror built by the test)."""
+import hashlib
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.gluon.model_zoo import (get_model_file,
+                                                 load_reference_parameters,
+                                                 model_store, purge)
+
+
+def _make_repo(tmp_path, name, params_bytes, monkeypatch=None):
+    """Build a file:// repo serving <name>-<hash8>.zip and register the
+    artifact's true sha1 (restored after the test via monkeypatch so the
+    published checksum table is never permanently overwritten)."""
+    sha1 = hashlib.sha1(params_bytes).hexdigest()
+    if monkeypatch is not None:
+        monkeypatch.setitem(model_store._SHA1, name, sha1)
+    else:
+        model_store.register_model(name, sha1)
+    fname = f"{name}-{sha1[:8]}"
+    repo = tmp_path / "repo" / "gluon" / "models"
+    repo.mkdir(parents=True, exist_ok=True)
+    params_file = tmp_path / (fname + ".params")
+    params_file.write_bytes(params_bytes)
+    with zipfile.ZipFile(repo / (fname + ".zip"), "w") as zf:
+        zf.write(params_file, fname + ".params")
+    return sha1
+
+
+def _reference_style_params(net, path):
+    """Write net's params as a reference-style artifact: same ndarray wire,
+    but RENAMED to structure-dotted keys a differently-nested
+    implementation would produce (net.0.conv.weight style)."""
+    params = net._collect_params_with_prefix()
+    renamed = {}
+    for i, (k, v) in enumerate(params.items()):
+        role = k.rsplit(".", 1)[-1] if "." in k else k
+        for suf in ("weight", "bias", "gamma", "beta", "running_mean",
+                    "running_var"):
+            if k.endswith(suf):
+                role = suf
+                break
+        renamed[f"stage{i // 7}.unit{i % 7}.{role}"] = v.data()
+    nd.save(str(path), renamed)
+
+
+def test_get_model_file_cache_and_corruption(tmp_path, monkeypatch):
+    payload = b"PARAMS-PAYLOAD-v1"
+    sha1 = _make_repo(tmp_path, "testnet", payload, monkeypatch)
+    monkeypatch.setenv("MXNET_GLUON_REPO",
+                       "file://" + str(tmp_path / "repo"))
+    root = str(tmp_path / "cache")
+    p = get_model_file("testnet", root=root)
+    assert open(p, "rb").read() == payload
+    # cache hit: deleting the repo must not matter
+    zips = list((tmp_path / "repo" / "gluon" / "models").glob("*.zip"))
+    for z in zips:
+        z.unlink()
+    assert get_model_file("testnet", root=root) == p
+    # corruption: repair requires the repo again -> MXNetError (no egress)
+    open(p, "wb").write(b"corrupted")
+    with pytest.raises(mx.base.MXNetError):
+        get_model_file("testnet", root=root)
+    # restore repo; corrupted cache entry is re-downloaded and verified
+    _make_repo(tmp_path, "testnet", payload, monkeypatch)
+    p2 = get_model_file("testnet", root=root)
+    assert open(p2, "rb").read() == payload
+
+
+def test_unknown_model_raises():
+    with pytest.raises(mx.base.MXNetError):
+        get_model_file("no_such_model_xyz")
+    with pytest.raises(mx.base.MXNetError):
+        model_store.short_hash("no_such_model_xyz")
+
+
+def test_purge(tmp_path):
+    root = tmp_path / "cache2"
+    root.mkdir()
+    (root / "a-12345678.params").write_bytes(b"x")
+    (root / "keep.txt").write_bytes(b"y")
+    purge(str(root))
+    assert not (root / "a-12345678.params").exists()
+    assert (root / "keep.txt").exists()
+
+
+def test_reference_params_load_by_role_mapping(tmp_path):
+    """A .params file with foreign dotted names (reference-style nesting)
+    loads into our zoo resnet18 and reproduces the source net's outputs."""
+    src = gluon.model_zoo.vision.resnet18_v1(classes=10)
+    src.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(2, 3, 32, 32)
+                 .astype(np.float32))
+    ref_out = src(x).asnumpy()
+
+    path = tmp_path / "foreign.params"
+    _reference_style_params(src, path)
+
+    dst = gluon.model_zoo.vision.resnet18_v1(classes=10)
+    dst.initialize(mx.init.Zero())
+    mapping = load_reference_parameters(dst, str(path))
+    assert len(mapping) == len(src._collect_params_with_prefix())
+    got = dst(x).asnumpy()
+    np.testing.assert_allclose(got, ref_out, rtol=1e-5, atol=1e-5)
+
+
+def test_reference_params_shape_mismatch_rejected(tmp_path):
+    src = gluon.model_zoo.vision.resnet18_v1(classes=10)
+    src.initialize(mx.init.Xavier())
+    src(nd.array(np.zeros((1, 3, 32, 32), np.float32)))  # materialize shapes
+    path = tmp_path / "foreign.params"
+    _reference_style_params(src, path)
+    dst = gluon.model_zoo.vision.resnet18_v1(classes=37)  # head differs
+    dst.initialize(mx.init.Zero())
+    with pytest.raises(mx.base.MXNetError):
+        load_reference_parameters(dst, str(path))
+
+
+def test_pretrained_resnet_via_local_repo(tmp_path, monkeypatch):
+    """get_resnet(pretrained=True) end to end against a local mirror."""
+    src = gluon.model_zoo.vision.resnet18_v1(classes=1000)
+    src.initialize(mx.init.Xavier())
+    src(nd.array(np.zeros((1, 3, 32, 32), np.float32)))  # materialize shapes
+    params_path = tmp_path / "art.params"
+    _reference_style_params(src, params_path)
+    _make_repo(tmp_path, "resnet18_v1", params_path.read_bytes(), monkeypatch)
+    monkeypatch.setenv("MXNET_GLUON_REPO",
+                       "file://" + str(tmp_path / "repo"))
+    net = gluon.model_zoo.vision.get_resnet(
+        1, 18, pretrained=True, root=str(tmp_path / "cache3"))
+    x = nd.array(np.random.RandomState(1).randn(1, 3, 32, 32)
+                 .astype(np.float32))
+    np.testing.assert_allclose(net(x).asnumpy(), src(x).asnumpy(),
+                               rtol=1e-5, atol=1e-5)

@@ -99,3 +99,184 @@ def test_quantize_graph_excluded():
     net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
     qsym = q.quantize_graph(net, excluded_sym_names=["fc1"])
     assert qsym is net  # nothing to rewrite
+
+
+# ---------------------------------------------------------------------------
+# quantized op tail + BN folding + int8 chain propagation + zoo end-to-end
+# (reference src/operator/quantization/quantized_{pooling,activation,
+# elemwise_add,concat,batch_norm,flatten}.cc + the MKLDNN fold/fuse pass)
+# ---------------------------------------------------------------------------
+
+def _q(x):
+    amax = float(np.abs(x).max()) or 1.0
+    q = np.clip(np.round(x * 127.0 / amax), -127, 127).astype(np.int8)
+    return q, amax
+
+
+def test_quantized_pooling_matches_fp32():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    q, amax = _q(x)
+    out, lo, hi = mx.nd.contrib.quantized_pooling(
+        nd.array(q), nd.array([-amax]), nd.array([amax]),
+        kernel=(2, 2), stride=(2, 2), pool_type="max")
+    deq = out.asnumpy().astype(np.float32) * amax / 127.0
+    ref = x.reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(deq, ref, atol=amax / 127.0)
+
+
+def test_quantized_act_and_flatten():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 4, 5, 5).astype(np.float32)
+    q, amax = _q(x)
+    out, lo, hi = mx.nd.contrib.quantized_act(
+        nd.array(q), nd.array([-amax]), nd.array([amax]))
+    deq = out.asnumpy().astype(np.float32) * amax / 127.0
+    np.testing.assert_allclose(deq, np.maximum(
+        np.round(x * 127 / amax).clip(-127, 127) * amax / 127, 0),
+        atol=1e-6)
+    f, _, _ = mx.nd.contrib.quantized_flatten(
+        nd.array(q), nd.array([-amax]), nd.array([amax]))
+    assert f.shape == (3, 100)
+
+
+def test_quantized_elemwise_add_matches_fp32():
+    rng = np.random.RandomState(2)
+    a = rng.randn(2, 8).astype(np.float32)
+    b = rng.randn(2, 8).astype(np.float32) * 3.0
+    qa, amax_a = _q(a)
+    qb, amax_b = _q(b)
+    out, lo, hi = mx.nd.contrib.quantized_elemwise_add(
+        nd.array(qa), nd.array(qb), nd.array([-amax_a]), nd.array([amax_a]),
+        nd.array([-amax_b]), nd.array([amax_b]))
+    out_amax = float(hi.asnumpy().reshape(-1)[0])
+    deq = out.asnumpy().astype(np.float64) * out_amax / 2147483647.0
+    np.testing.assert_allclose(deq, a + b,
+                               atol=(amax_a + amax_b) / 127.0)
+
+
+def test_quantized_concat_rescales():
+    rng = np.random.RandomState(3)
+    a = rng.randn(2, 3).astype(np.float32)
+    b = rng.randn(2, 3).astype(np.float32) * 4.0
+    qa, amax_a = _q(a)
+    qb, amax_b = _q(b)
+    out, lo, hi = mx.nd.contrib.quantized_concat(
+        nd.array(qa), nd.array(qb), nd.array([-amax_a]), nd.array([amax_a]),
+        nd.array([-amax_b]), nd.array([amax_b]), dim=1, num_args=2)
+    out_amax = float(hi.asnumpy().reshape(-1)[0])
+    deq = out.asnumpy().astype(np.float32) * out_amax / 127.0
+    np.testing.assert_allclose(deq, np.concatenate([a, b], 1),
+                               atol=2 * out_amax / 127.0)
+
+
+def test_quantized_batch_norm_matches_fp32():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 4, 6, 6).astype(np.float32)
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32) * 0.2
+    mean = rng.randn(4).astype(np.float32) * 0.1
+    var = rng.rand(4).astype(np.float32) + 0.5
+    q, amax = _q(x)
+    out, lo, hi = mx.nd.contrib.quantized_batch_norm(
+        nd.array(q), nd.array(gamma), nd.array(beta), nd.array(mean),
+        nd.array(var), nd.array([-amax]), nd.array([amax]), eps=1e-3)
+    out_amax = float(hi.asnumpy().reshape(-1)[0])
+    deq = out.asnumpy().astype(np.float32) * out_amax / 127.0
+    sh = (1, -1, 1, 1)
+    ref = (x - mean.reshape(sh)) / np.sqrt(var.reshape(sh) + 1e-3) * \
+        gamma.reshape(sh) + beta.reshape(sh)
+    np.testing.assert_allclose(deq, ref, atol=3 * out_amax / 127.0)
+
+
+def test_fold_batchnorm_exact():
+    from incubator_mxnet_tpu.contrib.quantization import fold_batchnorm
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, name="c1", kernel=(3, 3), num_filter=8,
+                           pad=(1, 1), no_bias=True)
+    b = mx.sym.BatchNorm(c, name="b1", fix_gamma=False)
+    r = mx.sym.Activation(b, act_type="relu", name="r1")
+    rng = np.random.RandomState(0)
+    args = {"c1_weight": nd.array(rng.randn(8, 3, 3, 3).astype(np.float32) * .2),
+            "b1_gamma": nd.array(rng.rand(8).astype(np.float32) + .5),
+            "b1_beta": nd.array(rng.randn(8).astype(np.float32) * .1)}
+    aux = {"b1_moving_mean": nd.array(rng.randn(8).astype(np.float32) * .1),
+           "b1_moving_var": nd.array(rng.rand(8).astype(np.float32) + .5)}
+    x = nd.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+    ref = r.eval_dict({**args, **aux, "data": x})
+    ref = (ref[0] if isinstance(ref, list) else ref).asnumpy()
+    s2, a2, x2 = fold_batchnorm(r, args, aux)
+    assert "BatchNorm" not in s2.tojson()
+    got = s2.eval_dict({**a2, **x2, "data": x})
+    got = (got[0] if isinstance(got, list) else got).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_int8_chain_propagation():
+    """conv -> relu -> maxpool quantizes into an int8 CHAIN: exactly one
+    dequantize between the conv block and the output, and no fp32
+    Activation/Pooling nodes remain."""
+    from incubator_mxnet_tpu.contrib.quantization import quantize_graph
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, name="c1", kernel=(3, 3), num_filter=8,
+                           pad=(1, 1), no_bias=True)
+    r = mx.sym.Activation(c, act_type="relu", name="r1")
+    p = mx.sym.Pooling(r, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="p1")
+    qsym = quantize_graph(p, quantized_dtype="int8")
+    js = qsym.tojson()
+    assert "_contrib_quantized_conv" in js
+    assert "_contrib_quantized_act" in js
+    assert "_contrib_quantized_pooling" in js
+    # the fp32 forms are gone
+    import json as _json
+    nodes = _json.loads(js)["nodes"]
+    names = [n["op"] for n in nodes]
+    assert "Activation" not in names and "Pooling" not in names
+    # numerically sane vs fp32
+    rng = np.random.RandomState(1)
+    w = rng.randn(8, 3, 3, 3).astype(np.float32) * 0.2
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    ref = p.eval_dict({"c1_weight": nd.array(w), "data": nd.array(x)})
+    ref = (ref[0] if isinstance(ref, list) else ref).asnumpy()
+    got = qsym.eval_dict({"c1_weight": nd.array(w), "data": nd.array(x)})
+    got = (got[0] if isinstance(got, list) else got).asnumpy()
+    assert np.abs(got - ref).max() < 0.1 * max(1.0, np.abs(ref).max())
+
+
+def test_zoo_resnet18_int8_end_to_end(tmp_path):
+    """Quantize a model-zoo resnet18 via the calibration driver and gate
+    the int8/fp32 prediction agreement (reference: the quantization
+    example's accuracy comparison over resnet)."""
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.contrib.quantization import (fold_batchnorm,
+                                                          quantize_model)
+    import incubator_mxnet_tpu.io as mio
+
+    net = gluon.model_zoo.vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.zeros((1, 3, 32, 32), np.float32)))
+    prefix = str(tmp_path / "rn18")
+    net.export(prefix)
+    sym, args, aux = mx.model.load_checkpoint(prefix, 0)
+
+    sym, args, aux = fold_batchnorm(sym, args, aux)
+    assert "BatchNorm" not in sym.tojson()
+
+    rng = np.random.RandomState(0)
+    calib_x = rng.rand(16, 3, 32, 32).astype(np.float32)
+    calib = mio.NDArrayIter(data=calib_x, batch_size=8)
+    qsym, qargs, qaux = quantize_model(
+        sym, args, aux, data_names=("data",), calib_mode="naive",
+        calib_data=calib, num_calib_examples=16, quantized_dtype="int8")
+    js = qsym.tojson()
+    assert "_contrib_quantized_conv" in js
+    assert "_contrib_quantized_act" in js
+
+    test_x = rng.rand(32, 3, 32, 32).astype(np.float32)
+    ref = sym.eval_dict({**args, **aux, "data": nd.array(test_x)})
+    ref = (ref[0] if isinstance(ref, list) else ref).asnumpy()
+    got = qsym.eval_dict({**qargs, **qaux, "data": nd.array(test_x)})
+    got = (got[0] if isinstance(got, list) else got).asnumpy()
+    agree = (ref.argmax(1) == got.argmax(1)).mean()
+    assert agree >= 0.9, f"int8 top-1 agreement {agree}"
