@@ -146,6 +146,54 @@ def bench_inference(batch, dtype, steps, image_size=224):
     return batch * steps / dt
 
 
+def bench_transformer(steps=20):
+    """Transformer-LM flagship train step (models/transformer.py): the
+    matmul-bound workload where the MXU shows its real utilization —
+    ResNet-50's conv backward is HBM-bound at ~16% MFU by roofline
+    (docs/perf_notes.md), a transformer step is not. GPT-style 12x1024
+    model, seq 2048, batch 32, Adam, remat, bf16; one scanned
+    multi-step program. Returns (tokens_per_sec, mfu)."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.models.transformer import (TransformerConfig,
+                                                        TransformerLM)
+    from incubator_mxnet_tpu.parallel import make_mesh
+
+    import sys as _sys
+    _sys.setrecursionlimit(20000)   # 30-step scan of a 12-layer remat graph
+    B, T, L, D = 32, 2048, 12, 1024
+    cfg = TransformerConfig(vocab_size=32000, d_model=D, n_heads=16,
+                            n_layers=L, d_ff=4 * D, max_len=T,
+                            dtype="bfloat16", remat=True)
+    model = TransformerLM(cfg)
+    mesh = make_mesh({"dp": 1})
+    step, shard_params, init_opt = model.make_train_step(
+        mesh, lr=1e-3, use_sp=False, n_steps=steps)
+    params = shard_params(model.init_params(jax.random.PRNGKey(0)))
+    n_matmul = sum(v.size for k, v in params.items()
+                   if k.endswith(("wq", "wk", "wv", "wo", "w_in", "w_out")))
+    n_embed = params["embed"].size
+    opt = init_opt(params)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T))
+                         .astype(np.int32))
+    targets = jnp.asarray(np.roll(np.asarray(tokens), -1, 1))
+
+    params, opt, loss = step(params, opt, tokens, targets, 0)  # compile
+    _sync(loss)
+    t0 = time.perf_counter()
+    params, opt, loss = step(params, opt, tokens, targets, steps)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    tok_s = B * T * steps / dt
+    # 6*N per token over matmul+embedding-output params, plus the
+    # attention quadratic: fwd 4*B*T^2*D per layer, x3 for train
+    flops_step = 6.0 * (n_matmul + n_embed) * B * T + 12.0 * L * B * T * T * D
+    _, peak = _device_peak()
+    mfu = flops_step * steps / dt / peak if peak else None
+    return tok_s, mfu
+
+
 def bench_int8_inference(batch, steps, image_size=224):
     """INT8 inference through the quantization driver: zoo resnet50 ->
     export -> BatchNorm fold -> calibrated int8 graph (quantized conv/fc
@@ -284,6 +332,20 @@ def main():
                 "value": results[-1]["img_per_sec"], "unit": "img/s",
                 "vs_baseline": results[-1]["vs_baseline"]}), flush=True)
             head_printed = True
+
+    if on_tpu:
+        try:
+            tok_s, tmfu = bench_transformer()
+            results.append({"mode": "transformer_train", "batch": 32,
+                            "dtype": "bfloat16",
+                            "tokens_per_sec": round(tok_s, 1),
+                            "mfu": round(tmfu, 4) if tmfu else None,
+                            "vs_baseline": None})
+            print(f"[bench] transformer train (12x1024, seq 2048, bf16) "
+                  f"{tok_s:9.0f} tok/s  MFU {tmfu*100:5.1f}%",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] transformer: FAILED {e!r}", file=sys.stderr)
 
     print(f"[bench] device: {kind} ({platform}), timed steps: "
           f"{args.steps or 'per-config'}", file=sys.stderr)

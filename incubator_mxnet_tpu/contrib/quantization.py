@@ -192,10 +192,25 @@ def _propagate_int8(sym):
     q_pool = _registry.get_op("_contrib_quantized_pooling")
     q_flat = _registry.get_op("_contrib_quantized_flatten")
     q_add = _registry.get_op("_contrib_quantized_elemwise_add")
+    q_v2 = _registry.get_op("_contrib_quantize_v2")
+    req_op = _registry.get_op("_contrib_requantize")
+    int32_producers = (_registry.get_op("_contrib_quantized_conv"),
+                       _registry.get_op("_contrib_quantized_fully_connected"),
+                       q_add)
 
     def is_dq(entry):
         node, oi = entry
         return node.op is dq_op and oi == 0
+
+    def _traces_to_int32(node, passthrough, producers):
+        """Code width of a quantized chain: walk the range-preserving ops
+        (act/pool/flatten keep their input's dtype) back to the ultimate
+        producer; int32 iff it is a conv/fc/add accumulator."""
+        seen = 0
+        while node.op in passthrough and seen < 64:
+            node = node.inputs[0][0]
+            seen += 1
+        return node.op in producers
 
     for _ in range(32):          # fixpoint; each pass sinks one layer
         order = _topo(sym._outputs)
@@ -239,6 +254,25 @@ def _propagate_int8(sym):
                             [lq, rq, llo, lhi, rlo, rhi],
                             arg_names=["lhs", "rhs", "lhs_min", "lhs_max",
                                        "rhs_min", "rhs_max"])
+            elif node.op is q_v2 and is_dq(ins[0]) and \
+                    _traces_to_int32(ins[0][0].inputs[0][0],
+                                     (q_act, q_pool, q_flat),
+                                     int32_producers):
+                # dequantize(int32) -> quantize_v2 collapses to ONE
+                # requantize (reference requantize-inl.h: the int32
+                # accumulator -> int8 bridge without an fp32 round trip).
+                # quantize_v2 and requantize have the same 3-output arity,
+                # so consumers remap directly with no dequantize wrapper.
+                q, lo, hi = ins[0][0].inputs
+                attrs = {"out_type": node.attrs.get("out_type", "int8")}
+                for k in ("min_calib_range", "max_calib_range"):
+                    if k in node.attrs:
+                        attrs[k] = node.attrs[k]
+                mapping[id(node)] = (_Node(
+                    req_op, f"requantized_{node.name}", attrs, [q, lo, hi],
+                    arg_names=["qdata", "min_range", "max_range"]), 0)
+                changed = True
+                continue
             if new is not None:
                 dq = _Node(dq_op, f"{node.name}_dequantize", {},
                            [(new, 0), (new, 1), (new, 2)],
@@ -283,6 +317,7 @@ def fold_batchnorm(sym, arg_params, aux_params):
         return (mapping[id(node)], idx) if id(node) in mapping else entry
 
     output_ids = {id(n) for n, _ in sym._outputs}
+    folded_weights = set()
     for node in order:
         if node.op is None or node.op.name != "BatchNorm":
             continue
@@ -315,6 +350,10 @@ def fold_batchnorm(sym, arg_params, aux_params):
                 b_name = inp.name
         if w_name is None or w_name not in arg2:
             continue
+        if w_name in folded_weights:
+            continue   # weight shared by another folded conv: a second
+            # in-place rescale would compound the scales
+        folded_weights.add(w_name)
         w = arg2[w_name].asnumpy()
         b = arg2[b_name].asnumpy() if b_name and b_name in arg2 else \
             _np2.zeros(w.shape[0], w.dtype)

@@ -107,6 +107,11 @@ def get_model_file(name, root=None):
     with zipfile.ZipFile(zip_path) as zf:
         zf.extractall(root)
     os.remove(zip_path)
+    if not os.path.exists(file_path):
+        raise MXNetError(
+            f"downloaded archive did not contain {file_name}.params — "
+            "the mirror's zip layout must match the reference repo "
+            "(flat <name>-<hash8>.params entry)")
     if not check_sha1(file_path, sha1):
         raise MXNetError("Downloaded file has different hash. "
                          "Please try again.")

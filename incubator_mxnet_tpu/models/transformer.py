@@ -160,12 +160,17 @@ class TransformerLM:
                                       "ln2_g", "ln2_b", "w_in", "w_out")]
         return names
 
-    def make_train_step(self, mesh, lr=1e-3, use_sp=True):
+    def make_train_step(self, mesh, lr=1e-3, use_sp=True, n_steps=None):
         """Fully-sharded train step: dp on batch, tp on weights, sp on
         sequence (ring attention through shard_map). Adam in fp32 master
         precision. Returns (step_fn, shard_params_fn, init_opt_fn);
         step_fn(params, opt_state, tokens, targets, step_i) -> (params,
-        opt_state, loss) with params/opt_state donated."""
+        opt_state, loss) with params/opt_state donated.
+
+        n_steps: compile a MULTI-step program — lax.scan of the step with
+        params/opt carried on device, one dispatch for the whole window
+        (the TrainStep.run_steps analog; per-step RNG/step_i advance in
+        the scan)."""
         from ..parallel._compat import shard_map
         from ..parallel.tensor_parallel import transformer_param_specs
 
@@ -230,6 +235,21 @@ class TransformerLM:
                                             opt_state[k], t)
                 new_params[k] = w32.astype(params[k].dtype)
             return new_params, new_opt, loss
+
+        if n_steps:
+            from jax import lax
+
+            def multi(params, opt_state, tokens, targets, step0,
+                      _one=step):
+                def body(carry, i):
+                    p, o = carry
+                    p, o, l = _one(p, o, tokens, targets, step0 + i)
+                    return (p, o), l
+                (p, o), losses = lax.scan(body, (params, opt_state),
+                                          jnp.arange(n_steps))
+                return p, o, losses[-1]
+
+            step = multi
 
         in_shardings = (
             {n: NamedSharding(mesh, s) for n, s in pspec.items()},

@@ -92,7 +92,13 @@ def _sample_one(csr, seed, probability, num_hops, num_neighbor,
                 cols, eids = cols[pick], eids[pick]
             else:
                 w = probability[cols]
-                w = w / w.sum()
+                total = w.sum()
+                if total <= 0:
+                    raise MXNetError(
+                        f"non-uniform sampling: vertex {v} has "
+                        f"{len(cols)} neighbors but zero total "
+                        "probability mass")
+                w = w / total
                 pick = rng.choice(len(cols), num_neighbor, replace=False,
                                   p=w)
                 # reference quirk (GetNonUniformSample, dgl_graph.cc:500):
@@ -203,18 +209,18 @@ def dgl_subgraph(graph, *varrays, return_mapping=False, num_args=None):
 
 def edge_id(data, u, v):
     """out[i] = data[u[i], v[i]] if the edge exists else -1
-    (dgl_graph.cc:1300 _contrib_edge_id)."""
-    dat, indices, indptr, _ = _csr_parts(data)
+    (dgl_graph.cc:1300 _contrib_edge_id). Values keep the CSR's own data
+    dtype (float edge data stays float — no int64 round trip)."""
+    dat = _np.asarray(data.data.asnumpy())
+    _, indices, indptr, _ = _csr_parts(data)
     uu, vv = _as_1d_int(u), _as_1d_int(v)
-    out = _np.full(len(uu), -1, _np.float32 if
-                   _np.issubdtype(_np.asarray(data.data.asnumpy()).dtype,
-                                  _np.floating) else _np.int64)
+    out = _np.full(len(uu), -1, dat.dtype)
     for i, (a, b) in enumerate(zip(uu, vv)):
         lo, hi = int(indptr[a]), int(indptr[a + 1])
         hit = _np.nonzero(indices[lo:hi] == b)[0]
         if len(hit):
             out[i] = dat[lo + hit[0]]
-    return _nd(out.astype(_np.asarray(data.data.asnumpy()).dtype))
+    return _nd(out)
 
 
 def dgl_adjacency(data):

@@ -75,7 +75,11 @@ def _make_update_rule(opt_name, lr, momentum, wd, opt_kwargs):
         eps = float(kw.pop("epsilon", 1e-8))
 
         def upd(w, g, st, t):
-            alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+            # jnp.power, not `float ** t`: a traced t (multi-step scan)
+            # sends __rpow__ through a ufunc path that recurses
+            tt = jnp.asarray(t, jnp.float32)
+            alpha = lr * jnp.sqrt(1 - jnp.power(b2, tt)) / \
+                (1 - jnp.power(b1, tt))
             w2, m2, v2 = _oo.adam_update.fn(w, g, st[0], st[1], lr=alpha,
                                             beta1=b1, beta2=b2, epsilon=eps,
                                             wd=wd, **common)

@@ -280,3 +280,32 @@ def test_zoo_resnet18_int8_end_to_end(tmp_path):
     got = (got[0] if isinstance(got, list) else got).asnumpy()
     agree = (ref.argmax(1) == got.argmax(1)).mean()
     assert agree >= 0.9, f"int8 top-1 agreement {agree}"
+
+
+def test_requantize_fusion_in_chain():
+    """conv -> conv chains bridge int32 -> int8 through ONE requantize
+    (no fp32 round trip): the quantized graph must contain
+    _contrib_requantize and have fewer dequantize nodes than convs."""
+    from incubator_mxnet_tpu.contrib.quantization import quantize_graph
+    import json as _json
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, name="c1", kernel=(3, 3), num_filter=8,
+                            pad=(1, 1), no_bias=True)
+    r1 = mx.sym.Activation(c1, act_type="relu", name="r1")
+    c2 = mx.sym.Convolution(r1, name="c2", kernel=(3, 3), num_filter=8,
+                            pad=(1, 1), no_bias=True)
+    qsym = quantize_graph(c2, quantized_dtype="int8")
+    names = [n["op"] for n in _json.loads(qsym.tojson())["nodes"]]
+    assert "_contrib_requantize" in names
+    # numerics still track fp32
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(8, 3, 3, 3).astype(np.float32) * 0.2
+    w2 = rng.randn(8, 8, 3, 3).astype(np.float32) * 0.2
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    feed = {"c1_weight": nd.array(w1), "c2_weight": nd.array(w2),
+            "data": nd.array(x)}
+    ref = c2.eval_dict(dict(feed))
+    ref = (ref[0] if isinstance(ref, list) else ref).asnumpy()
+    got = qsym.eval_dict(dict(feed))
+    got = (got[0] if isinstance(got, list) else got).asnumpy()
+    assert np.abs(got - ref).max() < 0.15 * max(1.0, np.abs(ref).max())
