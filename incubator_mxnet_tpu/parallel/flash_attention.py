@@ -136,7 +136,7 @@ def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 
 def _pick_block(t, preferred):
-    for b in (preferred, 256, 128, 64, 32, 16, 8):
+    for b in (preferred, 512, 256, 128, 64, 32, 16, 8):
         if b <= t and t % b == 0:
             return b
     return None
@@ -152,8 +152,10 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale):
 
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    bq = _pick_block(Tq, 256)
-    bk = _pick_block(Tk, 512)
+    # v5e-tuned: (512, 1024) measured 22.3 TF/s fwd vs 4.5 at (256, 512)
+    # and 14.8 for XLA's fused attention (docs/perf_notes.md)
+    bq = _pick_block(Tq, 512)
+    bk = _pick_block(Tk, 1024)
     if not pallas_available() or bq is None or bk is None or D % 8:
         return attention_reference(q, k, v, causal=causal,
                                    sm_scale=sm_scale)
@@ -187,31 +189,35 @@ def _flash_vjp_bwd(causal, sm_scale, res, g):
 
     f32 = jnp.float32
     n = Tq // bq
-    k32, v32 = k.astype(f32), v.astype(f32)
     qs = q.reshape(B, n, bq, H, D).transpose(1, 0, 2, 3, 4)
     gs = g.reshape(B, n, bq, H, D).transpose(1, 0, 2, 3, 4)
     cols = jnp.arange(Tk)
+    # matmul operands stay in the INPUT dtype (bf16 = full MXU rate; fp32
+    # operands force multi-pass emulation) with f32 accumulation via
+    # preferred_element_type; only the softmax/rescale math runs f32 —
+    # the same precision split as the forward Pallas kernel
+    ein = functools.partial(jnp.einsum, preferred_element_type=f32)
 
     def step(carry, inp):
         dk, dv = carry
         i, qb, gb = inp
-        qb32, gb32 = qb.astype(f32), gb.astype(f32)
-        s = jnp.einsum("bqhd,bkhd->bhqk", qb32, k32) * sm_scale
+        s = ein("bqhd,bkhd->bhqk", qb, k) * sm_scale
         if causal:
             rows = i * bq + jnp.arange(bq)
             s = jnp.where((rows[:, None] >= cols[None, :])[None, None],
                           s, _NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        dv_new = dv + jnp.einsum("bhqk,bqhd->bkhd", p, gb32)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", gb32, v32)
+        pc = p.astype(q.dtype)
+        dv_new = dv + ein("bhqk,bqhd->bkhd", pc, gb)
+        dp = ein("bqhd,bkhd->bhqk", gb, v)
         delta = jnp.sum(dp * p, axis=-1, keepdims=True)
-        ds = p * (dp - delta)
-        dqb = jnp.einsum("bhqk,bkhd->bqhd", ds, k32) * sm_scale
-        dk_new = dk + jnp.einsum("bhqk,bqhd->bkhd", ds, qb32) * sm_scale
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dqb = ein("bhqk,bkhd->bqhd", ds, k) * sm_scale
+        dk_new = dk + ein("bhqk,bqhd->bkhd", ds, qb) * sm_scale
         return (dk_new, dv_new), dqb
 
     (dk, dv), dqs = lax.scan(
-        step, (jnp.zeros_like(k32), jnp.zeros_like(v32)),
+        step, (jnp.zeros((B, Tk, H, D), f32), jnp.zeros((B, Tk, H, D), f32)),
         (jnp.arange(n), qs, gs))
     dq = dqs.transpose(1, 0, 2, 3, 4).reshape(B, Tq, H, D)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)

@@ -139,3 +139,37 @@ def test_blocked_backward_noncausal_cross():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-4)
+
+
+def test_blocked_backward_bf16_grad_parity():
+    """The blocked backward's matmuls run bf16-operand/f32-accumulate; a
+    T large enough to take the SCAN path (not the dense fallback) in bf16
+    must still track the reference gradients within mixed-precision
+    tolerance."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.parallel.flash_attention import flash_attention
+    from incubator_mxnet_tpu.parallel.ring_attention import attention_reference
+
+    rng = np.random.RandomState(0)
+    B, T, H, D = 1, 1024, 2, 32
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)).astype(jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)).astype(jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)).astype(jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        a32 = np.asarray(a, dtype=np.float32)
+        b32 = np.asarray(b, dtype=np.float32)
+        scale = max(1e-3, np.abs(b32).max())
+        err = np.abs(a32 - b32).max() / scale
+        assert err < 0.05, (name, err)
