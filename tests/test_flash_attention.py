@@ -173,3 +173,33 @@ def test_blocked_backward_bf16_grad_parity():
         scale = max(1e-3, np.abs(b32).max())
         err = np.abs(a32 - b32).max() / scale
         assert err < 0.05, (name, err)
+
+
+def test_scan_fallback_backward(monkeypatch):
+    """Force the no-pallas path: the XLA lax.scan backward fallback must
+    still produce reference-matching gradients (it covers unimportable
+    pallas and untileable shapes in production)."""
+    import jax
+    import jax.numpy as jnp
+    import importlib
+    FA = importlib.import_module(
+        "incubator_mxnet_tpu.parallel.flash_attention")
+    from incubator_mxnet_tpu.parallel.ring_attention import \
+        attention_reference
+
+    monkeypatch.setattr(FA, "pallas_available", lambda: False)
+    rng = np.random.RandomState(0)
+    B, T, H, D = 1, 512, 2, 32
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(
+        FA.flash_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        attention_reference(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
