@@ -47,6 +47,20 @@ def pallas_available():
         return False
 
 
+def _causal_mask(s, q_off, k_off, transposed=False):
+    """Mask `s` to the causal (q_row >= k_row) region. s is
+    (block_q, block_k), or (block_k, block_q) when transposed."""
+    from jax import lax
+    shape = s.shape
+    a = lax.broadcasted_iota(jnp.int32, shape, 0)
+    b = lax.broadcasted_iota(jnp.int32, shape, 1)
+    if transposed:                       # rows are k, cols are q
+        keep = (q_off + b) >= (k_off + a)
+    else:                                # rows are q, cols are k
+        keep = (q_off + a) >= (k_off + b)
+    return jnp.where(keep, s, _NEG_INF)
+
+
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
                block_q, block_k, causal, sm_scale):
     """One (batch*head, q_block, kv_block) grid step. The kv axis is the
@@ -86,11 +100,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
                             precision=lax.Precision.DEFAULT,
                             preferred_element_type=jnp.float32)
         if causal:
-            rows = q_offset + lax.broadcasted_iota(jnp.int32,
-                                                   (block_q, block_k), 0)
-            cols = j * block_k + lax.broadcasted_iota(jnp.int32,
-                                                      (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, _NEG_INF)
+            s = _causal_mask(s, q_offset, j * block_k)
         m_prev = m_sc[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
@@ -207,11 +217,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                             precision=lax.Precision.DEFAULT,
                             preferred_element_type=jnp.float32)
         if causal:
-            rows = q_off + lax.broadcasted_iota(jnp.int32,
-                                                (block_q, block_k), 0)
-            cols = j * block_k + lax.broadcasted_iota(jnp.int32,
-                                                      (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, _NEG_INF)
+            s = _causal_mask(s, q_off, j * block_k)
         p = jnp.exp(s - lse_ref[0])
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              precision=lax.Precision.DEFAULT,
@@ -254,15 +260,12 @@ def _fa_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0]
         q = q_ref[0]
         do = do_ref[0]
-        st = lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+        qs = q * jnp.asarray(sm_scale, q.dtype)   # match the forward
+        st = lax.dot_general(k, qs, (((1,), (1,)), ((), ())),
                              precision=lax.Precision.DEFAULT,
-                            preferred_element_type=jnp.float32) * sm_scale
+                             preferred_element_type=jnp.float32)
         if causal:
-            rows = k_off + lax.broadcasted_iota(jnp.int32,
-                                                (block_k, block_q), 0)
-            cols = q_off + lax.broadcasted_iota(jnp.int32,
-                                                (block_k, block_q), 1)
-            st = jnp.where(cols >= rows, st, _NEG_INF)
+            st = _causal_mask(st, q_off, k_off, transposed=True)
         pt = jnp.exp(st - lse_ref[0][:, 0][None, :])
         dv_sc[:] += lax.dot_general(pt.astype(do.dtype), do,
                                     (((1,), (0,)), ((), ())),
@@ -376,7 +379,9 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, want_lse=False):
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale):
     out, lse = _flash_fwd_impl(q, k, v, causal, sm_scale, want_lse=True)
-    return out, (q, k, v, out, lse)
+    # the scan fallback recomputes everything from q/k/v — keeping `out`
+    # alive would cost an activation-sized residual for nothing
+    return out, (q, k, v, out if lse is not None else None, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, res, g):
