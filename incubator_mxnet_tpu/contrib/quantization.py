@@ -304,11 +304,17 @@ def fold_batchnorm(sym, arg_params, aux_params):
     aux2 = dict(aux_params or {})
     order = _topo(sym._outputs)
     consumers = {}
+    nonzero_out_use = set()   # node ids consumed at an output index != 0
     for n in order:
         if n.op is None:
             continue
         for (i, oi) in n.inputs:
             consumers.setdefault(id(i), []).append(n)
+            if oi != 0:
+                nonzero_out_use.add(id(i))
+    for n, i in sym._outputs:
+        if i != 0:
+            nonzero_out_use.add(id(n))
 
     mapping = {}
 
@@ -324,6 +330,10 @@ def fold_batchnorm(sym, arg_params, aux_params):
         (src, src_oi) = node.inputs[0]
         if src.op is None or src.op.name != "Convolution" or src_oi != 0:
             continue
+        if id(node) in nonzero_out_use:
+            continue   # some consumer reads BN output 1/2 (mean/var);
+            # the fused conv exposes only output 0, so folding would hand
+            # that consumer conv activations — keep the BN
         if len(consumers.get(id(src), [])) != 1 or id(src) in output_ids:
             continue   # conv output used elsewhere / exposed: keep BN
             # (folding mutates the conv WEIGHTS, so every consumer of the

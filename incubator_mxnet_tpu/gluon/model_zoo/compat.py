@@ -96,3 +96,15 @@ def load_reference_parameters(net, filename, strict=True):
     for o, t in mapping.items():
         ours[o].set_data(theirs[t])
     return mapping
+
+
+def load_pretrained(net, name, root=None):
+    """Shared pretrained=True path for every zoo factory (reference
+    python/mxnet/gluon/model_zoo/vision/*.py: each factory calls
+    get_model_file + load_parameters). Resolves `name` through the
+    sha1-verified model_store cache and loads the reference-format
+    .params via the role-sequence compat mapper, so pretrained=True can
+    never silently return random weights."""
+    from .model_store import get_model_file
+    load_reference_parameters(net, get_model_file(name, root=root))
+    return net

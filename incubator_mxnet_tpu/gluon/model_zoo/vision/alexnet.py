@@ -41,5 +41,9 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
-    """Reference alexnet() factory (pretrained download not shipped)."""
-    return AlexNet(**kwargs)
+    """Reference alexnet() factory (vision/alexnet.py)."""
+    net = AlexNet(**kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, "alexnet", root=root)
+    return net

@@ -95,7 +95,11 @@ def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     if num_layers not in _SPECS:
         raise MXNetError(f"no densenet spec for depth {num_layers}")
     stem, growth, blocks = _SPECS[num_layers]
-    return DenseNet(stem, growth, blocks, **kwargs)
+    net = DenseNet(stem, growth, blocks, **kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, f"densenet{num_layers}", root=root)
+    return net
 
 
 def _ctor(depth):

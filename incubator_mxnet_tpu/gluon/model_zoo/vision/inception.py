@@ -150,6 +150,9 @@ class Inception3(HybridBlock):
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
-    """Constructor-parity entry; pretrained weights are not shipped
-    (zero-egress build) — use load_parameters on a local file."""
-    return Inception3(**kwargs)
+    """Reference inception_v3() factory (vision/inception.py)."""
+    net = Inception3(**kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, "inceptionv3", root=root)
+    return net

@@ -116,12 +116,20 @@ class MobileNetV2(HybridBlock):
 
 
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
-    return MobileNet(multiplier, **kwargs)
+    net = MobileNet(multiplier, **kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, f"mobilenet{float(multiplier)}", root=root)
+    return net
 
 
 def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
-    return MobileNetV2(multiplier, **kwargs)
+    net = MobileNetV2(multiplier, **kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, f"mobilenetv2_{float(multiplier)}", root=root)
+    return net
 
 
 def _ctor(factory, mult, name):

@@ -188,10 +188,8 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
         raise MXNetError(f"resnet version must be 1 or 2, got {version}")
     net = (ResNetV1 if version == 1 else ResNetV2)(num_layers, **kwargs)
     if pretrained:
-        from ..compat import load_reference_parameters
-        from ..model_store import get_model_file
-        path = get_model_file(f"resnet{num_layers}_v{version}", root=root)
-        load_reference_parameters(net, path)
+        from ..compat import load_pretrained
+        load_pretrained(net, f"resnet{num_layers}_v{version}", root=root)
     return net
 
 

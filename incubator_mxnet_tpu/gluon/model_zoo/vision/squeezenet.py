@@ -63,7 +63,11 @@ class SqueezeNet(HybridBlock):
 
 
 def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
-    return SqueezeNet(version, **kwargs)
+    net = SqueezeNet(version, **kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, f"squeezenet{version}", root=root)
+    return net
 
 
 def squeezenet1_0(**kwargs):

@@ -44,7 +44,12 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     if num_layers not in vgg_spec:
         raise MXNetError(f"invalid vgg depth {num_layers}")
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        bn = "_bn" if kwargs.get("batch_norm") else ""
+        load_pretrained(net, f"vgg{num_layers}{bn}", root=root)
+    return net
 
 
 def vgg11(**kwargs):

@@ -138,17 +138,21 @@ def _sample_one(csr, seed, probability, num_hops, num_neighbor,
 
 def dgl_csr_neighbor_uniform_sample(csr_matrix, *seed_arrays, num_args=None,
                                     num_hops=1, num_neighbor=2,
-                                    max_num_vertices=100, rng=None):
+                                    max_num_vertices=100, rng=None,
+                                    seed=None):
     """Uniform CSR neighborhood sampling
     (reference _contrib_dgl_csr_neighbor_uniform_sample,
     dgl_graph.cc:744). Returns, per seed array: a (max+1,) vertex array
     (count in the last slot), the sampled sub-CSR with ORIGINAL edge ids,
     and a (max,) per-vertex layer array — flattened into one list ordered
     [vers..., csrs..., layers...]."""
-    rng = rng or _np.random
+    # default keeps np.random.seed() reproducibility; pass seed= (or an
+    # rng) for isolation from global RNG state
+    rng = rng if rng is not None else (
+        _np.random.RandomState(seed) if seed is not None else _np.random)
     outs_v, outs_c, outs_l = [], [], []
-    for seed in seed_arrays:
-        ver, layer, parts, _ = _sample_one(csr_matrix, seed, None, num_hops,
+    for seed_arr in seed_arrays:
+        ver, layer, parts, _ = _sample_one(csr_matrix, seed_arr, None, num_hops,
                                            num_neighbor, max_num_vertices,
                                            rng)
         outs_v.append(_nd(ver))
@@ -160,17 +164,21 @@ def dgl_csr_neighbor_uniform_sample(csr_matrix, *seed_arrays, num_args=None,
 def dgl_csr_neighbor_non_uniform_sample(csr_matrix, probability,
                                         *seed_arrays, num_args=None,
                                         num_hops=1, num_neighbor=2,
-                                        max_num_vertices=100, rng=None):
+                                        max_num_vertices=100, rng=None,
+                                        seed=None):
     """Weighted sampling variant (dgl_graph.cc:838): neighbors drawn
     without replacement proportionally to `probability[neighbor]`. Adds a
     per-subgraph (max,) vertex-probability output after the CSRs."""
-    rng = rng or _np.random
+    # default keeps np.random.seed() reproducibility; pass seed= (or an
+    # rng) for isolation from global RNG state
+    rng = rng if rng is not None else (
+        _np.random.RandomState(seed) if seed is not None else _np.random)
     prob = _np.asarray(
         probability.asnumpy() if hasattr(probability, "asnumpy")
         else probability, _np.float32).reshape(-1)
     outs_v, outs_c, outs_p, outs_l = [], [], [], []
-    for seed in seed_arrays:
-        ver, layer, parts, pr = _sample_one(csr_matrix, seed, prob,
+    for seed_arr in seed_arrays:
+        ver, layer, parts, pr = _sample_one(csr_matrix, seed_arr, prob,
                                             num_hops, num_neighbor,
                                             max_num_vertices, rng)
         outs_v.append(_nd(ver))
