@@ -1,0 +1,120 @@
+"""incubator_mxnet_tpu: a TPU-native deep-learning framework with the
+capabilities of Apache MXNet (incubating).
+
+Built from scratch on jax/XLA/Pallas/pjit (see SURVEY.md for the structural
+analysis of the reference at /root/reference). The user surface mirrors MXNet
+1.5 — `mx.nd`, `mx.autograd`, `mx.gluon`, `mx.sym`, `mx.mod`, KVStore — while
+the runtime is idiomatic TPU: XLA owns scheduling/memory (no ThreadedEngine
+port), `hybridize()` is jax.jit tracing, distributed training rides
+jax.sharding Meshes and ICI collectives rather than NCCL/ps-lite.
+
+Typical use:
+    import incubator_mxnet_tpu as mx
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+
+def _configure_jax():
+    # MXNet fp32 semantics: a float32 matmul/conv accumulates in float32.
+    # JAX's default on TPU (and the virtual CPU backend) lowers fp32 dots to
+    # bf16 passes; force full precision globally. Performance-critical paths
+    # (bench, model zoo inference/training in bf16) pass bf16 inputs, which is
+    # the idiomatic TPU way to use the MXU and is unaffected by this setting.
+    import os
+    import jax
+    jax.config.update("jax_default_matmul_precision", "highest")
+    # Persistent XLA compilation cache: eager mode compiles one executable per
+    # (op, shape) like the reference's cudnn autotune cache persists algo
+    # choices (src/operator/nn/cudnn/cudnn_algoreg*) — ours persists whole
+    # binaries across processes.
+    cache_dir = os.environ.get("MXTPU_COMPILE_CACHE",
+                               os.path.expanduser("~/.cache/mxtpu_xla"))
+    if cache_dir and cache_dir != "0":
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:
+            pass
+
+
+_configure_jax()
+
+from . import base
+from .base import MXNetError, MXTPUError
+from . import context
+from .context import Context, cpu, cpu_pinned, cpu_shared, current_context, gpu, tpu
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from .ndarray import random as _nd_random
+
+
+class _RandomModule:
+    """mx.random — seeds the global key chain (reference python/mxnet/random.py)."""
+    seed = staticmethod(_nd_random.seed)
+    uniform = staticmethod(_nd_random.uniform)
+    normal = staticmethod(_nd_random.normal)
+    randn = staticmethod(_nd_random.randn)
+    randint = staticmethod(_nd_random.randint)
+    shuffle = staticmethod(_nd_random.shuffle)
+    multinomial = staticmethod(_nd_random.multinomial)
+
+
+random = _RandomModule()
+
+
+def __getattr__(name):
+    # heavier subsystems load lazily to keep import light
+    import importlib
+    lazy = {
+        "gluon": ".gluon",
+        "optimizer": ".optimizer",
+        "metric": ".metric",
+        "initializer": ".initializer",
+        "init": ".initializer",
+        "lr_scheduler": ".lr_scheduler",
+        "io": ".io",
+        "image": ".image",
+        "recordio": ".recordio",
+        "kvstore": ".kvstore",
+        "kv": ".kvstore",
+        "symbol": ".symbol",
+        "sym": ".symbol",
+        "module": ".module",
+        "mod": ".module",
+        "model": ".model",
+        "callback": ".callback",
+        "monitor": ".monitor",
+        "profiler": ".profiler",
+        "runtime": ".runtime",
+        "parallel": ".parallel",
+        "models": ".models",
+        "util": ".util",
+        "utils": ".util",
+        "test_utils": ".test_utils",
+        "visualization": ".visualization",
+        "viz": ".visualization",
+        "contrib": ".contrib",
+        "amp": ".contrib.amp",
+        "engine": ".engine",
+        "fault": ".fault",
+        "executor": ".executor",
+        "operator": ".operator",
+        "np": ".numpy",
+        "numpy": ".numpy",
+        "npx": ".numpy_extension",
+        "numpy_extension": ".numpy_extension",
+        "torch": ".torch",
+        "rtc": ".rtc",
+    }
+    if name in lazy:
+        m = importlib.import_module(lazy[name], __name__)
+        globals()[name] = m
+        return m
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

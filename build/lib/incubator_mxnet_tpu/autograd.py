@@ -1,0 +1,431 @@
+"""Autograd: record/pause/backward over an eager tape.
+
+Reference: src/imperative/imperative.cc (`RecordOp:193`, `Backward:280`,
+thread-local recording flags :27-31) and python/mxnet/autograd.py
+(`record():122`, `pause():146`, `train_mode():166`, `mark_variables():197`,
+`backward():246`, `grad():273`).
+
+TPU-native redesign: the reference builds an NNVM node tape and replays
+`_backward_*` operators through the dependency engine. Here each recorded op
+already produced a `jax.vjp` closure at forward time (residuals live on
+device), so backward is a reverse-topological walk calling those closures —
+XLA is the "engine"; ordering falls out of jax.Array data dependencies.
+Higher-order gradients work by re-entering record mode around vjp calls.
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "Function"]
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.recording = False
+        self.training = False
+
+
+_tls = _TLS()
+
+
+def is_recording() -> bool:
+    return _tls.recording
+
+
+def is_training() -> bool:
+    return _tls.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _tls.recording = _tls.recording, bool(flag)
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _tls.training = _tls.training, bool(flag)
+    return prev
+
+
+class _RecordScope:
+    def __init__(self, recording, training):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        self._prev_rec = _tls.recording if self._rec is not None else None
+        self._prev_train = _tls.training if self._train is not None else None
+        if self._rec is not None:
+            _tls.recording = self._rec
+        if self._train is not None:
+            _tls.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        if self._rec is not None:
+            _tls.recording = self._prev_rec
+        if self._train is not None:
+            _tls.training = self._prev_train
+
+
+def record(train_mode: bool = True):
+    """`with autograd.record():` — reference python/mxnet/autograd.py:122."""
+    return _RecordScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    """Reference python/mxnet/autograd.py:146."""
+    return _RecordScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordScope(None, True)
+
+
+def predict_mode():
+    return _RecordScope(None, False)
+
+
+class Node:
+    """One recorded op application (reference: AGInfo attached to NDArrays,
+    src/imperative/imperative.cc RecordOp)."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_refs", "out_avals", "out_aliases",
+                 "name", "bwd_info", "replay")
+
+    def __init__(self, vjp_fn, inputs, name=""):
+        self.vjp_fn = vjp_fn     # cotangents-tuple -> input-cotangents tuple
+        self.inputs = inputs     # list of NDArray
+        self.name = name
+        self.out_refs = None     # list of weakrefs to output NDArrays
+        self.out_avals = None    # list of (shape, dtype) for dead outputs
+        self.out_aliases = None  # slot -> extra weakrefs (rewrapped views)
+        # (op, params, saved_args, ndarray_positions) for replaying this
+        # node's backward as a recorded op (create_graph higher-order path)
+        self.bwd_info = None
+        # alternative replay hook for composite nodes (hybridized cached
+        # blocks): callable cts -> recorded input cotangents
+        self.replay = None
+
+    def add_alias(self, orig, view):
+        """Register `view` as another identity of output `orig` so backward
+        routes cotangents arriving via either object (as_np_ndarray/
+        as_nd_ndarray re-class arrays without copying)."""
+        import weakref
+        if not self.out_refs:
+            return
+        for i, ref in enumerate(self.out_refs):
+            if ref() is orig:
+                if self.out_aliases is None:
+                    self.out_aliases = {}
+                self.out_aliases.setdefault(i, []).append(weakref.ref(view))
+                return
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference python/mxnet/autograd.py:197."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._ag_node = None
+
+
+def _collect_tape(heads):
+    """Reverse-topological order of Nodes reachable from head arrays."""
+    order, seen = [], set()
+
+    def visit(node):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for inp in node.inputs:
+            visit(getattr(inp, "_ag_node", None))
+        order.append(node)
+
+    for h in heads:
+        visit(getattr(h, "_ag_node", None))
+    return order[::-1]
+
+
+_BWD_OPDEFS = {}
+
+
+def _record_bwd(node, cts):
+    """Replay `node`'s backward as a RECORDED op so the produced input
+    cotangents are themselves differentiable (create_graph=True). The
+    replayed op recomputes the node's forward under jax.vjp, taking the
+    cotangents AND the original input NDArrays as positional arguments —
+    second derivatives flow through both."""
+    import jax
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+    from .ops import registry as _R
+
+    op, params, saved, nd_pos = node.bwd_info
+    ncts = len(cts)
+    nd_pos_t = tuple(nd_pos)
+
+    def bwd_replay(*args, _op=op, _p=params):
+        cts_ = args[:ncts]
+        primals = args[ncts:]
+        if _op.stateful:
+            def fwd(rng, *xs):
+                return _op.fn(*xs, rng=rng, **_p)
+        else:
+            def fwd(*xs):
+                return _op.fn(*xs, **_p)
+        out, vjp = jax.vjp(fwd, *primals)
+        ct = tuple(_R._match_ct_dtypes(cts_, out)) \
+            if isinstance(out, (tuple, list)) else \
+            _R._match_ct_dtypes(cts_[0], out)
+        gin = vjp(ct)
+        sel = tuple(gin[i] for i in nd_pos_t)
+        # single cotangent returns bare (everywhere else a 1-tuple output
+        # and a single output use different cotangent conventions)
+        return sel[0] if len(sel) == 1 else sel
+
+    key = (id(op), _R._hashable(params), ncts, nd_pos_t)
+    bdef = _BWD_OPDEFS.get(key)
+    if bdef is None:
+        bdef = _R.OpDef(f"_backward_{op.name}", bwd_replay)
+        if len(_BWD_OPDEFS) > 256:
+            _BWD_OPDEFS.pop(next(iter(_BWD_OPDEFS)))
+        _BWD_OPDEFS[key] = bdef
+    args = [NDArray(c) if not isinstance(c, NDArray) else c for c in cts]
+    # primal slots: live NDArray inputs where available (tape-linked),
+    # the saved raw value otherwise (rng keys, non-diff args)
+    prim = list(saved)
+    for j, p in enumerate(nd_pos):
+        prim[p] = node.inputs[j]
+    with record():
+        outs = _R.apply_op(bdef, *args, *prim)
+    # bwd_replay returns cotangents already ordered like node.inputs
+    return outs if isinstance(outs, list) else [outs]
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             create_graph=False):
+    """Compute gradients of heads w.r.t. marked variables.
+
+    Reference python/mxnet/autograd.py:246 -> Imperative::Backward
+    (src/imperative/imperative.cc:280). Gradients accumulate per the variable's
+    grad_req ('write' overwrites, 'add' accumulates, 'null' skips) — the
+    reference's OpReqType semantics (include/mxnet/op_attr_types.h:46-60).
+
+    With create_graph=True each node's backward is replayed as a recorded
+    op (_record_bwd), so the produced gradients carry their own tape and
+    can be differentiated again (reference higher-order autograd).
+    """
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # cotangent accumulator keyed by id(NDArray); in create_graph mode the
+    # accumulated values are NDArrays (recorded adds), else raw jax arrays
+    cot: dict[int, object] = {}
+    keep = {}
+    for h, hg in zip(heads, head_grads):
+        if create_graph:
+            g = hg if hg is not None else NDArray(jnp.ones(h.shape, h.dtype))
+        else:
+            g = hg._data if hg is not None else jnp.ones(h.shape, h.dtype)
+        _accum(cot, keep, h, g)
+
+    order = _collect_tape(heads)
+    if not order and all(getattr(h, "_ag_node", None) is None for h in heads):
+        if not any(getattr(h, "_grad", None) is not None for h in heads):
+            raise MXNetError("backward() called on arrays with no recorded graph")
+
+    # create_graph must record the ENTIRE backward walk — including
+    # cotangent fan-in adds and grad_req='add' accumulation — regardless
+    # of whether the caller is inside a record() scope
+    scope = record() if create_graph else _RecordScope(None, None)
+    with scope:
+        _backward_walk(order, cot, keep, create_graph)
+
+    # write into .grad buffers per grad_req
+    from .ndarray.sparse import RowSparseNDArray, row_sparse_combine
+    from .ndarray import NDArray as _ND
+    for arr_id, (arr, g) in keep.items():
+        req = getattr(arr, "_grad_req", None)
+        if req in (None, "null"):
+            continue
+        if arr._grad is None:
+            continue
+        buf_sparse = isinstance(arr._grad, RowSparseNDArray)
+        if isinstance(g, RowSparseNDArray):
+            if buf_sparse:
+                arr._grad = g if req != "add" else \
+                    row_sparse_combine(arr._grad, g)
+            elif req == "add":
+                # dense buffer keeps its identity (mark_variables aliasing)
+                arr._grad._data = arr._grad._data + g.todense()._data
+            else:
+                arr._grad._data = g.todense()._data.astype(
+                    arr._grad._data.dtype)
+        elif buf_sparse:
+            # dense cotangent into a row_sparse buffer (e.g. a hybridized
+            # step after eager sparse steps): buffer stays row_sparse
+            from .ndarray.sparse import cast_storage
+            dense_g = _ND(jnp.asarray(g._data if isinstance(g, _ND) else g))
+            rs = cast_storage(dense_g, "row_sparse")
+            arr._grad = rs if req != "add" else \
+                row_sparse_combine(arr._grad, rs)
+        elif isinstance(g, _ND):
+            # create_graph path: keep the recorded NDArray (with its tape)
+            # as the grad so it can be differentiated again
+            if req == "add":
+                with record():
+                    arr._grad = g + arr._grad
+            else:
+                arr._grad = g
+        elif req == "add":
+            arr._grad._data = arr._grad._data + g
+        else:
+            arr._grad._data = jnp.asarray(g, arr._grad.dtype)
+
+    if not retain_graph:
+        for node in order:
+            node.vjp_fn = None
+        for h in heads:
+            h._ag_node = None
+
+
+def _backward_walk(order, cot, keep, create_graph):
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+
+    for node in order:
+        cts = []
+        missing_all = True
+        for i, (ref, (shp, dt)) in enumerate(zip(node.out_refs,
+                                                 node.out_avals)):
+            refs = [ref]
+            if node.out_aliases:
+                refs += node.out_aliases.get(i, [])
+            c = None
+            for r in refs:
+                arr = r()
+                cc = cot.pop(id(arr), None) if arr is not None else None
+                if cc is not None:
+                    c = cc if c is None else _add_ct(c, cc)
+            if c is None:
+                z = jnp.zeros(shp, dt)
+                c = NDArray(z) if create_graph else z
+            else:
+                missing_all = False
+            cts.append(c)
+        if missing_all or node.vjp_fn is None:
+            continue
+        if create_graph and node.bwd_info is not None:
+            in_cts = _record_bwd(node, cts)
+        elif create_graph and node.replay is not None:
+            in_cts = node.replay(cts)
+        else:
+            raw = [c._data if isinstance(c, NDArray) else c for c in cts]
+            in_cts = node.vjp_fn(tuple(raw) if len(raw) > 1 else raw[0])
+            if create_graph:
+                # node lacks replay context (custom Function): gradients
+                # are correct but not differentiable further
+                in_cts = [NDArray(g) if g is not None else None
+                          for g in in_cts]
+        for inp, ict in zip(node.inputs, in_cts):
+            if ict is not None:
+                _accum(cot, keep, inp, ict)
+
+
+def _accum(cot, keep, arr, g):
+    k = id(arr)
+    if k in cot:
+        cot[k] = _add_ct(cot[k], g)
+    else:
+        cot[k] = g
+    if getattr(arr, "_grad", None) is not None:
+        keep[k] = (arr, cot[k])
+
+
+def _add_ct(a, b):
+    """Cotangent addition incl. row_sparse + row_sparse/dense mixes."""
+    from .ndarray.sparse import RowSparseNDArray, row_sparse_combine
+
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        return row_sparse_combine(a, b)
+    if isinstance(a, RowSparseNDArray):
+        return a.todense()._data + b
+    if isinstance(b, RowSparseNDArray):
+        return a + b.todense()._data
+    return a + b
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional gradient (reference python/mxnet/autograd.py:273).
+
+    With create_graph=True the returned grads are themselves recorded, enabling
+    higher-order gradients (reference test_higher_order_grad.py).
+    """
+    from .ndarray import NDArray
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        single = True
+    else:
+        single = False
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "null")) for v in variables]
+    for v in variables:
+        from . import nd
+        v._grad = nd.zeros(v.shape, dtype=v.dtype, ctx=v.context)
+        v._grad_req = "write"
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph) or create_graph,
+                 train_mode=train_mode, create_graph=create_graph)
+        outs = [v.grad for v in variables]
+    finally:
+        for v, (g, req) in zip(variables, saved):
+            v._grad, v._grad_req = g, req
+    return outs[0] if single else outs
+
+
+class Function:
+    """Custom differentiable function (reference python/mxnet/autograd.py:368).
+
+    Subclass and implement forward(self, *inputs) and backward(self, *out_grads),
+    both operating on NDArrays with autograd paused.
+    """
+
+    def __call__(self, *inputs):
+        import weakref
+        from .ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            fn = self
+
+            def vjp_fn(cts):
+                cts = (cts,) if single else tuple(cts)
+                with pause():
+                    gin = fn.backward(*[NDArray(c) for c in cts])
+                if isinstance(gin, NDArray):
+                    gin = (gin,)
+                return tuple(g._data if g is not None else None for g in gin)
+
+            node = Node(vjp_fn, list(inputs), type(self).__name__)
+            node.out_refs = [weakref.ref(o) for o in outs]
+            node.out_avals = [(o.shape, o.dtype) for o in outs]
+            for o in outs:
+                o._ag_node = node
+        return outputs

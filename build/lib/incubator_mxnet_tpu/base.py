@@ -1,0 +1,117 @@
+"""Base utilities: dtypes, errors, registry.
+
+TPU-native re-design of the reference's base layer. The reference threads a
+C ABI (`include/mxnet/c_api.h`) and dmlc registries under everything; here the
+"ABI" is jax/XLA, so this module only keeps the shared vocabulary: dtype
+mapping (reference: 3rdparty/mshadow/mshadow/base.h MSHADOW_TYPE_SWITCH),
+the framework error type (reference: dmlc/logging.h CHECK + MXGetLastError,
+src/c_api/c_api_error.cc), and a tiny name->object registry (reference:
+dmlc/registry.h used by operators, iterators, optimizers, metrics).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["MXNetError", "MXTPUError", "Registry", "string_types", "numeric_types",
+           "integer_types", "dtype_np", "dtype_name", "DTYPE_NAMES"]
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: python/mxnet/base.py:75 MXNetError)."""
+
+
+# Alias under the new framework's own name.
+MXTPUError = MXNetError
+
+# dtype vocabulary (reference: python/mxnet/base.py _DTYPE_NP_TO_MX / _DTYPE_MX_TO_NP).
+# TPU-first addition: bfloat16 is a first-class dtype (the MXU's native input type).
+DTYPE_NAMES = {
+    "float32": _np.float32,
+    "float64": _np.float64,
+    "float16": _np.float16,
+    "uint8": _np.uint8,
+    "int32": _np.int32,
+    "int8": _np.int8,
+    "int64": _np.int64,
+    "bool": _np.bool_,
+    "int16": _np.int16,
+    "uint16": _np.uint16,
+    "uint32": _np.uint32,
+    "uint64": _np.uint64,
+}
+
+
+def _bfloat16():
+    import jax.numpy as jnp
+    return jnp.bfloat16
+
+
+def dtype_np(dtype):
+    """Normalize a dtype spec (name/np.dtype/type) to a numpy-compatible dtype object."""
+    if dtype is None:
+        return _np.float32
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return _bfloat16()
+        if dtype in DTYPE_NAMES:
+            return DTYPE_NAMES[dtype]
+        return _np.dtype(dtype).type
+    return dtype
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype."""
+    return str(_np.dtype(dtype).name) if not _is_bf16(dtype) else "bfloat16"
+
+
+def _is_bf16(dtype) -> bool:
+    try:
+        return "bfloat16" in str(dtype)
+    except Exception:  # pragma: no cover
+        return False
+
+
+class Registry:
+    """Name -> object registry with alias support.
+
+    Reference: dmlc/registry.h (operators via NNVM_REGISTER_OP, 338 uses in
+    src/operator/) and python/mxnet/registry.py (optimizers, metrics,
+    initializers). One registry class serves all of those here.
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._map: dict[str, object] = {}
+        self._lower: dict[str, object] = {}  # case-insensitive fallback only
+
+    def register(self, obj=None, name: str | None = None, aliases=()):
+        def _do(o):
+            key = name or getattr(o, "name", None) or o.__name__
+            self._map[key] = o
+            self._lower.setdefault(key.lower(), o)
+            for a in aliases:
+                self._map[a] = o
+                self._lower.setdefault(a.lower(), o)
+            return o
+
+        return _do(obj) if obj is not None else _do
+
+    def get(self, name: str):
+        if isinstance(name, str):
+            if name in self._map:
+                return self._map[name]
+            if name.lower() in self._lower:
+                return self._lower[name.lower()]
+            raise MXNetError(f"{self.kind} '{name}' is not registered "
+                             f"(known: {sorted(set(k for k in self._map))[:40]}...)")
+        return name
+
+    def __contains__(self, name):
+        return name in self._map or (isinstance(name, str) and name.lower() in self._lower)
+
+    def keys(self):
+        return sorted(self._map.keys())

@@ -1,0 +1,176 @@
+"""Training progress callbacks.
+
+Capability parity with the reference's callback module (throughput
+logging, periodic checkpointing, metric echo — python/mxnet/callback.py),
+designed differently: throughput is tracked by a monotonic-clock rate
+tracker with exponential smoothing, and every emission is a structured
+record first — the log line is just one sink for it. `tools/parse_log.py`
+consumes the default log format directly (it emits the `Epoch[e] ...
+Speed:` / `Train-metric=value` shapes that script scans for).
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
+           "LogValidationMetricsCallback", "module_checkpoint"]
+
+
+class _RateTracker:
+    """Windowed samples/sec with an EMA over the window rates.
+
+    Uses `time.monotonic` (wall-clock adjustments — NTP, suspend — must not
+    produce negative or absurd rates). One tracker per training run; reset()
+    on epoch change keeps windows from spanning the eval gap.
+    """
+
+    def __init__(self, smoothing=0.5):
+        self.smoothing = float(smoothing)
+        self.ema = None
+        self._mark = None       # (monotonic_time, batch_index)
+
+    def reset(self, batch=0):
+        self._mark = (time.monotonic(), batch)
+        return self
+
+    def advance(self, batch, batch_size):
+        """Close the window [mark, batch) and open a new one. Returns the
+        window's instantaneous rate in samples/sec (inf if the window took
+        no measurable time) and updates the EMA."""
+        now = time.monotonic()
+        if self._mark is None:
+            self._mark = (now, batch)
+            return None
+        t0, b0 = self._mark
+        self._mark = (now, batch)
+        dt = now - t0
+        nsamples = (batch - b0) * batch_size
+        rate = nsamples / dt if dt > 0 else float("inf")
+        # an unmeasurably-short window reports inf for ITSELF but must not
+        # poison the EMA (inf blended with anything stays inf forever)
+        if rate != float("inf"):
+            if self.ema is None:
+                self.ema = rate
+            else:
+                s = self.smoothing
+                self.ema = s * self.ema + (1.0 - s) * rate
+        return rate
+
+
+class Speedometer:
+    """Batch-end callback: report throughput (and optionally metrics) every
+    `frequent` batches.
+
+    Emits a structured record per report:
+        {"epoch", "batch_start", "batch_end", "rate", "ema_rate",
+         "metrics": [(name, value), ...]}
+    `sink` receives each record; the default sink writes a log line in the
+    format `tools/parse_log.py` parses. Same constructor surface as the
+    reference's Speedometer, so Module.fit callbacks are drop-in.
+    """
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True,
+                 smoothing=0.5, sink=None):
+        self.batch_size = int(batch_size)
+        self.frequent = max(1, int(frequent))
+        self.auto_reset = auto_reset
+        self._tracker = _RateTracker(smoothing)
+        self._epoch = None
+        self.sink = sink or self._log_sink
+        self.records = []        # most-recent reports (bounded)
+
+    @staticmethod
+    def _log_sink(rec):
+        parts = [f"Epoch[{rec['epoch']}] "
+                 f"Batch [{rec['batch_start']}-{rec['batch_end']}]\t"
+                 f"Speed: {rec['rate']:.2f} samples/sec"]
+        if rec["ema_rate"] is not None and rec["ema_rate"] != rec["rate"]:
+            parts.append(f"(ema {rec['ema_rate']:.2f})")
+        for name, value in rec["metrics"]:
+            parts.append(f"Train-{name}={value:f}")
+        logging.info("\t".join(parts))
+
+    def __call__(self, param):
+        batch = param.nbatch
+        mark = self._tracker._mark
+        # fresh epoch, first call, or a restarted batch counter: the old
+        # window is meaningless — open a new one at the current batch
+        if self._epoch != param.epoch or mark is None or batch < mark[1]:
+            self._epoch = param.epoch
+            self._tracker.reset(batch)
+            return
+        if batch % self.frequent or batch == mark[1]:
+            return
+        window_start = mark[1]
+        rate = self._tracker.advance(batch, self.batch_size)
+        metrics = []
+        if param.eval_metric is not None:
+            metrics = list(param.eval_metric.get_name_value())
+            if self.auto_reset:
+                param.eval_metric.reset_local()
+        rec = {"epoch": param.epoch,
+               "batch_start": window_start,
+               "batch_end": batch,
+               "rate": rate,
+               "ema_rate": self._tracker.ema,
+               "metrics": metrics}
+        self.records.append(rec)
+        del self.records[:-64]
+        self.sink(rec)
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback factory: persist symbol+params every `period`
+    epochs through model.save_checkpoint (artifact layout matches the
+    reference's prefix-epoch.params / prefix-symbol.json convention)."""
+    from .model import save_checkpoint
+
+    period = max(1, int(period))
+
+    def _save(epoch, sym, arg, aux):
+        if (epoch + 1) % period == 0:
+            save_checkpoint(prefix, epoch + 1, sym, arg, aux)
+
+    return _save
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Like do_checkpoint but routed through a Module instance (so trainer
+    state can ride along when save_optimizer_states is set)."""
+    period = max(1, int(period))
+
+    def _save(epoch, sym=None, arg=None, aux=None):
+        if (epoch + 1) % period == 0:
+            mod.save_checkpoint(prefix, epoch + 1, save_optimizer_states)
+
+    return _save
+
+
+def log_train_metric(period, auto_reset=False):
+    """Batch-end callback factory: echo the running train metrics every
+    `period` batches without any throughput tracking."""
+    period = max(1, int(period))
+
+    def _echo(param):
+        if param.nbatch % period or param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset:
+            param.eval_metric.reset_local()
+
+    return _echo
+
+
+class LogValidationMetricsCallback:
+    """Epoch-end callback: echo validation metrics in the Validation-
+    name=value shape parse_log.py scans for."""
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

@@ -1,0 +1,14 @@
+"""contrib: AMP, quantization, and extended ops
+(reference python/mxnet/contrib/)."""
+
+
+def __getattr__(name):
+    import importlib
+    lazy = {"amp": ".amp", "quantization": ".quantization", "onnx": ".onnx",
+            "text": ".text", "svrg": ".svrg", "svrg_optimization": ".svrg",
+            "tensorboard": ".tensorboard"}
+    if name in lazy:
+        m = importlib.import_module(lazy[name], __name__)
+        globals()[name] = m
+        return m
+    raise AttributeError(f"module 'contrib' has no attribute {name!r}")

@@ -1,0 +1,9 @@
+"""AMP: automatic mixed precision, bf16-first
+(reference python/mxnet/contrib/amp/)."""
+from . import lists
+from .amp import (convert_hybrid_block, convert_model, init, init_trainer,
+                  is_enabled, scale_loss, unscale)
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "convert_hybrid_block", "LossScaler", "lists", "is_enabled"]

@@ -1,0 +1,257 @@
+"""Automatic mixed precision.
+
+Reference: python/mxnet/contrib/amp/amp.py — `init():250` monkey-patches the
+generated op wrappers to insert amp_cast/amp_multicast, `init_trainer:287`
+attaches a dynamic LossScaler, `scale_loss` context manager,
+`convert_model:508` / `convert_hybrid_block:589` rewrite graphs for
+low-precision inference.
+
+TPU-native redesign: the compute dtype is bfloat16 (the MXU's native input
+type) instead of float16. There are no generated wrappers to patch — eager
+and traced execution both flow through ops.registry.apply_op, so AMP is ONE
+dispatch hook there: inputs of listed FLOP-heavy ops are cast to bf16,
+numerically-sensitive ops to fp32, mixed-dtype elementwise ops to the widest
+operand dtype. The hook applies inside hybridize/jit traces too, so the
+whole training step compiles with the casts fused in (the reference gets
+this via its symbol-rewrite pass; XLA's fusion does it for free here).
+"""
+from __future__ import annotations
+
+import logging
+import types
+
+from ...base import MXNetError, dtype_np
+from .lists import FP32_OPS, LOW_PRECISION_OPS, WIDEST_OPS
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "LossScaler"]
+
+_state = {"on": False, "target_dtype": None}
+
+
+def _cast_arr(a, dtype):
+    import jax.numpy as jnp
+    from ...ndarray import NDArray
+
+    if isinstance(a, NDArray):
+        if jnp.issubdtype(a._data.dtype, jnp.floating) and \
+                a._data.dtype != dtype:
+            return a.astype(dtype)
+        return a
+    if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+            and a.dtype != dtype:
+        return a.astype(dtype)
+    return a
+
+
+def _amp_hook(op_name, args, params=None):
+    """Dispatch hook installed into ops.registry (registry.AMP_HOOK)."""
+    import jax.numpy as jnp
+
+    tgt = _state["target_dtype"]
+    cond = _COND_FP32.get(op_name)
+    if cond is not None and params is not None:
+        pname, values = cond
+        if params.get(pname) in values:
+            return [_cast_arr(a, jnp.float32) for a in args]
+    if op_name in _LOW_SET:
+        return [_cast_arr(a, tgt) for a in args]
+    if op_name in _FP32_SET:
+        return [_cast_arr(a, jnp.float32) for a in args]
+    if op_name in _WIDEST_SET:
+        dts = [a.dtype for a in args
+               if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)]
+        if len(set(map(str, dts))) > 1:
+            widest = jnp.result_type(*dts)
+            return [_cast_arr(a, widest) for a in args]
+    return args
+
+
+_LOW_SET = frozenset(LOW_PRECISION_OPS)
+_FP32_SET = frozenset(FP32_OPS)
+_WIDEST_SET = frozenset(WIDEST_OPS)
+_COND_FP32 = {}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP process-wide (reference amp.py:250).
+
+    conditional_fp32_ops: [(op_name, param_name, [values])] — the op runs
+    fp32 when its param takes one of the listed values (reference
+    CONDITIONAL_FP32_FUNCS)."""
+    global _LOW_SET, _FP32_SET, _COND_FP32
+    tgt = dtype_np(target_dtype)
+    # each init starts from the defaults — custom lists never leak across
+    # inits (or tests)
+    _LOW_SET = frozenset(target_precision_ops) \
+        if target_precision_ops is not None else frozenset(LOW_PRECISION_OPS)
+    _FP32_SET = frozenset(fp32_ops) if fp32_ops is not None \
+        else frozenset(FP32_OPS)
+    _COND_FP32 = {}
+    for entry in (conditional_fp32_ops or []):
+        op_name, pname, values = entry
+        _COND_FP32[op_name] = (pname, set(values))
+    _state["on"] = True
+    _state["target_dtype"] = tgt
+    from ...ops import registry
+    registry.AMP_HOOK = _amp_hook
+    logging.info("AMP enabled: compute dtype %s", target_dtype)
+
+
+def is_enabled():
+    return _state["on"]
+
+
+def _off():
+    """Testing hook: disable AMP."""
+    from ...ops import registry
+    registry.AMP_HOOK = None
+    _state["on"] = False
+
+
+def init_trainer(trainer, init_scale=2.0 ** 16):
+    """Attach dynamic loss scaling to a Gluon Trainer
+    (reference amp.py:287): step() divides by the current scale and skips
+    the update on overflow."""
+    from ...gluon.trainer import Trainer
+
+    if not isinstance(trainer, Trainer):
+        raise MXNetError("init_trainer expects a gluon Trainer")
+    if getattr(trainer, "_amp_loss_scaler", None) is not None:
+        return trainer
+    scaler = LossScaler(init_scale=init_scale)
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_unscaled = False
+
+    def amp_step(self, batch_size, ignore_stale_grad=False):
+        scaler_ = self._amp_loss_scaler
+        overflow = scaler_.has_overflow(self._params)
+        scaler_.update_scale(overflow)
+        if overflow:
+            self._amp_unscaled = False
+            logging.info("AMP: overflow, skipping step; loss scale -> %g",
+                         scaler_.loss_scale)
+            return
+        # amp.unscale() already divided the grads; don't divide twice
+        scale = 1.0 if self._amp_unscaled else scaler_.loss_scale
+        self._amp_unscaled = False
+        self._optimizer.rescale_grad = self._scale / (batch_size * scale)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def amp_update(self, batch_size, ignore_stale_grad=False):
+        # same overflow-skip + unscale semantics for the no-allreduce path
+        scaler_ = self._amp_loss_scaler
+        overflow = scaler_.has_overflow(self._params)
+        scaler_.update_scale(overflow)
+        if overflow:
+            self._amp_unscaled = False
+            logging.info("AMP: overflow, skipping update; loss scale -> %g",
+                         scaler_.loss_scale)
+            return
+        scale = 1.0 if self._amp_unscaled else scaler_.loss_scale
+        self._amp_unscaled = False
+        self._optimizer.rescale_grad = self._scale / (batch_size * scale)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._update(ignore_stale_grad)
+
+    trainer.step = types.MethodType(amp_step, trainer)
+    trainer.update = types.MethodType(amp_update, trainer)
+    return trainer
+
+
+class _ScaledLoss:
+    def __init__(self, loss, trainer):
+        self._loss = loss
+        self._trainer = trainer
+
+    def __enter__(self):
+        scaler = getattr(self._trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            raise MXNetError("call amp.init_trainer(trainer) first")
+        s = scaler.loss_scale
+        if isinstance(self._loss, (list, tuple)):
+            return [l * s for l in self._loss]
+        return self._loss * s
+
+    def __exit__(self, *exc):
+        return False
+
+
+def scale_loss(loss, trainer):
+    """`with amp.scale_loss(loss, trainer) as l: l.backward()`
+    (reference amp.py scale_loss)."""
+    return _ScaledLoss(loss, trainer)
+
+
+def unscale(trainer):
+    """Divide current gradients by the loss scale (reference amp.py
+    unscale) for clipping between backward() and step()."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    s = scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._data is not None:
+            g = p.grad()
+            g._data = g._data / s
+    trainer._amp_unscaled = True
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  excluded_sym_names=None):
+    """Low-precision inference conversion for a symbolic model
+    (reference amp.py:508): cast parameters feeding listed FLOP-heavy ops;
+    the graph itself stays dtype-polymorphic (ops compute in their input
+    dtype under XLA)."""
+    tgt = dtype_np(target_dtype)
+    excluded = set(excluded_sym_names or [])
+    from ...symbol.symbol import _topo
+
+    low_args = set()
+    for node in _topo(sym._outputs):
+        if node.op is not None and node.op.name in _LOW_SET \
+                and node.name not in excluded:
+            for (inp, _) in node.inputs:
+                if inp.op is None:
+                    low_args.add(inp.name)
+    new_arg = {k: (v.astype(tgt) if k in low_args else v)
+               for k, v in arg_params.items()}
+    return sym, new_arg, dict(aux_params)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16"):
+    """Cast a HybridBlock for low-precision inference
+    (reference amp.py:589): parameters go to bf16 except normalization
+    statistics; inputs are cast on entry via a forward pre-hook."""
+    from ...gluon import nn
+    from ...ndarray import NDArray
+
+    tgt_name = "bfloat16" if "bfloat16" in str(target_dtype) else \
+        str(target_dtype)
+
+    def cast_block(b):
+        if isinstance(b, (nn.BatchNorm, nn.LayerNorm, nn.InstanceNorm,
+                          nn.GroupNorm)):
+            return  # keep norm statistics fp32 (reference FP32 list)
+        for child in b._children.values():
+            cast_block(child)
+        for p in b._reg_params.values():
+            p.cast(tgt_name)
+
+    cast_block(block)
+    tgt = dtype_np(tgt_name)
+    orig_forward = block.forward
+
+    def fwd(self, *args):
+        cast_args = [a.astype(tgt) if isinstance(a, NDArray) and
+                     "float32" in str(a.dtype) else a for a in args]
+        return orig_forward(*cast_args)
+
+    block.forward = types.MethodType(fwd, block)
+    return block

@@ -1,0 +1,70 @@
+"""AMP op lists (reference python/mxnet/contrib/amp/lists/symbol.py, 632 LoC).
+
+Three buckets, reference semantics:
+  LOW_PRECISION_OPS — run in the compute dtype (bf16 on TPU: MXU-bound
+    matmuls/convs, cheap elementwise that follows them);
+  FP32_OPS         — numerically-sensitive, forced to float32;
+  WIDEST_OPS       — cast all inputs to the widest dtype present
+    (amp_multicast semantics for mixed-dtype binary ops).
+Unlisted ops run in whatever dtype arrives.
+"""
+
+# the FLOP-heavy ops: these set the speed (reference FP16_FUNCS)
+LOW_PRECISION_OPS = [
+    "FullyConnected",
+    "Convolution",
+    "Deconvolution",
+    "dot",
+    "batch_dot",
+    "linalg_gemm",
+    "linalg_gemm2",
+    "RNN",
+]
+
+# numerically-sensitive (reference FP32_FUNCS core; norms/softmax/losses
+# keep fp32 statistics)
+FP32_OPS = [
+    "softmax",
+    "log_softmax",
+    "softmin",
+    "SoftmaxOutput",
+    "softmax_cross_entropy",
+    "SoftmaxActivation",
+    "BatchNorm",
+    "LayerNorm",
+    "InstanceNorm",
+    "GroupNorm",
+    "L2Normalization",
+    "LRN",
+    "mean",
+    "sum",
+    "prod",
+    "norm",
+    "CTCLoss",
+    "exp",
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "expm1",
+    "power",
+    "broadcast_power",
+    "erfinv",
+    "cosh",
+    "sinh",
+]
+
+# mixed-input binary/ternary ops promote to the widest operand dtype
+# (reference WIDEST_TYPE_CASTS -> amp_multicast)
+WIDEST_OPS = [
+    "broadcast_add",
+    "broadcast_sub",
+    "broadcast_mul",
+    "broadcast_div",
+    "broadcast_maximum",
+    "broadcast_minimum",
+    "broadcast_hypot",
+    "concat",
+    "stack",
+    "where",
+]

@@ -1,0 +1,42 @@
+"""Dynamic loss scaler (reference python/mxnet/contrib/amp/loss_scaler.py).
+
+Doubles the scale every `scale_window` clean steps, halves on overflow,
+never drops below 1. On TPU the compute dtype is bfloat16, whose exponent
+range equals float32 — overflow is rare and scaling is usually a no-op
+safety net — but float16 mode keeps full reference behavior.
+"""
+from __future__ import annotations
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (reference loss_scaler.py
+        has_overflow over contrib.multi_all_finite)."""
+        from ... import nd
+
+        grads = [p.grad() for p in params if p.grad_req != "null"
+                 and p._data is not None]
+        if not grads:
+            return False
+        finite = nd.all_finite(*grads)
+        return float(finite.asnumpy()) == 0.0
+
+    def update_scale(self, overflow):
+        """Reference loss_scaler.py update_scale."""
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -1,0 +1,7 @@
+"""ONNX interop (reference python/mxnet/contrib/onnx/): export_model
+(mx2onnx) and import_model/get_model_metadata (onnx2mx), speaking the
+protobuf wire format directly (_proto.py) — no onnx package required."""
+from .mx2onnx import export_model
+from .onnx2mx import import_model, get_model_metadata
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]

@@ -1,0 +1,325 @@
+"""Minimal ONNX protobuf wire-format codec (no onnx/protobuf dependency).
+
+Implements just enough of the protobuf encoding (varint, 32/64-bit, and
+length-delimited wire types) to read and write the ONNX message subset the
+exporter/importer use: ModelProto, GraphProto, NodeProto, AttributeProto,
+TensorProto, ValueInfoProto, TypeProto, TensorShapeProto,
+OperatorSetIdProto. Field numbers follow the public onnx.proto3 schema.
+
+Reference counterpart: python/mxnet/contrib/onnx/ relies on the onnx pip
+package; that package is not available here, so the wire format is spoken
+directly — files written by this codec load in onnxruntime/netron and
+files produced by standard onnx exporters parse here.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64, STRING, BOOL, FLOAT16, \
+    DOUBLE, UINT32, UINT64, COMPLEX64, COMPLEX128, BFLOAT16 = range(1, 17)
+
+NP_TO_ONNX = {
+    np.dtype(np.float32): FLOAT, np.dtype(np.float64): DOUBLE,
+    np.dtype(np.float16): FLOAT16, np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64, np.dtype(np.int8): INT8,
+    np.dtype(np.uint8): UINT8, np.dtype(np.bool_): BOOL,
+}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_GRAPH = 1, 2, 3, 4, 5
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+
+# --------------------------------------------------------------------------
+# low-level writer
+# --------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # two's-complement 64-bit, per protobuf int64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def w_int(field: int, v: int) -> bytes:
+    return _key(field, 0) + _varint(int(v))
+
+
+def w_float(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", float(v))
+
+
+def w_bytes(field: int, b: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(b)) + b
+
+
+def w_str(field: int, s: str) -> bytes:
+    return w_bytes(field, s.encode("utf-8"))
+
+
+def w_packed_ints(field: int, vals) -> bytes:
+    body = b"".join(_varint(int(v)) for v in vals)
+    return w_bytes(field, body)
+
+
+def w_msg(field: int, body: bytes) -> bytes:
+    return w_bytes(field, body)
+
+
+# --------------------------------------------------------------------------
+# low-level reader
+# --------------------------------------------------------------------------
+
+def _read_varint(buf: bytes, pos: int):
+    result, shift = 0, 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse(buf: bytes):
+    """Parse one message into {field_number: [raw values]}.
+
+    Wire type 0 -> int, 2 -> bytes, 5 -> 4 raw bytes, 1 -> 8 raw bytes.
+    Length-delimited fields may be submessages, strings, or packed arrays —
+    the caller interprets per schema.
+    """
+    out: dict[int, list] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def as_int64(v: int) -> int:
+    """Interpret a decoded varint as signed int64."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def unpack_ints(raw: bytes):
+    vals, pos = [], 0
+    while pos < len(raw):
+        v, pos = _read_varint(raw, pos)
+        vals.append(as_int64(v))
+    return vals
+
+
+def read_f32(raw: bytes) -> float:
+    return struct.unpack("<f", raw)[0]
+
+
+# --------------------------------------------------------------------------
+# ONNX message builders (encode)
+# --------------------------------------------------------------------------
+
+def tensor(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto with raw_data (little-endian)."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in NP_TO_ONNX:
+        raise TypeError(f"unsupported dtype {arr.dtype} for ONNX tensor")
+    body = b""
+    body += w_packed_ints(1, arr.shape)           # dims
+    body += w_int(2, NP_TO_ONNX[arr.dtype])        # data_type
+    body += w_str(8, name)                         # name
+    if arr.dtype == np.bool_:
+        raw = arr.astype(np.uint8).tobytes()
+    else:
+        raw = arr.astype(arr.dtype.newbyteorder("<")).tobytes()
+    body += w_bytes(9, raw)                        # raw_data
+    return body
+
+
+def tensor_to_array(fields) -> tuple[str, np.ndarray]:
+    dims = []
+    for d in fields.get(1, []):
+        if isinstance(d, bytes):
+            dims.extend(unpack_ints(d))
+        else:
+            dims.append(as_int64(d))
+    dt = fields.get(2, [FLOAT])[0]
+    name = fields.get(8, [b""])[0].decode("utf-8")
+    np_dt = ONNX_TO_NP.get(dt)
+    if np_dt is None:
+        raise TypeError(f"unsupported ONNX data_type {dt}")
+    if 9 in fields:  # raw_data
+        arr = np.frombuffer(fields[9][0], dtype=np_dt.newbyteorder("<"))
+        arr = arr.astype(np_dt)
+    elif 4 in fields and dt == FLOAT:  # float_data (packed or repeated)
+        vals = []
+        for chunk in fields[4]:
+            if isinstance(chunk, bytes) and len(chunk) % 4 == 0 and len(chunk) != 4:
+                vals.extend(struct.unpack(f"<{len(chunk)//4}f", chunk))
+            elif isinstance(chunk, bytes):
+                vals.append(read_f32(chunk))
+        arr = np.asarray(vals, np.float32)
+    elif 7 in fields and dt == INT64:  # int64_data
+        vals = []
+        for chunk in fields[7]:
+            if isinstance(chunk, bytes):
+                vals.extend(unpack_ints(chunk))
+            else:
+                vals.append(as_int64(chunk))
+        arr = np.asarray(vals, np.int64)
+    elif 5 in fields:  # int32_data
+        vals = []
+        for chunk in fields[5]:
+            if isinstance(chunk, bytes):
+                vals.extend(unpack_ints(chunk))
+            else:
+                vals.append(as_int64(chunk))
+        arr = np.asarray(vals, np.int32).astype(np_dt)
+    else:
+        arr = np.zeros(0, np_dt)
+    return name, arr.reshape(dims) if dims else arr
+
+
+def attribute(name: str, value) -> bytes:
+    """AttributeProto from a python value (type inferred)."""
+    body = w_str(1, name)
+    if isinstance(value, bool):
+        body += w_int(3, int(value)) + w_int(20, A_INT)
+    elif isinstance(value, int):
+        body += w_int(3, value) + w_int(20, A_INT)
+    elif isinstance(value, float):
+        body += w_float(2, value) + w_int(20, A_FLOAT)
+    elif isinstance(value, str):
+        body += w_bytes(4, value.encode("utf-8")) + w_int(20, A_STRING)
+    elif isinstance(value, np.ndarray):
+        body += w_msg(5, tensor(name + "_t", value)) + w_int(20, A_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            for v in value:
+                body += w_float(7, v)
+            body += w_int(20, A_FLOATS)
+        elif value and isinstance(value[0], str):
+            for v in value:
+                body += w_bytes(9, v.encode("utf-8"))
+            body += w_int(20, A_STRINGS)
+        else:
+            for v in value:
+                body += w_int(8, int(v))
+            body += w_int(20, A_INTS)
+    else:
+        raise TypeError(f"unsupported attribute type {type(value)}")
+    return body
+
+
+def attr_value(fields):
+    """Decode an AttributeProto into (name, python value)."""
+    name = fields[1][0].decode("utf-8")
+    atype = fields.get(20, [0])[0]
+    if atype == A_INT or (atype == 0 and 3 in fields):
+        return name, as_int64(fields[3][0])
+    if atype == A_FLOAT or (atype == 0 and 2 in fields):
+        return name, read_f32(fields[2][0])
+    if atype == A_STRING or (atype == 0 and 4 in fields):
+        return name, fields[4][0].decode("utf-8")
+    if atype == A_TENSOR or (atype == 0 and 5 in fields):
+        return name, tensor_to_array(parse(fields[5][0]))[1]
+    if atype == A_INTS or 8 in fields:
+        vals = []
+        for chunk in fields.get(8, []):
+            if isinstance(chunk, bytes):
+                vals.extend(unpack_ints(chunk))
+            else:
+                vals.append(as_int64(chunk))
+        return name, vals
+    if atype == A_FLOATS or 7 in fields:
+        vals = []
+        for chunk in fields.get(7, []):
+            if isinstance(chunk, bytes) and len(chunk) > 4:
+                vals.extend(struct.unpack(f"<{len(chunk)//4}f", chunk))
+            else:
+                vals.append(read_f32(chunk))
+        return name, vals
+    if atype == A_STRINGS or 9 in fields:
+        return name, [c.decode("utf-8") for c in fields.get(9, [])]
+    return name, None
+
+
+def node(op_type: str, inputs, outputs, name: str = "", domain: str = "",
+         **attrs) -> bytes:
+    body = b""
+    for i in inputs:
+        body += w_str(1, i)
+    for o in outputs:
+        body += w_str(2, o)
+    if name:
+        body += w_str(3, name)
+    body += w_str(4, op_type)
+    for k, v in attrs.items():
+        if v is not None:
+            body += w_msg(5, attribute(k, v))
+    if domain:
+        body += w_str(7, domain)
+    return body
+
+
+def value_info(name: str, elem_type: int, shape) -> bytes:
+    dims = b""
+    for d in shape:
+        if isinstance(d, str):
+            dims += w_msg(1, w_str(2, d))
+        else:
+            dims += w_msg(1, w_int(1, int(d)))
+    tensor_type = w_int(1, elem_type) + w_msg(2, dims)
+    return w_str(1, name) + w_msg(2, w_msg(1, tensor_type))
+
+
+def graph(nodes, name, inputs, outputs, initializers) -> bytes:
+    body = b""
+    for n in nodes:
+        body += w_msg(1, n)
+    body += w_str(2, name)
+    for t in initializers:
+        body += w_msg(5, t)
+    for vi in inputs:
+        body += w_msg(11, vi)
+    for vi in outputs:
+        body += w_msg(12, vi)
+    return body
+
+
+def model(graph_body: bytes, opset: int = 11, producer="incubator-mxnet-tpu",
+          ir_version: int = 6) -> bytes:
+    body = w_int(1, ir_version)
+    body += w_str(2, producer)
+    body += w_str(3, "0.1")
+    body += w_msg(8, w_str(1, "") + w_int(2, opset))  # opset_import
+    body += w_msg(7, graph_body)
+    return body

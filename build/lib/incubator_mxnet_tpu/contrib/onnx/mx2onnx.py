@@ -1,0 +1,493 @@
+"""Symbol/Gluon -> ONNX exporter.
+
+Reference counterpart: python/mxnet/contrib/onnx/mx2onnx/export_model.py +
+_op_translations.py (per-op translation table). Same design: walk the
+symbol graph in topo order, translate each mxnet op into one or more ONNX
+nodes, emit params as initializers. Targets opset 9 (attribute-style Clip/
+Pad/Slice), written with the in-repo wire codec (_proto.py) since the onnx
+package is not a dependency.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ...symbol.symbol import Symbol, _topo
+from . import _proto as P
+
+OPSET = 9
+
+
+def _tuple(v, n=2, default=1):
+    if v is None or v == ():
+        return (default,) * n
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+class _Builder:
+    def __init__(self, params):
+        self.params = dict(params or {})
+        self.nodes = []          # encoded NodeProto bytes
+        self.initializers = []   # encoded TensorProto bytes
+        self.init_names = set()
+        self.inputs = []         # (name, shape) graph inputs (non-param vars)
+        self.shapes = {}         # tensor name -> inferred shape (best effort)
+        self._uid = 0
+
+    def uniq(self, hint):
+        self._uid += 1
+        return f"{hint}_{self._uid}"
+
+    def add_node(self, op_type, inputs, outputs, name=None, **attrs):
+        self.nodes.append(P.node(op_type, inputs, outputs,
+                                 name=name or self.uniq(op_type.lower()),
+                                 **attrs))
+
+    def add_init(self, name, arr):
+        if name not in self.init_names:
+            self.initializers.append(P.tensor(name, np.asarray(arr)))
+            self.init_names.add(name)
+        return name
+
+    def const(self, hint, arr):
+        return self.add_init(self.uniq(hint), arr)
+
+
+# --------------------------------------------------------------------------
+# per-op translators: fn(b, n, ins, out) emits nodes producing `out`
+# --------------------------------------------------------------------------
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+_LEAKY = {"leaky": "LeakyRelu", "elu": "Elu", "prelu": "PRelu",
+          "selu": "Selu", "gelu": None}
+
+
+def _conv(b, n, ins, out):
+    a = n.attrs
+    kernel = _tuple(a.get("kernel"))
+    nd = len(kernel)
+    pads = _tuple(a.get("pad"), nd, default=0)
+    b.add_node("Conv", ins, [out], kernel_shape=list(kernel),
+               strides=list(_tuple(a.get("stride"), nd)),
+               dilations=list(_tuple(a.get("dilate"), nd)),
+               pads=list(pads) * 2, group=int(a.get("num_group", 1)))
+
+
+def _deconv(b, n, ins, out):
+    a = n.attrs
+    kernel = _tuple(a.get("kernel"))
+    nd = len(kernel)
+    b.add_node("ConvTranspose", ins, [out], kernel_shape=list(kernel),
+               strides=list(_tuple(a.get("stride"), nd)),
+               dilations=list(_tuple(a.get("dilate"), nd)),
+               pads=list(_tuple(a.get("pad"), nd, default=0)) * 2,
+               group=int(a.get("num_group", 1)))
+
+
+def _fc(b, n, ins, out):
+    a = n.attrs
+    data, weight = ins[0], ins[1]
+    if a.get("flatten", True):
+        flat = b.uniq("flatten")
+        b.add_node("Flatten", [data], [flat], axis=1)
+        data = flat
+    if a.get("no_bias", False) or len(ins) < 3:
+        nh = int(a.get("num_hidden"))
+        bias = b.const("zero_bias", np.zeros(nh, np.float32))
+    else:
+        bias = ins[2]
+    b.add_node("Gemm", [data, weight, bias], [out], alpha=1.0, beta=1.0,
+               transA=0, transB=1)
+
+
+def _activation(b, n, ins, out):
+    act = n.attrs.get("act_type", "relu")
+    if act not in _ACT:
+        raise MXNetError(f"ONNX export: unsupported Activation {act!r}")
+    b.add_node(_ACT[act], ins[:1], [out])
+
+
+def _leaky(b, n, ins, out):
+    act = n.attrs.get("act_type", "leaky")
+    slope = float(n.attrs.get("slope", 0.25))
+    if act == "leaky":
+        b.add_node("LeakyRelu", ins[:1], [out], alpha=slope)
+    elif act == "elu":
+        b.add_node("Elu", ins[:1], [out], alpha=slope)
+    elif act == "selu":
+        b.add_node("Selu", ins[:1], [out])
+    elif act == "prelu":
+        b.add_node("PRelu", ins[:2], [out])
+    else:
+        raise MXNetError(f"ONNX export: unsupported LeakyReLU {act!r}")
+
+
+def _batchnorm(b, n, ins, out):
+    a = n.attrs
+    ins = list(ins[:5])
+    if a.get("fix_gamma", True):
+        # mxnet fix_gamma treats gamma as constant 1; ONNX has no such
+        # flag, so bake ones into the scale initializer (reference
+        # mx2onnx/_op_translations.py does the same)
+        gshape = b.params.get(ins[1])
+        gshape = gshape.shape if gshape is not None else None
+        if gshape is not None:
+            ins[1] = b.const("bn_ones", np.ones(gshape, np.float32))
+    b.add_node("BatchNormalization", ins, [out],
+               epsilon=float(a.get("eps", 1e-3)),
+               momentum=float(a.get("momentum", 0.9)))
+
+
+def _pooling(b, n, ins, out):
+    a = n.attrs
+    ptype = a.get("pool_type", "max")
+    if a.get("global_pool", False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(ptype)
+        if op is None:
+            raise MXNetError(f"ONNX export: global {ptype} pool unsupported")
+        b.add_node(op, ins[:1], [out])
+        return
+    kernel = _tuple(a.get("kernel"))
+    nd = len(kernel)
+    kw = dict(kernel_shape=list(kernel),
+              strides=list(_tuple(a.get("stride"), nd)),
+              pads=list(_tuple(a.get("pad"), nd, default=0)) * 2)
+    if ptype == "max":
+        b.add_node("MaxPool", ins[:1], [out], **kw)
+    elif ptype == "avg":
+        cip = 1 if a.get("count_include_pad", True) else 0
+        b.add_node("AveragePool", ins[:1], [out], count_include_pad=cip, **kw)
+    else:
+        raise MXNetError(f"ONNX export: pool_type {ptype!r} unsupported")
+
+
+def _binary(op_type):
+    def tr(b, n, ins, out):
+        b.add_node(op_type, ins[:2], [out])
+    return tr
+
+
+def _scalar_op(op_type, reverse=False):
+    def tr(b, n, ins, out):
+        c = b.const("scalar", np.asarray(float(n.attrs.get("scalar", 0.0)),
+                                         np.float32))
+        args = [c, ins[0]] if reverse else [ins[0], c]
+        b.add_node(op_type, args, [out])
+    return tr
+
+
+def _unary(op_type):
+    def tr(b, n, ins, out):
+        b.add_node(op_type, ins[:1], [out])
+    return tr
+
+
+def _reshape(b, n, ins, out):
+    shape = n.attrs.get("shape", ())
+    c = b.const("shape", np.asarray(list(shape), np.int64))
+    b.add_node("Reshape", [ins[0], c], [out])
+
+
+def _transpose(b, n, ins, out):
+    axes = n.attrs.get("axes", ())
+    kw = {"perm": list(axes)} if axes else {}
+    b.add_node("Transpose", ins[:1], [out], **kw)
+
+
+def _softmax_decomposed(b, x, out, axis, log=False):
+    """Spec-correct softmax for any rank/axis: opset-9 Softmax coerces to
+    2D after `axis`, which matches mxnet semantics only for 2D inputs —
+    everything else is emitted as max/sub/exp/sum/div."""
+    mx_ = b.uniq("smax_max")
+    sub = b.uniq("smax_sub")
+    ex = b.uniq("smax_exp")
+    sm = b.uniq("smax_sum")
+    b.add_node("ReduceMax", [x], [mx_], axes=[axis], keepdims=1)
+    b.add_node("Sub", [x, mx_], [sub])
+    b.add_node("Exp", [sub], [ex])
+    b.add_node("ReduceSum", [ex], [sm], axes=[axis], keepdims=1)
+    if log:
+        lg = b.uniq("smax_logsum")
+        b.add_node("Log", [sm], [lg])
+        b.add_node("Sub", [sub, lg], [out])
+    else:
+        b.add_node("Div", [ex, sm], [out])
+
+
+def _softmax_axis(b, n, ins, default_axis=-1):
+    axis = int(n.attrs.get("axis", default_axis))
+    shp = b.shapes.get(ins[0])
+    if shp:
+        axis = axis % len(shp)
+    return axis, (len(shp) if shp else None)
+
+
+def _softmax(b, n, ins, out):
+    axis, nd_ = _softmax_axis(b, n, ins)
+    if nd_ == 2 and axis == 1:
+        b.add_node("Softmax", ins[:1], [out], axis=1)
+    else:
+        _softmax_decomposed(b, ins[0], out, axis)
+
+
+def _log_softmax(b, n, ins, out):
+    axis, nd_ = _softmax_axis(b, n, ins)
+    if nd_ == 2 and axis == 1:
+        b.add_node("LogSoftmax", ins[:1], [out], axis=1)
+    else:
+        _softmax_decomposed(b, ins[0], out, axis, log=True)
+
+
+def _softmax_output(b, n, ins, out):
+    shp = b.shapes.get(ins[0])
+    if shp is None or len(shp) == 2:
+        b.add_node("Softmax", ins[:1], [out], axis=1)
+    else:
+        _softmax_decomposed(b, ins[0], out, 1)
+
+
+def _concat(b, n, ins, out):
+    b.add_node("Concat", ins, [out], axis=int(n.attrs.get("dim", 1)))
+
+
+def _dropout(b, n, ins, out):
+    b.add_node("Dropout", ins[:1], [out], ratio=float(n.attrs.get("p", 0.5)))
+
+
+def _clip(b, n, ins, out):
+    # one-sided clips are legal (a_min/a_max default None); P.node drops
+    # None attrs and opset-9 Clip defaults to +/-3.4e38
+    amin, amax = n.attrs.get("a_min"), n.attrs.get("a_max")
+    b.add_node("Clip", ins[:1], [out],
+               min=float(amin) if amin is not None else None,
+               max=float(amax) if amax is not None else None)
+
+
+def _reduce(op_type):
+    def tr(b, n, ins, out):
+        axis = n.attrs.get("axis", None)
+        kw = {"keepdims": 1 if n.attrs.get("keepdims", False) else 0}
+        if axis is not None:
+            kw["axes"] = [axis] if isinstance(axis, int) else list(axis)
+        b.add_node(op_type, ins[:1], [out], **kw)
+    return tr
+
+
+def _cast(b, n, ins, out):
+    dt = np.dtype(n.attrs.get("dtype", "float32"))
+    b.add_node("Cast", ins[:1], [out], to=int(P.NP_TO_ONNX[dt]))
+
+
+def _slice_axis(b, n, ins, out):
+    a = n.attrs
+    end = a.get("end")
+    b.add_node("Slice", ins[:1], [out], axes=[int(a["axis"])],
+               starts=[int(a["begin"])],
+               ends=[int(end) if end is not None else 2**31 - 1])
+
+
+def _expand_dims(b, n, ins, out):
+    b.add_node("Unsqueeze", ins[:1], [out], axes=[int(n.attrs["axis"])])
+
+
+def _squeeze(b, n, ins, out):
+    ax = n.attrs.get("axis")
+    kw = {}
+    if ax is not None:
+        kw["axes"] = [ax] if isinstance(ax, int) else list(ax)
+    b.add_node("Squeeze", ins[:1], [out], **kw)
+
+
+def _flatten(b, n, ins, out):
+    b.add_node("Flatten", ins[:1], [out], axis=1)
+
+
+def _pad(b, n, ins, out):
+    a = n.attrs
+    pw = list(a.get("pad_width", ()))
+    ndim = len(pw) // 2
+    onnx_pads = [pw[2 * i] for i in range(ndim)] + \
+                [pw[2 * i + 1] for i in range(ndim)]
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect"}[a.get("mode", "constant")]
+    b.add_node("Pad", ins[:1], [out], mode=mode, pads=onnx_pads,
+               value=float(a.get("constant_value", 0.0)))
+
+
+def _embedding(b, n, ins, out):
+    cast = b.uniq("cast_idx")
+    b.add_node("Cast", [ins[0]], [cast], to=int(P.INT64))
+    b.add_node("Gather", [ins[1], cast], [out], axis=0)
+
+
+def _lrn(b, n, ins, out):
+    a = n.attrs
+    b.add_node("LRN", ins[:1], [out], alpha=float(a.get("alpha", 1e-4)),
+               beta=float(a.get("beta", 0.75)),
+               bias=float(a.get("knorm", 2.0)), size=int(a["nsize"]))
+
+
+def _instance_norm(b, n, ins, out):
+    b.add_node("InstanceNormalization", ins[:3], [out],
+               epsilon=float(n.attrs.get("eps", 1e-3)))
+
+
+def _dot(b, n, ins, out):
+    if n.attrs.get("transpose_a") or n.attrs.get("transpose_b"):
+        raise MXNetError("ONNX export: transposed dot unsupported; "
+                         "use linalg_gemm2 semantics via explicit Transpose")
+    b.add_node("MatMul", ins[:2], [out])
+
+
+TRANSLATORS = {
+    "Convolution": _conv,
+    "Deconvolution": _deconv,
+    "FullyConnected": _fc,
+    "Activation": _activation,
+    "LeakyReLU": _leaky,
+    "BatchNorm": _batchnorm,
+    "Pooling": _pooling,
+    "Flatten": _flatten,
+    "flatten": _flatten,
+    "Concat": _concat,
+    "concat": _concat,
+    "Dropout": _dropout,
+    "softmax": _softmax,
+    "log_softmax": _log_softmax,
+    "SoftmaxOutput": _softmax_output,
+    "Reshape": _reshape,
+    "reshape": _reshape,
+    "transpose": _transpose,
+    "clip": _clip,
+    "cast": _cast,
+    "slice_axis": _slice_axis,
+    "expand_dims": _expand_dims,
+    "squeeze": _squeeze,
+    "Pad": _pad,
+    "pad": _pad,
+    "Embedding": _embedding,
+    "LRN": _lrn,
+    "InstanceNorm": _instance_norm,
+    "dot": _dot,
+    "elemwise_add": _binary("Add"), "_plus": _binary("Add"),
+    "elemwise_sub": _binary("Sub"), "_minus": _binary("Sub"),
+    "elemwise_mul": _binary("Mul"), "_mul": _binary("Mul"),
+    "elemwise_div": _binary("Div"), "_div": _binary("Div"),
+    "broadcast_add": _binary("Add"), "broadcast_sub": _binary("Sub"),
+    "broadcast_mul": _binary("Mul"), "broadcast_div": _binary("Div"),
+    "broadcast_maximum": _binary("Max"), "broadcast_minimum": _binary("Min"),
+    "broadcast_power": _binary("Pow"),
+    "_add": _binary("Add"), "_sub": _binary("Sub"),
+    "_plus_scalar": _scalar_op("Add"), "_minus_scalar": _scalar_op("Sub"),
+    "_sub_scalar": _scalar_op("Sub"), "_radd_scalar": _scalar_op("Add"),
+    "_rmul_scalar": _scalar_op("Mul"),
+    "_rsub_scalar": _scalar_op("Sub", reverse=True),
+    "_mul_scalar": _scalar_op("Mul"), "_div_scalar": _scalar_op("Div"),
+    "_rdiv_scalar": _scalar_op("Div", reverse=True),
+    "_power_scalar": _scalar_op("Pow"),
+    "relu": _unary("Relu"), "sigmoid": _unary("Sigmoid"),
+    "tanh": _unary("Tanh"), "exp": _unary("Exp"), "log": _unary("Log"),
+    "sqrt": _unary("Sqrt"), "abs": _unary("Abs"),
+    "negative": _unary("Neg"), "floor": _unary("Floor"),
+    "ceil": _unary("Ceil"), "identity": _unary("Identity"),
+    "_copy": _unary("Identity"), "BlockGrad": _unary("Identity"),
+    "stop_gradient": _unary("Identity"),
+    "sum": _reduce("ReduceSum"), "mean": _reduce("ReduceMean"),
+    "max": _reduce("ReduceMax"), "min": _reduce("ReduceMin"),
+    "prod": _reduce("ReduceProd"),
+}
+
+
+def export_model(sym, params, input_shapes, input_dtype=np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol + params dict to an ONNX file.
+
+    Mirrors python/mxnet/contrib/onnx/mx2onnx/export_model.py:export_model:
+    `params` merges arg_params and aux_params; variables without a param
+    entry become graph inputs, bound positionally to `input_shapes`.
+    Returns onnx_file_path.
+    """
+    from ... import ndarray as _nd
+    if isinstance(sym, (list, tuple)):
+        raise MXNetError("pass a single Symbol (use Group for multi-output)")
+    np_params = {}
+    for k, v in (params or {}).items():
+        key = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k
+        np_params[key] = v.asnumpy() if isinstance(v, _nd.NDArray) \
+            else np.asarray(v)
+
+    order = _topo(sym._outputs)
+    b = _Builder(np_params)
+
+    # tensor name for each (node, out_index)
+    def tname(n, oi):
+        if n.op is None:
+            return n.name
+        return f"{n.name}_out{oi}" if oi else f"{n.name}_output"
+
+    in_shapes = list(input_shapes) if isinstance(input_shapes[0],
+                                                 (list, tuple)) \
+        else [input_shapes]
+    data_vars = [n for n in order
+                 if n.op is None and n.name not in np_params]
+    if len(data_vars) != len(in_shapes):
+        raise MXNetError(
+            f"got {len(in_shapes)} input shapes for {len(data_vars)} "
+            f"graph inputs ({[v.name for v in data_vars]})")
+
+    graph_inputs = []
+    for v, shp in zip(data_vars, in_shapes):
+        graph_inputs.append(P.value_info(
+            v.name, P.NP_TO_ONNX[np.dtype(input_dtype)], shp))
+
+    # best-effort per-tensor shapes so rank-sensitive translators
+    # (softmax family) can canonicalize axes
+    shape_kwargs0 = {v.name: tuple(shp)
+                     for v, shp in zip(data_vars, in_shapes)}
+    try:
+        internals = sym.get_internals()
+        _, int_shapes, _ = internals.infer_shape_partial(**shape_kwargs0)
+        for (node, oi), shp in zip(internals._outputs, int_shapes):
+            if shp:
+                b.shapes[tname(node, oi)] = tuple(shp)
+    except Exception:
+        pass
+    for name, arr in np_params.items():
+        b.shapes.setdefault(name, arr.shape)
+
+    for n in order:
+        if n.op is None:
+            if n.name in np_params:
+                b.add_init(n.name, np_params[n.name])
+            continue
+        tr = TRANSLATORS.get(n.op.name)
+        if tr is None:
+            raise MXNetError(
+                f"ONNX export: no translator for op {n.op.name!r}")
+        ins = [tname(i, oi) for i, oi in n.inputs]
+        tr(b, n, ins, tname(n, 0))
+
+    # output value_infos with inferred shapes
+    shape_kwargs = {v.name: tuple(shp)
+                    for v, shp in zip(data_vars, in_shapes)}
+    try:
+        _, out_shapes, _ = sym.infer_shape(**shape_kwargs)
+    except Exception:
+        out_shapes = [() for _ in sym._outputs]
+    graph_outputs = []
+    for (n, oi), shp in zip(sym._outputs, out_shapes):
+        graph_outputs.append(P.value_info(
+            tname(n, oi), P.NP_TO_ONNX[np.dtype(input_dtype)], shp or ()))
+
+    g = P.graph(b.nodes, "mxnet_tpu_graph", graph_inputs, graph_outputs,
+                b.initializers)
+    blob = P.model(g, opset=OPSET)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    if verbose:
+        print(f"exported {len(b.nodes)} nodes, "
+              f"{len(b.initializers)} initializers -> {onnx_file_path}")
+    return onnx_file_path

@@ -1,0 +1,458 @@
+"""ONNX -> Symbol importer.
+
+Reference counterpart: python/mxnet/contrib/onnx/onnx2mx/import_model.py +
+import_onnx.py (GraphProto._convert_operator). Returns
+(sym, arg_params, aux_params) exactly like the reference's import_model so
+the result drops into Module/SymbolBlock.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ... import ndarray as nd
+from ...symbol import symbol as sym_mod
+from . import _proto as P
+
+
+class _OnnxNode:
+    __slots__ = ("op_type", "inputs", "outputs", "name", "attrs")
+
+    def __init__(self, fields):
+        self.inputs = [x.decode("utf-8") for x in fields.get(1, [])]
+        self.outputs = [x.decode("utf-8") for x in fields.get(2, [])]
+        self.name = fields.get(3, [b""])[0].decode("utf-8")
+        self.op_type = fields.get(4, [b""])[0].decode("utf-8")
+        self.attrs = {}
+        for raw in fields.get(5, []):
+            k, v = P.attr_value(P.parse(raw))
+            self.attrs[k] = v
+
+
+def _parse_value_info(raw):
+    f = P.parse(raw)
+    name = f.get(1, [b""])[0].decode("utf-8")
+    shape = []
+    if 2 in f:
+        tp = P.parse(f[2][0])
+        if 1 in tp:  # tensor_type
+            tt = P.parse(tp[1][0])
+            if 2 in tt:
+                shp = P.parse(tt[2][0])
+                for draw in shp.get(1, []):
+                    d = P.parse(draw)
+                    if 1 in d:
+                        shape.append(P.as_int64(d[1][0]))
+                    else:
+                        shape.append(0)
+    return name, tuple(shape)
+
+
+def _parse_graph(raw):
+    f = P.parse(raw)
+    nodes = [_OnnxNode(P.parse(r)) for r in f.get(1, [])]
+    inits = dict(P.tensor_to_array(P.parse(r)) for r in f.get(5, []))
+    inputs = [_parse_value_info(r) for r in f.get(11, [])]
+    outputs = [_parse_value_info(r) for r in f.get(12, [])]
+    return nodes, inits, inputs, outputs
+
+
+def _load_model_proto(fname):
+    with open(fname, "rb") as fh:
+        blob = fh.read()
+    f = P.parse(blob)
+    if 7 not in f:
+        raise MXNetError(f"{fname}: no GraphProto in model")
+    return _parse_graph(f[7][0])
+
+
+# --------------------------------------------------------------------------
+# per-op converters: fn(node, ins, aux) -> Symbol   (ins are Symbols or
+# numpy arrays for initializer-backed inputs)
+# --------------------------------------------------------------------------
+
+def _sym_of(x, store):
+    """Materialize an initializer input as a bound Variable."""
+    if isinstance(x, sym_mod.Symbol):
+        return x
+    raise MXNetError("expected symbol input")
+
+
+def _pads2mx(pads, nd_):
+    if not pads:
+        return (0,) * nd_
+    begin, end = pads[:nd_], pads[nd_:]
+    if list(begin) != list(end):
+        raise MXNetError(f"asymmetric pads {pads} unsupported")
+    return tuple(begin)
+
+
+def _conv(n, ins, g):
+    k = n.attrs.get("kernel_shape")
+    nd_ = len(k)
+    no_bias = len(ins) < 3
+    num_filter = g.shape_of(n.inputs[1])[0]
+    kw = dict(kernel=tuple(k), stride=tuple(n.attrs.get("strides", (1,) * nd_)),
+              dilate=tuple(n.attrs.get("dilations", (1,) * nd_)),
+              pad=_pads2mx(n.attrs.get("pads"), nd_),
+              num_group=int(n.attrs.get("group", 1)),
+              num_filter=int(num_filter), no_bias=no_bias)
+    return sym_mod._create(g.op("Convolution"), tuple(ins), kw)
+
+
+def _deconv(n, ins, g):
+    k = n.attrs.get("kernel_shape")
+    nd_ = len(k)
+    num_filter = g.shape_of(n.inputs[1])[1] * int(n.attrs.get("group", 1))
+    kw = dict(kernel=tuple(k), stride=tuple(n.attrs.get("strides", (1,) * nd_)),
+              dilate=tuple(n.attrs.get("dilations", (1,) * nd_)),
+              pad=_pads2mx(n.attrs.get("pads"), nd_),
+              num_group=int(n.attrs.get("group", 1)),
+              num_filter=int(num_filter), no_bias=len(ins) < 3)
+    return sym_mod._create(g.op("Deconvolution"), tuple(ins), kw)
+
+
+def _gemm(n, ins, g):
+    alpha = float(n.attrs.get("alpha", 1.0))
+    beta = float(n.attrs.get("beta", 1.0))
+    transB = int(n.attrs.get("transB", 0))
+    transA = int(n.attrs.get("transA", 0))
+    if alpha == 1.0 and beta == 1.0 and transB == 1 and not transA:
+        nh = g.shape_of(n.inputs[1])[0]
+        return sym_mod._create(g.op("FullyConnected"), tuple(ins[:3]),
+                               dict(num_hidden=int(nh), no_bias=len(ins) < 3,
+                                    flatten=False))
+    a, b_ = ins[0], ins[1]
+    if transA:
+        a = sym_mod._create(g.op("transpose"), (a,), {})
+    if not transB:
+        b_ = sym_mod._create(g.op("transpose"), (b_,), {})
+    out = sym_mod._create(g.op("dot"), (a, b_), {})
+    if alpha != 1.0:
+        out = out * alpha
+    if len(ins) > 2:
+        c = ins[2] if beta == 1.0 else ins[2] * beta
+        out = sym_mod._create(g.op("broadcast_add"), (out, c), {})
+    return out
+
+
+def _pool(mx_type, global_pool):
+    def cv(n, ins, g):
+        kw = dict(pool_type=mx_type, global_pool=global_pool)
+        if not global_pool:
+            k = n.attrs["kernel_shape"]
+            nd_ = len(k)
+            kw.update(kernel=tuple(k),
+                      stride=tuple(n.attrs.get("strides", (1,) * nd_)),
+                      pad=_pads2mx(n.attrs.get("pads"), nd_))
+            if mx_type == "avg":
+                # ONNX spec default is 0 (exclude padding from the mean)
+                kw["count_include_pad"] = \
+                    bool(n.attrs.get("count_include_pad", 0))
+        return sym_mod._create(g.op("Pooling"), tuple(ins[:1]), kw)
+    return cv
+
+
+def _bn(n, ins, g):
+    return sym_mod._create(
+        g.op("BatchNorm"), tuple(ins[:5]),
+        dict(eps=float(n.attrs.get("epsilon", 1e-5)),
+             momentum=float(n.attrs.get("momentum", 0.9)), fix_gamma=False))
+
+
+def _simple(mx_op, **fixed):
+    def cv(n, ins, g):
+        return sym_mod._create(g.op(mx_op), tuple(ins), dict(fixed))
+    return cv
+
+
+def _unary1(mx_op):
+    def cv(n, ins, g):
+        return sym_mod._create(g.op(mx_op), tuple(ins[:1]), {})
+    return cv
+
+
+def _binary_bcast(mx_op):
+    def cv(n, ins, g):
+        return sym_mod._create(g.op(mx_op), tuple(ins[:2]), {})
+    return cv
+
+
+def _activationlike(mx_name, attr_map=()):
+    def cv(n, ins, g):
+        kw = {mk: n.attrs[ok] for ok, mk in attr_map if ok in n.attrs}
+        return sym_mod._create(g.op("LeakyReLU"), tuple(ins),
+                               dict(act_type=mx_name, **kw))
+    return cv
+
+
+def _softmax(n, ins, g):
+    return sym_mod._create(g.op("softmax"), tuple(ins[:1]),
+                           dict(axis=int(n.attrs.get("axis", 1))))
+
+
+def _log_softmax(n, ins, g):
+    return sym_mod._create(g.op("log_softmax"), tuple(ins[:1]),
+                           dict(axis=int(n.attrs.get("axis", 1))))
+
+
+def _reshape(n, ins, g):
+    shape = g.const_of(n.inputs[1])
+    if shape is None:
+        raise MXNetError("Reshape with dynamic shape input unsupported")
+    return sym_mod._create(g.op("reshape"), tuple(ins[:1]),
+                           dict(shape=tuple(int(x) for x in shape)))
+
+
+def _transpose_cv(n, ins, g):
+    perm = n.attrs.get("perm")
+    return sym_mod._create(g.op("transpose"), tuple(ins[:1]),
+                           dict(axes=tuple(perm)) if perm else {})
+
+
+def _concat_cv(n, ins, g):
+    return sym_mod._create(g.op("Concat"), tuple(ins),
+                           dict(dim=int(n.attrs.get("axis", 1)),
+                                num_args=len(ins)))
+
+
+def _clip_cv(n, ins, g):
+    lo = n.attrs.get("min", -3.4e38)
+    hi = n.attrs.get("max", 3.4e38)
+    if len(ins) > 1:  # opset>=11 min/max inputs (must be constants here)
+        lo = g.const_of(n.inputs[1]) if len(n.inputs) > 1 and n.inputs[1] else lo
+        hi = g.const_of(n.inputs[2]) if len(n.inputs) > 2 and n.inputs[2] else hi
+    return sym_mod._create(g.op("clip"), tuple(ins[:1]),
+                           dict(a_min=float(np.asarray(lo)),
+                                a_max=float(np.asarray(hi))))
+
+
+def _reduce_cv(mx_op):
+    def cv(n, ins, g):
+        axes = n.attrs.get("axes")
+        kw = dict(keepdims=bool(n.attrs.get("keepdims", 1)))
+        if axes is not None:
+            kw["axis"] = tuple(axes) if len(axes) > 1 else int(axes[0])
+        return sym_mod._create(g.op(mx_op), tuple(ins[:1]), kw)
+    return cv
+
+
+def _cast_cv(n, ins, g):
+    to = int(n.attrs["to"])
+    return sym_mod._create(g.op("cast"), tuple(ins[:1]),
+                           dict(dtype=str(P.ONNX_TO_NP[to])))
+
+
+def _slice_cv(n, ins, g):
+    axes = n.attrs.get("axes")
+    starts = n.attrs.get("starts")
+    ends = n.attrs.get("ends")
+    if axes is None or len(axes) != 1:
+        raise MXNetError("only single-axis Slice supported")
+    return sym_mod._create(g.op("slice_axis"), tuple(ins[:1]),
+                           dict(axis=int(axes[0]), begin=int(starts[0]),
+                                end=int(ends[0])))
+
+
+def _unsqueeze(n, ins, g):
+    out = ins[0]
+    for ax in sorted(n.attrs.get("axes", [0])):
+        out = sym_mod._create(g.op("expand_dims"), (out,),
+                              dict(axis=int(ax)))
+    return out
+
+
+def _squeeze_cv(n, ins, g):
+    axes = n.attrs.get("axes")
+    kw = dict(axis=tuple(axes)) if axes else {}
+    return sym_mod._create(g.op("squeeze"), tuple(ins[:1]), kw)
+
+
+def _pad_cv(n, ins, g):
+    pads = n.attrs.get("pads", [])
+    nd_ = len(pads) // 2
+    pw = []
+    for i in range(nd_):
+        pw += [int(pads[i]), int(pads[i + nd_])]
+    return sym_mod._create(g.op("Pad"), tuple(ins[:1]),
+                           dict(mode=n.attrs.get("mode", "constant"),
+                                pad_width=tuple(pw),
+                                constant_value=float(
+                                    n.attrs.get("value", 0.0))))
+
+
+def _gather(n, ins, g):
+    if int(n.attrs.get("axis", 0)) != 0:
+        raise MXNetError("Gather axis != 0 unsupported")
+    data, idx = ins[0], ins[1]
+    idxf = sym_mod._create(g.op("cast"), (idx,), dict(dtype="float32"))
+    shp = g.shape_of(n.inputs[0])
+    return sym_mod._create(g.op("Embedding"), (idxf, data),
+                           dict(input_dim=int(shp[0]),
+                                output_dim=int(shp[1])))
+
+
+def _lrn_cv(n, ins, g):
+    return sym_mod._create(g.op("LRN"), tuple(ins[:1]),
+                           dict(nsize=int(n.attrs["size"]),
+                                alpha=float(n.attrs.get("alpha", 1e-4)),
+                                beta=float(n.attrs.get("beta", 0.75)),
+                                knorm=float(n.attrs.get("bias", 1.0))))
+
+
+def _inorm(n, ins, g):
+    return sym_mod._create(g.op("InstanceNorm"), tuple(ins[:3]),
+                           dict(eps=float(n.attrs.get("epsilon", 1e-5))))
+
+
+def _dropout_cv(n, ins, g):
+    return sym_mod._create(g.op("Dropout"), tuple(ins[:1]),
+                           dict(p=float(n.attrs.get("ratio", 0.5))))
+
+
+def _matmul(n, ins, g):
+    return sym_mod._create(g.op("dot"), tuple(ins[:2]), {})
+
+
+def _identity_cv(n, ins, g):
+    return sym_mod._create(g.op("identity"), tuple(ins[:1]), {})
+
+
+def _sum_n(n, ins, g):
+    out = ins[0]
+    for x in ins[1:]:
+        out = sym_mod._create(g.op("broadcast_add"), (out, x), {})
+    return out
+
+
+def _constant(n, ins, g):
+    arr = n.attrs.get("value")
+    name = n.outputs[0]
+    g.initializers[name] = np.asarray(arr)
+    return g.var_for(name)
+
+
+CONVERTERS = {
+    "Conv": _conv,
+    "ConvTranspose": _deconv,
+    "Gemm": _gemm,
+    "MatMul": _matmul,
+    "BatchNormalization": _bn,
+    "MaxPool": _pool("max", False),
+    "AveragePool": _pool("avg", False),
+    "GlobalMaxPool": _pool("max", True),
+    "GlobalAveragePool": _pool("avg", True),
+    "Relu": _unary1("relu"), "Sigmoid": _unary1("sigmoid"),
+    "Tanh": _unary1("tanh"),
+    "Softplus": _simple("Activation", act_type="softrelu"),
+    "Softsign": _unary1("softsign"),
+    "Exp": _unary1("exp"), "Log": _unary1("log"), "Sqrt": _unary1("sqrt"),
+    "Abs": _unary1("abs"), "Neg": _unary1("negative"),
+    "Floor": _unary1("floor"), "Ceil": _unary1("ceil"),
+    "Identity": _identity_cv,
+    "LeakyRelu": _activationlike("leaky", (("alpha", "slope"),)),
+    "Elu": _activationlike("elu", (("alpha", "slope"),)),
+    "Selu": _activationlike("selu"),
+    "PRelu": _activationlike("prelu"),
+    "Softmax": _softmax, "LogSoftmax": _log_softmax,
+    "Add": _binary_bcast("broadcast_add"),
+    "Sub": _binary_bcast("broadcast_sub"),
+    "Mul": _binary_bcast("broadcast_mul"),
+    "Div": _binary_bcast("broadcast_div"),
+    "Pow": _binary_bcast("broadcast_power"),
+    "Max": _binary_bcast("broadcast_maximum"),
+    "Min": _binary_bcast("broadcast_minimum"),
+    "Sum": _sum_n,
+    "Concat": _concat_cv,
+    "Flatten": _unary1("Flatten"),
+    "Dropout": _dropout_cv,
+    "Reshape": _reshape,
+    "Transpose": _transpose_cv,
+    "Clip": _clip_cv,
+    "Cast": _cast_cv,
+    "Slice": _slice_cv,
+    "Unsqueeze": _unsqueeze,
+    "Squeeze": _squeeze_cv,
+    "Pad": _pad_cv,
+    "Gather": _gather,
+    "LRN": _lrn_cv,
+    "InstanceNormalization": _inorm,
+    "ReduceSum": _reduce_cv("sum"), "ReduceMean": _reduce_cv("mean"),
+    "ReduceMax": _reduce_cv("max"), "ReduceMin": _reduce_cv("min"),
+    "ReduceProd": _reduce_cv("prod"),
+    "Constant": _constant,
+}
+
+
+class _GraphCtx:
+    def __init__(self, initializers):
+        self.initializers = initializers
+        self.sym_map: dict[str, sym_mod.Symbol] = {}
+        self._vars: dict[str, sym_mod.Symbol] = {}
+        from ...ops.registry import OPS
+        self._ops = OPS
+
+    def op(self, name):
+        return self._ops.get(name)
+
+    def var_for(self, name):
+        if name not in self._vars:
+            self._vars[name] = sym_mod.Variable(name)
+        return self._vars[name]
+
+    def resolve(self, name):
+        if name in self.sym_map:
+            return self.sym_map[name]
+        return self.var_for(name)
+
+    def shape_of(self, name):
+        if name in self.initializers:
+            return self.initializers[name].shape
+        raise MXNetError(f"shape of non-initializer {name!r} unknown")
+
+    def const_of(self, name):
+        return self.initializers.get(name)
+
+
+def import_model(model_file):
+    """Load an ONNX file -> (sym, arg_params, aux_params).
+
+    Reference: python/mxnet/contrib/onnx/onnx2mx/import_model.py:import_model.
+    """
+    nodes, inits, inputs, outputs = _load_model_proto(model_file)
+    g = _GraphCtx(inits)
+
+    last = None
+    produced_outputs = {}
+    for n in nodes:
+        cv = CONVERTERS.get(n.op_type)
+        if cv is None:
+            raise MXNetError(f"ONNX import: unsupported op {n.op_type!r}")
+        ins = [g.resolve(i) for i in n.inputs if i]
+        out = cv(n, ins, g)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for name, s in zip(n.outputs, outs):
+            g.sym_map[name] = s
+            produced_outputs[name] = s
+        last = outs[0]
+
+    out_syms = [produced_outputs.get(name, g.sym_map.get(name))
+                for name, _ in outputs]
+    out_syms = [s for s in out_syms if s is not None] or [last]
+    sym = out_syms[0] if len(out_syms) == 1 else sym_mod.Group(out_syms)
+
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    for k, v in inits.items():
+        (aux_params if k in aux_names else arg_params)[k] = nd.array(v)
+    return sym, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    """Input/output names+shapes (reference onnx2mx.import_model:
+    get_model_metadata)."""
+    _, inits, inputs, outputs = _load_model_proto(model_file)
+    return {
+        "input_tensor_data": [(n, s) for n, s in inputs if n not in inits],
+        "output_tensor_data": list(outputs),
+    }

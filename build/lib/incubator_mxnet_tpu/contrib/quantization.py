@@ -1,0 +1,550 @@
+"""Post-training INT8 quantization driver.
+
+Reference: python/mxnet/contrib/quantization.py (976 LoC) — `quantize_model`
+rewrites FLOP-heavy nodes to quantized variants with quantize/dequantize
+glue, calibrating activation ranges over sample data with `naive` (min/max)
+or `entropy` (KL-divergence-optimal threshold) modes; the graph pass lives
+in src/operator/quantization/quantize_graph_pass.cc.
+
+TPU-native: the rewritten graph runs int8 matmul/conv on the MXU with int32
+accumulation (ops/quantization_ops.py); calibration executes the fp32 graph
+once per batch and records per-layer output statistics.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_model", "quantize_graph", "_calibrate_quantized_sym"]
+
+_QUANTIZABLE = {"FullyConnected", "Convolution"}
+
+
+def _optimal_threshold_kl(arr, quantized_dtype="int8", num_bins=2048,
+                          num_quantized_bins=128):
+    """KL-divergence-optimal clipping threshold over the |x| histogram
+    (the algorithm behind the reference's entropy mode, quantization.py
+    _get_optimal_threshold; smoothing per the standard TensorRT-style
+    calibration so sparse histograms don't collapse to tiny thresholds)."""
+    arr = _np.asarray(arr, dtype=_np.float64).ravel()
+    arr = arr[_np.isfinite(arr)]
+    if arr.size == 0:
+        return 1e-8
+    mag = _np.abs(arr)
+    amax = float(mag.max())
+    if amax < 1e-12:
+        return 1e-8
+    hist, edges = _np.histogram(mag, bins=num_bins, range=(0.0, amax))
+    hist = hist.astype(_np.float64)
+    eps = 1e-10
+    best_div, best_t = None, amax
+    stride = max(1, num_bins // 512)
+    for i in range(num_quantized_bins, num_bins + 1, stride):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # clip outliers into the last kept bin
+        if p.sum() <= 0:
+            continue
+        # quantize kept bins into num_quantized_bins, expand back over the
+        # nonzero support only
+        factor = i / num_quantized_bins
+        q = _np.zeros(i)
+        for j in range(num_quantized_bins):
+            lo = int(_np.floor(j * factor))
+            hi = int(_np.ceil((j + 1) * factor)) if j < num_quantized_bins - 1 \
+                else i
+            seg = hist[lo:hi]
+            nz = seg != 0
+            n_nz = int(nz.sum())
+            if n_nz:
+                q[lo:hi][nz] = seg[nz].sum() / n_nz
+        p_n = p / p.sum()
+        q_sum = q.sum()
+        if q_sum <= 0:
+            continue
+        q_n = q / q_sum
+        mask = p_n > 0
+        div = float(_np.sum(p_n[mask] *
+                            _np.log(p_n[mask] / (q_n[mask] + eps))))
+        if best_div is None or div < best_div:
+            best_div, best_t = div, float(edges[i])
+    return best_t
+
+
+def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
+                   calib_ranges=None):
+    """Rewrite FullyConnected/Convolution nodes to their int8 forms with
+    quantize/dequantize glue (reference quantize_graph_pass.cc).
+
+    calib_ranges: {node_name: (min, max)} activation ranges; when a node's
+    range is missing its input is quantized with on-the-fly min/max."""
+    from .. import symbol as S
+    from ..symbol.symbol import _Node, _topo
+    from ..ops import registry as _registry
+
+    excluded = set(excluded_sym_names)
+    calib_ranges = calib_ranges or {}
+
+    order = _topo(sym._outputs)
+    mapping = {}  # id(old_node) -> (new_node, out_idx_shift)
+
+    def conv(entry):
+        node, idx = entry
+        return (mapping[id(node)][0], idx + mapping[id(node)][1]) \
+            if id(node) in mapping else entry
+
+    q_fc = _registry.get_op("_contrib_quantized_fully_connected")
+    q_conv = _registry.get_op("_contrib_quantized_conv")
+    q_op = _registry.get_op("_contrib_quantize_v2")
+    dq_op = _registry.get_op("_contrib_dequantize")
+
+    for node in order:
+        if node.op is None or node.op.name not in _QUANTIZABLE or \
+                node.name in excluded:
+            continue
+        new_inputs = []
+        mins_maxs = []
+        for (inp, oi), aname in zip(node.inputs, node.arg_names):
+            src = conv((inp, oi))
+            rng = calib_ranges.get(f"{node.name}_{aname}")
+            attrs = {"out_type": quantized_dtype}
+            if rng is not None:
+                attrs["min_calib_range"] = float(rng[0])
+                attrs["max_calib_range"] = float(rng[1])
+            qnode = _Node(q_op, f"{node.name}_{aname}_quantize", attrs,
+                          [src], arg_names=["data"])
+            new_inputs.append(qnode)
+            mins_maxs.append(qnode)
+        # quantized op: data, weight, bias, then the six range scalars
+        ins, argn = [], []
+        for qn, aname in zip(new_inputs, node.arg_names):
+            ins.append((qn, 0))
+            argn.append(aname)
+        for qn, aname in zip(mins_maxs, node.arg_names):
+            ins.append((qn, 1))
+            argn.append(f"{aname}_min")
+            ins.append((qn, 2))
+            argn.append(f"{aname}_max")
+        qop = q_fc if node.op.name == "FullyConnected" else q_conv
+        qnode = _Node(qop, f"quantized_{node.name}", dict(node.attrs),
+                      ins, extra=dict(node.extra), arg_names=argn)
+        # dequantize uses the analytic int32 full-scale range (exact);
+        # calibrated output ranges would only matter for int8 op chaining
+        dq = _Node(dq_op, f"{node.name}_dequantize", {},
+                   [(qnode, 0), (qnode, 1), (qnode, 2)],
+                   arg_names=["qdata", "min_range", "max_range"])
+        mapping[id(node)] = (dq, 0)
+
+    if not mapping:
+        return sym
+    new_outputs = [(e[0], e[1]) for e in
+                   (_rebuild_mapped(sym._outputs, mapping))]
+    return _propagate_int8(S.Symbol(new_outputs))
+
+
+def _rebuild_mapped(outputs, mapping):
+    """Rebuild a graph applying `mapping` {id(old) -> (new_node, shift)}
+    EVERYWHERE — including inside the replacement nodes' own input
+    subtrees (a replacement's inputs still reference original upstream
+    nodes that may themselves be mapped)."""
+    from ..symbol.symbol import _Node
+
+    rebuilt = {}
+
+    def rebuild(node):
+        if id(node) in rebuilt:
+            return rebuilt[id(node)]
+        target = mapping[id(node)][0] if id(node) in mapping else node
+        if target.op is None:
+            rebuilt[id(node)] = target
+            return target
+        new_ins = []
+        for inp, oi in target.inputs:
+            nb = rebuild(inp)
+            if id(inp) in mapping:
+                oi = oi + mapping[id(inp)][1]
+            new_ins.append((nb, oi))
+        nn = _Node(target.op, target.name, target.attrs, new_ins,
+                   extra=target.extra, arg_names=target.arg_names)
+        rebuilt[id(node)] = nn
+        return nn
+
+    return [(rebuild(n), i + (mapping[id(n)][1] if id(n) in mapping else 0))
+            for n, i in outputs]
+
+
+def _propagate_int8(sym):
+    """Push dequantize nodes DOWN through range-preserving ops: a
+    relu / max-pool / flatten / residual-add whose inputs all come from
+    dequantize nodes is replaced by its quantized form consuming the int
+    codes directly (reference: the quantize pass's avoid-dequantize
+    patterns across quantized_pooling.cc, quantized_activation.cc,
+    quantized_elemwise_add.cc). Repeats to a fixpoint so chains like
+    conv -> relu -> pool stay integer end to end."""
+    from .. import symbol as S
+    from ..symbol.symbol import _Node, _topo
+    from ..ops import registry as _registry
+
+    dq_op = _registry.get_op("_contrib_dequantize")
+    q_act = _registry.get_op("_contrib_quantized_act")
+    q_pool = _registry.get_op("_contrib_quantized_pooling")
+    q_flat = _registry.get_op("_contrib_quantized_flatten")
+    q_add = _registry.get_op("_contrib_quantized_elemwise_add")
+    q_v2 = _registry.get_op("_contrib_quantize_v2")
+    req_op = _registry.get_op("_contrib_requantize")
+    int32_producers = (_registry.get_op("_contrib_quantized_conv"),
+                       _registry.get_op("_contrib_quantized_fully_connected"),
+                       q_add)
+
+    def is_dq(entry):
+        node, oi = entry
+        return node.op is dq_op and oi == 0
+
+    def _traces_to_int32(node, passthrough, producers):
+        """Code width of a quantized chain: walk the range-preserving ops
+        (act/pool/flatten keep their input's dtype) back to the ultimate
+        producer; int32 iff it is a conv/fc/add accumulator."""
+        seen = 0
+        while node.op in passthrough and seen < 64:
+            node = node.inputs[0][0]
+            seen += 1
+        return node.op in producers
+
+    for _ in range(32):          # fixpoint; each pass sinks one layer
+        order = _topo(sym._outputs)
+        mapping = {}
+
+        def conv(entry):
+            node, idx = entry
+            return (mapping[id(node)][0], idx + mapping[id(node)][1]) \
+                if id(node) in mapping else entry
+
+        changed = False
+        for node in order:
+            if node.op is None or id(node) in mapping:
+                continue
+            ins = [conv(e) for e in node.inputs]
+            name = node.op.name
+            new = None
+            if (name == "relu" or (name == "Activation" and
+                                   node.attrs.get("act_type") == "relu")) \
+                    and is_dq(ins[0]):
+                q, lo, hi = ins[0][0].inputs
+                new = _Node(q_act, f"quantized_{node.name}", {},
+                            [q, lo, hi],
+                            arg_names=["data", "min_range", "max_range"])
+            elif name == "Pooling" and is_dq(ins[0]) and \
+                    node.attrs.get("pool_type", "max") in ("max",):
+                q, lo, hi = ins[0][0].inputs
+                new = _Node(q_pool, f"quantized_{node.name}",
+                            dict(node.attrs), [q, lo, hi],
+                            arg_names=["data", "min_range", "max_range"])
+            elif name in ("Flatten", "flatten") and is_dq(ins[0]):
+                q, lo, hi = ins[0][0].inputs
+                new = _Node(q_flat, f"quantized_{node.name}", {},
+                            [q, lo, hi],
+                            arg_names=["data", "min_range", "max_range"])
+            elif name in ("elemwise_add", "broadcast_add", "_plus") and \
+                    len(ins) == 2 and is_dq(ins[0]) and is_dq(ins[1]):
+                lq, llo, lhi = ins[0][0].inputs
+                rq, rlo, rhi = ins[1][0].inputs
+                new = _Node(q_add, f"quantized_{node.name}", {},
+                            [lq, rq, llo, lhi, rlo, rhi],
+                            arg_names=["lhs", "rhs", "lhs_min", "lhs_max",
+                                       "rhs_min", "rhs_max"])
+            elif node.op is q_v2 and is_dq(ins[0]) and \
+                    _traces_to_int32(ins[0][0].inputs[0][0],
+                                     (q_act, q_pool, q_flat),
+                                     int32_producers):
+                # dequantize(int32) -> quantize_v2 collapses to ONE
+                # requantize (reference requantize-inl.h: the int32
+                # accumulator -> int8 bridge without an fp32 round trip).
+                # quantize_v2 and requantize have the same 3-output arity,
+                # so consumers remap directly with no dequantize wrapper.
+                q, lo, hi = ins[0][0].inputs
+                attrs = {"out_type": node.attrs.get("out_type", "int8")}
+                for k in ("min_calib_range", "max_calib_range"):
+                    if k in node.attrs:
+                        attrs[k] = node.attrs[k]
+                mapping[id(node)] = (_Node(
+                    req_op, f"requantized_{node.name}", attrs, [q, lo, hi],
+                    arg_names=["qdata", "min_range", "max_range"]), 0)
+                changed = True
+                continue
+            if new is not None:
+                dq = _Node(dq_op, f"{node.name}_dequantize", {},
+                           [(new, 0), (new, 1), (new, 2)],
+                           arg_names=["qdata", "min_range", "max_range"])
+                mapping[id(node)] = (dq, 0)
+                changed = True
+
+        if not changed:
+            return sym
+        sym = S.Symbol(_rebuild_mapped(sym._outputs, mapping))
+    return sym
+
+
+def fold_batchnorm(sym, arg_params, aux_params):
+    """Fold inference-mode BatchNorm into the preceding Convolution
+    (reference: the MKLDNN subgraph fuse pass's conv+BN folding) — an
+    EXACT transform with running stats:
+        W' = W * (gamma / sqrt(var + eps))    (per output channel)
+        b' = beta + (b - mean) * gamma / sqrt(var + eps)
+    Quantizing the folded conv avoids a separate int8 BN stage and its
+    extra requantization error. Returns (sym2, arg2, aux2)."""
+    import numpy as _np2
+    from .. import symbol as S
+    from ..symbol.symbol import _Node, _topo
+    from ..ndarray import NDArray
+    from ..ndarray import array as _nd_array
+
+    arg2 = dict(arg_params)
+    aux2 = dict(aux_params or {})
+    order = _topo(sym._outputs)
+    consumers = {}
+    nonzero_out_use = set()   # node ids consumed at an output index != 0
+    for n in order:
+        if n.op is None:
+            continue
+        for (i, oi) in n.inputs:
+            consumers.setdefault(id(i), []).append(n)
+            if oi != 0:
+                nonzero_out_use.add(id(i))
+    for n, i in sym._outputs:
+        if i != 0:
+            nonzero_out_use.add(id(n))
+
+    mapping = {}
+
+    def conv_entry(entry):
+        node, idx = entry
+        return (mapping[id(node)], idx) if id(node) in mapping else entry
+
+    output_ids = {id(n) for n, _ in sym._outputs}
+    folded_weights = set()
+    for node in order:
+        if node.op is None or node.op.name != "BatchNorm":
+            continue
+        (src, src_oi) = node.inputs[0]
+        if src.op is None or src.op.name != "Convolution" or src_oi != 0:
+            continue
+        if id(node) in nonzero_out_use:
+            continue   # some consumer reads BN output 1/2 (mean/var);
+            # the fused conv exposes only output 0, so folding would hand
+            # that consumer conv activations — keep the BN
+        if len(consumers.get(id(src), [])) != 1 or id(src) in output_ids:
+            continue   # conv output used elsewhere / exposed: keep BN
+            # (folding mutates the conv WEIGHTS, so every consumer of the
+            # raw conv output — including a graph output — must go)
+        names = dict(zip(node.arg_names, [i for i, _ in node.inputs]))
+        try:
+            gamma = arg2[names["gamma"].name].asnumpy()
+            beta = arg2[names["beta"].name].asnumpy()
+            mean = aux2[names["moving_mean"].name].asnumpy()
+            var = aux2[names["moving_var"].name].asnumpy()
+        except KeyError:
+            continue
+        eps = float(node.attrs.get("eps", 1e-3))
+        if node.attrs.get("fix_gamma", True) in (True, "True", "true", "1"):
+            gamma = _np2.ones_like(gamma)
+        scale = gamma / _np2.sqrt(var + eps)
+
+        w_name = None
+        b_name = None
+        for (inp, _), aname in zip(src.inputs, src.arg_names):
+            if aname == "weight":
+                w_name = inp.name
+            elif aname == "bias":
+                b_name = inp.name
+        if w_name is None or w_name not in arg2:
+            continue
+        if w_name in folded_weights:
+            continue   # weight shared by another folded conv: a second
+            # in-place rescale would compound the scales
+        folded_weights.add(w_name)
+        w = arg2[w_name].asnumpy()
+        b = arg2[b_name].asnumpy() if b_name and b_name in arg2 else \
+            _np2.zeros(w.shape[0], w.dtype)
+        arg2[w_name] = _nd_array(
+            w * scale.reshape((-1,) + (1,) * (w.ndim - 1)))
+        nb = beta + (b - mean) * scale
+        # the folded conv always carries a bias
+        if b_name is None:
+            b_name = src.name + "_folded_bias"
+        arg2[b_name] = _nd_array(nb.astype(w.dtype))
+        new_attrs = dict(src.attrs)
+        new_attrs["no_bias"] = False
+        bias_var = _Node(None, b_name, {}, [])
+        new_inputs = []
+        new_argn = []
+        has_bias = False
+        for (inp, oi), aname in zip(src.inputs, src.arg_names):
+            e = conv_entry((inp, oi))
+            if aname == "bias":
+                new_inputs.append((bias_var, 0))
+                has_bias = True
+            else:
+                new_inputs.append(e)
+            new_argn.append(aname)
+        if not has_bias:
+            new_inputs.append((bias_var, 0))
+            new_argn.append("bias")
+        fused = _Node(src.op, src.name, new_attrs, new_inputs,
+                      extra=dict(src.extra), arg_names=new_argn)
+        mapping[id(node)] = fused
+
+    if not mapping:
+        return sym, arg2, aux2
+
+    rebuilt = {}
+
+    def rebuild(node):
+        """Replace mapped BNs with their fused conv AND rebuild the fused
+        node's own input subtree (a fused conv's inputs still reference
+        original upstream nodes containing earlier mapped BNs)."""
+        if id(node) in rebuilt:
+            return rebuilt[id(node)]
+        target = mapping.get(id(node), node)
+        if target.op is None:
+            rebuilt[id(node)] = target
+            return target
+        new_ins = []
+        for inp, oi in target.inputs:
+            nb = rebuild(inp)
+            # a mapped BatchNorm had 3 outputs; its fused conv exposes 1
+            new_ins.append((nb, 0 if id(inp) in mapping else oi))
+        nn = _Node(target.op, target.name, target.attrs, new_ins,
+                   extra=target.extra, arg_names=target.arg_names)
+        rebuilt[id(node)] = nn
+        return nn
+
+    new_outputs = []
+    for n, i in sym._outputs:
+        nb = rebuild(n)
+        new_outputs.append((nb, 0 if id(n) in mapping else i))
+    return S.Symbol(new_outputs), arg2, aux2
+
+
+def _calibrate_quantized_sym(sym, calib_data, data_names, num_batches,
+                             calib_mode, ctx=None, arg_params=None,
+                             aux_params=None):
+    """Collect per-layer output ranges from fp32 execution (reference
+    quantization.py _collect_layer_statistics / _LayerOutputCollector)."""
+    internals = sym.get_internals()
+    out_names = internals.list_outputs()
+    shapes = {d.name: tuple(d.shape) for d in calib_data.provide_data}
+    lbl = {d.name: tuple(d.shape)
+           for d in (calib_data.provide_label or [])}
+    shapes.update(lbl)
+    ex = internals.simple_bind(ctx, grad_req="null", **shapes)
+    if arg_params or aux_params:
+        ex.copy_params_from(arg_params or {}, aux_params or {},
+                            allow_extra_params=True)
+
+    # bounded memory: running min/max for naive; a capped per-layer sample
+    # for the entropy KL sweep (the reference keeps per-layer histograms,
+    # quantization.py LayerHistogramCollector — a sample bounds host RAM
+    # the same way without a two-pass range scan)
+    SAMPLE_CAP = 1 << 18
+    minmax = {}
+    samples = {}
+    rng = _np.random.RandomState(0)
+    calib_data.reset()
+    for nbatch, batch in enumerate(calib_data):
+        if nbatch >= num_batches:
+            break
+        feeds = {n: a for n, a in zip(data_names, batch.data)}
+        if batch.label:
+            for d, a in zip(calib_data.provide_label, batch.label):
+                feeds[d.name] = a
+        outs = ex.forward(is_train=False, **feeds)
+        for name, arr in zip(out_names, outs):
+            v = arr.asnumpy().ravel()
+            lo, hi = float(v.min()), float(v.max())
+            if name in minmax:
+                plo, phi = minmax[name]
+                minmax[name] = (min(lo, plo), max(hi, phi))
+            else:
+                minmax[name] = (lo, hi)
+            if calib_mode != "naive":
+                if v.size > SAMPLE_CAP // max(1, num_batches):
+                    idx = rng.choice(v.size,
+                                     SAMPLE_CAP // max(1, num_batches),
+                                     replace=False)
+                    v = v[idx]
+                samples.setdefault(name, []).append(v)
+
+    ranges = {}
+    for name, (lo, hi) in minmax.items():
+        if calib_mode == "naive":
+            ranges[name] = (lo, hi)
+        else:  # entropy
+            t = _optimal_threshold_kl(_np.concatenate(samples[name]))
+            ranges[name] = (-t, t)
+    return ranges
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, excluded_sym_names=None, calib_mode="entropy",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=logging):
+    """Reference quantization.py quantize_model: returns
+    (quantized symbol, quantized arg_params, aux_params)."""
+    if quantized_dtype not in ("int8", "uint8", "auto"):
+        raise MXNetError(f"unsupported quantized_dtype {quantized_dtype!r}")
+    if quantized_dtype == "auto":
+        quantized_dtype = "int8"
+    excluded = list(excluded_sym_names or [])
+
+    calib_ranges = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError(f"calib_mode={calib_mode!r} requires calib_data")
+        batch = calib_data.provide_data[0].shape[0]
+        num_batches = max(1, (num_calib_examples or batch) // batch)
+        calib_ranges = _calibrate_quantized_sym(
+            sym, calib_data, list(data_names), num_batches, calib_mode, ctx,
+            arg_params=arg_params, aux_params=aux_params)
+
+    # weight/bias ranges come from the params themselves
+    for pname, arr in arg_params.items():
+        v = arr.asnumpy()
+        calib_ranges[pname] = (float(v.min()), float(v.max()))
+
+    # rewrite: per-node input keys expected as f"{node}_{argname}"
+    # translate node input stats: data input of node X is the output of its
+    # predecessor — quantize_graph falls back to on-the-fly ranges when a
+    # key is missing, so partial coverage is fine.
+    from ..symbol.symbol import _topo
+    for node in _topo(sym._outputs):
+        if node.op is None or node.op.name not in _QUANTIZABLE:
+            continue
+        for (inp, oi), aname in zip(node.inputs, node.arg_names):
+            key = f"{node.name}_{aname}"
+            if inp.op is None:
+                if inp.name in calib_ranges:
+                    calib_ranges[key] = calib_ranges[inp.name]
+            else:
+                src = f"{inp.name}_output"
+                if src in calib_ranges:
+                    calib_ranges[key] = calib_ranges[src]
+
+    qsym = quantize_graph(sym, excluded, quantized_dtype, calib_ranges)
+
+    # parameter shapes are no longer inferrable through the quantize nodes
+    # (the per-op weight-shape rules attach to the fp32 ops); hint them on
+    # the variable nodes so simple_bind works from data shapes alone
+    from ..symbol.symbol import _topo as _topo2
+    for node in _topo2(qsym._outputs):
+        if node.op is None and node.name in arg_params:
+            node.extra.setdefault("__shape__",
+                                  tuple(arg_params[node.name].shape))
+
+    # pre-quantize the weights/biases (int8 symmetric) so the quantize
+    # nodes on params fold to casts at run time — params stay fp32 in the
+    # returned dict (the graph quantizes on entry), matching the
+    # reference's quantize_params behavior of emitting _quantize-suffixed
+    # params; here the graph handles it uniformly.
+    return qsym, dict(arg_params), dict(aux_params or {})

@@ -1,0 +1,148 @@
+"""SVRG optimization (variance-reduced SGD).
+
+Reference: python/mxnet/contrib/svrg_optimization/ — SVRGModule keeps a
+snapshot of the parameters every `update_freq` epochs, the full-dataset
+gradient at that snapshot (mu), and corrects every minibatch gradient as
+    g_corrected = g_i(w) - g_i(w_snapshot) + mu
+(Johnson & Zhang, 2013). The reference implements this with a pair of
+Modules and a special _SVRGOptimizer; here the snapshot executor is a
+second Module bound to the same Symbol and the correction is applied
+in-place on grad_dict before the normal update — no special optimizer
+needed, any registered optimizer composes.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """Module with SVRG gradient correction.
+
+    usage (reference svrg_module.py example):
+        mod = SVRGModule(sym, update_freq=2)
+        mod.bind(data_shapes=..., label_shapes=...)
+        mod.init_params(); mod.init_optimizer(...)
+        mod.fit(train_iter, num_epoch=N)   # handles snapshots itself
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), context=None,
+                 update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, context=context, **kwargs)
+        if int(update_freq) < 1:
+            raise MXNetError("update_freq must be >= 1")
+        self.update_freq = int(update_freq)
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, context=context,
+                               **kwargs)
+        self._mu = None           # full gradient at the snapshot
+        self._last_batch = None
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                           inputs_need_grad, force_rebind, None, grad_req)
+
+    def init_params(self, *args, **kwargs):
+        super().init_params(*args, **kwargs)
+        self._take_snapshot()
+
+    def _take_snapshot(self):
+        """Copy current params into the snapshot module."""
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(arg_params=arg, aux_params=aux,
+                                  force_init=True, allow_missing=False)
+
+    def update_full_grads(self, train_data):
+        """Compute mu = (1/B) sum over ALL batches of the snapshot's
+        gradient (reference svrg_module.py update_full_grads)."""
+        train_data.reset()
+        n = 0
+        sums = {}
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            for name in self._param_names:
+                g = self._mod_aux._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                # accumulate ON DEVICE (XLA async adds) — a host asnumpy()
+                # per param per batch would serialize the whole pass
+                gd = g._data
+                sums[name] = gd if name not in sums else sums[name] + gd
+            n += 1
+        train_data.reset()
+        if n == 0:
+            raise MXNetError("update_full_grads: empty train_data")
+        from ..ndarray.ndarray import NDArray
+        self._mu = {k: NDArray(v / n) for k, v in sums.items()}
+
+    def forward(self, data_batch, is_train=None):
+        self._last_batch = data_batch
+        super().forward(data_batch, is_train)
+
+    def update(self):
+        """Correct grads in place (g - g_snap + mu), then the normal
+        optimizer step."""
+        if self._mu is not None and self._last_batch is not None:
+            self._mod_aux.forward(self._last_batch, is_train=True)
+            self._mod_aux.backward()
+            for name in self._param_names:
+                g = self._exec.grad_dict.get(name)
+                gs = self._mod_aux._exec.grad_dict.get(name)
+                mu = self._mu.get(name)
+                if g is None or gs is None or mu is None:
+                    continue
+                g._data = (g._data - gs._data + mu._data).astype(g.dtype)
+        super().update()
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd", optimizer_params=None,
+            initializer=None, num_epoch=None, begin_epoch=0, **kwargs):
+        """Epoch loop with snapshot + full-grad refresh every update_freq
+        epochs (reference svrg_module.py fit)."""
+        if num_epoch is None:
+            raise MXNetError("fit needs num_epoch")
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label, for_training=True)
+        self.init_params(initializer=initializer)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        from ..metric import create as _metric_create
+        metric = _metric_create(eval_metric) if isinstance(eval_metric, str) \
+            else eval_metric
+        from ..model import BatchEndParam
+        for epoch in range(begin_epoch, num_epoch):
+            if (epoch - begin_epoch) % self.update_freq == 0:
+                self._take_snapshot()
+                self.update_full_grads(train_data)
+            metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update()
+                self.update_metric(metric, batch.label)
+                if batch_end_callback is not None:
+                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                          eval_metric=metric, locals=None)
+                    cbs = batch_end_callback if isinstance(
+                        batch_end_callback, (list, tuple)) \
+                        else [batch_end_callback]
+                    for cb in cbs:
+                        cb(param)
+            if epoch_end_callback is not None:
+                epoch_end_callback(epoch, self.symbol, *self.get_params())
+            if eval_data is not None:
+                res = self.score(eval_data, eval_metric)
+                self.logger.info("Epoch[%d] validation: %s", epoch,
+                                 dict(res))
+        return metric

@@ -1,0 +1,48 @@
+"""TensorBoard logging callback.
+
+Reference: python/mxnet/contrib/tensorboard.py (73 LoC LogMetricsCallback
+over the `tensorboard` SummaryWriter). The writer dependency is optional;
+without it, events fall back to a JSONL file a TensorBoard-compatible
+ingester (or any log parser) can consume — nothing in this image may be
+pip-installed, so the fallback is the default path here.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Batch-end callback logging eval metrics.
+
+    usage: mod.fit(..., batch_end_callback=LogMetricsCallback(logdir))
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.logging_dir = logging_dir
+        self.prefix = prefix
+        self.step = 0
+        os.makedirs(logging_dir, exist_ok=True)
+        self._writer = None
+        try:  # optional real SummaryWriter (tensorboardX / torch.utils)
+            from torch.utils.tensorboard import SummaryWriter
+            self._writer = SummaryWriter(logging_dir)
+        except Exception:
+            self._file = open(os.path.join(logging_dir, "metrics.jsonl"),
+                              "a", buffering=1)
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            tag = f"{self.prefix}-{name}" if self.prefix else name
+            if self._writer is not None:
+                self._writer.add_scalar(tag, value, self.step)
+            else:
+                self._file.write(json.dumps(
+                    {"tag": tag, "value": float(value), "step": self.step,
+                     "ts": time.time()}) + "\n")

@@ -1,0 +1,6 @@
+"""Text utilities (reference python/mxnet/contrib/text/): Vocabulary and
+token embeddings."""
+from . import embedding, utils, vocab
+from .vocab import Vocabulary
+
+__all__ = ["vocab", "embedding", "utils", "Vocabulary"]

@@ -1,0 +1,233 @@
+"""Token embeddings (reference python/mxnet/contrib/text/embedding.py).
+
+Pretrained-file downloads are gated (zero-egress environment): GloVe and
+FastText accept a local `pretrained_file_path`; CustomEmbedding loads any
+token<delim>vec text file. The registry/create/CompositeEmbedding API
+matches the reference.
+"""
+from __future__ import annotations
+
+import copy
+import io
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...base import MXNetError, Registry
+from . import vocab as _vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "CustomEmbedding", "GloVe", "FastText", "CompositeEmbedding"]
+
+_REG = Registry("token_embedding")
+
+
+def register(embedding_cls):
+    """Register a _TokenEmbedding subclass (reference embedding.py:40)."""
+    _REG.register(embedding_cls, name=embedding_cls.__name__.lower())
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding by name (reference :63)."""
+    cls = _REG.get(embedding_name.lower())
+    if cls is None:
+        raise MXNetError(f"unknown embedding {embedding_name!r}")
+    return cls(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names per embedding (reference :90)."""
+    table = {"glove": GloVe.pretrained_file_names,
+             "fasttext": FastText.pretrained_file_names}
+    if embedding_name is not None:
+        key = embedding_name.lower()
+        if key not in table:
+            raise MXNetError(f"unknown embedding {embedding_name!r}")
+        return table[key]
+    return table
+
+
+class _TokenEmbedding(_vocab.Vocabulary):
+    """Vocabulary + idx_to_vec matrix (reference embedding.py:133)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    def _load_embedding(self, pretrained_file_path, elem_delim=" ",
+                        init_unknown_vec=None, encoding="utf-8"):
+        """Parse `token<delim>v1<delim>v2...` lines (reference :232)."""
+        tokens, vecs = [], []
+        seen: set = set()
+        vec_len = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                if line_num == 0 and len(parts) == 2:
+                    # fastText .vec header: "<count> <dim>" (two ints)
+                    try:
+                        int(parts[0]), int(parts[1])
+                        continue
+                    except ValueError:
+                        pass
+                token, elems = parts[0], parts[1:]
+                if vec_len is None:
+                    vec_len = len(elems)
+                elif len(elems) != vec_len:
+                    raise MXNetError(
+                        f"line {line_num + 1}: dim {len(elems)} != {vec_len}")
+                # keep the FIRST occurrence; real files (GloVe 840B) contain
+                # duplicate tokens (reference embedding.py:268 does the same)
+                if token in self._token_to_idx or token in seen:
+                    continue
+                seen.add(token)
+                tokens.append(token)
+                vecs.append([float(e) for e in elems])
+        if vec_len is None:
+            raise MXNetError(f"no vectors found in {pretrained_file_path}")
+        self._vec_len = vec_len
+        for t in tokens:
+            self._token_to_idx[t] = len(self._idx_to_token)
+            self._idx_to_token.append(t)
+        mat = _np.zeros((len(self._idx_to_token), vec_len), _np.float32)
+        n_special = len(self._idx_to_token) - len(tokens)
+        mat[n_special:] = _np.asarray(vecs, _np.float32)
+        if init_unknown_vec is not None and n_special:
+            mat[:n_special] = init_unknown_vec(shape=(n_special, vec_len)) \
+                if callable(init_unknown_vec) else init_unknown_vec
+        self._idx_to_vec = nd.array(mat)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Look up vectors; unknown tokens get index 0's vector
+        (reference :366)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idxs = self.to_indices(toks)
+        out = nd.take(self._idx_to_vec,
+                      nd.array(_np.asarray(idxs, _np.float32)))
+        return out[0] if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """In-place update of vectors for known tokens (reference :405)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        for t in toks:
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} is unknown; cannot update")
+        idxs = [self._token_to_idx[t] for t in toks]
+        nv = new_vectors if isinstance(new_vectors, nd.NDArray) \
+            else nd.array(_np.asarray(new_vectors, _np.float32))
+        if single:
+            nv = nv.reshape((1, -1))
+        # dedup keeping the LAST row per token (jax scatter with repeated
+        # indices is implementation-defined), then device-side row scatter
+        last = {}
+        for pos, i in enumerate(idxs):
+            last[i] = pos
+        keep = sorted(last.values())
+        if len(keep) != len(idxs):
+            nv = nd.take(nv, nd.array(_np.asarray(keep, _np.float32)))
+            idxs = [idxs[p] for p in keep]
+        self._idx_to_vec[_np.asarray(idxs)] = nv
+
+    def _build_for_vocabulary(self, vocabulary, source):
+        """Restrict `source` embedding to `vocabulary`'s tokens
+        (reference :305-357)."""
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._vec_len = source.vec_len
+        mat = _np.zeros((len(self), self._vec_len), _np.float32)
+        src_vecs = source.idx_to_vec.asnumpy()
+        for i, tok in enumerate(self._idx_to_token):
+            j = source.token_to_idx.get(tok)
+            if j is not None:
+                mat[i] = src_vecs[j]
+        self._idx_to_vec = nd.array(mat)
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """Load any `token<delim>vec` text file (reference embedding.py:623)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf-8", init_unknown_vec=None, vocabulary=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            self._build_for_vocabulary(vocabulary, copy.copy(self))
+
+
+class _PretrainedEmbedding(_TokenEmbedding):
+    pretrained_file_names: tuple = ()
+
+    def __init__(self, pretrained_file_name=None, pretrained_file_path=None,
+                 init_unknown_vec=None, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        if pretrained_file_path is None:
+            raise MXNetError(
+                f"{type(self).__name__}: pretrained-file download is "
+                "unavailable in this environment (zero egress); pass "
+                "pretrained_file_path= to a local copy of "
+                f"{pretrained_file_name or self.pretrained_file_names[:3]}")
+        self._load_embedding(pretrained_file_path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_for_vocabulary(vocabulary, copy.copy(self))
+
+
+@register
+class GloVe(_PretrainedEmbedding):
+    """GloVe vectors (reference embedding.py:469). Local-file only here."""
+    pretrained_file_names = ("glove.42B.300d.txt", "glove.6B.50d.txt",
+                             "glove.6B.100d.txt", "glove.6B.200d.txt",
+                             "glove.6B.300d.txt", "glove.840B.300d.txt")
+
+
+@register
+class FastText(_PretrainedEmbedding):
+    """fastText vectors (reference embedding.py:541). Local-file only."""
+    pretrained_file_names = ("wiki.simple.vec", "wiki.en.vec")
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (reference embedding.py:665)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__()
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        mats = []
+        for emb in token_embeddings:
+            part = _np.zeros((len(self), emb.vec_len), _np.float32)
+            src = emb.idx_to_vec.asnumpy()
+            for i, tok in enumerate(self._idx_to_token):
+                j = emb.token_to_idx.get(tok)
+                if j is not None:
+                    part[i] = src[j]
+            mats.append(part)
+        full = _np.concatenate(mats, axis=1)
+        self._vec_len = full.shape[1]
+        self._idx_to_vec = nd.array(full)

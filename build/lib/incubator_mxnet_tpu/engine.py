@@ -1,0 +1,32 @@
+"""Engine control surface (reference python/mxnet/engine.py, 75 LoC).
+
+The reference exposes `bulk(size)` to batch engine ops and reduce dispatch
+overhead (MXEngineSetBulkSize). XLA's async runtime already pipelines
+dispatch, so bulking is a no-op here — the context manager is kept so
+reference code runs unchanged, and `set_bulk_size` returns the previous
+value like the C API did.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_bulk_size = 0
+
+
+def set_bulk_size(size):
+    """Reference engine.py set_bulk_size -> MXEngineSetBulkSize."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextmanager
+def bulk(size):
+    """Reference engine.py bulk(size) context manager."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

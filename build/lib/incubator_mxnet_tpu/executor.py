@@ -1,0 +1,284 @@
+"""Executor: a Symbol bound to arrays, compiled with jax.jit.
+
+Reference: python/mxnet/executor.py (Executor wrapper) over
+GraphExecutor::Init/Forward/Backward (src/executor/graph_executor.cc:388,78,91).
+The reference plans memory, attaches per-node engine ops, and bulks segments;
+here `bind` closes the graph over its argument arrays and hands the whole
+program to XLA — memory planning, fusion, and scheduling are the compiler's
+job (SURVEY §7: GraphExecutor simple_bind -> AOT jit compile).
+
+Semantics kept from the reference:
+  * grad_req per-argument: write / add / null,
+  * backward() with no out_grads seeds ones (loss-head ops like SoftmaxOutput
+    ignore the seed by construction, src/operator/softmax_output-inl.h),
+  * auxiliary states (BatchNorm moving stats) update on is_train forward with
+    the op's momentum — the reference mutates them inside the kernel
+    (src/operator/nn/batch_norm.cc:417), we apply the same update functionally,
+  * dropout masks agree between forward and backward: the backward executable
+    replays the forward's PRNG key.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError, dtype_np
+from .ndarray import NDArray
+from .ndarray import random as _rnd
+
+__all__ = ["Executor"]
+
+
+def _as_nd(x, dtype=_np.float32):
+    if isinstance(x, NDArray):
+        return x
+    from .ndarray import array
+    return array(x, dtype=getattr(x, "dtype", dtype))
+
+
+class Executor:
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        from .symbol.symbol import AUX_INPUTS, _topo
+
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+
+        if args is None:
+            raise MXNetError("bind requires args (dict or list)")
+        if isinstance(args, dict):
+            missing = [n for n in arg_names if n not in args]
+            if missing:
+                raise MXNetError(f"bind: missing args {missing}")
+            self.arg_dict = {n: _as_nd(args[n]) for n in arg_names}
+        else:
+            if len(args) != len(arg_names):
+                raise MXNetError(
+                    f"bind: expected {len(arg_names)} args, got {len(args)}")
+            self.arg_dict = {n: _as_nd(a) for n, a in zip(arg_names, args)}
+
+        if aux_states is None:
+            aux_states = {}
+        if isinstance(aux_states, dict):
+            self.aux_dict = {n: _as_nd(aux_states[n]) for n in aux_names
+                             if n in aux_states}
+            missing = [n for n in aux_names if n not in self.aux_dict]
+            if missing:
+                raise MXNetError(f"bind: missing aux states {missing}")
+        else:
+            self.aux_dict = {n: _as_nd(a) for n, a in zip(aux_names, aux_states)}
+
+        # grad bookkeeping
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null") for n in arg_names}
+
+        self.grad_dict = {}
+        if args_grad is not None:
+            if isinstance(args_grad, dict):
+                self.grad_dict = {n: _as_nd(g) for n, g in args_grad.items()}
+            else:
+                self.grad_dict = {n: _as_nd(g)
+                                  for n, g in zip(arg_names, args_grad)}
+        for n in arg_names:
+            if self._grad_req.get(n, "null") != "null" and n not in self.grad_dict:
+                a = self.arg_dict[n]
+                from .ndarray import zeros
+                self.grad_dict[n] = zeros(a.shape, dtype=a.dtype)
+
+        # BatchNorm aux wiring: node name -> (momentum, mean_var_name, var_name)
+        self._bn_wiring = {}
+        for node in _topo(symbol._outputs):
+            if node.op is not None and node.op.name in AUX_INPUTS:
+                aux_argnames = AUX_INPUTS[node.op.name]
+                names = {}
+                for (inp, _), aname in zip(node.inputs, node.arg_names):
+                    if aname in aux_argnames and inp.op is None:
+                        names[aname] = inp.name
+                if len(names) == len(aux_argnames):
+                    self._bn_wiring[node.name] = (
+                        float(node.attrs.get("momentum", 0.9)),
+                        names[aux_argnames[0]], names[aux_argnames[1]],
+                        bool(node.attrs.get("use_global_stats", False)))
+
+        self.outputs = []
+        self._monitor_callback = None
+        self._jit = {}          # is_train -> jitted forward
+        self._jit_bwd = None
+        self._last = None       # (rng, arg_vals, aux_vals) of last train fwd
+
+    # -- convenience views --------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    # -- compile ------------------------------------------------------------
+    def _forward_fn(self, is_train):
+        fn = self._jit.get(is_train)
+        if fn is None:
+            import jax
+            run = self._symbol._build_eval(training=is_train)
+
+            def f(arg_vals, aux_vals, rng):
+                bindings = dict(arg_vals)
+                bindings.update(aux_vals)
+                outs, stats = run(bindings, rng)
+                new_aux = {}
+                if is_train:
+                    for node_name, (mom, mname, vname, use_global) in \
+                            self._bn_wiring.items():
+                        if use_global or node_name not in stats:
+                            continue
+                        bm, bv = stats[node_name]
+                        new_aux[mname] = mom * bindings[mname] + (1 - mom) * bm
+                        new_aux[vname] = mom * bindings[vname] + (1 - mom) * bv
+                return outs, new_aux
+
+            fn = jax.jit(f)
+            self._jit[is_train] = fn
+        return fn
+
+    def _backward_fn(self):
+        if self._jit_bwd is None:
+            import jax
+            run = self._symbol._build_eval(training=True)
+            wrt = [n for n in self._arg_names
+                   if self._grad_req.get(n, "null") != "null"]
+            self._wrt = wrt
+
+            def f(diff_vals, fixed_vals, aux_vals, rng, cts):
+                def fwd(dv):
+                    bindings = dict(fixed_vals)
+                    bindings.update(aux_vals)
+                    bindings.update(dv)
+                    outs, _ = run(bindings, rng)
+                    return tuple(outs)
+
+                _, vjp_fn = jax.vjp(fwd, diff_vals)
+                return vjp_fn(tuple(cts))[0]
+
+            self._jit_bwd = jax.jit(f)
+        return self._jit_bwd
+
+    # -- run ----------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k!r}")
+            self.arg_dict[k]._data = _as_nd(v)._data.astype(
+                self.arg_dict[k].dtype)
+        arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+        aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        rng = _rnd.next_key()
+        outs, new_aux = self._forward_fn(bool(is_train))(arg_vals, aux_vals, rng)
+        self.outputs = [NDArray(o) for o in outs]
+        if is_train:
+            self._last = (rng, arg_vals, aux_vals)
+            for name, val in new_aux.items():
+                self.aux_dict[name]._data = val
+        if self._monitor_callback is not None:
+            for name, o in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, o)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if self._last is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        rng, arg_vals, aux_vals = self._last
+        bwd = self._backward_fn()
+        wrt = self._wrt
+        if not wrt:
+            return
+        import jax.numpy as jnp
+        if out_grads is None:
+            cts = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        diff_vals = {n: arg_vals[n] for n in wrt}
+        fixed_vals = {n: v for n, v in arg_vals.items() if n not in diff_vals}
+        grads = bwd(diff_vals, fixed_vals, aux_vals, rng, cts)
+        for n in wrt:
+            g = grads[n]
+            if self._grad_req[n] == "add":
+                self.grad_dict[n]._data = self.grad_dict[n]._data + g
+            else:
+                self.grad_dict[n]._data = g
+
+    # -- misc ---------------------------------------------------------------
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for n, v in arg_params.items():
+            if n in self.arg_dict:
+                self.arg_dict[n]._data = _as_nd(v)._data.astype(
+                    self.arg_dict[n].dtype)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown parameter {n!r}")
+        if aux_params:
+            for n, v in aux_params.items():
+                if n in self.aux_dict:
+                    self.aux_dict[n]._data = _as_nd(v)._data.astype(
+                        self.aux_dict[n].dtype)
+                elif not allow_extra_params:
+                    raise MXNetError(f"unknown aux state {n!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new input shapes, keeping parameter arrays whose
+        shapes are unchanged (reference executor.py reshape)."""
+        shapes = dict(kwargs)
+        for n, a in self.arg_dict.items():
+            shapes.setdefault(n, a.shape)
+        new = Executor.simple_bind(self._symbol, self._ctx,
+                                   grad_req=self._grad_req, **{
+                                       k: v for k, v in shapes.items()})
+        for n, a in self.arg_dict.items():
+            if n in new.arg_dict and new.arg_dict[n].shape == a.shape:
+                new.arg_dict[n]._data = a._data
+        for n, a in self.aux_dict.items():
+            if n in new.aux_dict and new.aux_dict[n].shape == a.shape:
+                new.aux_dict[n]._data = a._data
+        return new
+
+    @staticmethod
+    def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
+                    **shapes):
+        """Allocate arrays from inferred shapes and bind
+        (reference graph_executor.cc:388 Init, simple_bind path)."""
+        from .ndarray import zeros
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        known = {k: tuple(v) for k, v in shapes.items()
+                 if not isinstance(v, (str, type, _np.dtype))}
+        dtypes = {k: dtype_np(v) for k, v in (type_dict or {}).items()}
+        shapes_map, types_map = symbol._run_inference(
+            known, dtypes, False, want_types=True)
+        unk = [n for n in arg_names + aux_names if shapes_map.get(n) is None]
+        if unk:
+            raise MXNetError(f"simple_bind: could not infer shapes for {unk}")
+        from .base import dtype_name
+        args = {n: zeros(shapes_map[n], dtype=dtype_name(types_map[n]))
+                for n in arg_names}
+        aux = {n: zeros(shapes_map[n], dtype=dtype_name(types_map[n]))
+               for n in aux_names}
+        return Executor(symbol, ctx, args=args, grad_req=grad_req,
+                        aux_states=aux)

@@ -1,0 +1,243 @@
+"""Failure handling: checkpoint/resume + preemption (SURVEY §5.3).
+
+The reference's failure story is thin — ps-lite node timeouts surface as
+`kv.get_dead_nodes(timeout)` (src/kvstore/kvstore_dist.h:121) and a
+restart-recovery flag skips the startup barrier; there is no automatic
+checkpoint-resume orchestration. On TPU pods preemption is routine, so
+this module goes further:
+
+- ``CheckpointManager``: atomic (write-tmp + rename), rotating, resumable
+  checkpoints of net parameters + trainer state, with a manifest that
+  survives partial writes.
+- ``PreemptionHandler``: SIGTERM/SIGINT hook that flips a flag (and
+  optionally checkpoints immediately) so training loops can exit cleanly
+  at the next step boundary.
+- ``get_dead_nodes``: liveness parity API (reference kvstore_dist.h:121);
+  under the single-controller jax runtime a missing host fails the whole
+  program, so live == all.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+
+from .base import MXNetError
+
+__all__ = ["CheckpointManager", "PreemptionHandler", "get_dead_nodes",
+           "resume_or_start"]
+
+
+class CheckpointManager:
+    """Atomic rotating checkpoints for (net, trainer).
+
+    Layout: ``{dir}/{prefix}-{step:08d}.params`` (+ ``.states`` when a
+    trainer is given) and a ``{prefix}.manifest.json`` that is only
+    updated AFTER the artifact files are fully on disk — a crash mid-save
+    never corrupts the latest restorable step.
+    """
+
+    def __init__(self, directory, prefix="ckpt", max_keep=3):
+        self.directory = directory
+        self.prefix = prefix
+        self.max_keep = max_keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------
+    def _manifest_path(self):
+        return os.path.join(self.directory, f"{self.prefix}.manifest.json")
+
+    def _params_path(self, step):
+        return os.path.join(self.directory,
+                            f"{self.prefix}-{step:08d}.params")
+
+    def _states_path(self, step):
+        return os.path.join(self.directory,
+                            f"{self.prefix}-{step:08d}.states")
+
+    def _read_manifest(self):
+        try:
+            with open(self._manifest_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"steps": []}
+
+    def _write_atomic(self, path, writer):
+        fd, tmp = tempfile.mkstemp(dir=self.directory,
+                                   prefix=os.path.basename(path) + ".tmp")
+        os.close(fd)
+        try:
+            writer(tmp)
+            # flush DATA before the rename: a journaled rename without a
+            # data fsync can survive power loss pointing at torn content
+            fd2 = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd2)
+            finally:
+                os.close(fd2)
+            os.replace(tmp, path)  # atomic on POSIX
+            dirfd = os.open(self.directory, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
+    # -- API -----------------------------------------------------------
+    def save(self, step, net, trainer=None, extra=None):
+        """Checkpoint at `step`. Returns the params path."""
+        step = int(step)
+        ppath = self._params_path(step)
+        self._write_atomic(ppath, net.save_parameters)
+        if trainer is not None:
+            self._write_atomic(self._states_path(step), trainer.save_states)
+        man = self._read_manifest()
+        entry = {"step": step, "has_states": trainer is not None,
+                 "time": time.time()}
+        if extra:
+            entry["extra"] = extra
+        man["steps"] = [e for e in man["steps"] if e["step"] != step]
+        man["steps"].append(entry)
+        man["steps"].sort(key=lambda e: e["step"])
+        while len(man["steps"]) > self.max_keep:
+            old = man["steps"].pop(0)
+            for p in (self._params_path(old["step"]),
+                      self._states_path(old["step"])):
+                if os.path.exists(p):
+                    os.remove(p)
+        def write_manifest(tmp):
+            with open(tmp, "w") as f:
+                f.write(json.dumps(man, indent=1))
+
+        self._write_atomic(self._manifest_path(), write_manifest)
+        return ppath
+
+    def latest_step(self):
+        """Newest restorable step, or None."""
+        for e in reversed(self._read_manifest()["steps"]):
+            if os.path.exists(self._params_path(e["step"])):
+                return e["step"]
+        return None
+
+    def restore(self, net, trainer=None, step=None, ctx=None):
+        """Load params (+trainer states) from `step` (default: latest).
+        Returns the restored step number. Raises if the manifest says the
+        step was saved WITH trainer state but the .states file is gone —
+        silently resetting optimizer state is not a resume."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise MXNetError(f"no checkpoint found in {self.directory}")
+        net.load_parameters(self._params_path(step), ctx=ctx)
+        if trainer is not None:
+            spath = self._states_path(step)
+            expected = any(e["step"] == step and e.get("has_states")
+                           for e in self._read_manifest()["steps"])
+            if os.path.exists(spath):
+                trainer.load_states(spath)
+            elif expected:
+                raise MXNetError(
+                    f"checkpoint step {step} was saved with trainer state "
+                    f"but {spath} is missing; refusing a silent partial "
+                    "resume (pass trainer=None to load params only)")
+        return step
+
+    def extra(self, step=None):
+        """The `extra` dict saved with a step (default: latest)."""
+        if step is None:
+            step = self.latest_step()
+        for e in self._read_manifest()["steps"]:
+            if e["step"] == step:
+                return e.get("extra", {})
+        return {}
+
+
+def resume_or_start(manager, net, trainer=None, ctx=None):
+    """Restore the latest checkpoint if one exists; returns the step to
+    resume from (0 when starting fresh)."""
+    step = manager.latest_step()
+    if step is None:
+        return 0
+    manager.restore(net, trainer, step=step, ctx=ctx)
+    return step
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT-driven graceful stop.
+
+    The signal handler ONLY sets a flag — checkpointing from inside a
+    signal handler could capture parameters mid-update. `on_preempt` is
+    deferred to the first `should_stop()` call after the signal, i.e. the
+    training loop's step boundary, where state is consistent.
+
+    usage:
+        with PreemptionHandler() as pre:
+            for step in range(start, total):
+                ...train one step...
+                if pre.should_stop():
+                    mgr.save(step, net, trainer)
+                    break
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 on_preempt=None):
+        self._signals = tuple(signals)
+        self._on_preempt = on_preempt
+        self._stop = threading.Event()
+        self._callback_fired = False
+        self._prev = {}
+        self._installed = False
+
+    def _handler(self, signum, frame):
+        self._stop.set()
+
+    def install(self):
+        if self._installed:
+            return self
+        for s in self._signals:
+            self._prev[s] = signal.signal(s, self._handler)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def should_stop(self):
+        stopped = self._stop.is_set()
+        if stopped and self._on_preempt is not None and \
+                not self._callback_fired:
+            # deferred to here: main-thread, step-boundary context
+            self._callback_fired = True
+            try:
+                self._on_preempt()
+            except Exception:
+                pass  # never mask the shutdown path
+        return stopped
+
+    def reset(self):
+        self._stop.clear()
+        self._callback_fired = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+
+def get_dead_nodes(timeout_sec=60):
+    """Liveness parity API (reference kvstore_dist.h:121 get_dead_nodes).
+
+    Under jax's single-controller runtime a dead host aborts the program
+    (there is no partial-failure mode to report), so any process that can
+    call this sees every peer alive: returns [].
+    """
+    return []

@@ -1,0 +1,18 @@
+"""Gluon: imperative + hybridizable neural network API
+(reference python/mxnet/gluon/)."""
+from . import nn
+from . import loss
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict
+from .trainer import Trainer
+
+
+def __getattr__(name):
+    import importlib
+    lazy = {"rnn": ".rnn", "data": ".data", "model_zoo": ".model_zoo",
+            "contrib": ".contrib", "utils": ".utils"}
+    if name in lazy:
+        m = importlib.import_module(lazy[name], __name__)
+        globals()[name] = m
+        return m
+    raise AttributeError(f"module 'gluon' has no attribute {name!r}")

@@ -1,0 +1,598 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Reference: python/mxnet/gluon/block.py (1,217 LoC): `Block:131`
+(`__call__:568` -> `forward:581`), `HybridBlock:705` (`hybridize:870`,
+`_build_cache:786` -> CachedOp at `:823`, deferred shape init, `export:907`).
+
+TPU-native redesign: `hybridize()` compiles the block's forward into TWO cached
+jax.jit executables instead of an NNVM CachedOp (src/imperative/cached_op.cc):
+
+  * fwd:  (params, rng, *inputs) -> (outputs, state_updates)   [one XLA program]
+  * bwd:  (params, rng, inputs, cotangents) -> input/param grads
+          — recomputes the forward inside the same XLA program (classic
+          rematerialization; XLA dedups/fuses), so backward needs no Python
+          retracing and no residual shipping across the jit boundary.
+
+Parameters enter as traced arguments (never baked constants), mutable state
+(BatchNorm running stats) is captured functionally and written back after the
+call, and randomness flows from a traced PRNG key so dropout masks agree
+between the fwd and bwd executables.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+
+from .. import autograd, nd
+from ..base import MXNetError
+from ..ndarray import random as _rnd
+from ..ndarray.ndarray import NDArray
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _NameCounter:
+    _lock = threading.Lock()
+    _counts: dict[str, int] = {}
+
+    @classmethod
+    def next(cls, alias):
+        with cls._lock:
+            i = cls._counts.get(alias, 0)
+            cls._counts[alias] = i + 1
+        return f"{alias}{i}_"
+
+
+class _StateWriteScope:
+    """Captures Parameter.set_data of traced values during hybridize tracing."""
+
+    _tls = threading.local()
+
+    def __init__(self):
+        self.writes = OrderedDict()
+
+    def __enter__(self):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        self._tls.stack.pop()
+
+    @classmethod
+    def current(cls):
+        stack = getattr(cls._tls, "stack", None)
+        return stack[-1] if stack else None
+
+
+def _is_tracer(x):
+    import jax
+    return isinstance(x, jax.core.Tracer)
+
+
+class _TraceScope:
+    """Active while a hybridize trace is being built: nested hybridized blocks
+    must run their eager path so the whole subtree lowers into ONE flat XLA
+    program (the reference inlines sub-CachedOps the same way,
+    cached_op.h inline_limit)."""
+
+    _tls = threading.local()
+
+    def __enter__(self):
+        self._tls.depth = getattr(self._tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        self._tls.depth -= 1
+
+    @classmethod
+    def active(cls):
+        return getattr(cls._tls, "depth", 0) > 0
+
+
+class _SymbolicScope:
+    """Active while exporting: hybrid_forward runs with F = the symbol
+    namespace and parameters as named variables, producing the serving graph
+    (the reference traces hybrid_forward with Symbol args, block.py:786)."""
+
+    _tls = threading.local()
+
+    def __enter__(self):
+        self._tls.depth = getattr(self._tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        self._tls.depth -= 1
+
+    @classmethod
+    def active(cls):
+        return getattr(cls._tls, "depth", 0) > 0
+
+
+# patch Parameter.set_data to intercept traced writes
+_orig_set_data = Parameter.set_data
+
+
+def _set_data_trace_aware(self, data):
+    scope = _StateWriteScope.current()
+    val = data._data if isinstance(data, NDArray) else data
+    if scope is not None and _is_tracer(val):
+        scope.writes[self.name] = val
+        return
+    _orig_set_data(self, data)
+
+
+Parameter.set_data = _set_data_trace_aware
+
+
+class Block:
+    """Base building block (reference gluon/block.py:131)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix = prefix if prefix is not None else _NameCounter.next(self._alias())
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children = OrderedDict()
+        self._reg_params = OrderedDict()
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return type(self).__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+
+    @property
+    def params(self):
+        return self._params
+
+    def __repr__(self):
+        lines = [f"{type(self).__name__}("]
+        for key, child in self._children.items():
+            lines.append(f"  ({key}): {type(child).__name__}")
+        lines.append(")")
+        return "\n".join(lines)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def collect_params(self, select=None) -> ParameterDict:
+        """All parameters of self + descendants (reference block.py:361)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pat = re.compile(select)
+            ret.update({k: v for k, v in self._params.items() if pat.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Structured-name save (reference gluon/block.py:319)."""
+        params = self._collect_params_with_prefix()
+        nd.save(filename, {k: p.data() for k, p in params.items()
+                           if p._data is not None})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        """Reference gluon/block.py:361."""
+        loaded = nd.load(filename)
+        if not isinstance(loaded, dict):
+            raise MXNetError("not a parameter dict file")
+        params = self._collect_params_with_prefix()
+        for name, p in params.items():
+            if name in loaded:
+                p._infer_shape(loaded[name].shape)
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"extra params in file: {sorted(extra)}")
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._reg_params.values():
+            p.cast(dtype)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        n_params = sum(int(_np.prod(p.shape)) for p in self.collect_params().values()
+                       if p.shape)
+        print(f"{type(self).__name__}: {n_params} parameters, "
+              f"output {[o.shape for o in (out if isinstance(out, (list, tuple)) else [out])]}")
+        return out
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class HybridBlock(Block):
+    """Block that can be compiled (reference gluon/block.py:705)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph = {}
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, forward_bulk_size=None, backward_bulk_size=None):
+        """Reference gluon/block.py:870. static_alloc/static_shape are
+        accepted for API parity; XLA always compiles statically."""
+        self._active = active
+        self._flags = {"static_alloc": static_alloc, "static_shape": static_shape}
+        self._cached_graph = {}
+        super().hybridize(active=active)
+
+    def cast(self, dtype):
+        self._cached_graph = {}
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Hook for leaf layers to resolve deferred parameter shapes from the
+        first input (reference: deferred shape inference through the symbolic
+        graph, block.py:786)."""
+        raise DeferredInitializationError(
+            f"{type(self).__name__} has uninitialized parameters with unknown "
+            f"shape; implement infer_shape() or give explicit shapes")
+
+    # -- eager path ---------------------------------------------------------
+    def _eager_forward(self, *args):
+        if _SymbolicScope.active():
+            from .. import symbol as _sym
+            params = {k: _sym.var(p.name)
+                      for k, p in self._reg_params.items()}
+            return self.hybrid_forward(_sym, *args, **params)
+        try:
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._finish_deferred(*args)
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        return self.hybrid_forward(nd, *args, **params)
+
+    def _finish_deferred(self, *args):
+        self.infer_shape(*args)
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def forward(self, *args):
+        if args and isinstance(args[0], NDArray):
+            self._num_inputs = len(args)
+        if self._active and not _TraceScope.active() and args and \
+                isinstance(args[0], NDArray):
+            return self._call_cached(*args)
+        return self._eager_forward(*args)
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- compiled path ------------------------------------------------------
+    def _trace_param_list(self):
+        params = self.collect_params()
+        return [params[k] for k in sorted(params.keys())]
+
+    def _call_cached(self, *args):
+        import jax
+
+        # train-mode flag mirrors the eager ops' train_aware gating exactly:
+        # `with autograd.train_mode():` outside record() must still run
+        # Dropout/BatchNorm in training mode (reference train_mode semantics)
+        training = autograd.is_training()
+        arrs = [a._data for a in args]
+        key = (tuple((tuple(a.shape), str(a.dtype)) for a in arrs), training)
+        entry = self._cached_graph.get(key)
+        if entry is None:
+            entry = self._build_cache(args, training)
+            self._cached_graph[key] = entry
+        jit_fwd, jit_bwd, param_list, unflatten, replay_def = entry
+
+        pf = [p.data()._data for p in param_list]
+        rng = _rnd.next_key()
+        flat_out, aux = jit_fwd(pf, rng, *arrs)
+        outs = [NDArray(o) for o in flat_out]
+
+        # write back captured state updates (BatchNorm running stats)
+        if aux:
+            by_name = {p.name: p for p in param_list}
+            for name, val in aux.items():
+                _orig_set_data(by_name[name], NDArray(val))
+
+        if autograd.is_recording():
+            import weakref
+
+            inputs_record = [p.data() for p in param_list] + list(args)
+            saved = (pf, rng, arrs)
+
+            def node_vjp(cts):
+                cts_t = cts if isinstance(cts, tuple) else (cts,)
+                p_cts, *in_cts = jit_bwd(saved[0], saved[1], tuple(saved[2]),
+                                         tuple(cts_t))
+                return tuple(p_cts) + tuple(in_cts)
+
+            node = autograd.Node(node_vjp, inputs_record, f"cachedop_{self.name}")
+            node.out_refs = [weakref.ref(o) for o in outs]
+            node.out_avals = [(o.shape, o.dtype) for o in outs]
+
+            def node_replay(cts, _args=args, _pl=param_list, _rng=rng,
+                            _rd=replay_def):
+                from ..ops import registry as _R
+                cargs = [c if isinstance(c, NDArray) else NDArray(c)
+                         for c in cts]
+                prim = [p.data() for p in _pl] + list(_args)
+                with autograd.record():
+                    o = _R.apply_op(_rd, *cargs, _rng, *prim)
+                return o if isinstance(o, list) else [o]
+
+            node.replay = node_replay
+            for o in outs:
+                o._ag_node = node
+
+        return unflatten(outs)
+
+    def _build_cache(self, args, training):
+        """Trace the eager forward into fwd/bwd jitted executables
+        (the CachedOp build, reference cached_op.cc ctor + Forward:904)."""
+        import jax
+
+        # resolve deferred shapes cheaply via abstract tracing; the state
+        # scope swallows traced stat writes (BatchNorm running stats) that
+        # would otherwise store abstract tracers into Parameters
+        for p in self.collect_params().values():
+            if p._deferred_init is not None:
+                with _TraceScope(), autograd.pause(train_mode=training), \
+                        _rnd._TraceKeyScope(jax.random.PRNGKey(0)), \
+                        _StateWriteScope():
+                    jax.eval_shape(lambda *xs: self._abstract_forward(xs),
+                                   *[jax.ShapeDtypeStruct(a.shape, a.dtype)
+                                     for a in [x._data for x in args]])
+                break
+
+        param_list = self._trace_param_list()
+        for p in param_list:
+            if p._data is None:
+                p._finish_deferred_init()
+
+        out_struct = {}
+
+        def fun(pf, rng, *inputs):
+            wrapped = [NDArray(t) for t in inputs]
+            old = []
+            for p, t in zip(param_list, pf):
+                old.append(p._data._data)
+                p._data._data = t
+            try:
+                with _TraceScope(), _rnd._TraceKeyScope(rng), \
+                        autograd.pause(train_mode=training), \
+                        _StateWriteScope() as sw:
+                    out = self._eager_forward(*wrapped)
+            finally:
+                for p, o in zip(param_list, old):
+                    p._data._data = o
+            flat, rebuild = _flatten_outputs(out)
+            out_struct["rebuild"] = rebuild
+            return tuple(o._data for o in flat), dict(sw.writes)
+
+        jit_fwd = jax.jit(fun)
+
+        def bwd(pf, rng, inputs, cts):
+            from ..ops.registry import _match_ct_dtypes
+
+            outs, vjp_fn = jax.vjp(
+                lambda pf_, *ins: fun(pf_, rng, *ins)[0], list(pf), *inputs)
+            # under AMP a bf16 block output can receive an fp32 cotangent
+            grads = vjp_fn(_match_ct_dtypes(tuple(cts), tuple(outs)))
+            return grads  # (pf_grads_list, *input_grads)
+
+        jit_bwd = jax.jit(bwd)
+
+        # trigger fwd trace now so out_struct is known
+        pf0 = []
+        for p in param_list:
+            d = p.data()._data
+            pf0.append(jax.ShapeDtypeStruct(d.shape, d.dtype))
+        res = jax.eval_shape(fun, pf0, jax.ShapeDtypeStruct((2,), _np.uint32),
+                             *[jax.ShapeDtypeStruct(a._data.shape, a._data.dtype)
+                               for a in args])
+        rebuild = out_struct["rebuild"]
+
+        # create_graph replay: the block's backward expressed as ONE
+        # registry op over (cts..., rng, params..., inputs...) so
+        # apply_op's vjp-at-forward makes the produced cotangents
+        # differentiable — the CachedOp analog of autograd._record_bwd
+        n_out = len(res[0])
+        n_params = len(param_list)
+
+        def cached_bwd_replay(*flat):
+            from ..ops.registry import _match_ct_dtypes
+            cts = flat[:n_out]
+            rng_ = flat[n_out]
+            pf_ = list(flat[n_out + 1:n_out + 1 + n_params])
+            ins_ = flat[n_out + 1 + n_params:]
+            outs, vjp_fn = jax.vjp(
+                lambda p_, *i_: fun(p_, rng_, *i_)[0], pf_, *ins_)
+            grads = vjp_fn(_match_ct_dtypes(tuple(cts), tuple(outs)))
+            pf_g = grads[0]
+            sel = tuple(pf_g) + tuple(grads[1:])
+            return sel[0] if len(sel) == 1 else sel
+
+        from ..ops import registry as _R
+        replay_def = _R.OpDef(f"_backward_cachedop_{self.name}",
+                              cached_bwd_replay)
+
+        return jit_fwd, jit_bwd, param_list, rebuild, replay_def
+
+    def _abstract_forward(self, xs):
+        wrapped = [NDArray(t) for t in xs]
+        out = self._eager_forward(*wrapped)
+        flat, _ = _flatten_outputs(out)
+        return tuple(o._data for o in flat)
+
+    def _trace_symbol(self, num_inputs=None):
+        """Trace hybrid_forward into a Symbol graph (reference
+        block.py:786 _build_cache with Symbol args)."""
+        from .. import symbol as _sym
+
+        n = num_inputs or getattr(self, "_num_inputs", 1)
+        names = ["data"] if n == 1 else [f"data{i}" for i in range(n)]
+        inputs = [_sym.var(nm) for nm in names]
+        with _SymbolicScope(), autograd.pause():
+            out = self._eager_forward(*inputs)
+        if isinstance(out, (list, tuple)):
+            flat = []
+            for o in out:
+                flat.extend(o if isinstance(o, (list, tuple)) else [o])
+            out = _sym.Group(flat)
+        return out, names
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Serving export (reference gluon/block.py:907): traces the block
+        into `path-symbol.json` + `path-{epoch:04d}.params` loadable by
+        SymbolBlock.imports, the Module API, or any reference-compatible
+        consumer."""
+        deferred = [p.name for p in self.collect_params().values()
+                    if p._data is None]
+        if deferred:
+            raise MXNetError(
+                "export() requires fully-initialized parameters; run a "
+                f"forward pass first (uninitialized: {deferred[:5]}...)")
+        sym_out, _ = self._trace_symbol()
+        sym_out.save(f"{path}-symbol.json")
+
+        arg_names = set(sym_out.list_arguments())
+        aux_names = set(sym_out.list_auxiliary_states())
+        save_dict = {}
+        for p in self.collect_params().values():
+            if p.name in aux_names:
+                save_dict[f"aux:{p.name}"] = p.data()
+            elif p.name in arg_names:
+                save_dict[f"arg:{p.name}"] = p.data()
+        nd.save(f"{path}-{epoch:04d}.params", save_dict)
+        return sym_out
+
+
+def _flatten_outputs(out):
+    """Flatten nested (list/tuple of) NDArrays, return (flat, rebuild)."""
+    if isinstance(out, NDArray):
+        return [out], lambda flat: flat[0]
+    if isinstance(out, (list, tuple)):
+        flats, specs = [], []
+        for o in out:
+            f, r = _flatten_outputs(o)
+            specs.append((len(f), r))
+            flats.extend(f)
+        typ = type(out)
+
+        def rebuild(flat):
+            res, i = [], 0
+            for n, r in specs:
+                res.append(r(flat[i:i + n]))
+                i += n
+            return typ(res)
+
+        return flats, rebuild
+    raise MXNetError(f"hybrid_forward returned unsupported type {type(out)}")
+
+
+class SymbolBlock(HybridBlock):
+    """Run a symbolic graph as a Block (reference gluon/block.py:992).
+    Constructed from symbol outputs + inputs, typically via `.imports`
+    of a `HybridBlock.export` (or reference-exported) artifact."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=None)
+        from .. import symbol as _sym
+        self._out_sym = outputs if isinstance(outputs, _sym.Symbol) else outputs
+        self._in_syms = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        in_names = {s.name for s in self._in_syms}
+        names = ([a for a in self._out_sym.list_arguments()
+                  if a not in in_names] +
+                 self._out_sym.list_auxiliary_states())
+        for arg in names:
+            p = Parameter(arg, allow_deferred_init=True)
+            if params is not None and arg in params:
+                p._infer_shape(params[arg].shape)
+                p.set_data(params[arg])
+            self._reg_params[arg] = p
+            self._params._params[arg] = p
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as _sym
+        out = _sym.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [_sym.var(n) for n in input_names]
+        params = None
+        if param_file:
+            raw = nd.load(param_file)
+            params = {k.split(":", 1)[-1]: v for k, v in raw.items()}
+        return SymbolBlock(out, inputs, params=params)
+
+    def forward(self, *args):
+        bindings = {s.name: a for s, a in zip(self._in_syms, args)}
+        for name, p in self._reg_params.items():
+            bindings[name] = p.data()
+        return self._out_sym.eval_dict(bindings)

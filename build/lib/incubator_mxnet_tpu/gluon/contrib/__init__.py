@@ -1,0 +1,5 @@
+"""gluon.contrib (reference python/mxnet/gluon/contrib/): estimator fit
+loop + event handlers, extra nn layers, conv/variational RNN cells."""
+from . import estimator, nn, rnn
+
+__all__ = ["estimator", "nn", "rnn"]

@@ -1,0 +1,313 @@
+"""Estimator: high-level fit loop with event handlers.
+
+Reference: python/mxnet/gluon/contrib/estimator/estimator.py +
+event_handler.py — Estimator.fit drives train/val epochs and dispatches
+to handlers at train/epoch/batch boundaries; handlers cover logging,
+metrics, validation, checkpointing, and early stopping.
+"""
+from __future__ import annotations
+
+import copy
+import logging
+import time
+
+from ... import autograd, metric as _metric, ndarray as nd
+from ...base import MXNetError
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, batch):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, batch, pred, label, loss):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch / max_batch (reference event_handler.py
+    StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+
+    def train_begin(self, estimator):
+        self.current_batch = 0
+        self.current_epoch = 0
+        if self.max_batch == 0 or self.max_epoch == 0:
+            estimator.stop_training = True
+
+    def batch_end(self, estimator, batch, pred, label, loss):
+        self.current_batch += 1
+        if self.max_batch is not None and self.current_batch >= self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator):
+        self.current_epoch += 1
+        if self.max_epoch is not None and self.current_epoch >= self.max_epoch:
+            estimator.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Update train metrics every batch, reset per epoch."""
+
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def epoch_begin(self, estimator):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, batch, pred, label, loss):
+        for m in self.metrics:
+            if isinstance(m, _metric.Loss):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, EpochEnd):
+    """Run evaluation on val_data every `epoch_period` epochs."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+
+    def train_begin(self, estimator):
+        self._epoch = 0
+
+    def epoch_end(self, estimator):
+        self._epoch += 1
+        if self._epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Log throughput + metric values (reference LoggingHandler;
+    Speedometer-style img/s)."""
+
+    def __init__(self, log_interval="epoch", metrics=None,
+                 logger=None):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.logger = logger or logging.getLogger("estimator")
+        self.batch_index = 0
+
+    def train_begin(self, estimator):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator):
+        self.logger.info("Training done in %.1fs",
+                         time.time() - self.train_start)
+
+    def epoch_begin(self, estimator):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+        self.samples = 0
+
+    def batch_end(self, estimator, batch, pred, label, loss):
+        self.batch_index += 1
+        self.samples += label.shape[0] if hasattr(label, "shape") else 0
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            msg = " ".join(f"{n}={v:.4f}" for n, v in
+                           (m.get() for m in self.metrics))
+            self.logger.info("[batch %d] %s", self.batch_index, msg)
+
+    def epoch_end(self, estimator):
+        dt = time.time() - self.epoch_start
+        speed = self.samples / dt if dt > 0 else 0.0
+        msg = " ".join(f"{n}={v:.4f}" for n, v in
+                       (m.get() for m in self.metrics))
+        self.logger.info("epoch done: %.1f samples/s %s", speed, msg)
+
+
+class CheckpointHandler(TrainBegin, EpochEnd):
+    """Save params (+trainer states) every epoch_period epochs
+    (reference CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", epoch_period=1,
+                 max_checkpoints=5, save_best=False, monitor=None,
+                 mode="min"):
+        import os
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.epoch_period = epoch_period
+        self.max_checkpoints = max_checkpoints
+        self.save_best = save_best
+        self.monitor = monitor
+        self.mode = mode
+        self.best = None
+        self.saved = []
+        os.makedirs(model_dir, exist_ok=True)
+
+    def train_begin(self, estimator):
+        self._epoch = 0
+
+    def epoch_end(self, estimator):
+        import os
+        self._epoch += 1
+        if self._epoch % self.epoch_period:
+            return
+        path = os.path.join(self.model_dir,
+                            f"{self.model_prefix}-{self._epoch:04d}.params")
+        estimator.net.save_parameters(path)
+        self.saved.append(path)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+        if self.save_best and self.monitor is not None:
+            _, val = self.monitor.get()
+            if val != val:  # NaN must not poison best-checkpoint tracking
+                return
+            better = self.best is None or \
+                (val < self.best if self.mode == "min" else val > self.best)
+            if better:
+                self.best = val
+                estimator.net.save_parameters(os.path.join(
+                    self.model_dir, f"{self.model_prefix}-best.params"))
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd):
+    """Stop when the monitored metric stops improving
+    (reference EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, mode="min", patience=2, min_delta=0.0):
+        self.monitor = monitor
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+
+    def train_begin(self, estimator):
+        self.best = None
+        self.wait = 0
+        self.stopped_epoch = 0
+
+    def epoch_end(self, estimator):
+        _, val = self.monitor.get()
+        if val != val:  # NaN
+            return
+        improved = self.best is None or \
+            (val < self.best - self.min_delta if self.mode == "min"
+             else val > self.best + self.min_delta)
+        if improved:
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                estimator.stop_training = True
+
+
+class Estimator:
+    """High-level train/eval driver (reference estimator.py Estimator)."""
+
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or [_metric.Accuracy()]
+        if not isinstance(self.train_metrics, (list, tuple)):
+            self.train_metrics = [self.train_metrics]
+        self.train_metrics = list(self.train_metrics)
+        self.train_loss_metric = _metric.Loss("train_loss")
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3})
+        self.stop_training = False
+        self.val_metrics = [copy.deepcopy(m) for m in self.train_metrics]
+        self.val_loss_metric = _metric.Loss("val_loss")
+
+    def evaluate(self, val_data):
+        for m in self.val_metrics:
+            m.reset()
+        self.val_loss_metric.reset()
+        for batch in val_data:
+            x, y = batch
+            pred = self.net(x)
+            loss = self.loss(pred, y)
+            self.val_loss_metric.update(0, loss)
+            for m in self.val_metrics:
+                m.update(y, pred)
+        return {n: v for n, v in (m.get() for m in
+                                  self.val_metrics + [self.val_loss_metric])}
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None):
+        if epochs is None and batches is None:
+            raise MXNetError("fit needs epochs or batches")
+        # order matters at epoch_end: ValidationHandler must refresh the
+        # val metrics BEFORE user handlers (early stopping / best
+        # checkpoint) read them; StoppingHandler runs last
+        handlers = [MetricHandler(
+            self.train_metrics + [self.train_loss_metric])]
+        if val_data is not None:
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        handlers.extend(event_handlers or [])
+        handlers.append(StoppingHandler(epochs, batches))
+
+        def dispatch(kind, *args):
+            for h in handlers:
+                fn = getattr(h, kind, None)
+                if fn is not None and isinstance(h, _HOOK_TYPES[kind]):
+                    fn(self, *args)
+
+        self.stop_training = False
+        dispatch("train_begin")
+        epoch_cap = epochs if epochs is not None else 2 ** 31
+        for _ in range(epoch_cap):
+            if self.stop_training:
+                break
+            dispatch("epoch_begin")
+            for i, (x, y) in enumerate(train_data):
+                dispatch("batch_begin", i)
+                with autograd.record():
+                    pred = self.net(x)
+                    loss = self.loss(pred, y)
+                    mean_loss = loss.mean()
+                mean_loss.backward()
+                bs = x.shape[0] if hasattr(x, "shape") else 1
+                self.trainer.step(bs)
+                dispatch("batch_end", i, pred, y, loss)
+                if self.stop_training:
+                    break
+            dispatch("epoch_end")
+        dispatch("train_end")
+        return self
+
+
+_HOOK_TYPES = {
+    "train_begin": TrainBegin, "train_end": TrainEnd,
+    "epoch_begin": EpochBegin, "epoch_end": EpochEnd,
+    "batch_begin": BatchBegin, "batch_end": BatchEnd,
+}

@@ -1,0 +1,147 @@
+"""gluon.contrib.nn layers.
+
+Reference: python/mxnet/gluon/contrib/nn/basic_layers.py — Concurrent,
+HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm, PixelShuffle
+1D/2D/3D. TPU notes inline where the design diverges.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ..block import Block, HybridBlock
+from ..nn.basic_layers import BatchNorm, Sequential, HybridSequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs
+    (reference basic_layers.py Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x, *args):
+        outs = [block(x) for block in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def _eager_forward(self, x, *args):
+        outs = [block(x) for block in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+    def hybrid_forward(self, F, x):
+        outs = [block(x) for block in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through (reference Identity) — useful in Concurrent branches."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding whose gradient is row_sparse (reference SparseEmbedding;
+    here backed by the row_sparse grad path of the Embedding op with
+    sparse_grad=True — see ndarray/sparse.py)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "dtype": dtype, "sparse_grad": True}
+        self.weight = self.params.get("weight", shape=(input_dim, output_dim),
+                                      init=weight_initializer, dtype=dtype,
+                                      grad_stype="row_sparse")
+        self._reg_params["weight"] = self.weight
+
+    def forward(self, x):
+        return nd.Embedding(x, self.weight.data(), **self._kwargs)
+
+    def __repr__(self):
+        return (f"SparseEmbedding({self._kwargs['input_dim']} -> "
+                f"{self._kwargs['output_dim']})")
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm.
+
+    Reference: src/operator/contrib/sync_batch_norm-inl.h:56-197 (key-based
+    barrier + cross-GPU reduce) and gluon.contrib.nn.SyncBatchNorm
+    (num_devices). TPU-native design: inside a pjit'd train step the batch
+    axis is a mesh axis, so XLA's batch-norm statistics ARE global — the
+    barrier machinery is unnecessary. This subclass exists for API parity
+    and for eager multi-device loops, where stats are computed over the
+    full (already gathered) batch.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = (int(factor),) * ndim if isinstance(factor, int) \
+            else tuple(int(f) for f in factor)
+        assert len(self._factors) == ndim
+
+    def __repr__(self):
+        return f"{type(self).__name__}(factors={self._factors})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) -> (N, C, W*f) (reference PixelShuffle1D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f, = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f, 0))   # (N, C, f, W)
+        x = F.transpose(x, axes=(0, 1, 3, 2))       # (N, C, W, f)
+        return F.reshape(x, shape=(0, 0, -3))       # (N, C, W*f)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2) (reference PixelShuffle2D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))  # N C H f1 W f2
+        return F.reshape(x, shape=(0, 0, -3, -3))
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2 * f3, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2 * f3, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, 0, -4, f2, f3, 0, 0, 0))
+        # N C f1 f2 f3 D H W -> N C D f1 H f2 W f3
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(0, 0, -3, -3, -3))

@@ -1,0 +1,287 @@
+"""gluon.contrib.rnn cells.
+
+Reference: python/mxnet/gluon/contrib/rnn/rnn_cell.py
+(VariationalDropoutCell, LSTMPCell) and conv_rnn_cell.py
+(Conv1D/2D/3D RNN/LSTM/GRU cells).
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..rnn.rnn_cell import ModifierCell, RecurrentCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell", "Conv1DRNNCell",
+           "Conv2DRNNCell", "Conv3DRNNCell", "Conv1DLSTMCell",
+           "Conv2DLSTMCell", "Conv3DLSTMCell", "Conv1DGRUCell",
+           "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational dropout (Gal & Ghahramani): ONE dropout mask per unroll,
+    reused at every timestep, applied to inputs/states/outputs.
+    Reference: gluon/contrib/rnn/rnn_cell.py VariationalDropoutCell."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.reset()
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _mask(self, p, like):
+        """Bernoulli keep-mask scaled by 1/(1-p), same shape as `like`."""
+        keep = nd.uniform(low=0.0, high=1.0, shape=like.shape) >= p
+        return keep.astype(like.dtype) / (1.0 - p)
+
+    def __call__(self, inputs, states):
+        from ... import autograd
+        training = autograd.is_training() or autograd.is_recording()
+        if training and self.drop_inputs > 0:
+            if self._input_mask is None:
+                self._input_mask = self._mask(self.drop_inputs, inputs)
+            inputs = inputs * self._input_mask
+        if training and self.drop_states > 0:
+            if self._state_mask is None:
+                self._state_mask = self._mask(self.drop_states, states[0])
+            states = [states[0] * self._state_mask] + list(states[1:])
+        out, nstates = self.base_cell(inputs, states)
+        if training and self.drop_outputs > 0:
+            if self._output_mask is None:
+                self._output_mask = self._mask(self.drop_outputs, out)
+            out = out * self._output_mask
+        return out, nstates
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()  # fresh masks each unroll
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs,
+                              valid_length=valid_length)
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell(in={self.drop_inputs}, "
+                f"state={self.drop_states}, out={self.drop_outputs})")
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projection layer on the hidden state
+    (reference gluon/contrib/rnn/rnn_cell.py LSTMPCell; arXiv:1402.1128).
+    The recurrent input is the PROJECTED state, so h2h_weight is
+    (4*hidden, projection) and h2r_weight projects h -> r."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 h2r_weight_initializer=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        nh = hidden_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * nh, input_size),
+            allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * nh, projection_size),
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * nh,), init="zeros",
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * nh,), init="zeros",
+            allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, nh),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        for n in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias",
+                  "h2r_weight"):
+            self._reg_params[n] = getattr(self, n)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._infer_shape(
+            (self.i2h_weight.shape[0], int(x.shape[-1])))
+        for p in (self.h2h_weight, self.i2h_bias, self.h2h_bias,
+                  self.h2r_weight):
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def hybrid_forward(self, F, inputs, r, c, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias, h2r_weight):
+        nh = self._hidden_size
+        gates = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                                 num_hidden=4 * nh) + \
+            F.FullyConnected(r, h2h_weight, h2h_bias, num_hidden=4 * nh)
+        i, f, g, o = (F.slice_axis(gates, axis=-1, begin=k * nh,
+                                   end=(k + 1) * nh) for k in range(4))
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * F.tanh(c_new)
+        r_new = F.FullyConnected(h_new, h2r_weight, no_bias=True,
+                                 num_hidden=self._projection_size)
+        return r_new, [r_new, c_new]
+
+
+class _ConvRNNBase(RecurrentCell):
+    """Shared machinery for convolutional recurrent cells (reference
+    conv_rnn_cell.py _BaseConvRNNCell): i2h and h2h are convolutions over
+    (N, C, spatial...) instead of dense layers."""
+
+    def __init__(self, input_shape, hidden_channels, gates,
+                 i2h_kernel, h2h_kernel, i2h_pad=None, conv_ndim=2,
+                 activation="tanh", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)  # (C, spatial...)
+        self._hidden_channels = hidden_channels
+        self._conv_ndim = conv_ndim
+        self._activation = activation
+        tup = lambda v: (v,) * conv_ndim if isinstance(v, int) else tuple(v)
+        self._i2h_kernel = tup(i2h_kernel)
+        self._h2h_kernel = tup(h2h_kernel)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise MXNetError("h2h_kernel must be odd to preserve the "
+                                 f"state's spatial shape, got {k}")
+        self._i2h_pad = tup(i2h_pad) if i2h_pad is not None else \
+            tuple(k // 2 for k in self._i2h_kernel)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+
+        in_ch = self._input_shape[0]
+        ng = gates
+        self.i2h_weight = self.params.get(
+            "i2h_weight",
+            shape=(ng * hidden_channels, in_ch) + self._i2h_kernel,
+            allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight",
+            shape=(ng * hidden_channels, hidden_channels) + self._h2h_kernel,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ng * hidden_channels,), init="zeros",
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ng * hidden_channels,), init="zeros",
+            allow_deferred_init=True)
+        for n in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+            self._reg_params[n] = getattr(self, n)
+
+    def state_info(self, batch_size=0):
+        spatial = tuple(
+            (s + 2 * p - k) + 1
+            for s, p, k in zip(self._input_shape[1:], self._i2h_pad,
+                               self._i2h_kernel))
+        shape = (batch_size, self._hidden_channels) + spatial
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._conv_ndim:]}
+                for _ in range(self._n_states)]
+
+    def _convs(self, F, inputs, state, i2h_weight, h2h_weight, i2h_bias,
+               h2h_bias, gates):
+        nf = gates * self._hidden_channels
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=nf)
+        h2h = F.Convolution(state, h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            num_filter=nf)
+        return i2h, h2h
+
+    def _split(self, F, x, n):
+        return F.SliceChannel(x, num_outputs=n, axis=1)
+
+
+class _ConvRNNCell(_ConvRNNBase):
+    _n_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 conv_ndim, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, 1, i2h_kernel,
+                         h2h_kernel, conv_ndim=conv_ndim,
+                         activation=activation, **kwargs)
+
+    def hybrid_forward(self, F, inputs, state, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, state, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias, 1)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class _ConvLSTMCell(_ConvRNNBase):
+    _n_states = 2
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 conv_ndim, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, 4, i2h_kernel,
+                         h2h_kernel, conv_ndim=conv_ndim,
+                         activation=activation, **kwargs)
+
+    def hybrid_forward(self, F, inputs, h, c, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, h, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias, 4)
+        gates = i2h + h2h
+        i, f, g, o = self._split(F, gates, 4)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.Activation(g, act_type=self._activation)
+        c_new = f * c + i * g
+        h_new = o * F.Activation(c_new, act_type=self._activation)
+        return h_new, [h_new, c_new]
+
+
+class _ConvGRUCell(_ConvRNNBase):
+    _n_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 conv_ndim, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, 3, i2h_kernel,
+                         h2h_kernel, conv_ndim=conv_ndim,
+                         activation=activation, **kwargs)
+
+    def hybrid_forward(self, F, inputs, h, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h, h2h = self._convs(F, inputs, h, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias, 3)
+        xr, xz, xn = self._split(F, i2h, 3)
+        hr, hz, hn = self._split(F, h2h, 3)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        n = F.Activation(xn + r * hn, act_type=self._activation)
+        out = (1 - z) * n + z * h
+        return out, [out]
+
+
+def _mk(base, ndim, alias):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, **kwargs):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, conv_ndim=ndim, **kwargs)
+
+        def _alias(self):
+            return alias
+    Cell.__name__ = Cell.__qualname__ = alias
+    return Cell
+
+
+Conv1DRNNCell = _mk(_ConvRNNCell, 1, "Conv1DRNNCell")
+Conv2DRNNCell = _mk(_ConvRNNCell, 2, "Conv2DRNNCell")
+Conv3DRNNCell = _mk(_ConvRNNCell, 3, "Conv3DRNNCell")
+Conv1DLSTMCell = _mk(_ConvLSTMCell, 1, "Conv1DLSTMCell")
+Conv2DLSTMCell = _mk(_ConvLSTMCell, 2, "Conv2DLSTMCell")
+Conv3DLSTMCell = _mk(_ConvLSTMCell, 3, "Conv3DLSTMCell")
+Conv1DGRUCell = _mk(_ConvGRUCell, 1, "Conv1DGRUCell")
+Conv2DGRUCell = _mk(_ConvGRUCell, 2, "Conv2DGRUCell")
+Conv3DGRUCell = _mk(_ConvGRUCell, 3, "Conv3DGRUCell")

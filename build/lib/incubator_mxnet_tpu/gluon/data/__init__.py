@@ -1,0 +1,13 @@
+"""Gluon data API (reference python/mxnet/gluon/data/)."""
+from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset
+from .dataloader import DataLoader, default_batchify_fn
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+
+
+def __getattr__(name):
+    if name == "vision":
+        import importlib
+        m = importlib.import_module(".vision", __name__)
+        globals()[name] = m
+        return m
+    raise AttributeError(name)

@@ -1,0 +1,135 @@
+"""Datasets (reference python/mxnet/gluon/data/dataset.py)."""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from ... import nd
+from ...base import MXNetError
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
+
+# Set True inside DataLoader worker processes (dataloader._worker_init):
+# workers must stay jax-free — a forked child touching the parent's XLA
+# client deadlocks — so datasets store HOST (numpy) arrays and only wrap
+# into device-backed NDArrays on access in the main process.
+IN_WORKER = False
+
+
+def _maybe_nd(a, dtype=None):
+    if IN_WORKER or not isinstance(a, _np.ndarray):
+        return a
+    return nd.array(a, dtype=dtype)
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def filter(self, fn):
+        return SimpleDataset([self[i] for i in range(len(self))
+                              if fn(self[i])])
+
+    def take(self, count):
+        return SimpleDataset([self[i] for i in range(min(count, len(self)))])
+
+    def transform(self, fn, lazy=True):
+        return _LazyTransformDataset(self, fn)
+
+    def transform_first(self, fn, lazy=True):
+        def first(*items):
+            if len(items) == 1:
+                return fn(items[0])
+            return (fn(items[0]),) + items[1:]
+        return self.transform(lambda *items: first(*items), lazy)
+
+
+class SimpleDataset(Dataset):
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, dataset, fn):
+        self._dataset = dataset
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._dataset)
+
+    def __getitem__(self, idx):
+        item = self._dataset[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class ArrayDataset(Dataset):
+    """Zip of arrays/datasets (reference dataset.py ArrayDataset)."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for a in args:
+            if len(a) != self._length:
+                raise MXNetError("all arrays must have the same length")
+            self._data.append(a)
+        # main-process access uses device-resident columns (one upload,
+        # device-side indexing); numpy copies only materialize when the
+        # dataset is pickled to workers (__getstate__)
+        self._nd_cache = [a if isinstance(a, nd.NDArray) else None
+                          for a in self._data]
+
+    def __getstate__(self):
+        # ship HOST arrays to workers: device handles don't pickle and
+        # workers must stay jax-free
+        host = [a.asnumpy() if isinstance(a, nd.NDArray) else a
+                for a in self._data]
+        return {"_length": self._length, "_data": host,
+                "_nd_cache": [None] * len(host)}
+
+    def __len__(self):
+        return self._length
+
+    def _one(self, col, idx):
+        if IN_WORKER:
+            return self._data[col][idx]
+        cache = self._nd_cache[col]
+        if cache is None and isinstance(self._data[col], _np.ndarray) \
+                and self._data[col].dtype != _np.object_:
+            cache = self._nd_cache[col] = nd.array(self._data[col])
+        if cache is not None:
+            return cache[idx]
+        # list / ragged columns: wrap each item on access
+        return _maybe_nd(self._data[col][idx])
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._one(0, idx)
+        return tuple(self._one(c, idx) for c in range(len(self._data)))
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over a RecordIO file (reference dataset.py RecordFileDataset)."""
+
+    def __init__(self, filename):
+        from ... import recordio
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+
+    def __len__(self):
+        return len(self._record.keys)
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])

@@ -1,0 +1,91 @@
+"""Index samplers for DataLoader.
+
+Reference surface: python/mxnet/gluon/data/sampler.py (Sequential/Random/
+Batch). Written generator-first: every sampler is an iterable of indices,
+BatchSampler chunks any sampler lazily with keep/discard/rollover tail
+policies.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
+
+
+class Sampler:
+    """Iterable over dataset indices."""
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class SequentialSampler(Sampler):
+    """start, start+1, ..., start+length-1."""
+
+    def __init__(self, length, start=0):
+        self._range = range(start, start + length)
+
+    def __iter__(self):
+        yield from self._range
+
+    def __len__(self):
+        return len(self._range)
+
+
+class RandomSampler(Sampler):
+    """A fresh uniform permutation per epoch."""
+
+    def __init__(self, length):
+        self._length = length
+
+    def __iter__(self):
+        for i in _np.random.permutation(self._length):
+            yield int(i)
+
+    def __len__(self):
+        return self._length
+
+
+class BatchSampler(Sampler):
+    """Chunk `sampler` into lists of batch_size indices.
+
+    last_batch: 'keep' yields the short tail, 'discard' drops it,
+    'rollover' prepends it to the next epoch.
+    """
+
+    _POLICIES = ("keep", "discard", "rollover")
+
+    def __init__(self, sampler, batch_size, last_batch="keep"):
+        if last_batch not in self._POLICIES:
+            raise ValueError(f"last_batch must be one of {self._POLICIES}, "
+                             f"got {last_batch!r}")
+        self._sampler = sampler
+        self._batch_size = batch_size
+        self._last_batch = last_batch
+        self._carry = []
+
+    def __iter__(self):
+        batch = self._carry
+        self._carry = []
+        for idx in self._sampler:
+            batch.append(idx)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if not batch:
+            return
+        if self._last_batch == "keep":
+            yield batch
+        elif self._last_batch == "rollover":
+            self._carry = batch
+
+    def __len__(self):
+        n = len(self._sampler)
+        if self._last_batch == "keep":
+            return -(-n // self._batch_size)
+        if self._last_batch == "discard":
+            return n // self._batch_size
+        return (n + len(self._carry)) // self._batch_size

@@ -1,0 +1,219 @@
+"""Vision datasets (reference python/mxnet/gluon/data/vision/datasets.py:
+MNIST/FashionMNIST/CIFAR10/CIFAR100/ImageRecordDataset/ImageFolderDataset).
+
+Zero-egress environment: datasets read from local files (`root` dir); the
+standard MNIST idx / CIFAR binary formats are parsed natively. A deterministic
+synthetic fallback (`synthetic=True`) exists so examples/benchmarks run
+without the real archives.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as _np
+
+from .... import nd
+from ....base import MXNetError
+from ..dataset import Dataset
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform, synthetic=False):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._synthetic = synthetic
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        # host (numpy) storage for picklability; main-process access goes
+        # through a lazily-built device-resident copy (one upload, indexed
+        # on device); workers stay on numpy (dataset.IN_WORKER — jax is
+        # not fork/multi-client safe)
+        from .. import dataset as _ds
+        if _ds.IN_WORKER:
+            data = self._data[idx]
+        else:
+            if getattr(self, "_data_nd", None) is None:
+                self._data_nd = nd.array(self._data)
+            data = self._data_nd[idx]
+        label = self._label[idx]
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_data_nd", None)       # device handles don't pickle
+        return state
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST (reference datasets.py MNIST; idx-ubyte format)."""
+
+    _N_CLASS = 10
+    _SHAPE = (28, 28, 1)
+
+    def __init__(self, root="~/.mxtpu/datasets/mnist", train=True,
+                 transform=None, synthetic=None):
+        self._train_files = ("train-images-idx3-ubyte.gz",
+                             "train-labels-idx1-ubyte.gz")
+        self._test_files = ("t10k-images-idx3-ubyte.gz",
+                            "t10k-labels-idx1-ubyte.gz")
+        if synthetic is None:
+            synthetic = not self._files_exist(root, train)
+        super().__init__(root, train, transform, synthetic)
+
+    def _files_exist(self, root, train):
+        files = self._train_files if train else self._test_files
+        root = os.path.expanduser(root)
+        return all(os.path.exists(os.path.join(root, f)) or
+                   os.path.exists(os.path.join(root, f[:-3])) for f in files)
+
+    def _get_data(self):
+        if self._synthetic:
+            n = 6000 if self._train else 1000
+            rng = _np.random.RandomState(42 if self._train else 43)
+            labels = rng.randint(0, self._N_CLASS, n).astype(_np.int32)
+            base = rng.rand(n, *self._SHAPE) * 0.1
+            imgs = ((base + labels[:, None, None, None] / self._N_CLASS) *
+                    255).astype(_np.uint8)
+            self._data = imgs
+            self._label = labels
+            return
+        imgf, lblf = self._train_files if self._train else self._test_files
+        self._label = self._read_idx(os.path.join(self._root, lblf))
+        data = self._read_idx(os.path.join(self._root, imgf))
+        self._data = data.reshape(-1, 28, 28, 1)
+
+    @staticmethod
+    def _read_idx(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        if not os.path.exists(path) and path.endswith(".gz"):
+            path = path[:-3]
+            opener = open
+        with opener(path, "rb") as f:
+            raw = f.read()
+        magic = struct.unpack(">I", raw[:4])[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, raw[4:4 + 4 * ndim])
+        return _np.frombuffer(raw[4 + 4 * ndim:],
+                              dtype=_np.uint8).reshape(dims).astype(
+            _np.int32 if ndim == 1 else _np.uint8)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxtpu/datasets/fashion-mnist", train=True,
+                 transform=None, synthetic=None):
+        super().__init__(root, train, transform, synthetic)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10 binary format (reference datasets.py CIFAR10)."""
+
+    _N_CLASS = 10
+    _SHAPE = (32, 32, 3)
+
+    def __init__(self, root="~/.mxtpu/datasets/cifar10", train=True,
+                 transform=None, synthetic=None, fine_label=False):
+        self._fine_label = fine_label
+        if synthetic is None:
+            synthetic = not os.path.exists(os.path.expanduser(root))
+        super().__init__(root, train, transform, synthetic)
+
+    def _get_data(self):
+        if self._synthetic:
+            n = 5000 if self._train else 1000
+            rng = _np.random.RandomState(44 if self._train else 45)
+            labels = rng.randint(0, self._N_CLASS, n).astype(_np.int32)
+            imgs = ((rng.rand(n, *self._SHAPE) * 0.2 +
+                     labels[:, None, None, None] / self._N_CLASS) * 255
+                    ).astype(_np.uint8)
+            self._data = imgs
+            self._label = labels
+            return
+        files = [f"data_batch_{i}.bin" for i in range(1, 6)] if self._train \
+            else ["test_batch.bin"]
+        data, label = [], []
+        for fname in files:
+            with open(os.path.join(self._root, fname), "rb") as f:
+                raw = _np.frombuffer(f.read(), _np.uint8).reshape(-1, 3073)
+            label.append(raw[:, 0].astype(_np.int32))
+            data.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        self._data = _np.concatenate(data)
+        self._label = _np.concatenate(label)
+
+
+class CIFAR100(CIFAR10):
+    _N_CLASS = 100
+
+    def __init__(self, root="~/.mxtpu/datasets/cifar100", fine_label=False,
+                 train=True, transform=None, synthetic=None):
+        super().__init__(root, train, transform, synthetic, fine_label)
+
+
+class ImageRecordDataset(Dataset):
+    """Dataset over packed image records (reference datasets.py
+    ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._record)
+
+    def __getitem__(self, idx):
+        from .... import recordio
+        from ....image.image import imdecode
+        record = self._record[idx]
+        header, img = recordio.unpack(record)
+        img = imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, nd.array(_np.atleast_1d(label)) if not _np.isscalar(label) \
+            else (img, label)
+
+
+class ImageFolderDataset(Dataset):
+    """folder/label/img.jpg layout (reference datasets.py ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if fname.lower().endswith((".jpg", ".jpeg", ".png")):
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from ....image.image import imread
+        img = imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

@@ -1,0 +1,189 @@
+"""Vision transforms (reference python/mxnet/gluon/data/vision/transforms.py:
+Compose/Cast/ToTensor/Normalize/Resize/CenterCrop/RandomResizedCrop/
+RandomFlipLeftRight/...)."""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from .... import nd
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential
+
+
+class Compose(Sequential):
+    """Reference transforms.py Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference transforms.py)."""
+
+    def hybrid_forward(self, F, x):
+        x = F.cast(x, dtype="float32") / 255.0
+        if x.ndim == 3:
+            return F.transpose(x, axes=(2, 0, 1))
+        return F.transpose(x, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = nd.array(_np.asarray(self._mean, _np.float32).reshape(-1, 1, 1))
+        std = nd.array(_np.asarray(self._std, _np.float32).reshape(-1, 1, 1))
+        return (x - mean) / std
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from ....image.image import imresize, resize_short
+        if self._keep:
+            return resize_short(x, min(self._size), self._interpolation)
+        return imresize(x, self._size[0], self._size[1], self._interpolation)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from ....image.image import center_crop
+        return center_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._args = (size if isinstance(size, (tuple, list)) else (size, size),
+                      scale, ratio, interpolation)
+
+    def forward(self, x):
+        from ....image.image import random_size_crop
+        return random_size_crop(x, *self._args)[0]
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._pad = pad
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from ....image.image import random_crop
+        if self._pad:
+            arr = _np.pad(x.asnumpy(),
+                          [(self._pad, self._pad), (self._pad, self._pad), (0, 0)],
+                          mode="constant")
+            x = nd.array(arr, dtype="uint8")
+        return random_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomFlipLeftRight(HybridBlock):
+    def hybrid_forward(self, F, x):
+        if _pyrandom.random() < 0.5:
+            return F.reverse(x, axis=1 if x.ndim == 3 else 2)
+        return x
+
+
+class RandomFlipTopBottom(HybridBlock):
+    def hybrid_forward(self, F, x):
+        if _pyrandom.random() < 0.5:
+            return F.reverse(x, axis=0 if x.ndim == 3 else 1)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + _pyrandom.uniform(-self._b, self._b)
+        return x.astype("float32") * alpha
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        from ....image.image import ContrastJitterAug
+        return ContrastJitterAug(self._c)(x.astype("float32"))
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        from ....image.image import SaturationJitterAug
+        return SaturationJitterAug(self._s)(x.astype("float32"))
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        from ....image.image import HueJitterAug
+        return HueJitterAug(self._h)(x.astype("float32"))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        from ....image.image import ColorJitterAug
+        self._aug = ColorJitterAug(brightness, contrast, saturation)
+        self._hue = hue
+
+    def forward(self, x):
+        from ....image.image import HueJitterAug
+        x = self._aug(x.astype("float32"))
+        if self._hue:
+            x = HueJitterAug(self._hue)(x)
+        return x
+
+
+class RandomLighting(Block):
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        from ....image.image import LightingAug
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        return LightingAug(self._alpha, eigval, eigvec)(x.astype("float32"))

@@ -1,0 +1,249 @@
+"""Gluon losses.
+
+Covers the reference set (python/mxnet/gluon/loss.py: L1/L2/SigmoidBCE/
+SoftmaxCE/KL/CTC/Huber/Hinge/SquaredHinge/Logistic/Triplet/Cosine) with a
+different internal shape: every loss implements `_unreduced` returning the
+per-element loss, and the base class owns weighting + per-sample reduction.
+Numerically-stable formulations are built on one `_softplus` helper
+(log(1+e^x) = relu(x) + log1p(e^-|x|)) instead of softrelu activations.
+"""
+from __future__ import annotations
+
+from .. import nd
+from ..base import MXNetError
+from .block import HybridBlock
+
+__all__ = ["Loss", "L1Loss", "L2Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
+           "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
+           "CosineEmbeddingLoss"]
+
+
+def _softplus(F, x):
+    """Stable log(1 + e^x)."""
+    return F.relu(x) + F.log(1.0 + F.exp(-F.abs(x)))
+
+
+def _match(label, pred):
+    """View the label with the prediction's shape (layouts always agree up
+    to a trailing singleton in this API)."""
+    return label.reshape(pred.shape)
+
+
+class Loss(HybridBlock):
+    """Base: subclasses implement _unreduced(F, *args) -> elementwise loss;
+    the base applies the constructor weight, the per-call sample_weight, and
+    the mean over every non-batch axis."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def _finish(self, F, loss, sample_weight, reduce=True):
+        if sample_weight is not None:
+            loss = F.broadcast_mul(loss, sample_weight)
+        if self._weight is not None:
+            loss = loss * self._weight
+        if reduce:
+            loss = F.mean(loss, axis=self._batch_axis, exclude=True)
+        return loss
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        return self._finish(F, self._unreduced(F, pred, label), sample_weight)
+
+    def _unreduced(self, F, pred, label):
+        raise NotImplementedError
+
+
+class L1Loss(Loss):
+    """mean |pred - label|."""
+
+    def _unreduced(self, F, pred, label):
+        return F.abs(pred - _match(label, pred))
+
+
+class L2Loss(Loss):
+    """mean (pred - label)^2 / 2 (the reference's 1/2 convention)."""
+
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def _unreduced(self, F, pred, label):
+        d = pred - _match(label, pred)
+        return F.square(d) * 0.5
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    """BCE on logits (default) or on probabilities (from_sigmoid=True).
+
+    Logit form: softplus(x) - x*y, with the optional pos_weight rescaling
+    the positive-class term as in the reference.
+    """
+
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       pos_weight=None):
+        y = _match(label, pred)
+        if self._from_sigmoid:
+            eps = 1e-12
+            pos_term = F.log(pred + eps) * y
+            if pos_weight is not None:
+                pos_term = F.broadcast_mul(pos_term, pos_weight)
+            loss = -(pos_term + F.log(1.0 - pred + eps) * (1.0 - y))
+        elif pos_weight is None:
+            loss = _softplus(F, pred) - pred * y
+        else:
+            # rescale only the y=1 branch: loss = (1 + (pw-1) y) softplus(-x)
+            #                                     + (1-y) x  [- x*0 terms]
+            w = 1.0 + F.broadcast_mul(pos_weight - 1.0, y)
+            loss = w * _softplus(F, -pred) + (1.0 - y) * pred
+        return self._finish(F, loss, sample_weight)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Cross entropy over an axis; sparse integer labels by default."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def _unreduced(self, F, pred, label):
+        logp = pred if self._from_logits else F.log_softmax(pred,
+                                                            axis=self._axis)
+        if self._sparse_label:
+            return -F.pick(logp, label, axis=self._axis, keepdims=True)
+        return -F.sum(logp * _match(label, logp), axis=self._axis,
+                      keepdims=True)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    """KL(label || pred); pred is log-probabilities when from_logits=True
+    (the default, matching the reference)."""
+
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def _unreduced(self, F, pred, label):
+        logq = pred if self._from_logits else F.log_softmax(pred,
+                                                            axis=self._axis)
+        return label * (F.log(label + 1e-12) - logq)
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification, blank = last class
+    (reference loss.py CTCLoss over the warp-ctc op)."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        if layout not in ("NTC", "TNC"):
+            raise MXNetError(f"CTC layout must be NTC or TNC, got {layout}")
+        if label_layout not in ("NT", "TN"):
+            raise MXNetError(f"CTC label_layout must be NT or TN")
+        self._layout = layout
+        self._label_layout = label_layout
+        super().__init__(weight, label_layout.find("N"), **kwargs)
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        if self._layout == "NTC":
+            pred = F.swapaxes(pred, dim1=0, dim2=1)
+        if self._label_layout == "TN":
+            label = F.swapaxes(label, dim1=0, dim2=1)
+        loss = F.CTCLoss(pred, label, pred_lengths, label_lengths,
+                         use_data_lengths=pred_lengths is not None,
+                         use_label_lengths=label_lengths is not None,
+                         blank_label="last")
+        return self._finish(F, loss, sample_weight, reduce=False)
+
+
+class HuberLoss(Loss):
+    """Quadratic within rho of the target, linear outside."""
+
+    def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def _unreduced(self, F, pred, label):
+        err = F.abs(pred - _match(label, pred))
+        quad = F.square(err) * (0.5 / self._rho)
+        return F.where(err > self._rho, err - 0.5 * self._rho, quad)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def _unreduced(self, F, pred, label):
+        return F.relu(self._margin - pred * _match(label, pred))
+
+
+class SquaredHingeLoss(HingeLoss):
+    def _unreduced(self, F, pred, label):
+        return F.square(super()._unreduced(F, pred, label))
+
+
+class LogisticLoss(Loss):
+    """BCE on logits with labels in {-1,1} ('signed', default) or {0,1}
+    ('binary')."""
+
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        if label_format not in ("signed", "binary"):
+            raise MXNetError(f"bad label_format {label_format}")
+        self._label_format = label_format
+
+    def _unreduced(self, F, pred, label):
+        y = _match(label, pred)
+        if self._label_format == "signed":
+            y = (y + 1.0) * 0.5
+        return _softplus(F, pred) - pred * y
+
+
+class TripletLoss(Loss):
+    """relu(margin + d(pred, pos) - d(pred, neg)), squared-L2 distances."""
+
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
+        gap = F.square(pred - _match(positive, pred)) - \
+            F.square(pred - _match(negative, pred))
+        per_sample = F.relu(F.sum(gap, axis=self._batch_axis, exclude=True) +
+                            self._margin)
+        return self._finish(F, per_sample, sample_weight, reduce=False)
+
+
+class CosineEmbeddingLoss(Loss):
+    """1 - cos(a,b) for label 1; relu(cos(a,b) - margin) for label -1."""
+
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
+        a = input1.reshape((input1.shape[0], -1))
+        b = input2.reshape((input2.shape[0], -1))
+        cos = F.sum(a * b, axis=-1) / (F.norm(a, axis=-1) *
+                                       F.norm(b, axis=-1) + 1e-12)
+        loss = F.where(label.reshape((-1,)) == 1, 1.0 - cos,
+                       F.relu(cos - self._margin))
+        return self._finish(F, loss, sample_weight, reduce=False)

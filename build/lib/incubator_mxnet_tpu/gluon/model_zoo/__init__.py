@@ -1,0 +1,5 @@
+"""Gluon model zoo (reference python/mxnet/gluon/model_zoo/)."""
+from . import model_store, vision
+from .compat import load_reference_parameters
+from .model_store import get_model_file, purge
+from .vision import get_model

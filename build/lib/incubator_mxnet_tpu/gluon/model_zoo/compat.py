@@ -1,0 +1,110 @@
+"""Load reference-format .params files into zoo nets by name mapping.
+
+A reference-trained artifact (gluon save_parameters: the ndarray save
+wire with structure-dotted keys like `features.0.weight`) cannot load
+through Block.load_parameters here because the two implementations nest
+blocks differently, so the dotted paths disagree even though both nets
+are the same canonical architecture.
+
+The mapping key insight: `_collect_params_with_prefix` walks children in
+registration order on BOTH sides, and a canonical architecture declares
+its layers in topological order — so the k-th parameter OF EACH ROLE
+(conv/fc weight, bias, BN gamma/beta/running stats) on one side is the
+k-th of that role on the other. The loader therefore matches by (role
+sequence, shape), which is invariant to how the blocks are nested, and
+verifies every shape before any assignment (all-or-nothing).
+
+Reference counterpart: python/mxnet/gluon/model_zoo/model_store.py +
+block.load_parameters — which get this mapping for free by being the
+same implementation.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+
+__all__ = ["load_reference_parameters", "param_role"]
+
+_ROLE_SUFFIXES = ("weight", "bias", "gamma", "beta", "running_mean",
+                  "running_var", "moving_mean", "moving_var")
+
+
+def param_role(name):
+    """Map a parameter name (dotted or underscored) to its role. The two
+    BN running-stat spellings (reference layers use running_*, symbol-era
+    files moving_*) collapse to one role each."""
+    leaf = name.rsplit(".", 1)[-1].rsplit("_", 1)[-1]
+    full = name.rsplit(".", 1)[-1]
+    for suf in _ROLE_SUFFIXES:
+        if full.endswith(suf):
+            role = suf.replace("moving_", "running_")
+            return role
+    raise MXNetError(f"cannot classify parameter {name!r} "
+                     f"(leaf {leaf!r}) into a role")
+
+
+def load_reference_parameters(net, filename, strict=True):
+    """Load a reference-format .params file into `net` by role-sequence
+    mapping. Returns {our_name: their_name} for audit."""
+    from ...ndarray.utils import load as nd_load
+
+    loaded = nd_load(filename)
+    # strip the arg:/aux: prefixes the symbol-era save wrote
+    theirs = {}
+    for k, v in loaded.items():
+        if k.startswith(("arg:", "aux:")):
+            k = k[4:]
+        theirs[k] = v
+
+    ours = net._collect_params_with_prefix()
+
+    def by_role(names):
+        seq = {}
+        for n in names:
+            seq.setdefault(param_role(n), []).append(n)
+        return seq
+
+    # insertion order of dicts preserves the collection (= registration /
+    # file) order on both sides
+    their_seq = by_role(theirs.keys())
+    our_seq = by_role(ours.keys())
+
+    mapping = {}
+    for role, our_names in our_seq.items():
+        their_names = their_seq.get(role, [])
+        if len(their_names) != len(our_names):
+            if strict:
+                raise MXNetError(
+                    f"role {role!r}: file has {len(their_names)} "
+                    f"parameters, net needs {len(our_names)}")
+            continue
+        for o, t in zip(our_names, their_names):
+            o_shape = tuple(ours[o].shape or ())
+            t_shape = tuple(theirs[t].shape)
+            # deferred-init parameters have 0-dims: adopt the file's shape
+            if all(s > 0 for s in o_shape) and o_shape and \
+                    o_shape != t_shape:
+                raise MXNetError(
+                    f"shape mismatch mapping {t!r} -> {o!r}: "
+                    f"{t_shape} vs {o_shape}")
+            mapping[o] = t
+    extra = set(theirs) - {t for t in mapping.values()}
+    if strict and extra:
+        raise MXNetError(f"file has unmapped parameters: {sorted(extra)[:5]}")
+
+    # every known shape verified: assign (set_data adopts the file's
+    # shape for deferred-init parameters)
+    for o, t in mapping.items():
+        ours[o].set_data(theirs[t])
+    return mapping
+
+
+def load_pretrained(net, name, root=None):
+    """Shared pretrained=True path for every zoo factory (reference
+    python/mxnet/gluon/model_zoo/vision/*.py: each factory calls
+    get_model_file + load_parameters). Resolves `name` through the
+    sha1-verified model_store cache and loads the reference-format
+    .params via the role-sequence compat mapper, so pretrained=True can
+    never silently return random weights."""
+    from .model_store import get_model_file
+    load_reference_parameters(net, get_model_file(name, root=root))
+    return net

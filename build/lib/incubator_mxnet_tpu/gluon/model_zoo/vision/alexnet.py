@@ -1,0 +1,49 @@
+"""AlexNet, table-driven.
+
+Same architecture the reference ships (python/mxnet/gluon/model_zoo/vision/
+alexnet.py), expressed as a conv-spec table + classifier loop instead of an
+inline layer list.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["AlexNet", "alexnet"]
+
+# (channels, kernel, stride, pad, pool_after)
+_CONV_TABLE = [
+    (64, 11, 4, 2, True),
+    (192, 5, 1, 2, True),
+    (384, 3, 1, 1, False),
+    (256, 3, 1, 1, False),
+    (256, 3, 1, 1, True),
+]
+
+
+class AlexNet(HybridBlock):
+    def __init__(self, classes=1000, dropout=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential(prefix="")
+        for ch, k, s, p, pool in _CONV_TABLE:
+            self.features.add(nn.Conv2D(ch, k, strides=s, padding=p,
+                                        activation="relu"))
+            if pool:
+                self.features.add(nn.MaxPool2D(3, 2))
+        self.features.add(nn.Flatten())
+        for _ in range(2):
+            self.features.add(nn.Dense(4096, activation="relu"))
+            self.features.add(nn.Dropout(dropout))
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
+    """Reference alexnet() factory (vision/alexnet.py)."""
+    net = AlexNet(**kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, "alexnet", root=root)
+    return net

@@ -1,0 +1,113 @@
+"""DenseNet 121/161/169/201.
+
+Same architectures as the reference (python/mxnet/gluon/model_zoo/vision/
+densenet.py), restructured: the dense block is ONE HybridBlock that loops
+its bottleneck layers and carries the concatenation internally, rather than
+a sequential of per-layer concat blocks.
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "get_densenet"]
+
+# depth -> (stem width, growth rate k, units per dense block)
+_SPECS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+}
+
+
+class _DenseBlock(HybridBlock):
+    """`units` bottleneck layers (BN-relu-1x1 -> BN-relu-3x3, each emitting
+    k channels) with the running feature concat held in the loop."""
+
+    def __init__(self, units, growth, bn_size=4, dropout=0, **kwargs):
+        super().__init__(**kwargs)
+        self.norms1 = nn.HybridSequential(prefix="")
+        self.convs1 = nn.HybridSequential(prefix="")
+        self.norms2 = nn.HybridSequential(prefix="")
+        self.convs2 = nn.HybridSequential(prefix="")
+        self._dropout = dropout
+        for _ in range(units):
+            self.norms1.add(nn.BatchNorm())
+            self.convs1.add(nn.Conv2D(bn_size * growth, 1, use_bias=False))
+            self.norms2.add(nn.BatchNorm())
+            self.convs2.add(nn.Conv2D(growth, 3, padding=1, use_bias=False))
+
+    def hybrid_forward(self, F, x):
+        for n1, c1, n2, c2 in zip(self.norms1, self.convs1,
+                                  self.norms2, self.convs2):
+            y = c1(F.relu(n1(x)))
+            y = c2(F.relu(n2(y)))
+            if self._dropout:
+                y = F.Dropout(y, p=self._dropout)
+            x = F.concat(x, y, dim=1)
+        return x
+
+
+class _Transition(HybridBlock):
+    """BN-relu-1x1 halving channels, then 2x2 average pool."""
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        self.norm = nn.BatchNorm()
+        self.conv = nn.Conv2D(channels, 1, use_bias=False)
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def hybrid_forward(self, F, x):
+        return self.pool(self.conv(F.relu(self.norm(x))))
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential(prefix="")
+        self.features.add(nn.Conv2D(num_init_features, 7, strides=2,
+                                    padding=3, use_bias=False))
+        self.features.add(nn.BatchNorm())
+        self.features.add(nn.Activation("relu"))
+        self.features.add(nn.MaxPool2D(3, 2, 1))
+        width = num_init_features
+        for i, units in enumerate(block_config):
+            self.features.add(_DenseBlock(units, growth_rate, bn_size, dropout))
+            width += units * growth_rate
+            if i + 1 < len(block_config):
+                width //= 2
+                self.features.add(_Transition(width))
+        self.features.add(nn.BatchNorm())
+        self.features.add(nn.Activation("relu"))
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
+    if num_layers not in _SPECS:
+        raise MXNetError(f"no densenet spec for depth {num_layers}")
+    stem, growth, blocks = _SPECS[num_layers]
+    net = DenseNet(stem, growth, blocks, **kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, f"densenet{num_layers}", root=root)
+    return net
+
+
+def _ctor(depth):
+    def f(**kwargs):
+        return get_densenet(depth, **kwargs)
+    f.__name__ = f"densenet{depth}"
+    return f
+
+
+densenet121, densenet161, densenet169, densenet201 = \
+    (_ctor(d) for d in (121, 161, 169, 201))

@@ -1,0 +1,158 @@
+"""Inception V3, spec-driven.
+
+Capability parity with the reference's Inception3 (python/mxnet/gluon/
+model_zoo/vision/inception.py), built differently: the whole network is a
+declarative table. Every inception module is a tuple of branch *trees* —
+a branch is a sequence of primitives (`C` conv-bn-relu specs and pooling
+atoms), and the V3 "E" modules' forked tails are expressed with a `Split`
+node instead of a dedicated block class. One generic `_Mixed` block
+interprets the trees; nothing is hand-assembled per module type.
+
+Architecture constants (channel counts, kernel/stride/padding) are the
+published Inception-V3 topology and therefore match any implementation.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["Inception3", "inception_v3"]
+
+# branch primitives -----------------------------------------------------
+C = namedtuple("C", "ch k s p")         # conv(ch, kernel) + BN + relu
+C.__new__.__defaults__ = (1, 0)          # s=1, p=0
+AVG3 = "avg3"                            # 3x3 stride-1 avg pool, pad 1
+MAX3 = "max3"                            # 3x3 stride-2 max pool
+Split = namedtuple("Split", "head tails")  # run head, concat tails
+
+
+def _module_A(pool_ch):
+    return ((C(64, 1),),
+            (C(48, 1), C(64, 5, p=2)),
+            (C(64, 1), C(96, 3, p=1), C(96, 3, p=1)),
+            (AVG3, C(pool_ch, 1)))
+
+
+def _module_B():
+    return ((C(384, 3, s=2),),
+            (C(64, 1), C(96, 3, p=1), C(96, 3, s=2)),
+            (MAX3,))
+
+
+def _module_C(ch7):
+    return ((C(192, 1),),
+            (C(ch7, 1), C(ch7, (1, 7), p=(0, 3)), C(192, (7, 1), p=(3, 0))),
+            (C(ch7, 1), C(ch7, (7, 1), p=(3, 0)), C(ch7, (1, 7), p=(0, 3)),
+             C(ch7, (7, 1), p=(3, 0)), C(192, (1, 7), p=(0, 3))),
+            (AVG3, C(192, 1)))
+
+
+def _module_D():
+    return ((C(192, 1), C(320, 3, s=2)),
+            (C(192, 1), C(192, (1, 7), p=(0, 3)), C(192, (7, 1), p=(3, 0)),
+             C(192, 3, s=2)),
+            (MAX3,))
+
+
+def _module_E():
+    fork13 = ((C(384, (1, 3), p=(0, 1)),), (C(384, (3, 1), p=(1, 0)),))
+    return ((C(320, 1),),
+            Split((C(384, 1),), fork13),
+            Split((C(448, 1), C(384, 3, p=1)), fork13),
+            (AVG3, C(192, 1)))
+
+
+# stem + module sequence (published V3 layout)
+_STEM = (C(32, 3, s=2), C(32, 3), C(64, 3, p=1), MAX3,
+         C(80, 1), C(192, 3), MAX3)
+_MODULES = (_module_A(32), _module_A(64), _module_A(64),
+            _module_B(),
+            _module_C(128), _module_C(160), _module_C(160), _module_C(192),
+            _module_D(),
+            _module_E(), _module_E())
+
+
+class _ConvUnit(HybridBlock):
+    """conv -> BatchNorm(eps=1e-3) -> relu, bias-free."""
+
+    def __init__(self, spec, **kwargs):
+        super().__init__(**kwargs)
+        self.conv = nn.Conv2D(spec.ch, spec.k, strides=spec.s,
+                              padding=spec.p, use_bias=False)
+        self.bn = nn.BatchNorm(epsilon=0.001)
+
+    def hybrid_forward(self, F, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _build_seq(atoms, prefix):
+    seq = nn.HybridSequential(prefix=prefix)
+    for atom in atoms:
+        if atom == AVG3:
+            seq.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        elif atom == MAX3:
+            seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+        else:
+            seq.add(_ConvUnit(atom))
+    return seq
+
+
+class _Mixed(HybridBlock):
+    """Interpret one inception-module spec: run every branch tree on the
+    input and concatenate along channels. A Split branch runs its head
+    then both tails (each concatenated in place, V3 'E' style)."""
+
+    def __init__(self, branches, prefix=None, **kwargs):
+        super().__init__(prefix=prefix, **kwargs)
+        self._plan = []
+        for bi, br in enumerate(branches):
+            if isinstance(br, Split):
+                head = _build_seq(br.head, f"b{bi}_")
+                tails = [_build_seq(t, f"b{bi}t{ti}_")
+                         for ti, t in enumerate(br.tails)]
+                self.register_child(head)
+                for t in tails:
+                    self.register_child(t)
+                self._plan.append(("split", head, tails))
+            else:
+                seq = _build_seq(br, f"b{bi}_")
+                self.register_child(seq)
+                self._plan.append(("seq", seq, None))
+
+    def hybrid_forward(self, F, x):
+        outs = []
+        for kind, head, tails in self._plan:
+            if kind == "seq":
+                outs.append(head(x))
+            else:
+                mid = head(x)
+                outs.append(F.concat(*[t(mid) for t in tails], dim=1))
+        return F.concat(*outs, dim=1)
+
+
+class Inception3(HybridBlock):
+    """Inception V3 over 299x299 inputs (reference inception.py:147)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential(prefix="")
+        self.features.add(_build_seq(_STEM, "stem_"))
+        for mi, spec in enumerate(_MODULES):
+            self.features.add(_Mixed(spec, prefix=f"mixed{mi}_"))
+        self.features.add(nn.AvgPool2D(pool_size=8))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(F.flatten(self.features(x)))
+
+
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    """Reference inception_v3() factory (vision/inception.py)."""
+    net = Inception3(**kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, "inceptionv3", root=root)
+    return net

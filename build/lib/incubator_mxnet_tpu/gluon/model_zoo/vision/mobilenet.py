@@ -1,0 +1,149 @@
+"""MobileNet v1 / v2, paper-table driven.
+
+Same architectures as the reference (python/mxnet/gluon/model_zoo/vision/
+mobilenet.py) but generated from the published stage tables: v1 from a
+(out_channels, stride) list of depthwise-separable pairs, v2 from the
+(expansion t, out c, repeats n, stride s) table of the MobileNetV2 paper.
+
+Depthwise convs are grouped Conv2D (groups == channels); XLA lowers grouped
+convolutions natively, so no hand-written depthwise kernels are needed
+(the reference carries depthwise_convolution_tf.cuh for CUDA).
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
+           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
+           "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25",
+           "get_mobilenet", "get_mobilenet_v2"]
+
+# v1: (out_channels, stride) per depthwise-separable pair
+_V1_TABLE = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+             (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+             (1024, 1)]
+
+# v2: (expansion t, out channels c, repeats n, first stride s) — paper tab.2
+_V2_TABLE = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+             (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+
+
+class _ConvBN(HybridBlock):
+    """conv -> BN -> optional (relu | relu6)."""
+
+    def __init__(self, channels, kernel=1, stride=1, groups=1, act="relu",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.conv = nn.Conv2D(channels, kernel, strides=stride,
+                              padding=kernel // 2, groups=groups,
+                              use_bias=False)
+        self.bn = nn.BatchNorm()
+        self._act = act
+
+    def hybrid_forward(self, F, x):
+        y = self.bn(self.conv(x))
+        if self._act == "relu":
+            y = F.relu(y)
+        elif self._act == "relu6":
+            y = F.clip(y, a_min=0.0, a_max=6.0)
+        return y
+
+
+class _InvertedResidual(HybridBlock):
+    """MobileNetV2 block: 1x1 expand (t*) -> 3x3 depthwise -> 1x1 linear
+    project, identity shortcut when shapes allow."""
+
+    def __init__(self, in_ch, out_ch, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self._identity = (stride == 1 and in_ch == out_ch)
+        mid = in_ch * t
+        self.layers = nn.HybridSequential(prefix="")
+        # the reference LinearBottleneck keeps the 1x1 expansion even at t=1
+        # (python/mxnet/gluon/model_zoo/vision/mobilenet.py _add_conv chain),
+        # so parameter layouts line up with reference-exported weights
+        self.layers.add(_ConvBN(mid, 1, act="relu6"))
+        self.layers.add(_ConvBN(mid, 3, stride, groups=mid, act="relu6"))
+        self.layers.add(_ConvBN(out_ch, 1, act=None))
+
+    def hybrid_forward(self, F, x):
+        y = self.layers(x)
+        return x + y if self._identity else y
+
+
+class MobileNet(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        scale = lambda c: max(1, int(c * multiplier))
+        self.features = nn.HybridSequential(prefix="")
+        self.features.add(_ConvBN(scale(32), 3, 2))
+        prev = scale(32)
+        for out, stride in _V1_TABLE:
+            # depthwise 3x3 over prev channels, then 1x1 pointwise to out
+            self.features.add(_ConvBN(prev, 3, stride, groups=prev))
+            self.features.add(_ConvBN(scale(out), 1))
+            prev = scale(out)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        scale = lambda c: max(1, int(c * multiplier))
+        self.features = nn.HybridSequential(prefix="features_")
+        prev = scale(32)
+        self.features.add(_ConvBN(prev, 3, 2, act="relu6"))
+        for t, c, n, s in _V2_TABLE:
+            for i in range(n):
+                out = scale(c)
+                self.features.add(_InvertedResidual(prev, out, t,
+                                                    s if i == 0 else 1))
+                prev = out
+        head = 1280 if multiplier <= 1.0 else scale(1280)
+        self.features.add(_ConvBN(head, 1, act="relu6"))
+        self.features.add(nn.GlobalAvgPool2D())
+        self.output = nn.HybridSequential(prefix="output_")
+        self.output.add(nn.Conv2D(classes, 1, use_bias=False))
+        self.output.add(nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
+    net = MobileNet(multiplier, **kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, f"mobilenet{float(multiplier)}", root=root)
+    return net
+
+
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
+                     **kwargs):
+    net = MobileNetV2(multiplier, **kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, f"mobilenetv2_{float(multiplier)}", root=root)
+    return net
+
+
+def _ctor(factory, mult, name):
+    def f(**kwargs):
+        return factory(mult, **kwargs)
+    f.__name__ = name
+    return f
+
+
+mobilenet1_0 = _ctor(get_mobilenet, 1.0, "mobilenet1_0")
+mobilenet0_75 = _ctor(get_mobilenet, 0.75, "mobilenet0_75")
+mobilenet0_5 = _ctor(get_mobilenet, 0.5, "mobilenet0_5")
+mobilenet0_25 = _ctor(get_mobilenet, 0.25, "mobilenet0_25")
+mobilenet_v2_1_0 = _ctor(get_mobilenet_v2, 1.0, "mobilenet_v2_1_0")
+mobilenet_v2_0_75 = _ctor(get_mobilenet_v2, 0.75, "mobilenet_v2_0_75")
+mobilenet_v2_0_5 = _ctor(get_mobilenet_v2, 0.5, "mobilenet_v2_0_5")
+mobilenet_v2_0_25 = _ctor(get_mobilenet_v2, 0.25, "mobilenet_v2_0_25")

@@ -1,0 +1,78 @@
+"""SqueezeNet 1.0/1.1, stage-spec driven.
+
+Same fire-module architectures as the reference (python/mxnet/gluon/
+model_zoo/vision/squeezenet.py), but the two versions are data: a layout
+list of fire widths and pool markers, consumed by one builder.
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1", "get_squeezenet"]
+
+
+class _Fire(HybridBlock):
+    """squeeze 1x1 -> relu -> parallel expand 1x1 / expand 3x3 -> concat."""
+
+    def __init__(self, squeeze, expand, **kwargs):
+        super().__init__(**kwargs)
+        self.squeeze = nn.Conv2D(squeeze, 1, activation="relu")
+        self.left = nn.Conv2D(expand, 1, activation="relu")
+        self.right = nn.Conv2D(expand, 3, padding=1, activation="relu")
+
+    def hybrid_forward(self, F, x):
+        y = self.squeeze(x)
+        return F.concat(self.left(y), self.right(y), dim=1)
+
+
+# layout entries: "P" = 3x3/2 ceil max-pool, int n = fire(squeeze=n,
+# expand=4n per branch — the published ratio), tuple = stem conv
+_LAYOUTS = {
+    "1.0": [(96, 7, 2), "P", 16, 16, 32, "P", 32, 48, 48, 64, "P", 64],
+    "1.1": [(64, 3, 2), "P", 16, 16, "P", 32, 32, "P", 48, 48, 64, 64],
+}
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        if version not in _LAYOUTS:
+            raise MXNetError(f"squeezenet version {version!r} not in "
+                             f"{sorted(_LAYOUTS)}")
+        self.features = nn.HybridSequential(prefix="")
+        for entry in _LAYOUTS[version]:
+            if entry == "P":
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            elif isinstance(entry, tuple):
+                ch, k, s = entry
+                self.features.add(nn.Conv2D(ch, k, strides=s,
+                                            activation="relu"))
+            else:
+                self.features.add(_Fire(entry, entry * 4))
+        self.features.add(nn.Dropout(0.5))
+        # fully-convolutional classifier head
+        self.output = nn.HybridSequential(prefix="")
+        self.output.add(nn.Conv2D(classes, 1, activation="relu"))
+        self.output.add(nn.GlobalAvgPool2D())
+        self.output.add(nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
+    net = SqueezeNet(version, **kwargs)
+    if pretrained:
+        from ..compat import load_pretrained
+        load_pretrained(net, f"squeezenet{version}", root=root)
+    return net
+
+
+def squeezenet1_0(**kwargs):
+    return get_squeezenet("1.0", **kwargs)
+
+
+def squeezenet1_1(**kwargs):
+    return get_squeezenet("1.1", **kwargs)

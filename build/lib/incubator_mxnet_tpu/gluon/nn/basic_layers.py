@@ -1,0 +1,379 @@
+"""Gluon basic layers.
+
+Reference: python/mxnet/gluon/nn/basic_layers.py: Sequential/HybridSequential/
+Dense/Dropout/BatchNorm/Embedding/Flatten/InstanceNorm/LayerNorm/Lambda/
+HybridLambda (+ activations.py).
+"""
+from __future__ import annotations
+
+from ... import autograd, nd
+from ...base import MXNetError
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "Embedding", "Flatten", "InstanceNorm", "LayerNorm", "GroupNorm",
+           "Lambda", "HybridLambda", "Activation", "LeakyReLU", "PReLU", "ELU",
+           "SELU", "Swish", "GELU"]
+
+
+class Sequential(Block):
+    """Reference basic_layers.py Sequential."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+            if isinstance(x, (tuple, list)) and len(x) == 1:
+                x = x[0]
+        return x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)(prefix=self._prefix)
+            net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def _eager_forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+        return x
+
+    def hybrid_forward(self, F, x, *args):
+        return self._eager_forward(x, *args)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)(prefix=self._prefix)
+            net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Reference basic_layers.py Dense — FullyConnected layer; MXU-friendly
+    (a single jnp.matmul, fused with the activation by XLA)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None, bias_initializer="zeros",
+                 in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self.act_type = activation
+        self.weight = self.params.get("weight", shape=(units, in_units),
+                                      init=weight_initializer, dtype=dtype,
+                                      allow_deferred_init=True)
+        if use_bias:
+            self.bias = self.params.get("bias", shape=(units,),
+                                        init=bias_initializer, dtype=dtype,
+                                        allow_deferred_init=True)
+        else:
+            self.bias = None
+        self._reg_params["weight"] = self.weight
+        if self.bias is not None:
+            self._reg_params["bias"] = self.bias
+
+    def infer_shape(self, x, *args):
+        in_units = int(x.size // x.shape[0]) if self._flatten else int(x.shape[-1])
+        self.weight._infer_shape((self._units, in_units))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
+                               no_bias=bias is None, flatten=self._flatten)
+        if self.act_type:
+            out = F.Activation(out, act_type=self.act_type)
+        return out
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = tuple(axes)
+
+    def hybrid_forward(self, F, x):
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+
+class BatchNorm(HybridBlock):
+    """Reference basic_layers.py BatchNorm. Running stats update is explicit
+    and functional (captured during hybridize tracing, see block.py)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        ch = in_channels
+        self.gamma = self.params.get("gamma", shape=(ch,), init=gamma_initializer,
+                                     allow_deferred_init=True,
+                                     differentiable=scale)
+        self.beta = self.params.get("beta", shape=(ch,), init=beta_initializer,
+                                    allow_deferred_init=True,
+                                    differentiable=center)
+        self.running_mean = self.params.get("running_mean", shape=(ch,),
+                                            init=running_mean_initializer,
+                                            allow_deferred_init=True,
+                                            differentiable=False)
+        self.running_var = self.params.get("running_var", shape=(ch,),
+                                           init=running_variance_initializer,
+                                           allow_deferred_init=True,
+                                           differentiable=False)
+        for n in ("gamma", "beta", "running_mean", "running_var"):
+            self._reg_params[n] = getattr(self, n)
+
+    def infer_shape(self, x, *args):
+        ch = int(x.shape[self._axis])
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p._infer_shape((ch,))
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        training = autograd.is_training() and not self._use_global_stats
+        res = F.BatchNorm(
+            x, gamma, beta, running_mean, running_var, eps=self._epsilon,
+            momentum=self._momentum, fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis,
+            training=training)
+        if not isinstance(res, (tuple, list)):
+            # symbolic trace: one visible output; stat updates are the
+            # executor's job (executor.py BatchNorm aux wiring)
+            return res
+        out, mean, var = res
+        if training:
+            with autograd.pause():
+                m = self._momentum
+                self.running_mean.set_data(running_mean * m + mean * (1 - m))
+                self.running_var.set_data(running_var * m + var * (1 - m))
+        return out
+
+
+class Embedding(HybridBlock):
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
+        self.weight = self.params.get("weight", shape=(input_dim, output_dim),
+                                      init=weight_initializer, dtype=dtype,
+                                      grad_stype="row_sparse" if sparse_grad
+                                      else "default")
+        self._reg_params["weight"] = self.weight
+
+    def hybrid_forward(self, F, x, weight):
+        from ..block import _TraceScope
+        if self._sparse_grad and F is nd and autograd.is_recording() \
+                and not _TraceScope.active():
+            # eager-only: under hybridize the whole step is one XLA program
+            # and a dense scatter-add grad is what the compiler fuses best
+            from ...ndarray.sparse import sparse_embedding
+            return sparse_embedding(x, weight, self._input_dim,
+                                    self._output_dim)
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim,
+                           sparse_grad=self._sparse_grad)
+
+
+class Flatten(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.flatten(x)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._epsilon = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init=gamma_initializer,
+                                     allow_deferred_init=True)
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init=beta_initializer,
+                                    allow_deferred_init=True)
+        self._reg_params.update({"gamma": self.gamma, "beta": self.beta})
+
+    def infer_shape(self, x, *args):
+        ch = int(x.shape[1])
+        self.gamma._infer_shape((ch,))
+        self.beta._infer_shape((ch,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init=gamma_initializer,
+                                     allow_deferred_init=True)
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init=beta_initializer,
+                                    allow_deferred_init=True)
+        self._reg_params.update({"gamma": self.gamma, "beta": self.beta})
+
+    def infer_shape(self, x, *args):
+        ch = int(x.shape[self._axis])
+        self.gamma._infer_shape((ch,))
+        self.beta._infer_shape((ch,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    """Reference src/operator/nn/group_norm.cc + gluon contrib."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        # gamma/beta are per-GROUP (reference basic_layers.py:690-695:
+        # shape=(num_groups,)) and applied in the grouped view by the op
+        self.gamma = self.params.get("gamma", shape=(num_groups,),
+                                     init=gamma_initializer, allow_deferred_init=True)
+        self.beta = self.params.get("beta", shape=(num_groups,),
+                                    init=beta_initializer, allow_deferred_init=True)
+        self._reg_params.update({"gamma": self.gamma, "beta": self.beta})
+
+    def infer_shape(self, x, *args):
+        self.gamma._infer_shape((self._num_groups,))
+        self.beta._infer_shape((self._num_groups,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups,
+                           eps=self._epsilon)
+
+
+class Lambda(Block):
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            self._func = getattr(nd, function)
+        else:
+            self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            self._func_name = function
+            self._func = None
+        else:
+            self._func = function
+            self._func_name = getattr(function, "__name__", "lambda")
+
+    def hybrid_forward(self, F, *args):
+        f = self._func or getattr(F, self._func_name)
+        return f(*args)
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self._act_type = activation
+
+    def _alias(self):
+        return self._act_type if hasattr(self, "_act_type") else "activation"
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type=self._act_type)
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        from ... import initializer as _init
+        self.alpha = self.params.get("alpha", shape=(1,),
+                                     init=alpha_initializer or _init.Constant(0.25))
+        self._reg_params["alpha"] = self.alpha
+
+    def hybrid_forward(self, F, x, alpha):
+        return F.LeakyReLU(x, alpha, act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="gelu")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def hybrid_forward(self, F, x):
+        return x * F.sigmoid(self._beta * x)

@@ -1,0 +1,236 @@
+"""Gluon convolution / pooling layers.
+
+Reference: python/mxnet/gluon/nn/conv_layers.py: Conv1D-3D(+Transpose),
+Max/Avg/GlobalMax/GlobalAvgPool1D-3D, ReflectionPad2D.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 transpose=False, output_padding=0, **kwargs):
+        super().__init__(**kwargs)
+        ndim = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._strides = _tup(strides, ndim)
+        self._padding = _tup(padding, ndim)
+        self._dilation = _tup(dilation, ndim)
+        self._groups = groups
+        self._transpose = transpose
+        self._output_padding = _tup(output_padding, ndim)
+        self.act_type = activation
+        if transpose:
+            wshape = (in_channels, channels // groups) + tuple(kernel_size)
+        else:
+            wshape = (channels, in_channels // groups if in_channels else 0) + \
+                tuple(kernel_size)
+        self.weight = self.params.get("weight", shape=wshape,
+                                      init=weight_initializer,
+                                      allow_deferred_init=True)
+        self._reg_params["weight"] = self.weight
+        if use_bias:
+            self.bias = self.params.get("bias", shape=(channels,),
+                                        init=bias_initializer,
+                                        allow_deferred_init=True)
+            self._reg_params["bias"] = self.bias
+        else:
+            self.bias = None
+
+    def infer_shape(self, x, *args):
+        ci = int(x.shape[1])
+        if self._transpose:
+            self.weight._infer_shape((ci, self._channels // self._groups) +
+                                     tuple(self._kernel))
+        else:
+            self.weight._infer_shape((self._channels, ci // self._groups) +
+                                     tuple(self._kernel))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        if self._transpose:
+            out = F.Deconvolution(x, weight, bias, kernel=self._kernel,
+                                  stride=self._strides, dilate=self._dilation,
+                                  pad=self._padding, adj=self._output_padding,
+                                  num_filter=self._channels,
+                                  num_group=self._groups, no_bias=bias is None)
+        else:
+            out = F.Convolution(x, weight, bias, kernel=self._kernel,
+                                stride=self._strides, dilate=self._dilation,
+                                pad=self._padding, num_filter=self._channels,
+                                num_group=self._groups, no_bias=bias is None)
+        if self.act_type:
+            out = F.Activation(out, act_type=self.act_type)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, transpose=True,
+                         output_padding=output_padding, **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, transpose=True,
+                         output_padding=output_padding, **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, transpose=True,
+                         output_padding=output_padding, **kwargs)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, global_pool, pool_type,
+                 ceil_mode=False, count_include_pad=True, ndim=2, **kwargs):
+        super().__init__(**kwargs)
+        self._ndim = ndim
+        self._kernel = pool_size
+        self._stride = strides if strides is not None else pool_size
+        self._pad = padding
+        self._global = global_pool
+        self._pool_type = pool_type
+        self._convention = "full" if ceil_mode else "valid"
+        self._count_include_pad = count_include_pad
+
+    def hybrid_forward(self, F, x):
+        # spatial rank comes from the layer config, not the input, so the
+        # same code traces symbolically (Symbols have no static ndim)
+        ndim = self._ndim
+        return F.Pooling(x, kernel=_tup(self._kernel, ndim),
+                         stride=_tup(self._stride, ndim),
+                         pad=_tup(self._pad, ndim), pool_type=self._pool_type,
+                         global_pool=self._global,
+                         pooling_convention=self._convention,
+                         count_include_pad=self._count_include_pad)
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, False, "max", ceil_mode,
+                         ndim=1, **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, False, "max", ceil_mode,
+                         ndim=2, **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, False, "max", ceil_mode,
+                         ndim=3, **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, False, "avg", ceil_mode,
+                         count_include_pad, ndim=1, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, False, "avg", ceil_mode,
+                         count_include_pad, ndim=2, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(pool_size, strides, padding, False, "avg", ceil_mode,
+                         count_include_pad, ndim=3, **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__(1, None, 0, True, "max", ndim=1, **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, 0, True, "max", ndim=2, **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, 0, True, "max", ndim=3, **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__(1, None, 0, True, "avg", ndim=1, **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, 0, True, "avg", ndim=2, **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, 0, True, "avg", ndim=3, **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        self._padding = tuple(padding)
+
+    def hybrid_forward(self, F, x):
+        return F.pad(x, mode="reflect", pad_width=self._padding)

@@ -1,0 +1,338 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: python/mxnet/gluon/parameter.py (1,029 LoC): `Parameter:47`
+(deferred alloc, grad_req:142, per-ctx copies list_ctx:605, _reduce:381),
+`ParameterDict`.
+
+TPU-native redesign: the reference replicates each parameter per GPU context
+and all-reduces gradients across copies. Here a parameter owns ONE jax-backed
+NDArray whose jax.sharding spec covers any number of devices — replication and
+partitioning are sharding annotations, not copies (see parallel/). The
+deferred-init dance (shape unknown until first forward) is kept because the
+Gluon UX depends on it.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as _np
+
+from .. import autograd, initializer, nd
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its shape was known (reference parameter.py:40)."""
+
+
+def _shape_known(shape):
+    return shape is not None and all(int(s) > 0 for s in shape)
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._data = None
+        self._deferred_init = None  # (init, ctx, default_init)
+        self._sharding = None  # optional jax.sharding spec (set by parallel/)
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._data.attach_grad(req, stype=self._grad_stype)
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+    # -- init ---------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Allocate + fill (reference parameter.py initialize). If shape is
+        unknown, stash a deferred init executed at first forward."""
+        default_init = default_init or initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if not _shape_known(self.shape):
+            if not self.allow_deferred_init:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has unknown shape {self.shape} and "
+                    f"allow_deferred_init=False")
+            self._deferred_init = (init, ctx, default_init)
+            return
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        import jax
+        ctx = ctx if isinstance(ctx, Context) or ctx is None else \
+            (ctx[0] if isinstance(ctx, (list, tuple)) and ctx else None)
+        # ensure_compile_time_eval: deferred init may fire while a hybridize
+        # trace is being built; parameters must be real device arrays, not
+        # tracers of that trace.
+        with jax.ensure_compile_time_eval():
+            arr = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx)
+            filler = init or self.init or default_init
+            if isinstance(filler, str):
+                filler = initializer.create(filler)
+            desc = initializer.InitDesc(self.name)
+            with autograd.pause():
+                filler(desc, arr)
+        self._data = arr
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req, stype=self._grad_stype)
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            raise DeferredInitializationError(
+                f"Parameter {self.name} was not initialized (call "
+                f".initialize() or net.initialize())")
+        if not _shape_known(self.shape):
+            raise DeferredInitializationError(
+                f"Parameter {self.name} shape still unknown: {self.shape}")
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def _infer_shape(self, partial_shape):
+        """Fill unknown (0) dims from an inferred shape, then finish deferred
+        init (called by layers on first forward)."""
+        if self.shape is None:
+            self.shape = tuple(partial_shape)
+        else:
+            new = []
+            for have, got in zip(self.shape, partial_shape):
+                if have and int(have) > 0:
+                    if int(got) > 0 and int(got) != int(have):
+                        raise MXNetError(
+                            f"{self.name}: inferred shape {partial_shape} "
+                            f"incompatible with declared {self.shape}")
+                    new.append(have)
+                else:
+                    new.append(got)
+            self.shape = tuple(new)
+        if self._deferred_init is not None and _shape_known(self.shape):
+            self._finish_deferred_init()
+
+    # -- access -------------------------------------------------------------
+    def data(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} deferred-initialized; run a forward "
+                    f"pass (or set shape) first")
+            raise MXNetError(f"Parameter {self.name} not initialized; call "
+                             f".initialize()")
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        d = self.data()
+        if d._grad is None:
+            raise MXNetError(f"Parameter {self.name} has grad_req='null'")
+        return d._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        return [self.data().context] if self._data is not None else []
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data.zero_grad()
+
+    def set_data(self, data):
+        if self._data is None:
+            if not _shape_known(self.shape) and hasattr(data, "shape"):
+                self.shape = tuple(data.shape)
+            self._finish_init(initializer.Constant(0.0), None, None)
+        src = data if isinstance(data, nd.NDArray) else nd.array(data)
+        self._data._data = src.astype(self.dtype)._data if str(src.dtype) != str(self.dtype) \
+            else src._data
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_context(
+                ctx[0] if isinstance(ctx, (list, tuple)) else ctx)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            had_grad = self._data._grad is not None
+            self._data = self._data.astype(dtype)
+            if had_grad:
+                self._data.attach_grad(self._grad_req,
+                                       stype=self._grad_stype)
+
+    def var(self):
+        from .. import symbol
+        return symbol.var(self.name, shape=self.shape, dtype=self.dtype)
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, nd.NDArray):
+            value = nd.array(value)
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(value.dtype), init=None,
+                         differentiable=False)
+        self.init = _ConstInit(value)
+
+
+class _ConstInit(initializer.Initializer):
+    def __init__(self, value):
+        super().__init__()
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+    _init_default = _init_weight
+
+
+class ParameterDict:
+    """Prefix-scoped name->Parameter mapping (reference parameter.py
+    ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __len__(self):
+        return len(self._params)
+
+    def __repr__(self):
+        body = "\n".join(f"  {v}" for v in self._params.values())
+        return f"ParameterDict '{self._prefix}' (\n{body}\n)"
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve `prefix+name` (reference parameter.py get)."""
+        full = self._prefix + name
+        param = self._get_impl(full)
+        if param is None:
+            param = Parameter(full, **kwargs)
+            self._params[full] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None and param.shape is not None:
+                    param._infer_shape_compat(v) if hasattr(param, "_infer_shape_compat") else None
+        return param
+
+    def get_constant(self, name, value=None):
+        full = self._prefix + name
+        param = self._get_impl(full)
+        if param is None:
+            if value is None:
+                raise MXNetError(f"no constant {full} and no value given")
+            param = Constant(full, value)
+            self._params[full] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared:
+            self._params[name] = self._shared[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        init = init or initializer.Uniform()
+        for p in self.values():
+            p.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for p in self.values():
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg_dict[name] = p.data()
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        loaded = nd.load(filename)
+        if not isinstance(loaded, dict):
+            raise MXNetError("params file does not contain a name->array map")
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self.items():
+            if name in loaded:
+                p._infer_shape(loaded[name].shape)
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name} missing from {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError(f"extra parameters in {filename}: {sorted(extra)}")

@@ -1,0 +1,451 @@
+"""Unfused recurrent cells + modifiers.
+
+Reference: python/mxnet/gluon/rnn/rnn_cell.py — single-step cells
+(RNN/LSTM/GRU) with `unroll`, plus Sequential/Bidirectional containers and
+Dropout/Zoneout/Residual modifiers. Gate math matches the fused op
+(ops/rnn_ops.py) so a cell-unrolled network and the fused layer agree
+numerically. `unroll` is a Python loop over steps — under hybridize the
+whole unrolled graph compiles into one XLA program.
+"""
+from __future__ import annotations
+
+from ... import nd
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
+
+
+def _format_sequence(length, inputs, layout, merge):
+    """Normalize inputs to a list of per-step tensors (reference
+    rnn_cell.py _format_sequence)."""
+    axis = layout.find("T")
+    if isinstance(inputs, (list, tuple)):
+        seq = list(inputs)
+        batch = seq[0].shape[0]
+    else:
+        if length is None:
+            length = inputs.shape[axis]
+        seq = [nd.squeeze(nd.slice_axis(inputs, axis=axis, begin=i, end=i + 1),
+                          axis=axis) for i in range(length)]
+        batch = inputs.shape[layout.find("N")]
+    return seq, axis, batch
+
+
+def _merge_outputs(outputs, axis):
+    return nd.stack(*outputs, axis=axis)
+
+
+class RecurrentCell(HybridBlock):
+    """Base cell (reference rnn_cell.py RecurrentCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for child in self._children.values():
+            if hasattr(child, "reset"):
+                child.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        if self._modified:
+            raise MXNetError("cannot begin_state on a modifier-wrapped cell; "
+                             "call it on the outermost cell")
+        func = func or nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info)
+            info.pop("__layout__", None)
+            states.append(func(**info, **kwargs))
+        return states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        return super().__call__(inputs, *states)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Reference rnn_cell.py unroll."""
+        self.reset()
+        seq, axis, batch = _format_sequence(length, inputs, layout, merge_outputs)
+        if begin_state is None:
+            begin_state = self.begin_state(batch, dtype=seq[0].dtype)
+        states = begin_state
+        outputs = []
+        all_states = []
+        for i in range(length):
+            out, states = self(seq[i], states)
+            outputs.append(out)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [nd.SequenceLast(nd.stack(*[s[j] for s in all_states],
+                                               axis=0),
+                                      valid_length, use_sequence_length=True,
+                                      axis=0)
+                      for j in range(len(states))]
+            outputs = [nd.SequenceMask(
+                _merge_outputs(outputs, 0), valid_length,
+                use_sequence_length=True, axis=0)]
+            merged = nd.swapaxes(outputs[0], dim1=0, dim2=1) if axis == 1 \
+                else outputs[0]
+            return merged, states
+        if merge_outputs is None or merge_outputs:
+            return _merge_outputs(outputs, axis), states
+        return outputs, states
+
+
+class _BaseRNNCell(RecurrentCell):
+    def __init__(self, hidden_size, gates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = gates
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(ng * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(ng * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ng * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ng * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+        for n in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+            self._reg_params[n] = getattr(self, n)
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._infer_shape(
+            (self.i2h_weight.shape[0], int(x.shape[-1])))
+        for p in (self.h2h_weight, self.i2h_bias, self.h2h_bias):
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+
+class RNNCell(_BaseRNNCell):
+    """Elman cell: h' = act(W_i x + b_i + W_h h + b_h)."""
+
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F, inputs, state, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        pre = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size) + \
+            F.FullyConnected(state, h2h_weight, h2h_bias,
+                             num_hidden=self._hidden_size)
+        out = F.Activation(pre, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseRNNCell):
+    """LSTM cell, gate order [i, f, g, o] (reference rnn_cell.py LSTMCell,
+    matching the fused op / cuDNN layout)."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def hybrid_forward(self, F, inputs, h, c, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        nh = self._hidden_size
+        gates = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                                 num_hidden=4 * nh) + \
+            F.FullyConnected(h, h2h_weight, h2h_bias, num_hidden=4 * nh)
+        i, f, g, o = (F.slice_axis(gates, axis=-1, begin=k * nh,
+                                   end=(k + 1) * nh) for k in range(4))
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * F.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(_BaseRNNCell):
+    """GRU cell, cuDNN linear_before_reset semantics (matches fused op)."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def hybrid_forward(self, F, inputs, h, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        nh = self._hidden_size
+        xp = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=3 * nh)
+        hp = F.FullyConnected(h, h2h_weight, h2h_bias, num_hidden=3 * nh)
+        xr, xz, xn = (F.slice_axis(xp, axis=-1, begin=k * nh, end=(k + 1) * nh)
+                      for k in range(3))
+        hr, hz, hn = (F.slice_axis(hp, axis=-1, begin=k * nh, end=(k + 1) * nh)
+                      for k in range(3))
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        n = F.tanh(xn + r * hn)
+        out = (1 - z) * n + z * h
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells (reference rnn_cell.py SequentialRNNCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell, str(len(self._children)))
+
+    def state_info(self, batch_size=0):
+        info = []
+        for cell in self._children.values():
+            info.extend(cell.state_info(batch_size))
+        return info
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        if begin_state is None:
+            seq, _, batch = _format_sequence(length, inputs, layout, None)
+            begin_state = self.begin_state(batch, dtype=seq[0].dtype)
+        p = 0
+        states = []
+        cells = list(self._children.values())
+        for i, cell in enumerate(cells):
+            n = len(cell.state_info())
+            st = begin_state[p:p + n]
+            p += n
+            inputs, st = cell.unroll(
+                length, inputs, begin_state=st, layout=layout,
+                merge_outputs=None if i < len(cells) - 1 else merge_outputs,
+                valid_length=valid_length)
+            states.extend(st)
+        return inputs, states
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __len__(self):
+        return len(self._children)
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+class ModifierCell(RecurrentCell):
+    """Base for cells wrapping another cell (reference ModifierCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__(prefix=None, params=None)
+        base_cell._modified = True
+        self.base_cell = base_cell
+        self.register_child(base_cell, "base_cell")
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class DropoutCell(RecurrentCell):
+    """Apply dropout to step outputs (reference DropoutCell)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.rate = rate
+        self.axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs):
+        if self.rate > 0:
+            inputs = F.Dropout(inputs, p=self.rate, axes=self.axes)
+        return inputs, []
+
+    def __call__(self, inputs, states):
+        out, _ = super().__call__(inputs, [])
+        if isinstance(out, tuple):
+            out = out[0]
+        return out, states
+
+    def forward(self, inputs, *states):
+        out = self._eager_forward(inputs)
+        return out
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def __call__(self, inputs, states):
+        from ... import autograd
+
+        out, next_states = self.base_cell(inputs, states)
+        if not autograd.is_training():
+            return out, next_states
+        po, ps = self.zoneout_outputs, self.zoneout_states
+
+        def mask(rate, like):
+            return nd.Dropout(nd.ones_like(like), p=rate, training=True)
+
+        prev = self._prev_output if self._prev_output is not None \
+            else nd.zeros_like(out)
+        if po:
+            m = mask(po, out)
+            out = nd.where(m, out, prev)
+        if ps:
+            next_states = [nd.where(mask(ps, ns), ns, s)
+                           for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(ModifierCell):
+    """Add the input to the cell output (reference ResidualCell)."""
+
+    def __call__(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs, valid_length=valid_length)
+        self.base_cell._modified = True
+        seq, axis, _ = _format_sequence(length, inputs, layout, True)
+        if isinstance(outputs, list):
+            outputs = [o + s for o, s in zip(outputs, seq)]
+        else:
+            outputs = outputs + _merge_outputs(seq, axis)
+        return outputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Run two cells over the sequence in opposite directions
+    (reference BidirectionalCell; unroll-only)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix=None, params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot run stepwise; use unroll")
+
+    def state_info(self, batch_size=0):
+        l, r = self._children["l_cell"], self._children["r_cell"]
+        return l.state_info(batch_size) + r.state_info(batch_size)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        seq, axis, batch = _format_sequence(length, inputs, layout, None)
+        if begin_state is None:
+            begin_state = self.begin_state(batch, dtype=seq[0].dtype)
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        nl = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(length, seq,
+                                        begin_state=begin_state[:nl],
+                                        layout="TNC", merge_outputs=False,
+                                        valid_length=valid_length)
+        # reverse respecting per-sample lengths so padding never leads the
+        # reverse pass (reference rnn_cell.py BidirectionalCell uses
+        # SequenceReverse with use_sequence_length)
+        stacked = nd.stack(*seq, axis=0)
+        if valid_length is not None:
+            rev_in = nd.SequenceReverse(stacked, valid_length,
+                                        use_sequence_length=True, axis=0)
+        else:
+            rev_in = nd.SequenceReverse(stacked, axis=0)
+        rseq = [nd.squeeze(nd.slice_axis(rev_in, axis=0, begin=i, end=i + 1),
+                           axis=0) for i in range(length)]
+        r_out, r_states = r_cell.unroll(length, rseq,
+                                        begin_state=begin_state[nl:],
+                                        layout="TNC", merge_outputs=False,
+                                        valid_length=valid_length)
+        if isinstance(l_out, list):
+            r_merged = _merge_outputs(r_out, 0)
+        else:
+            r_merged = r_out
+        if valid_length is not None:
+            r_rev = nd.SequenceReverse(r_merged, valid_length,
+                                       use_sequence_length=True, axis=0)
+        else:
+            r_rev = nd.SequenceReverse(r_merged, axis=0)
+        l_merged = _merge_outputs(l_out, 0) if isinstance(l_out, list) \
+            else l_out
+        merged = nd.concat(l_merged, r_rev, dim=-1)
+        if axis == 1:
+            merged = nd.swapaxes(merged, dim1=0, dim2=1)
+        if merge_outputs is False and valid_length is None:
+            t_axis = 1 if axis == 1 else 0
+            merged = [nd.squeeze(nd.slice_axis(merged, axis=t_axis, begin=i,
+                                               end=i + 1), axis=t_axis)
+                      for i in range(length)]
+        return merged, l_states + r_states

@@ -1,0 +1,170 @@
+"""RNN/LSTM/GRU layers over the fused RNN op.
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py — per-(layer, direction)
+i2h/h2h parameters concatenated into the fused op's flat cuDNN-layout
+vector at forward. Same here: the concat is one XLA fusion, and the fused
+op (ops/rnn_ops.py) hoists input projections out of its lax.scan so the
+recurrent loop stays MXU-bound.
+"""
+from __future__ import annotations
+
+from ... import autograd, nd
+from ...base import MXNetError
+from ...ops.rnn_ops import GATES as _GATES
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout!r}; need TNC or NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = _GATES[mode]
+
+        ng, nh = self._gates, hidden_size
+        for layer in range(num_layers):
+            for d in ["l", "r"][:self._dir]:
+                in_sz = input_size if layer == 0 else hidden_size * self._dir
+                for conn, sz in (("i2h", in_sz), ("h2h", nh)):
+                    w = self.params.get(
+                        f"{d}{layer}_{conn}_weight", shape=(ng * nh, sz),
+                        init=(i2h_weight_initializer if conn == "i2h"
+                              else h2h_weight_initializer),
+                        dtype=dtype, allow_deferred_init=True)
+                    b = self.params.get(
+                        f"{d}{layer}_{conn}_bias", shape=(ng * nh,),
+                        init=(i2h_bias_initializer if conn == "i2h"
+                              else h2h_bias_initializer),
+                        dtype=dtype, allow_deferred_init=True)
+                    self._reg_params[f"{d}{layer}_{conn}_weight"] = w
+                    self._reg_params[f"{d}{layer}_{conn}_bias"] = b
+
+    def _alias(self):
+        # called from Block.__init__ before _mode is assigned
+        return getattr(self, "_mode", type(self).__name__.lower())
+
+    def state_info(self, batch_size=0):
+        info = [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            info.append(dict(info[0]))
+        return info
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial hidden (and cell) state (reference rnn_layer.py
+        begin_state)."""
+        func = func or nd.zeros
+        return [func(shape=i["shape"], **kwargs) for i in
+                self.state_info(batch_size)]
+
+    def infer_shape(self, x, *args):
+        in_sz = int(x.shape[2] if self._layout == "TNC" else x.shape[-1])
+        ng, nh = self._gates, self._hidden_size
+        for layer in range(self._num_layers):
+            for d in ["l", "r"][:self._dir]:
+                sz = in_sz if layer == 0 else nh * self._dir
+                self._reg_params[f"{d}{layer}_i2h_weight"]._infer_shape(
+                    (ng * nh, sz))
+                self._reg_params[f"{d}{layer}_h2h_weight"]._infer_shape(
+                    (ng * nh, nh))
+                self._reg_params[f"{d}{layer}_i2h_bias"]._infer_shape(
+                    (ng * nh,))
+                self._reg_params[f"{d}{layer}_h2h_bias"]._infer_shape(
+                    (ng * nh,))
+
+    def forward(self, inputs, states=None):
+        self._num_inputs = 1
+        skip_states = states is None
+        if skip_states:
+            if not hasattr(inputs, "shape"):
+                raise MXNetError(
+                    "symbolic trace requires explicit begin_state()")
+            batch = inputs.shape[self._layout.index("N")]
+            states = self.begin_state(batch, dtype=inputs.dtype)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        out = super().forward(inputs, *states)
+        outputs, *out_states = out
+        return outputs if skip_states else (outputs, out_states)
+
+    def hybrid_forward(self, F, inputs, *states, **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+
+        # flat cuDNN-layout vector: all weights, then all biases
+        # (reference rnn-inl.h GetRnnParamSize; _rnn_param_concat)
+        order = []
+        for layer in range(self._num_layers):
+            for d in ["l", "r"][:self._dir]:
+                order.append(f"{d}{layer}_i2h_weight")
+                order.append(f"{d}{layer}_h2h_weight")
+        bias_order = []
+        for layer in range(self._num_layers):
+            for d in ["l", "r"][:self._dir]:
+                bias_order.append(f"{d}{layer}_i2h_bias")
+                bias_order.append(f"{d}{layer}_h2h_bias")
+        flat = F.concat(*[F.reshape(params[k], shape=(-1,))
+                          for k in order + bias_order], dim=0)
+
+        rnn_args = [inputs, flat, states[0]]
+        if self._mode == "lstm":
+            rnn_args.append(states[1])
+        res = F.RNN(*rnn_args, state_size=self._hidden_size,
+                    num_layers=self._num_layers, mode=self._mode,
+                    bidirectional=self._dir == 2, p=self._dropout,
+                    state_outputs=True)
+        if self._mode == "lstm":
+            outputs, h, c = res
+            out_states = [h, c]
+        else:
+            outputs, h = res
+            out_states = [h]
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        return tuple([outputs] + out_states)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"num_layers={self._num_layers}, layout={self._layout!r}, "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    """Elman RNN with relu/tanh (reference rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers,
+                         layout, dropout, bidirectional, input_size, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU, cuDNN gate semantics (reference rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)

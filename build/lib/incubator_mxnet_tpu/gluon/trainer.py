@@ -1,0 +1,133 @@
+"""Gluon Trainer.
+
+Reference: python/mxnet/gluon/trainer.py (495 LoC): `_init_kvstore:169`
+(local vs dist, update_on_kvstore decision), `step/allreduce_grads/update`,
+save_states/load_states.
+
+TPU-native: gradients of a sharded parameter are already partial sums per
+device shard; `allreduce_grads` maps to an ICI psum through the kvstore='tpu'
+backend (kvstore.py). In the single-mesh case there is nothing to reduce —
+XLA inserted the collectives inside the compiled step.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())]
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict/dict/list")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"expected Parameter, got {type(p)}")
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        self._compression_params = compression_params
+        self._contains_sparse = False
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._states_to_load = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params and set(optimizer_params) - {"rescale_grad"}:
+                raise MXNetError("optimizer_params must be None when optimizer "
+                                 "is an Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        """Reference trainer.py:169. A kvstore is created for 'dist*'/'tpu'
+        types; plain single-process training needs none (XLA reduces sharded
+        grads inside the compiled step)."""
+        if self._kvstore_type and str(self._kvstore_type) not in ("None", "local",
+                                                                 "device"):
+            from .. import kvstore as kvs
+            self._kvstore = kvs.create(self._kvstore_type)
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(self._compression_params)
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null" and p._data is not None:
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce (if distributed) + optimizer update
+        (reference trainer.py step)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    g = p.grad()
+                    if getattr(g, "stype", "default") == "row_sparse":
+                        # the kvstore reduce path is dense; densify for the
+                        # collective and keep the dense result (the lazy
+                        # single-process path never reaches here)
+                        dense = g.todense()
+                        self._kvstore.pushpull(i, dense, out=dense)
+                        p.data()._grad = dense
+                    else:
+                        self._kvstore.pushpull(i, g, out=g)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if p._data is None:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(f"parameter {p.name} not initialized")
+            updater(i, p.grad(), p.data())
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())
+        self._optimizer = self._updaters[0].optimizer

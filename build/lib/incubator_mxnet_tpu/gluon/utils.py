@@ -1,0 +1,92 @@
+"""Gluon utilities (reference python/mxnet/gluon/utils.py, 470 LoC:
+split_data/split_and_load/clip_global_norm/download)."""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from .. import nd
+from ..base import MXNetError
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Reference gluon/utils.py split_data."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(f"cannot evenly split axis of size {size} into "
+                         f"{num_slice} slices")
+    step = size // num_slice
+    if batch_axis == 0:
+        return [data[i * step:(i + 1) * step] for i in range(num_slice)]
+    return [nd.slice_axis(data, axis=batch_axis, begin=i * step,
+                          end=(i + 1) * step) for i in range(num_slice)]
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch across contexts (reference gluon/utils.py).
+
+    On a TPU mesh prefer parallel.shard_batch — sharding over copies; this
+    keeps the multi-Context API for parity."""
+    if not isinstance(data, nd.NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Reference gluon/utils.py clip_global_norm."""
+    assert len(arrays) > 0
+    total = 0.0
+    for a in arrays:
+        n = float(nd.norm(a).asscalar())
+        total += n * n
+    total = total ** 0.5
+    if check_isfinite and not _np.isfinite(total):
+        return total
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._data = (a * scale)._data
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Reference gluon/utils.py download. This environment has no egress;
+    only file:// URLs and existing local paths work."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+        src = url[7:]
+        if not os.path.exists(src):
+            raise MXNetError(f"download source not found: {url}")
+        shutil.copyfile(src, fname)
+        return fname
+    raise MXNetError("network downloads unavailable (zero-egress environment); "
+                     f"place the file at {fname} manually")

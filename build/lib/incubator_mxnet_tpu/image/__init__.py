@@ -1,0 +1,5 @@
+"""Image IO/augmentation (reference python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from .image_iter import ImageRecordIter  # noqa: F401
+from .detection import (CreateDetAugmenter, DetBorrowAug,  # noqa: F401
+                        DetHorizontalFlipAug, ImageDetIter)

@@ -1,0 +1,203 @@
+"""Detection image iterator.
+
+Reference: python/mxnet/image/detection.py (ImageDetIter + det augmenters)
+and src/io/iter_image_det_recordio.cc. Label wire format per image is the
+reference's: a flat float vector [A, B, <A-2 extras>, obj0 .. objN-1] where
+A = header width (>= 2), B = per-object width (>= 5: class, x1, y1, x2, y2
+in normalized [0,1] coords). Batches pad the object dimension with
+`label_pad_value` (-1) so shapes stay static — exactly what MultiBoxTarget
+expects downstream.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .image import ImageIter, imdecode
+from .. import ndarray as nd
+
+
+class DetHorizontalFlipAug:
+    """Mirror image + boxes with probability p (reference
+    DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, img, label):
+        if _np.random.uniform() < self.p:
+            arr = img.asnumpy() if isinstance(img, nd.NDArray) else img
+            img = nd.array(arr[:, ::-1, :].copy())
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return img, label
+
+
+class DetBorrowAug:
+    """Adapt a plain image augmenter to the det interface (reference
+    DetBorrowAug). ONLY valid for geometry-preserving augs (cast,
+    normalize, color jitter) — a crop/resize-with-crop borrowed this way
+    would leave boxes pointing at the wrong region."""
+
+    def __init__(self, aug):
+        self.aug = aug
+
+    def __call__(self, img, label):
+        return self.aug(img), label
+
+
+class DetForceResizeAug:
+    """Resize the image EXACTLY to (w, h), no cropping. Boxes are in
+    normalized [0,1] coordinates, so a pure resize leaves them unchanged
+    (reference ForceResizeAug wrapped by CreateDetAugmenter)."""
+
+    def __init__(self, size, interp=1):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, img, label):
+        arr = img.asnumpy() if isinstance(img, nd.NDArray) else img
+        if arr.shape[1] != self.size[0] or arr.shape[0] != self.size[1]:
+            from .image import imresize
+            img = imresize(nd.array(arr), self.size[0], self.size[1],
+                           self.interp)
+        return img, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_mirror=False, mean=None,
+                       std=None, brightness=0, contrast=0, saturation=0,
+                       **kwargs):
+    """Det augmenter list (reference CreateDetAugmenter). Geometry is
+    handled ONLY by box-aware augs (exact resize, label-aware flip); the
+    plain-image crop family is deliberately excluded. Color/cast augs run
+    AFTER resize so the resize sees uint8 pixels. Users can append custom
+    (img, label) -> (img, label) callables (e.g. IoU-constrained crops)."""
+    from .image import CastAug, ColorJitterAug, ColorNormalizeAug, ResizeAug
+    augs = []
+    if resize > 0:
+        # shorter-edge resize scales both dims by the same factor, so
+        # normalized boxes are unaffected — safe to borrow
+        augs.append(DetBorrowAug(ResizeAug(resize)))
+    augs.append(DetForceResizeAug((data_shape[2], data_shape[1])))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    augs.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        augs.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
+                                                saturation)))
+    if mean is True:
+        mean = nd.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = nd.array(mean)
+    if std is True:
+        std = nd.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = nd.array(std)
+    if mean is not None or std is not None:
+        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return augs
+
+
+class ImageDetIter(ImageIter):
+    """Detection batches: data (B, C, H, W), label (B, max_objs, obj_width)
+    padded with label_pad_value (reference ImageDetIter)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, label_pad_width=None,
+                 label_pad_value=-1.0, data_name="data",
+                 label_name="label", **kwargs):
+        _aug_keys = ("resize", "rand_mirror", "mean", "std", "brightness",
+                     "contrast", "saturation")
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items() if k in _aug_keys})
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, shuffle=shuffle,
+                         aug_list=[], imglist=imglist, data_name=data_name,
+                         label_name=label_name, **{
+                             k: v for k, v in kwargs.items()
+                             if k not in _aug_keys})
+        self.det_auglist = aug_list
+        self.label_pad_value = float(label_pad_value)
+        # scan the dataset once to size the padded label tensor (reference
+        # ImageDetIter._estimate_label_shape). When labels are in memory
+        # (imglist), read them directly — next_sample() would read every
+        # image file just to discard the bytes.
+        if label_pad_width is None:
+            max_objs, obj_w = 1, 5
+            if self.imglist is not None:
+                labels = (self.imglist[i][0] for i in self.seq)
+            else:
+                labels = (lab for lab, _ in self._iter_labels())
+            for lab in labels:
+                objs = self._parse_det_label(lab)
+                max_objs = max(max_objs, objs.shape[0])
+                obj_w = max(obj_w, objs.shape[1])
+            self.reset()
+            label_pad_width = max_objs
+            self._obj_width = obj_w
+        else:
+            self._obj_width = int(kwargs.get("obj_width", 5))
+        self.label_shape = (label_pad_width, self._obj_width)
+        from ..io.io import DataDesc
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size,) + self.label_shape)]
+
+    def _iter_labels(self):
+        while True:
+            try:
+                yield self.next_sample()
+            except StopIteration:
+                return
+
+    @staticmethod
+    def _parse_det_label(label):
+        lab = _np.asarray(label, _np.float32).reshape(-1)
+        if lab.size < 2:
+            raise MXNetError("det label needs [header_width, obj_width, ...]")
+        A = int(lab[0])
+        B = int(lab[1])
+        if A < 2 or B < 5:
+            raise MXNetError(f"bad det label header A={A} B={B}")
+        body = lab[A:]
+        n = body.size // B
+        return body[:n * B].reshape(n, B)
+
+    def next(self):
+        from ..io.io import DataBatch
+        B = self.batch_size
+        C, H, W = self.data_shape if len(self.data_shape) == 3 \
+            else (1,) + tuple(self.data_shape)
+        batch_data = _np.zeros((B, C, H, W), _np.float32)
+        batch_label = _np.full((B,) + self.label_shape,
+                               self.label_pad_value, _np.float32)
+        i = 0
+        try:
+            while i < B:
+                label, buf = self.next_sample()
+                img = imdecode(buf)
+                objs = self._parse_det_label(label)
+                for aug in self.det_auglist:
+                    img, objs = aug(img, objs)
+                arr = img.asnumpy() if isinstance(img, nd.NDArray) else img
+                if arr.shape[:2] != (H, W):
+                    # DetForceResizeAug runs first in the default pipeline;
+                    # landing here means a custom aug_list dropped it
+                    raise MXNetError(
+                        f"det image is {arr.shape[:2]} but data_shape wants "
+                        f"{(H, W)}; include DetForceResizeAug (it must run "
+                        "before cast/normalize augs)")
+                batch_data[i] = _np.transpose(arr, (2, 0, 1))
+                n = min(objs.shape[0], self.label_shape[0])
+                w = min(objs.shape[1], self.label_shape[1])
+                batch_label[i, :n, :w] = objs[:n, :w]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return DataBatch(data=[nd.array(batch_data)],
+                         label=[nd.array(batch_label)], pad=B - i)

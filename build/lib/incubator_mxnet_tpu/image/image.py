@@ -1,0 +1,501 @@
+"""Image IO + augmenters.
+
+Reference: python/mxnet/image/image.py (2,475 LoC with detection variant):
+imdecode/imresize/fixed_crop/random_crop/center_crop/color_normalize/
+HorizontalFlipAug/..., `ImageIter`; C++ twin src/io/image_aug_default.cc (565).
+
+TPU-native: decode/augment run on host (PIL instead of OpenCV — no cv2 in
+this image); normalization/flip also exist as device ops (ops/image_ops via
+nd) so they can fuse into the compiled step.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+from .. import nd
+from ..base import MXNetError
+
+__all__ = ["imdecode", "imdecode_np", "imencode", "imread", "imresize",
+           "fixed_crop", "random_crop", "center_crop", "resize_short",
+           "color_normalize", "random_size_crop", "Augmenter", "ResizeAug",
+           "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug",
+           "CenterCropAug", "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "LightingAug", "ColorJitterAug", "RandomGrayAug", "HueJitterAug",
+           "RandomOrderAug", "CreateAugmenter", "ImageIter", "scale_down"]
+
+
+def _pil():
+    from PIL import Image
+    return Image
+
+
+def imdecode_np(buf, to_rgb=1) -> _np.ndarray:
+    """Decode compressed image bytes -> HWC uint8 numpy."""
+    img = _pil().open(_io.BytesIO(bytes(buf)))
+    img = img.convert("RGB") if to_rgb else img.convert("RGB")
+    return _np.asarray(img)
+
+
+def imdecode(buf, to_rgb=1, flag=1, **kwargs):
+    """Reference image.py imdecode -> NDArray HWC uint8."""
+    return nd.array(imdecode_np(buf, to_rgb), dtype="uint8")
+
+
+def imencode(img, quality=95, fmt=".jpg"):
+    if isinstance(img, nd.NDArray):
+        img = img.asnumpy()
+    pil_img = _pil().fromarray(_np.asarray(img, _np.uint8))
+    out = _io.BytesIO()
+    pil_img.save(out, format="JPEG" if fmt in (".jpg", ".jpeg") else "PNG",
+                 quality=quality)
+    return out.getvalue()
+
+
+def imread(filename, to_rgb=1, flag=1):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Reference image.py imresize."""
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else _np.asarray(src)
+    img = _pil().fromarray(_np.asarray(arr, _np.uint8))
+    img = img.resize((w, h), _pil().BILINEAR if interp else _pil().NEAREST)
+    return nd.array(_np.asarray(img), dtype="uint8")
+
+
+def scale_down(src_size, size):
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    h, w = src.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        new_ratio = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(_np.sqrt(target_area * new_ratio)))
+        new_h = int(round(_np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class Augmenter:
+    """Reference image.py Augmenter."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return nd.flip(src, axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = nd.array(mean) if mean is not None else None
+        self.std = nd.array(std) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self.coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (src.asnumpy() * self.coef).sum() * (3.0 / src.size)
+        return src * alpha + (1.0 - alpha) * gray
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self.coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (src.asnumpy() * self.coef).sum(axis=2, keepdims=True)
+        return src * alpha + nd.array(gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = _np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], _np.float32)
+        self.ityiq = _np.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], _np.float32)
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u, w = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]], _np.float32)
+        t = _np.dot(_np.dot(self.ityiq, bt), self.tyiq).T
+        return nd.array(_np.dot(src.asnumpy(), t))
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (reference image.py LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,)).astype(_np.float32)
+        rgb = _np.dot(self.eigvec * alpha, self.eigval)
+        return src + nd.array(rgb)
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness, contrast, saturation):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self.augs = []
+        if brightness > 0:
+            self.augs.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            self.augs.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            self.augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        augs = list(self.augs)
+        _pyrandom.shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = nd.array([[0.21, 0.21, 0.21],
+                             [0.72, 0.72, 0.72],
+                             [0.07, 0.07, 0.07]])
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return nd.dot(src, self.mat)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augment pipeline (reference image.py CreateAugmenter;
+    C++ twin image_aug_default.cc DefaultImageAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, 0.08, (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = nd.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = nd.array(mean)
+    if std is True:
+        std = nd.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = nd.array(std)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Python image iterator over .rec or image list
+    (reference image.py ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 part_index=0, num_parts=1, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 **kwargs):
+        from .. import recordio as rio
+        from ..io.io import DataBatch, DataDesc
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._DataBatch = DataBatch
+        self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(label_name, (batch_size, label_width)
+                                       if label_width > 1 else (batch_size,))]
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
+                                           if k in ("resize", "rand_crop",
+                                                    "rand_resize", "rand_mirror",
+                                                    "mean", "std")})
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self.imgrec = rio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = rio.MXRecordIO(path_imgrec, "r")
+        elif path_imglist or imglist is not None:
+            if imglist is None:
+                imglist = []
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        imglist.append((float(parts[1]), parts[-1]))
+            self.imglist = [(l if not isinstance(l, (list, tuple)) or
+                             len(_np.atleast_1d(l)) > 1 else float(_np.atleast_1d(l)[0]), p)
+                            for l, p in imglist]
+            self.path_root = path_root
+            self.seq = list(range(len(self.imglist)))
+        else:
+            raise MXNetError("need path_imgrec or path_imglist/imglist")
+        if num_parts > 1 and self.seq is not None:
+            self.seq = self.seq[part_index::num_parts]
+        self.shuffle = shuffle
+        self.cur = 0
+        self.reset()
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            _np.random.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        from . import image as _self
+        from .. import recordio as rio
+        if self.seq is not None and self.cur >= len(self.seq):
+            raise StopIteration
+        if self.imgrec is not None:
+            if self.seq is not None:
+                rec = self.imgrec.read_idx(self.seq[self.cur])
+            else:
+                rec = self.imgrec.read()
+                if rec is None:
+                    raise StopIteration
+            self.cur += 1
+            header, img = rio.unpack(rec)
+            return header.label, img
+        label, fname = self.imglist[self.seq[self.cur]]
+        self.cur += 1
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            return label, f.read()
+
+    def next(self):
+        batch_data = _np.zeros((self.batch_size,) + self.data_shape, _np.float32)
+        batch_label = _np.zeros((self.batch_size, self.label_width), _np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, buf = self.next_sample()
+                img = imdecode(buf)
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = img.asnumpy() if isinstance(img, nd.NDArray) else img
+                batch_data[i] = _np.transpose(arr, (2, 0, 1))
+                batch_label[i] = label
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = self.batch_size - i
+        return self._DataBatch(data=[nd.array(batch_data)],
+                               label=[nd.array(batch_label.squeeze(-1)
+                                               if self.label_width == 1 else
+                                               batch_label)],
+                               pad=pad)
+
+    def __next__(self):
+        return self.next()

@@ -1,0 +1,245 @@
+"""Weight initializers (reference: python/mxnet/initializer.py, 758 LoC:
+Uniform/Normal/Xavier/MSRAPrelu/Orthogonal/Bilinear/LSTMBias/Constant/Mixed).
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+from . import nd
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "Mixed",
+           "register", "create"]
+
+_REG = Registry("initializer")
+register = _REG.register
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint passed to initializers
+    (reference initializer.py:38)."""
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer; __call__(name, arr) fills arr in place."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr):
+        if not isinstance(name, InitDesc):
+            name = InitDesc(name)
+        init = name.attrs.get("__init__", "")
+        if init:
+            create(init)._init_weight(name, arr)
+            return
+        if name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    def init_weight(self, name, arr):
+        self._init_weight(InitDesc(name), arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    """alias: zeros"""
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+
+
+_REG.register(Zero, name="zeros")
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 1.0
+
+
+_REG.register(One, name="ones")
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr[:] = nd.random.uniform(-self.scale, self.scale, shape=arr.shape,
+                                   dtype="float32").astype(arr.dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr[:] = nd.random.normal(0.0, self.sigma, shape=arr.shape,
+                                  dtype="float32").astype(arr.dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        rows = arr.shape[0]
+        cols = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (rows, cols))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (rows, cols))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = nd.array((self.scale * q).reshape(arr.shape).astype(_np.float32))
+
+
+@register
+class Xavier(Initializer):
+    """reference initializer.py Xavier(rnd_type, factor_type, magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(f"Xavier requires ndim>=2, got {shape} for {name}")
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = nd.random.uniform(-scale, scale, shape=shape).astype(arr.dtype)
+        else:
+            arr[:] = nd.random.normal(0, scale, shape=shape).astype(arr.dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference initializer.py Bilinear)."""
+
+    def _init_weight(self, name, arr):
+        weight = _np.zeros(arr.shape, _np.float32).reshape(-1)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(len(weight)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = nd.array(weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, rest 0 (reference initializer.py)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        num_hidden = arr.shape[0] // 4
+        arr[num_hidden:2 * num_hidden] = self.forget_bias
+
+    _init_default = _init_weight
+    _init_bias = _init_weight
+
+
+class Mixed:
+    """Pattern-matched initializer list (reference initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError(f"no initializer pattern matches {name}")
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    cls = _REG.get(name)
+    return cls(**kwargs)

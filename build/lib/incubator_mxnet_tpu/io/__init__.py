@@ -1,0 +1,7 @@
+from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, CSVIter,
+                 ResizeIter, PrefetchingIter, MXDataIter, ImageRecordIter,
+                 MNISTIter, LibSVMIter)
+
+__all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter", "MXDataIter", "ImageRecordIter",
+           "MNISTIter", "LibSVMIter"]

@@ -1,0 +1,133 @@
+"""Learning-rate schedules.
+
+Covers the reference set (python/mxnet/lr_scheduler.py: Factor/MultiFactor/
+Poly/Cosine with linear/constant warmup) as PURE functions of the update
+count: the base class blends warmup with the subclass's `_decayed(t)`, and
+no schedule mutates its own state between calls — the same `num_update`
+always yields the same lr, which keeps schedules safe to call from multiple
+updaters and trivially checkpointable.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+
+from .base import MXNetError
+
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
+           "PolyScheduler", "CosineScheduler"]
+
+
+class LRScheduler:
+    """Callable: lr = scheduler(num_update)."""
+
+    def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0,
+                 warmup_mode="linear"):
+        if warmup_begin_lr > base_lr:
+            raise MXNetError("warmup_begin_lr must be <= base_lr")
+        if warmup_mode not in ("linear", "constant"):
+            raise MXNetError(f"warmup_mode must be linear or constant, "
+                             f"got {warmup_mode!r}")
+        self.base_lr = base_lr
+        self.warmup_steps = warmup_steps
+        self.warmup_begin_lr = warmup_begin_lr
+        self.warmup_final_lr = base_lr
+        self.warmup_mode = warmup_mode
+
+    def get_warmup_lr(self, num_update):
+        if self.warmup_mode == "constant":
+            return self.warmup_begin_lr
+        frac = num_update / max(1, self.warmup_steps)
+        return self.warmup_begin_lr + \
+            (self.warmup_final_lr - self.warmup_begin_lr) * frac
+
+    def _decayed(self, num_update):
+        return self.base_lr
+
+    def __call__(self, num_update):
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self._decayed(num_update)
+
+
+class FactorScheduler(LRScheduler):
+    """lr = base_lr * factor^(t // step), floored at stop_factor_lr."""
+
+    def __init__(self, step, factor=1, stop_factor_lr=1e-8, base_lr=0.01,
+                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
+        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
+        if step < 1:
+            raise MXNetError("step must be >= 1")
+        if not 0 < factor <= 1:
+            raise MXNetError("factor must be in (0, 1]")
+        self.step = step
+        self.factor = factor
+        self.stop_factor_lr = stop_factor_lr
+
+    def _decayed(self, num_update):
+        # strict boundary: no drop at num_update == k*step itself, matching
+        # MultiFactorScheduler's bisect_left milestone semantics below
+        drops = max(0, num_update - 1) // self.step
+        return max(self.stop_factor_lr, self.base_lr * self.factor ** drops)
+
+
+class MultiFactorScheduler(LRScheduler):
+    """lr drops by `factor` at each milestone in `step` (ascending list)."""
+
+    def __init__(self, step, factor=1, base_lr=0.01, warmup_steps=0,
+                 warmup_begin_lr=0, warmup_mode="linear"):
+        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
+        if not step or list(step) != sorted(step):
+            raise MXNetError("step must be a non-empty ascending list")
+        if not 0 < factor <= 1:
+            raise MXNetError("factor must be in (0, 1]")
+        self.step = list(step)
+        self.factor = factor
+
+    def _decayed(self, num_update):
+        passed = bisect.bisect_left(self.step, num_update)
+        return self.base_lr * self.factor ** passed
+
+
+class _AnnealToFinal(LRScheduler):
+    """Shared shape for poly/cosine: interpolate base_lr -> final_lr over
+    (max_update - warmup_steps) with a subclass-specific curve."""
+
+    def __init__(self, max_update, base_lr=0.01, final_lr=0, warmup_steps=0,
+                 warmup_begin_lr=0, warmup_mode="linear"):
+        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
+        if max_update <= warmup_steps:
+            raise MXNetError("max_update must exceed warmup_steps")
+        self.max_update = max_update
+        self.final_lr = final_lr
+        self.max_steps = max_update - warmup_steps
+
+    def _curve(self, frac):
+        raise NotImplementedError
+
+    def _decayed(self, num_update):
+        if num_update >= self.max_update:
+            return self.final_lr
+        frac = (num_update - self.warmup_steps) / self.max_steps
+        return self.final_lr + (self.base_lr - self.final_lr) * \
+            self._curve(frac)
+
+
+class PolyScheduler(_AnnealToFinal):
+    """(1 - frac)^pwr polynomial decay."""
+
+    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
+                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
+        super().__init__(max_update, base_lr, final_lr, warmup_steps,
+                         warmup_begin_lr, warmup_mode)
+        self.power = pwr
+
+    def _curve(self, frac):
+        return (1.0 - frac) ** self.power
+
+
+class CosineScheduler(_AnnealToFinal):
+    """Half-cosine decay."""
+
+    def _curve(self, frac):
+        return 0.5 * (1.0 + math.cos(math.pi * frac))

@@ -1,0 +1,11 @@
+"""Flagship model definitions.
+
+- vision CNNs come from gluon.model_zoo (ResNet-50 is the benchmark flagship,
+  BASELINE.md headline rows).
+- transformer.py is the SPMD language-model used to exercise dp/tp/sp
+  parallelism (capability the reference lacks, SURVEY.md §2.3 last row).
+"""
+from . import transformer
+from .transformer import TransformerLM, TransformerConfig
+
+__all__ = ["transformer", "TransformerLM", "TransformerConfig"]

@@ -1,0 +1,407 @@
+"""Composed-parallel MoE transformer: dp x pp x tp x sp x ep in ONE step.
+
+This is the all-axes flagship the reference cannot express at all — its
+only model parallelism is static layer placement with no pipelining
+(`group2ctx`, reference src/executor/graph_executor.cc:986) and it has no
+TP/SP/EP. Here a single jitted shard_map over one jax.sharding.Mesh
+composes:
+
+  dp  — batch sharding, gradients meaned across the axis (by shard_map's
+        autodiff transpose of the loss pmean; no explicit allreduce),
+  pp  — layers split into stages; microbatches flow through a ppermute
+        ring (parallel/pipeline.py). The BACKWARD schedule is the
+        transpose of that scan: stages run in reverse over the inverted
+        ring, microbatch by microbatch, with each stage's weight gradient
+        accumulated across microbatches in the scan-carry cotangent — the
+        GPipe backward. Cross-ROUND gradient accumulation is explicit: the
+        local batch is chunked into rounds scanned sequentially, so
+        activation memory is bounded by one round's pipeline.
+  tp  — Megatron column/row sharding of attention + FFN matmuls with one
+        psum after each row-parallel matmul,
+  sp  — sequence sharding with ring attention (parallel/ring_attention.py),
+  ep  — MoE expert sharding with GShard all-to-all token dispatch
+        (parallel/moe.py moe_apply_a2a); experts ride a dedicated `ep`
+        axis when the mesh has one, else the data-parallel axis (the
+        GShard layout).
+
+Every axis is optional: the step builder reads the mesh's axis names and
+degrades to the axes present, so the same code serves {dp}, {dp,pp,tp},
+{dp,pp,sp} ... meshes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel._compat import shard_map
+from ..parallel.moe import moe_apply, moe_apply_a2a
+from ..parallel.pipeline import pipeline_train_apply
+from ..parallel.ring_attention import attention_reference, ring_attention
+
+__all__ = ["ComposedConfig", "ComposedPipelineLM"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ComposedConfig:
+    vocab_size: int = 1024
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 4          # total; must divide by the mesh's pp size
+    d_ff: int = 256
+    n_experts: int = 4         # per MoE block; divisible by the ep size
+    moe_every: int = 2         # within a stage, every k-th block is MoE
+    capacity_factor: float = 2.0
+    aux_weight: float = 0.01   # MoE load-balance loss weight
+    max_len: int = 256
+    dtype: str = "float32"
+
+
+class ComposedPipelineLM:
+    """Stage-stacked parameter layout: every per-block tensor has a
+    leading stage dim S (sharded over pp); block j of every stage has the
+    same FFN kind (dense or MoE) so the stacks stay uniform."""
+
+    def __init__(self, cfg: ComposedConfig):
+        self.cfg = cfg
+
+    def _ffn_kind(self, j):
+        if self.cfg.moe_every <= 0:
+            return "dense"
+        return "moe" if (j % self.cfg.moe_every == self.cfg.moe_every - 1) \
+            else "dense"
+
+    # -- parameters --------------------------------------------------------
+    def init_params(self, key, n_stages):
+        cfg = self.cfg
+        if cfg.n_layers % n_stages:
+            raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
+                             f"pp stages {n_stages}")
+        lps = cfg.n_layers // n_stages
+        dt = jnp.dtype(cfg.dtype)
+        d, f, E = cfg.d_model, cfg.d_ff, cfg.n_experts
+        keys = iter(jax.random.split(key, 4 + 16 * cfg.n_layers))
+
+        def dense(fan_in, shape):
+            return (jax.random.normal(next(keys), shape, jnp.float32) /
+                    math.sqrt(fan_in)).astype(dt)
+
+        def stacked(fan_in, shape):
+            return (jax.random.normal(next(keys), (n_stages,) + shape,
+                                      jnp.float32) / math.sqrt(fan_in)
+                    ).astype(dt)
+
+        params = {
+            "embed": dense(d, (cfg.vocab_size, d)),
+            "pos_embed": dense(d, (cfg.max_len, d)),
+            "lnf_g": jnp.ones((d,), dt),
+            "lnf_b": jnp.zeros((d,), dt),
+        }
+        for j in range(lps):
+            b = f"b{j}_"
+            params[b + "ln1_g"] = jnp.ones((n_stages, d), dt)
+            params[b + "ln1_b"] = jnp.zeros((n_stages, d), dt)
+            params[b + "wq"] = stacked(d, (d, d))
+            params[b + "wk"] = stacked(d, (d, d))
+            params[b + "wv"] = stacked(d, (d, d))
+            params[b + "wo"] = stacked(d, (d, d))
+            params[b + "ln2_g"] = jnp.ones((n_stages, d), dt)
+            params[b + "ln2_b"] = jnp.zeros((n_stages, d), dt)
+            if self._ffn_kind(j) == "moe":
+                params[b + "wg"] = stacked(d, (d, E))
+                params[b + "w1"] = stacked(d, (E, d, f))
+                params[b + "w2"] = stacked(f, (E, f, d))
+            else:
+                params[b + "w_in"] = stacked(d, (d, f))
+                params[b + "w_out"] = stacked(f, (f, d))
+        return params
+
+    # -- building blocks ---------------------------------------------------
+    @staticmethod
+    def _ln(x, g, b):
+        m = jnp.mean(x.astype(jnp.float32), axis=-1, keepdims=True)
+        v = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
+        return ((x - m) * lax.rsqrt(v + 1e-5)).astype(x.dtype) * g + b
+
+    def _block(self, p, b, x, *, sp_axis, tp_axis, ep_axis, kind):
+        """One pre-norm block on (mb, T_local, D). Weight tensors arrive
+        already LOCAL (stage-sliced, tp/ep-sharded by shard_map)."""
+        cfg = self.cfg
+        B, T, D = x.shape
+        hd = D // cfg.n_heads
+        h = self._ln(x, p[b + "ln1_g"], p[b + "ln1_b"])
+        d_local = p[b + "wq"].shape[1]
+        q = (h @ p[b + "wq"]).reshape(B, T, d_local // hd, hd)
+        k = (h @ p[b + "wk"]).reshape(B, T, d_local // hd, hd)
+        v = (h @ p[b + "wv"]).reshape(B, T, d_local // hd, hd)
+        if sp_axis is not None:
+            attn = ring_attention(q, k, v, sp_axis, causal=True)
+        else:
+            attn = attention_reference(q, k, v, causal=True)
+        attn_out = attn.reshape(B, T, d_local) @ p[b + "wo"]
+        if tp_axis is not None:
+            attn_out = lax.psum(attn_out, tp_axis)
+        x = x + attn_out
+        h = self._ln(x, p[b + "ln2_g"], p[b + "ln2_b"])
+        aux = jnp.float32(0)
+        if kind == "moe":
+            flat = h.reshape(B * T, D)
+            moe_p = {"wg": p[b + "wg"], "w1": p[b + "w1"], "w2": p[b + "w2"]}
+            if ep_axis is not None:
+                y, aux = moe_apply_a2a(flat, moe_p, ep_axis,
+                                       capacity_factor=cfg.capacity_factor)
+            else:
+                y, aux = moe_apply(flat, moe_p,
+                                   capacity_factor=cfg.capacity_factor)
+            y = y.reshape(B, T, D)
+        else:
+            y = jax.nn.gelu(h @ p[b + "w_in"]) @ p[b + "w_out"]
+            if tp_axis is not None:
+                y = lax.psum(y, tp_axis)
+        return x + y, aux
+
+    # -- composed train step ----------------------------------------------
+    def param_specs(self, mesh):
+        """PartitionSpec per param name for a stage-stacked tree."""
+        names = set(mesh.axis_names)
+        pp = "pp" if "pp" in names else None
+        tp = "tp" if "tp" in names else None
+        ep = "ep" if "ep" in names else ("dp" if "dp" in names else None)
+        specs = {}
+        lps = self.cfg.n_layers // (mesh.shape["pp"] if pp else 1)
+        specs["embed"] = P()
+        specs["pos_embed"] = P()
+        specs["lnf_g"] = P()
+        specs["lnf_b"] = P()
+        for j in range(lps):
+            b = f"b{j}_"
+            for s in ("ln1_g", "ln1_b", "ln2_g", "ln2_b"):
+                specs[b + s] = P(pp)
+            for s in ("wq", "wk", "wv"):       # column-parallel
+                specs[b + s] = P(pp, None, tp)
+            specs[b + "wo"] = P(pp, tp, None)  # row-parallel
+            if self._ffn_kind(j) == "moe":
+                specs[b + "wg"] = P(pp)
+                specs[b + "w1"] = P(pp, ep)
+                specs[b + "w2"] = P(pp, ep)
+            else:
+                specs[b + "w_in"] = P(pp, None, tp)
+                specs[b + "w_out"] = P(pp, tp, None)
+        return specs
+
+    def make_train_step(self, mesh, n_microbatches=2, grad_accum_rounds=1,
+                        lr=1e-3):
+        """Returns (step_fn, shard_params, init_opt). step_fn(params, opt,
+        tokens, targets, step_i) -> (params, opt, loss); tokens/targets
+        (B, T) int32 sharded (dp, sp). ONE jitted program contains the
+        full pipeline fwd+bwd schedule, every collective, and Adam."""
+        cfg = self.cfg
+        names = set(mesh.axis_names)
+        dp = "dp" if "dp" in names else None
+        pp = "pp" if "pp" in names else None
+        tp = "tp" if "tp" in names else None
+        sp = "sp" if "sp" in names else None
+        ep = "ep" if "ep" in names else dp
+        S = mesh.shape[pp] if pp else 1
+        lps = cfg.n_layers // S
+        model = self
+        specs = self.param_specs(mesh)
+        data_spec = P(dp, sp)
+        mesh_axes = [a for a in (dp, pp, tp, sp,
+                                 "ep" if "ep" in names else None) if a]
+
+        def stage_fn(stage_p, h):
+            aux_total = jnp.float32(0)
+            for j in range(lps):
+                h, aux = model._block(stage_p, f"b{j}_", h, sp_axis=sp,
+                                      tp_axis=tp, ep_axis=ep,
+                                      kind=model._ffn_kind(j))
+                aux_total = aux_total + aux
+            return h, aux_total
+
+        def local_loss(params, tokens, targets):
+            # stage-stacked tensors (the b*_ block params) arrive with a
+            # local stage dim of 1 under a pp axis, or S=1 without one —
+            # either way the local stage is slice 0
+            stage_p = {k: (v[0] if k.startswith("b") else v)
+                       for k, v in params.items()}
+            B_l, T_l = tokens.shape
+            n_sp = mesh.shape[sp] if sp else 1
+            if T_l * n_sp > cfg.max_len:
+                # shapes are static: fail at trace time, not by the silent
+                # index clamp a jit gather would apply past the table end
+                raise ValueError(
+                    f"sequence length {T_l * n_sp} exceeds max_len "
+                    f"{cfg.max_len}")
+            sp_idx = lax.axis_index(sp) if sp else 0
+            positions = sp_idx * T_l + jnp.arange(T_l)
+            x = params["embed"][tokens] + params["pos_embed"][positions]
+
+            R = grad_accum_rounds
+            if B_l % (R * n_microbatches):
+                raise ValueError(
+                    f"local batch {B_l} not divisible by rounds*microbatches "
+                    f"{R}x{n_microbatches}")
+            x_r = x.reshape((R, B_l // R) + x.shape[1:])
+            tgt_r = targets.reshape((R, B_l // R) + targets.shape[1:])
+
+            def round_fn(carry, xs):
+                xr, tr = xs
+                if pp:
+                    h, aux = pipeline_train_apply(stage_fn, stage_p, xr,
+                                                  pp, n_microbatches)
+                else:
+                    # no pp axis: same microbatch chunking, plain scan —
+                    # this IS the grad-accumulation baseline
+                    mb = xr.shape[0] // n_microbatches
+                    xm = xr.reshape((n_microbatches, mb) + xr.shape[1:])
+
+                    def mb_fn(_, xmb):
+                        hh, aa = stage_fn(stage_p, xmb)
+                        return None, (hh, aa)
+                    _, (hs, aas) = lax.scan(mb_fn, None, xm)
+                    h = hs.reshape(xr.shape)
+                    aux = jnp.mean(aas)
+                h = model._ln(h, params["lnf_g"], params["lnf_b"])
+                logits = (h @ params["embed"].T).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(logp, tr[..., None],
+                                           axis=-1)[..., 0]
+                loss_r = jnp.mean(nll) + cfg.aux_weight * aux
+                return carry + loss_r, None
+
+            total, _ = lax.scan(round_fn, jnp.float32(0), (x_r, tgt_r))
+            loss = total / R
+            for ax in mesh_axes:
+                loss = lax.pmean(loss, ax)
+            return loss
+
+        loss_fn = shard_map(
+            local_loss, mesh,
+            in_specs=(specs, data_spec, data_spec), out_specs=P())
+
+        from ..parallel.train import _make_update_rule
+        _, adam_rule = _make_update_rule("adam", lr, 0.0, 0.0, {})
+
+        def step(params, opt_state, tokens, targets, step_i):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                      targets)
+            new_params, new_opt = {}, {}
+            t = step_i + 1
+            for k, g in grads.items():
+                w32, new_opt[k] = adam_rule(params[k].astype(jnp.float32),
+                                            g.astype(jnp.float32),
+                                            opt_state[k], t)
+                new_params[k] = w32.astype(params[k].dtype)
+            return new_params, new_opt, loss
+
+        shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+        jit_step = jax.jit(
+            step,
+            in_shardings=(shardings,
+                          {k: (shardings[k], shardings[k]) for k in specs},
+                          NamedSharding(mesh, data_spec),
+                          NamedSharding(mesh, data_spec), None),
+            donate_argnums=(0, 1))
+
+        def shard_params(params):
+            return {k: jax.device_put(jnp.asarray(v).copy(), shardings[k])
+                    for k, v in params.items()}
+
+        def init_opt(params):
+            return {k: (jnp.zeros(v.shape, jnp.float32),
+                        jnp.zeros(v.shape, jnp.float32))
+                    for k, v in params.items()}
+
+        return jit_step, shard_params, init_opt
+
+    # -- single-device oracle ----------------------------------------------
+    def reference_loss(self, params, tokens, targets, *, dp_groups=1,
+                       sp_shards=1, n_microbatches=2, grad_accum_rounds=1):
+        """Dense single-device forward computing the SAME loss the composed
+        step computes, including the MoE gating GROUPS (gating capacity is
+        per (dp shard, round, microbatch, sp shard) token group in the
+        composed run; the oracle reproduces that chunking so dispatch
+        decisions — and with dropless capacity, the loss — match)."""
+        cfg = self.cfg
+        S = params["b0_wq"].shape[0]
+        lps = cfg.n_layers // S
+        B, T = tokens.shape
+        x = params["embed"][tokens] + params["pos_embed"][jnp.arange(T)]
+
+        def run_blocks(xg):
+            aux_total = jnp.float32(0)
+            for s in range(S):
+                for j in range(lps):
+                    p = {k: (v[s] if v.ndim and k.startswith("b") else v)
+                         for k, v in params.items()}
+                    kind = self._ffn_kind(j)
+                    Bg, Tg, D = xg.shape
+                    h = self._ln(xg, p[f"b{j}_ln1_g"], p[f"b{j}_ln1_b"])
+                    hd = D // cfg.n_heads
+                    q = (h @ p[f"b{j}_wq"]).reshape(Bg, Tg, -1, hd)
+                    k_ = (h @ p[f"b{j}_wk"]).reshape(Bg, Tg, -1, hd)
+                    v_ = (h @ p[f"b{j}_wv"]).reshape(Bg, Tg, -1, hd)
+                    attn = attention_reference(q, k_, v_, causal=True)
+                    xg = xg + attn.reshape(Bg, Tg, D) @ p[f"b{j}_wo"]
+                    h = self._ln(xg, p[f"b{j}_ln2_g"], p[f"b{j}_ln2_b"])
+                    if kind == "moe":
+                        # chunk into the composed run's gating groups: the
+                        # sp axis splits the SEQUENCE of each microbatch
+                        flat_groups = []
+                        auxs = []
+                        Tl = Tg // sp_shards
+                        for si in range(sp_shards):
+                            seg = h[:, si * Tl:(si + 1) * Tl, :]
+                            yseg, aux = moe_apply(
+                                seg.reshape(Bg * Tl, D),
+                                {"wg": p[f"b{j}_wg"], "w1": p[f"b{j}_w1"],
+                                 "w2": p[f"b{j}_w2"]},
+                                capacity_factor=cfg.capacity_factor)
+                            flat_groups.append(yseg.reshape(Bg, Tl, D))
+                            auxs.append(aux)
+                        y = jnp.concatenate(flat_groups, axis=1)
+                        aux_total = aux_total + jnp.mean(jnp.stack(auxs))
+                    else:
+                        y = jax.nn.gelu(h @ p[f"b{j}_w_in"]) @ \
+                            p[f"b{j}_w_out"]
+                    xg = xg + y
+            return xg, aux_total
+
+        # reproduce the (dp, round, microbatch) batch chunking
+        per_dp = B // dp_groups
+        losses = []
+        for g in range(dp_groups):
+            xg_all = x[g * per_dp:(g + 1) * per_dp]
+            tg_all = targets[g * per_dp:(g + 1) * per_dp]
+            per_round = per_dp // grad_accum_rounds
+            round_losses = []
+            for r in range(grad_accum_rounds):
+                xr = xg_all[r * per_round:(r + 1) * per_round]
+                tr = tg_all[r * per_round:(r + 1) * per_round]
+                mb = per_round // n_microbatches
+                aux_sum = jnp.float32(0)
+                outs = []
+                for m in range(n_microbatches):
+                    xm = xr[m * mb:(m + 1) * mb]
+                    o, aux = run_blocks(xm)
+                    outs.append(o)
+                    aux_sum = aux_sum + aux
+                h = jnp.concatenate(outs)
+                h = self._ln(h, params["lnf_g"], params["lnf_b"])
+                logits = (h @ params["embed"].T).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(logp, tr[..., None],
+                                           axis=-1)[..., 0]
+                # the composed aux is meaned over the S * M real
+                # (stage, microbatch) visits; aux_sum here has summed all
+                # blocks over all M microbatches
+                aux_mean = aux_sum / (S * n_microbatches)
+                round_losses.append(jnp.mean(nll) +
+                                    cfg.aux_weight * aux_mean)
+            losses.append(jnp.mean(jnp.stack(round_losses)))
+        return jnp.mean(jnp.stack(losses))

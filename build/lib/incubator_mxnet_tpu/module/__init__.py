@@ -1,0 +1,6 @@
+"""`mx.mod`: Module training API (reference python/mxnet/module/, 4,007 LoC)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

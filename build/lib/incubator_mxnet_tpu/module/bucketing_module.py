@@ -1,0 +1,165 @@
+"""BucketingModule: per-bucket executors sharing parameters.
+
+Reference: python/mxnet/module/bucketing_module.py:36 — `sym_gen(bucket_key)`
+returns (symbol, data_names, label_names); one Module per seen bucket, all
+sharing the default bucket's parameter arrays (`_curr_module` switch
+:94-124). On TPU each bucket is one compiled XLA program (static shapes),
+which is exactly the reference's per-bucket executor discipline
+(docs/faq/bucketing.md).
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        if default_bucket_key is None:
+            raise MXNetError("BucketingModule requires default_bucket_key")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._init_args = None
+        self._monitor = None
+
+    @property
+    def symbol(self):
+        return self._curr_module._symbol if self._curr_module else None
+
+    @property
+    def data_names(self):
+        return self._curr_module.data_names
+
+    @property
+    def output_names(self):
+        return self._curr_module.output_names
+
+    def _gen_module(self, bucket_key):
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(sym, data_names=data_names, label_names=label_names,
+                      logger=self.logger, context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.switch_bucket(self._default_bucket_key, data_shapes,
+                               label_shapes)
+            return
+        # rebind invalidates every bucket executor: stale modules alias the
+        # OLD default executor's arrays (reference _reset_bind). Trained
+        # values survive the rebind (reference round-trips get/set_params).
+        saved_params = self.get_params() if self.params_initialized else None
+        self._buckets = {}
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        mod = self._gen_module(self._default_bucket_key)
+        mod.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                 force_rebind=False, grad_req=grad_req)
+        self._buckets[self._default_bucket_key] = mod
+        self._curr_module = mod
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+        self.for_training = for_training
+        if saved_params is not None:
+            arg, aux = saved_params
+            mod.init_params(arg_params=arg, aux_params=aux, force_init=True)
+            self.params_initialized = True
+        self._bind_args = dict(for_training=for_training,
+                               inputs_need_grad=inputs_need_grad,
+                               grad_req=grad_req)
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Reference bucketing_module.py:94-124: lazily create the bucket's
+        module, sharing parameters with the default bucket."""
+        if not self.binded:
+            raise MXNetError("switch_bucket requires bind()")
+        if bucket_key not in self._buckets:
+            default = self._buckets[self._default_bucket_key]
+            mod = self._gen_module(bucket_key)
+            mod.bind(data_shapes, label_shapes, **self._bind_args,
+                     shared_module=default)
+            # share optimizer machinery AND the kvstore so non-default
+            # buckets aggregate gradients identically (reference
+            # bucketing_module.py borrow_optimizer)
+            if default.optimizer_initialized:
+                mod._optimizer = default._optimizer
+                mod._updater = default._updater
+                mod._kvstore = default._kvstore
+                mod.optimizer_initialized = True
+            if self._monitor is not None:
+                mod.install_monitor(self._monitor)
+            self._buckets[bucket_key] = mod
+        # parameter arrays are aliased across buckets (Module.bind
+        # shared_module), so switching needs no copying
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        self._curr_module.init_params(initializer=initializer,
+                                      arg_params=arg_params,
+                                      aux_params=aux_params,
+                                      allow_missing=allow_missing,
+                                      force_init=force_init,
+                                      allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        default = self._buckets[self._default_bucket_key]
+        default.init_optimizer(kvstore, optimizer, optimizer_params,
+                               force_init=force_init)
+        # all buckets share the one updater + kvstore (optimizer state is
+        # keyed by parameter name, so bucket argument order is irrelevant)
+        for mod in self._buckets.values():
+            mod._optimizer = default._optimizer
+            mod._updater = default._updater
+            mod._kvstore = default._kvstore
+            mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = data_batch.bucket_key
+        if key is None:
+            key = self._curr_bucket_key
+        self.switch_bucket(key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads=out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        self._monitor = mon
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._curr_module.save_checkpoint(prefix, epoch, save_optimizer_states)

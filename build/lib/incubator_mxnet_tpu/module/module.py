@@ -1,0 +1,296 @@
+"""Module: a Symbol bound to data shapes with optimizer state.
+
+Reference: python/mxnet/module/module.py — `bind:364` (builds
+DataParallelExecutorGroup over per-device simple_bind), `init_optimizer:474`
+(kvstore decision via model._create_kvstore), `forward:575`, `backward:629`,
+`update:646` (kv push/pull + Updater).
+
+TPU-native redesign: one Executor over the whole (possibly sharded) program —
+batch slicing across devices is XLA sharding, not a Python executor group.
+The kvstore path is kept for API parity: updates route through
+kvstore.push/pull when a kvstore is given (our kvstore rides mesh
+collectives), and through a local Updater otherwise.
+"""
+from __future__ import annotations
+
+import logging
+
+from .. import initializer as _init
+from .. import optimizer as _opt
+from ..base import MXNetError
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._context = context
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names) | set(self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._preload_opt_states = None
+        self._preload_params = None
+
+    # -- bind ---------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def output_shapes(self):
+        return [(n, o.shape) for n, o in
+                zip(self.output_names, self._exec.outputs)] \
+            if self._exec and self._exec.outputs else None
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Reference module.py:364."""
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = list(data_shapes)
+        self._label_shapes = list(label_shapes or [])
+        self.for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+
+        shapes = {}
+        dtypes = {}
+        for desc in self._data_shapes + self._label_shapes:
+            name, shape = desc[0], desc[1]
+            shapes[name] = tuple(shape)
+            if len(desc) > 2 and desc[2] is not None:
+                dtypes[name] = desc[2]
+
+        grad_reqs = {}
+        for n in self._symbol.list_arguments():
+            if n in self._param_names and n not in self._fixed_param_names \
+                    and for_training:
+                grad_reqs[n] = grad_req if isinstance(grad_req, str) else \
+                    grad_req.get(n, "write")
+            elif n in self._data_names and inputs_need_grad and for_training:
+                grad_reqs[n] = "write"
+            else:
+                grad_reqs[n] = "null"
+
+        from ..executor import Executor
+        old_exec = self._exec if shared_module is None else shared_module._exec
+        self._exec = Executor.simple_bind(self._symbol, self._context,
+                                          grad_req=grad_reqs,
+                                          type_dict=dtypes, **shapes)
+        if shared_module is not None and shared_module._exec is not None:
+            # ALIAS parameter NDArrays with the shared module (reference:
+            # bucket executors share arg arrays via shared_exec memory pool,
+            # executor_group.py) — updates through either executor are
+            # visible to both
+            src = shared_module._exec
+            for n in list(self._exec.arg_dict):
+                if n in src.arg_dict and \
+                        src.arg_dict[n].shape == self._exec.arg_dict[n].shape:
+                    self._exec.arg_dict[n] = src.arg_dict[n]
+            for n in list(self._exec.aux_dict):
+                if n in src.aux_dict and \
+                        src.aux_dict[n].shape == self._exec.aux_dict[n].shape:
+                    self._exec.aux_dict[n] = src.aux_dict[n]
+            self.params_initialized = shared_module.params_initialized
+        elif old_exec is not None:
+            # re-bind keeps parameter values
+            self._exec.copy_params_from(
+                {n: a for n, a in old_exec.arg_dict.items()
+                 if n in self._param_names},
+                old_exec.aux_dict, allow_extra_params=True)
+        self.binded = True
+        if self._preload_params is not None:
+            # checkpoint loaded via Module.load binds into initialized params
+            arg, aux = self._preload_params
+            self.init_params(arg_params=arg, aux_params=aux, force_init=True)
+            self._preload_params = None
+
+    # -- params -------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("init_params requires bind()")
+        if initializer is None:
+            initializer = _init.Uniform(0.01)
+        elif isinstance(initializer, str):
+            initializer = _init.create(initializer)
+
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr[:] = arg_params[name]
+            elif arg_params is not None and not allow_missing:
+                raise MXNetError(f"init_params: missing arg {name}")
+            else:
+                initializer(_init.InitDesc(name), arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr[:] = aux_params[name]
+            elif aux_params is not None and not allow_missing:
+                raise MXNetError(f"init_params: missing aux {name}")
+            else:
+                initializer(_init.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        if not self.binded:
+            raise MXNetError("get_params requires bind()")
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    # -- optimizer ----------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        """Reference module.py:474 + model._create_kvstore."""
+        if self.optimizer_initialized and not force_init:
+            return
+        optimizer_params = dict(optimizer_params or {"learning_rate": 0.01})
+        if isinstance(optimizer, str):
+            # reference module.py:498: default rescale_grad = 1/batch_size
+            # (SoftmaxOutput's default normalization sums over the batch)
+            if "rescale_grad" not in optimizer_params and self.binded:
+                batch = self._data_shapes[0][1][0]
+                optimizer_params["rescale_grad"] = 1.0 / batch
+            optimizer = _opt.create(optimizer, **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = _opt.get_updater(optimizer)
+
+        kv = None
+        if kvstore is not None and not isinstance(kvstore, str):
+            kv = kvstore
+        elif isinstance(kvstore, str) and kvstore not in ("local", None):
+            from .. import kvstore as _kvs
+            kv = _kvs.create(kvstore)
+        self._kvstore = kv
+        if kv is not None:
+            for name in self._param_names:
+                kv.init(name, self._exec.arg_dict[name])
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    # -- step ---------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Reference module.py:646 -> model._update_params[_on_kvstore]."""
+        if not self.optimizer_initialized:
+            raise MXNetError("update() requires init_optimizer()")
+        # keys are parameter NAMES so optimizer state and kvstore entries
+        # stay consistent across bucket executors whose argument orders may
+        # differ (reference keys kvstore by name, kvstore.py:123)
+        if self._kvstore is not None:
+            from ..ndarray import NDArray
+            for name in self._param_names:
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                self._kvstore.push(name, g)
+                # pull rebinds the buffer wholesale, so a zero-copy view is
+                # enough as the out slot (no per-step weight copy)
+                agg = NDArray(g._data)
+                self._kvstore.pull(name, out=agg)
+                self._updater(name, agg, self._exec.arg_dict[name])
+        else:
+            for name in self._param_names:
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                self._updater(name, g, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self._inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    # -- persistence --------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        remove_amp_cast=True):
+        from ..model import save_checkpoint
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+        symbol, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._preload_params = (arg, aux)
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        return mod
+
+    def save_optimizer_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer=True))
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        shapes = {d[0]: tuple(d[1]) for d in data_shapes}
+        if label_shapes:
+            shapes.update({d[0]: tuple(d[1]) for d in label_shapes})
+        self._exec = self._exec.reshape(**shapes)
+        self._data_shapes = list(data_shapes)
+        self._label_shapes = list(label_shapes or [])

@@ -1,0 +1,93 @@
+"""Monitor: windowed per-op output statistics for debugging.
+
+Capability parity with the reference monitor (python/mxnet/monitor.py —
+executor stat callback + tic/toc windows around every `interval`-th
+batch), designed around an explicit capture window: probes are reduced to
+plain floats the moment they are captured (no deferred NDArray handling),
+and arguments are swept once when the window closes. Executors attach via
+the same `set_monitor_callback` hook.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(arr):
+    """mean(|x|) — the reference's default summary statistic."""
+    return arr.abs().mean() if hasattr(arr, "abs") else arr
+
+
+def _to_text(value):
+    """Render a captured statistic: NDArray-likes become their (scalar)
+    value; lists render space-separated; everything else via str()."""
+    items = value if isinstance(value, (list, tuple)) else [value]
+    out = []
+    for v in items:
+        if hasattr(v, "asnumpy"):
+            out.append(str(float(v.asnumpy().reshape(-1)[0])))
+        else:
+            out.append(str(v))
+    return " ".join(out)
+
+
+class Monitor:
+    """Collect (batch, node_name, stat) rows for ops whose name matches
+    `pattern`, on every `interval`-th batch between tic() and toc()."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        self.interval = int(interval)
+        self.stat_func = stat_func or _default_stat
+        self.sort = sort
+        self.re_pattern = re.compile(pattern)
+        self._capturing = False
+        self._batch = 0
+        self._rows = []          # (batch, name, rendered stat)
+        self._targets = []       # executors swept at window close
+        # legacy attribute names some callers poke at
+        self.activated = False
+        self.step = 0
+        self.exes = self._targets
+
+    def install(self, exe):
+        """Attach to an executor; its per-op outputs flow into the current
+        window through the monitor callback."""
+        exe.set_monitor_callback(self._capture)
+        self._targets.append(exe)
+
+    def _capture(self, name, arr):
+        if self._capturing and self.re_pattern.match(name):
+            self._rows.append((self._batch, name,
+                               _to_text(self.stat_func(arr))))
+
+    def tic(self):
+        """Open a capture window if this batch index is due."""
+        if self._batch % self.interval == 0:
+            self._rows = []
+            self._capturing = True
+            self.activated = True
+        self._batch += 1
+        self.step = self._batch
+
+    def toc(self):
+        """Close the window: sweep matching executor arguments (weights),
+        then return all rows as (batch, name, stat_string)."""
+        if not self._capturing:
+            return []
+        self._capturing = False
+        self.activated = False
+        for exe in self._targets:
+            for name, arr in exe.arg_dict.items():
+                if self.re_pattern.match(name):
+                    self._rows.append((self._batch, name,
+                                       _to_text(self.stat_func(arr))))
+        rows, self._rows = self._rows, []
+        if self.sort:
+            rows.sort(key=lambda r: r[1])
+        return rows
+
+    def toc_print(self):
+        for batch, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", batch, name, stat)

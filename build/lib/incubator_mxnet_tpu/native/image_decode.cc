@@ -1,0 +1,157 @@
+// Native JPEG decode + resize + mirror batch kernel.
+//
+// Reference: src/io/iter_image_recordio_2.cc (multi-threaded OpenCV
+// imdecode + DefaultImageAugmenter). TPU-native equivalent: libjpeg
+// decompress straight into a caller-provided HWC uint8 batch buffer with
+// bilinear resize and optional horizontal mirror, one worker thread per
+// shard of the batch. Color normalization stays on the (vectorized)
+// python side — it fuses into the host->device cast anyway.
+//
+// Exposed via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <csetjmp>
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void err_exit(j_common_ptr cinfo) {
+  ErrMgr* e = reinterpret_cast<ErrMgr*>(cinfo->err);
+  longjmp(e->jb, 1);
+}
+
+// decode buf into an RGB HWC buffer; returns {w, h} or {0, 0} on error
+bool decode_rgb(const uint8_t* buf, long len, std::vector<uint8_t>* pix,
+                int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = static_cast<int>(cinfo.output_width);
+  *h = static_cast<int>(cinfo.output_height);
+  pix->resize(static_cast<size_t>(*w) * *h * 3);
+  JSAMPROW row;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    row = pix->data() + static_cast<size_t>(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// bilinear resize of a sub-window (cx, cy, cw, ch) of src (sw x sh HWC
+// uint8) into dst (oh x ow x 3), optional mirror
+void resize_bilinear(const uint8_t* src, int sw, int cx, int cy, int cw,
+                     int ch, uint8_t* dst, int ow, int oh, bool mirror) {
+  const float sx = ow > 1 ? static_cast<float>(cw - 1) / (ow - 1) : 0.f;
+  const float sy = oh > 1 ? static_cast<float>(ch - 1) / (oh - 1) : 0.f;
+  for (int y = 0; y < oh; ++y) {
+    const float fy = y * sy;
+    int y0 = static_cast<int>(fy);
+    if (y0 > ch - 1) y0 = ch - 1;
+    const int y1 = y0 + 1 < ch ? y0 + 1 : ch - 1;
+    const float wy = fy - y0;
+    const size_t r0 = static_cast<size_t>(cy + y0) * sw;
+    const size_t r1 = static_cast<size_t>(cy + y1) * sw;
+    for (int x = 0; x < ow; ++x) {
+      const float fx = x * sx;
+      int x0 = static_cast<int>(fx);
+      if (x0 > cw - 1) x0 = cw - 1;
+      const int x1 = x0 + 1 < cw ? x0 + 1 : cw - 1;
+      const float wx = fx - x0;
+      const int ox = mirror ? (ow - 1 - x) : x;
+      uint8_t* d = dst + (static_cast<size_t>(y) * ow + ox) * 3;
+      const uint8_t* p00 = src + (r0 + cx + x0) * 3;
+      const uint8_t* p01 = src + (r0 + cx + x1) * 3;
+      const uint8_t* p10 = src + (r1 + cx + x0) * 3;
+      const uint8_t* p11 = src + (r1 + cx + x1) * 3;
+      for (int c = 0; c < 3; ++c) {
+        const float v = (1 - wy) * ((1 - wx) * p00[c] + wx * p01[c]) +
+                        wy * ((1 - wx) * p10[c] + wx * p11[c]);
+        d[c] = static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// decode one JPEG to (oh, ow, 3) uint8 HWC; center_crop selects the
+// python CenterCropAug semantics (centered target-aspect crop, then
+// resize — image.py center_crop/scale_down), else a full-frame resize.
+int mxtpu_jpeg_decode_resize(const uint8_t* buf, long len, int oh, int ow,
+                             int mirror, int center_crop, uint8_t* out) {
+  std::vector<uint8_t> pix;
+  int w = 0, h = 0;
+  if (!decode_rgb(buf, len, &pix, &w, &h) || w <= 0 || h <= 0) return 1;
+  int cx = 0, cy = 0, cw = w, ch = h;
+  if (center_crop) {
+    // scale_down((w, h), (ow, oh)): shrink the TARGET box to fit inside
+    // the source, preserving the target's aspect ratio
+    float tw = ow, th = oh;
+    if (h < th) { tw = tw * h / th; th = h; }
+    if (w < tw) { th = th * w / tw; tw = w; }
+    cw = static_cast<int>(tw) > 0 ? static_cast<int>(tw) : 1;
+    ch = static_cast<int>(th) > 0 ? static_cast<int>(th) : 1;
+    cx = (w - cw) / 2;
+    cy = (h - ch) / 2;
+  }
+  resize_bilinear(pix.data(), w, cx, cy, cw, ch, out, ow, oh, mirror != 0);
+  return 0;
+}
+
+// batch variant: bufs[i] has lens[i] bytes; out is (n, oh, ow, 3) uint8.
+// mirrors may be null. Returns number of failed decodes.
+int mxtpu_jpeg_decode_batch(const uint8_t** bufs, const long* lens, int n,
+                            int oh, int ow, const int* mirrors,
+                            int center_crop, uint8_t* out, int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > n) nthreads = n;
+  std::vector<int> fails(nthreads, 0);
+  const size_t item = static_cast<size_t>(oh) * ow * 3;
+  std::vector<std::thread> workers;
+  for (int t = 0; t < nthreads; ++t) {
+    workers.emplace_back([&, t]() {
+      for (int i = t; i < n; i += nthreads) {
+        const int m = mirrors ? mirrors[i] : 0;
+        if (mxtpu_jpeg_decode_resize(bufs[i], lens[i], oh, ow, m,
+                                     center_crop, out + item * i) != 0) {
+          std::memset(out + item * i, 0, item);
+          ++fails[t];
+        }
+      }
+    });
+  }
+  for (auto& th : workers) th.join();
+  int total = 0;
+  for (int f : fails) total += f;
+  return total;
+}
+
+}  // extern "C"

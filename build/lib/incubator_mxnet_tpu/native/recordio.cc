@@ -1,0 +1,265 @@
+// Native RecordIO codec + threaded prefetching reader.
+//
+// Reference: dmlc-core's recordio (src/io/ in the reference tree uses
+// dmlc::RecordIOWriter/Reader; framing documented at
+// python/mxnet/recordio.py) and the background PrefetcherIter
+// (src/io/iter_prefetcher.h:47 over dmlc::ThreadedIter:142).
+//
+// Frame: [uint32 magic 0xced7230a][uint32 lrecord][payload][pad to 4B]
+//   lrecord = (cflag << 29) | length
+//   cflag: 0 = complete, 1 = begin, 2 = middle, 3 = end (multipart for
+//   payloads >= 2^29 bytes).
+//
+// Exposed as a flat C ABI consumed via ctypes (no pybind11 in the image).
+// Each handle owns the buffer returned by its read call; the pointer stays
+// valid until the next read on the same handle or close.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+constexpr uint64_t kChunk = (1ull << 29) - 4;  // payload per physical record
+
+// mutex-guarded global (NOT thread_local: the prefetcher worker thread must
+// surface read errors to the consumer thread's mxtpu_last_error call)
+std::mutex g_error_mu;
+std::string g_last_error;
+thread_local std::string t_error_copy;
+
+void set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lk(g_error_mu);
+  g_last_error = msg;
+}
+
+const char* get_error() {
+  std::lock_guard<std::mutex> lk(g_error_mu);
+  t_error_copy = g_last_error;
+  return t_error_copy.c_str();
+}
+
+struct Stream {
+  FILE* f = nullptr;
+  bool writable = false;
+  std::string buf;  // last full (reassembled) record for readers
+};
+
+// one physical record; returns 1 ok, 0 eof, -1 error
+int read_physical(FILE* f, uint32_t* cflag, std::string* out) {
+  uint32_t header[2];
+  size_t n = fread(header, 1, 8, f);
+  if (n == 0) return 0;
+  if (n < 8) { set_error("truncated record header"); return -1; }
+  if (header[0] != kMagic) { set_error("bad record magic"); return -1; }
+  *cflag = header[1] >> 29;
+  uint32_t len = header[1] & kLenMask;
+  out->resize(len);
+  if (len && fread(&(*out)[0], 1, len, f) != len) {
+    set_error("truncated record payload");
+    return -1;
+  }
+  uint32_t pad = (4 - (len & 3)) & 3;
+  if (pad) {
+    char skip[4];
+    if (fread(skip, 1, pad, f) != pad) {
+      set_error("truncated record padding");
+      return -1;
+    }
+  }
+  return 1;
+}
+
+// full logical record with multipart reassembly; 1 ok, 0 eof, -1 error
+int read_logical(FILE* f, std::string* out) {
+  uint32_t cflag = 0;
+  int rc = read_physical(f, &cflag, out);
+  if (rc <= 0) return rc;
+  if (cflag == 0) return 1;
+  if (cflag != 1) { set_error("multipart record starts mid-stream"); return -1; }
+  std::string part;
+  while (true) {
+    rc = read_physical(f, &cflag, &part);
+    if (rc == 0) { set_error("truncated multipart record"); return -1; }
+    if (rc < 0) return -1;
+    out->append(part);
+    if (cflag == 3) return 1;
+    if (cflag != 2) { set_error("unexpected cflag inside multipart"); return -1; }
+  }
+}
+
+int write_physical(FILE* f, uint32_t cflag, const char* data, uint64_t len) {
+  uint32_t header[2] = {kMagic,
+                        (cflag << 29) | static_cast<uint32_t>(len & kLenMask)};
+  if (fwrite(header, 1, 8, f) != 8) return -1;
+  if (len && fwrite(data, 1, len, f) != len) return -1;
+  uint32_t pad = (4 - (len & 3)) & 3;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad && fwrite(zeros, 1, pad, f) != pad) return -1;
+  return 0;
+}
+
+struct Prefetcher {
+  FILE* f = nullptr;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::deque<std::string> queue;
+  size_t depth = 4;
+  bool done = false;     // producer finished (eof or error)
+  bool stop = false;     // consumer closing
+  int status = 1;        // sticky producer status (0 eof, -1 error)
+  std::string buf;       // consumer-owned last record
+
+  void run() {
+    while (true) {
+      std::string rec;
+      int rc = read_logical(f, &rec);
+      std::unique_lock<std::mutex> lk(mu);
+      if (rc <= 0) {
+        status = rc;
+        done = true;
+        cv_get.notify_all();
+        return;
+      }
+      cv_put.wait(lk, [&] { return queue.size() < depth || stop; });
+      if (stop) return;
+      queue.emplace_back(std::move(rec));
+      cv_get.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* mxtpu_last_error() { return get_error(); }
+
+void* mxtpu_rio_open_read(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { set_error("cannot open for read"); return nullptr; }
+  auto* s = new Stream();
+  s->f = f;
+  s->writable = false;
+  return s;
+}
+
+void* mxtpu_rio_open_write(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) { set_error("cannot open for write"); return nullptr; }
+  auto* s = new Stream();
+  s->f = f;
+  s->writable = true;
+  return s;
+}
+
+int mxtpu_rio_write(void* h, const char* data, uint64_t len) {
+  auto* s = static_cast<Stream*>(h);
+  if (!s->writable) { set_error("handle not writable"); return -1; }
+  if (len <= kLenMask) {
+    return write_physical(s->f, 0, data, len);
+  }
+  uint64_t off = 0, n = (len + kChunk - 1) / kChunk, i = 0;
+  for (; off < len; off += kChunk, ++i) {
+    uint64_t part = (len - off < kChunk) ? (len - off) : kChunk;
+    uint32_t cflag = (i == 0) ? 1u : ((i == n - 1) ? 3u : 2u);
+    if (write_physical(s->f, cflag, data + off, part) != 0) return -1;
+  }
+  return 0;
+}
+
+// 1 = record returned, 0 = eof, -1 = error
+int mxtpu_rio_read(void* h, const char** out, uint64_t* len) {
+  auto* s = static_cast<Stream*>(h);
+  int rc = read_logical(s->f, &s->buf);
+  if (rc == 1) {
+    *out = s->buf.data();
+    *len = s->buf.size();
+  }
+  return rc;
+}
+
+uint64_t mxtpu_rio_tell(void* h) {
+  auto* s = static_cast<Stream*>(h);
+  return static_cast<uint64_t>(ftello(s->f));
+}
+
+int mxtpu_rio_seek(void* h, uint64_t pos) {
+  auto* s = static_cast<Stream*>(h);
+  return fseeko(s->f, static_cast<off_t>(pos), SEEK_SET);
+}
+
+void mxtpu_rio_close(void* h) {
+  auto* s = static_cast<Stream*>(h);
+  if (s->f) fclose(s->f);
+  delete s;
+}
+
+// Scan a .rec file and write "<i>\t<offset>" lines; returns record count
+// or -1 (the fast path behind tools/rec2idx, reference tools/rec2idx.py).
+long long mxtpu_recordio_index(const char* path, const char* idx_out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { set_error("cannot open for read"); return -1; }
+  FILE* out = fopen(idx_out, "w");
+  if (!out) { fclose(f); set_error("cannot open idx for write"); return -1; }
+  long long count = 0;
+  std::string rec;
+  while (true) {
+    uint64_t pos = static_cast<uint64_t>(ftello(f));
+    int rc = read_logical(f, &rec);
+    if (rc == 0) break;
+    if (rc < 0) { count = -1; break; }
+    fprintf(out, "%lld\t%llu\n", count, (unsigned long long)pos);
+    ++count;
+  }
+  fclose(f);
+  fclose(out);
+  return count;
+}
+
+void* mxtpu_prefetch_open(const char* path, int depth) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { set_error("cannot open for read"); return nullptr; }
+  auto* p = new Prefetcher();
+  p->f = f;
+  p->depth = depth > 0 ? static_cast<size_t>(depth) : 4;
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+// 1 = record, 0 = eof, -1 = error
+int mxtpu_prefetch_next(void* h, const char** out, uint64_t* len) {
+  auto* p = static_cast<Prefetcher*>(h);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_get.wait(lk, [&] { return !p->queue.empty() || p->done; });
+  if (p->queue.empty()) return p->status;
+  p->buf = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->cv_put.notify_one();
+  *out = p->buf.data();
+  *len = p->buf.size();
+  return 1;
+}
+
+void mxtpu_prefetch_close(void* h) {
+  auto* p = static_cast<Prefetcher*>(h);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+    p->cv_put.notify_all();
+  }
+  if (p->worker.joinable()) p->worker.join();
+  if (p->f) fclose(p->f);
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,151 @@
+"""nd.contrib: control-flow sugar + contrib op namespace.
+
+Reference: python/mxnet/ndarray/contrib.py — `foreach`, `while_loop`,
+`cond` run imperative Python loops over NDArrays (the symbolic versions
+build _foreach/_while_loop/_cond subgraph ops, src/operator/control_flow.cc).
+
+TPU note: outside autograd recording these lower to the registered
+`_foreach`/`_while_loop` ops (ops/control_flow_ops.py) — lax.scan-based, so
+the XLA program is NOT unrolled and compile time is independent of trip
+count. Under autograd.record() the tape needs gradients to flow into arrays
+the body *closes over* (not just explicit inputs), so the recorded path is
+an unrolled eager loop exactly like the reference's imperative sugar.
+"""
+from __future__ import annotations
+
+from .. import autograd
+from ..base import MXNetError
+from .ndarray import NDArray
+from ..ops.dgl_ops import (dgl_csr_neighbor_uniform_sample,      # noqa: F401
+                           dgl_csr_neighbor_non_uniform_sample,  # noqa: F401
+                           dgl_subgraph, edge_id, dgl_adjacency,  # noqa: F401
+                           dgl_graph_compact)                     # noqa: F401
+
+__all__ = ["foreach", "while_loop", "cond",
+           "dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+           "edge_id", "dgl_adjacency", "dgl_graph_compact"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _trace_errors():
+    import jax
+    return (jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerBoolConversionError,
+            jax.errors.TracerIntegerConversionError,
+            NotImplementedError, TypeError)
+
+
+def foreach(body, data, init_states):
+    """Scan `body` over axis 0 (reference contrib.py foreach;
+    src/operator/control_flow.cc:1089 _foreach).
+
+    body(data_t, states) -> (out_t, new_states)."""
+    from . import stack as nd_stack
+
+    data_list = _as_list(data)
+    states = _as_list(init_states)
+    single_state = not isinstance(init_states, (list, tuple))
+    single_data = not isinstance(data, (list, tuple))
+
+    if not autograd.is_recording():
+        from ..ops.registry import invoke
+        try:
+            res = invoke("_foreach", *data_list, *states, body=body,
+                         n_data=len(data_list), single_data=single_data,
+                         single_state=single_state)
+            res = res if isinstance(res, list) else [res]
+            n_out = len(res) - len(states)
+            outs, fin = res[:n_out], res[n_out:]
+            merged = outs[0] if len(outs) == 1 else outs
+            return merged, (fin[0] if single_state and fin else fin)
+        except _trace_errors():
+            pass  # body not trace-safe: run the eager unrolled loop
+
+    T = data_list[0].shape[0]
+    outputs = []
+    for t in range(T):
+        sliced = [d[t] for d in data_list]
+        out, states = body(sliced[0] if len(sliced) == 1 else sliced,
+                           states[0] if single_state else states)
+        states = _as_list(states)
+        outputs.append(out)
+    if outputs and isinstance(outputs[0], (list, tuple)):
+        merged = [nd_stack(*[o[i] for o in outputs], axis=0)
+                  for i in range(len(outputs[0]))]
+    else:
+        merged = nd_stack(*outputs, axis=0)
+    return merged, (states[0] if single_state and states else states)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Reference contrib.py while_loop (_while_loop op :1150): iterate
+    `func` while `cond` holds, up to max_iterations; step outputs are
+    stacked and zero-padded to max_iterations like the reference."""
+    import jax.numpy as jnp
+    from . import stack as nd_stack, zeros
+
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    loop_vars = _as_list(loop_vars)
+
+    if not autograd.is_recording():
+        from ..ops.registry import invoke
+        try:
+            res = invoke("_while_loop", *loop_vars, cond=cond, func=func,
+                         max_iterations=int(max_iterations))
+            n_vars = len(loop_vars)
+            steps_arr, outs, fin = res[0], res[1:len(res) - n_vars], \
+                res[len(res) - n_vars:]
+            if int(steps_arr.asnumpy()) == 0:
+                raise MXNetError("while_loop made no iterations; cond was false")
+            return (outs[0] if len(outs) == 1 else outs), fin
+        except _trace_errors():
+            pass  # cond/func not trace-safe: eager loop below
+
+    outputs = []
+    steps = 0
+    while steps < max_iterations and bool(cond(*loop_vars).asnumpy()):
+        out, loop_vars = func(*loop_vars)
+        loop_vars = _as_list(loop_vars)
+        if out is not None:
+            outputs.append(_as_list(out))
+        steps += 1
+    if steps == 0:
+        raise MXNetError("while_loop made no iterations; cond was false")
+    if not outputs:
+        return [], loop_vars
+    stacked = []
+    for i in range(len(outputs[0])):
+        arr = nd_stack(*[o[i] for o in outputs], axis=0)
+        if steps < max_iterations:
+            pad = zeros((max_iterations - steps,) + arr.shape[1:],
+                        dtype=arr.dtype)
+            from . import concatenate
+            arr = concatenate([arr, pad], axis=0)
+        stacked.append(arr)
+    return (stacked[0] if len(stacked) == 1 else stacked), loop_vars
+
+
+def cond(pred, then_func, else_func):
+    """Reference contrib.py cond (_cond op): evaluate one branch."""
+    p = pred() if callable(pred) else pred
+    flag = bool(p.asnumpy()) if isinstance(p, NDArray) else bool(p)
+    return then_func() if flag else else_func()
+
+
+def __getattr__(name):
+    # contrib-prefixed ops resolve from the registry (nd.contrib.box_nms...)
+    from . import _make_wrapper
+    from ..ops import registry as _registry
+
+    for candidate in (f"_contrib_{name}", name):
+        if candidate in _registry.OPS:
+            w = _make_wrapper(_registry.OPS.get(candidate))
+            globals()[name] = w  # cache: next access skips __getattr__
+            return w
+    raise AttributeError(f"nd.contrib has no attribute {name!r}")

@@ -1,0 +1,137 @@
+"""Framework RNG: the key chain + `mx.nd.random` sampler API.
+
+Reference: python/mxnet/ndarray/random.py + per-device RNG resource
+(src/resource.cc ResourceManagerImpl seeds mshadow Random states;
+include/mxnet/random_generator.h parallel RNG).
+
+TPU-native redesign: a process-global jax PRNG key chain, split per sampler
+call. `seed()` resets it (reference mx.random.seed seeds every device's
+generator). Inside a jit trace (hybridized blocks), the ambient key comes from
+a trace-local override installed by the tracer so randomness is reproducible
+and trace-safe.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+__all__ = ["seed", "next_key", "uniform", "normal", "randn", "randint", "gamma",
+           "exponential", "poisson", "negative_binomial",
+           "generalized_negative_binomial", "multinomial", "shuffle"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.key = None
+        self.trace_key = None  # set by hybridize tracing
+        self.trace_count = 0
+
+
+_state = _State()
+
+
+def seed(seed_state: int):
+    import jax
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    import jax
+    if _state.trace_key is not None:
+        _state.trace_count += 1
+        return jax.random.fold_in(_state.trace_key, _state.trace_count)
+    if _state.key is None:
+        _state.key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
+    _state.key, sub = jax.random.split(_state.key)
+    return sub
+
+
+class _TraceKeyScope:
+    """Install a traced key as the ambient RNG source during jit tracing."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        self.prev = (_state.trace_key, _state.trace_count)
+        _state.trace_key, _state.trace_count = self.key, 0
+        return self
+
+    def __exit__(self, *exc):
+        _state.trace_key, _state.trace_count = self.prev
+
+
+def _shape(shape):
+    if shape is None:
+        return (1,)
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    from ..ops.registry import invoke
+    return invoke("_random_uniform", low=float(low), high=float(high),
+                  shape=_shape(shape), dtype=str(dtype or "float32"), out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    from ..ops.registry import invoke
+    return invoke("_random_normal", loc=float(loc), scale=float(scale),
+                  shape=_shape(shape), dtype=str(dtype or "float32"), out=out)
+
+
+def randn(*shape, dtype="float32", ctx=None, **kw):
+    return normal(0.0, 1.0, shape=shape or (1,), dtype=dtype)
+
+
+def randint(low, high=None, shape=None, dtype="int32", ctx=None, out=None, **kw):
+    from ..ops.registry import invoke
+    if high is None:
+        low, high = 0, low
+    return invoke("_random_randint", low=int(low), high=int(high),
+                  shape=_shape(shape), dtype=str(dtype or "int32"), out=out)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    from ..ops.registry import invoke
+    return invoke("_random_gamma", alpha=float(alpha), beta=float(beta),
+                  shape=_shape(shape), dtype=str(dtype or "float32"), out=out)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    from ..ops.registry import invoke
+    return invoke("_random_exponential", lam=1.0 / float(scale), shape=_shape(shape),
+                  dtype=str(dtype or "float32"), out=out)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    from ..ops.registry import invoke
+    return invoke("_random_poisson", lam=float(lam), shape=_shape(shape),
+                  dtype=str(dtype or "float32"), out=out)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    from ..ops.registry import invoke
+    return invoke("_random_negative_binomial", k=int(k), p=float(p),
+                  shape=_shape(shape), dtype=str(dtype or "float32"), out=out)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype="float32",
+                                  ctx=None, out=None, **kw):
+    from ..ops.registry import invoke
+    return invoke("_random_generalized_negative_binomial", mu=float(mu),
+                  alpha=float(alpha), shape=_shape(shape),
+                  dtype=str(dtype or "float32"), out=out)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+    from ..ops.registry import invoke
+    return invoke("_sample_multinomial", data, shape=tuple(shape) if
+                  isinstance(shape, (tuple, list)) else (shape,) if shape else (),
+                  get_prob=get_prob, dtype=str(dtype))
+
+
+def shuffle(data, **kw):
+    from ..ops.registry import invoke
+    return invoke("_shuffle", data)

@@ -1,0 +1,352 @@
+"""Sparse NDArrays: row_sparse + csr.
+
+Reference: include/mxnet/ndarray.h:61-66 (storage types), python/mxnet/
+ndarray/sparse.py (RowSparseNDArray/CSRNDArray user API),
+src/operator/tensor/cast_storage*, sparse dot, and the row_sparse
+optimizer-update variants (src/operator/optimizer_op.cc).
+
+TPU-native redesign (SURVEY §7 hard parts): XLA has no dynamic-nnz sparse
+tensor, so a RowSparseNDArray is an explicit (indices [K], values
+[K, ...cols]) pair and CSR an explicit (data, indices, indptr) triple of
+dense jax arrays — padding-free on the host side, and every consuming
+kernel (dot, retain, lazy optimizer updates) is a gather/scatter/
+segment-sum over static shapes once K is known. That is exactly the form
+XLA tiles well; the reference reaches the same layout through its
+row_sparse chunk machinery.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, dtype_np
+from .ndarray import NDArray
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "zeros",
+           "retain", "dot", "sparse_add", "row_sparse_combine"]
+
+
+class BaseSparseNDArray:
+    stype = None
+
+    @property
+    def context(self):
+        from ..context import current_context
+        return current_context()
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.shape} @{self.stype}>"
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, values) rows of a mostly-zero matrix/tensor
+    (reference ndarray/sparse.py RowSparseNDArray)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape):
+        import jax.numpy as jnp
+        self.data = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        self.indices = indices if isinstance(indices, NDArray) else \
+            NDArray(jnp.asarray(indices, jnp.int32))
+        self._shape = tuple(int(s) for s in shape)
+        if self.data.shape[0] != self.indices.shape[0]:
+            raise MXNetError("row_sparse data/indices row-count mismatch")
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def __len__(self):
+        return self._shape[0]
+
+    def copy(self):
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(),
+                                self._shape)
+
+    def todense(self) -> NDArray:
+        import jax.numpy as jnp
+        dense = jnp.zeros(self._shape, self.data._data.dtype)
+        dense = dense.at[self.indices._data].add(self.data._data)
+        return NDArray(dense)
+
+    tostype = None  # set below
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def astype(self, dtype):
+        return RowSparseNDArray(self.data.astype(dtype), self.indices,
+                                self._shape)
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return row_sparse_combine(self, other)
+        if isinstance(other, NDArray):
+            return self.todense() + other
+        raise MXNetError(f"cannot add RowSparseNDArray and {type(other)}")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __mul__(self, scalar):
+        return RowSparseNDArray(self.data * scalar, self.indices, self._shape)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, scalar):
+        return RowSparseNDArray(self.data / scalar, self.indices, self._shape)
+
+
+def _rs_tostype(self, stype):
+    if stype == "row_sparse":
+        return self
+    if stype == "default":
+        return self.todense()
+    raise MXNetError(f"cannot cast row_sparse to {stype!r}")
+
+
+RowSparseNDArray.tostype = _rs_tostype
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference ndarray/sparse.py
+    CSRNDArray)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        import jax.numpy as jnp
+        self.data = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        self.indices = indices if isinstance(indices, NDArray) else \
+            NDArray(jnp.asarray(indices, jnp.int32))
+        self.indptr = indptr if isinstance(indptr, NDArray) else \
+            NDArray(jnp.asarray(indptr, jnp.int32))
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def copy(self):
+        return CSRNDArray(self.data.copy(), self.indices.copy(),
+                          self.indptr.copy(), self._shape)
+
+    def _row_ids(self):
+        """Expand indptr to a per-nnz row id vector."""
+        import jax.numpy as jnp
+        counts = self.indptr._data[1:] - self.indptr._data[:-1]
+        return jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self.data.shape[0])
+
+    def todense(self) -> NDArray:
+        import jax.numpy as jnp
+        dense = jnp.zeros(self._shape, self.data._data.dtype)
+        dense = dense.at[self._row_ids(), self.indices._data].add(
+            self.data._data)
+        return NDArray(dense)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError(f"cannot cast csr to {stype!r}")
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# constructors + conversion
+# ---------------------------------------------------------------------------
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """row_sparse_array((data, indices), shape=...) or from dense
+    (reference sparse.py row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            raise MXNetError("row_sparse_array((data, indices)) needs shape")
+        return RowSparseNDArray(_as_nd(data, dtype), _as_nd(indices), shape)
+    dense = _as_nd(arg1, dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """csr_matrix((data, indices, indptr), shape=...) or from dense
+    (reference sparse.py csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("csr_matrix((data, indices, indptr)) needs shape")
+        return CSRNDArray(_as_nd(data, dtype), _as_nd(indices),
+                          _as_nd(indptr), shape)
+    dense = _as_nd(arg1, dtype)
+    return cast_storage(dense, "csr")
+
+
+def _as_nd(x, dtype=None):
+    if isinstance(x, NDArray):
+        return x.astype(dtype) if dtype else x
+    import jax.numpy as jnp
+    return NDArray(jnp.asarray(x, dtype_np(dtype) if dtype else None))
+
+
+def cast_storage(arr, stype):
+    """dense <-> row_sparse/csr conversion (reference
+    src/operator/tensor/cast_storage-inl.h). nnz is data-dependent, so this
+    runs eagerly on host-visible values — exactly like the reference's
+    cast_storage, which materializes the compacted storage."""
+    if isinstance(arr, (RowSparseNDArray, CSRNDArray)):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    v = arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = _np.where(_np.any(v.reshape(v.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(_as_nd(v[nz_rows]),
+                                _as_nd(nz_rows.astype(_np.int32)), v.shape)
+    if stype == "csr":
+        if v.ndim != 2:
+            raise MXNetError("csr requires a 2-D array")
+        indptr = [0]
+        indices, data = [], []
+        for row in v:
+            nz = _np.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            data.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(_as_nd(_np.asarray(data, v.dtype)),
+                          _as_nd(_np.asarray(indices, _np.int32)),
+                          _as_nd(_np.asarray(indptr, _np.int32)), v.shape)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    """Reference sparse.py zeros."""
+    import jax.numpy as jnp
+    dt = dtype_np(dtype) if dtype else _np.float32
+    if stype == "row_sparse":
+        cols = shape[1:]
+        return RowSparseNDArray(NDArray(jnp.zeros((0,) + tuple(cols), dt)),
+                                NDArray(jnp.zeros((0,), jnp.int32)), shape)
+    if stype == "csr":
+        return CSRNDArray(NDArray(jnp.zeros((0,), dt)),
+                          NDArray(jnp.zeros((0,), jnp.int32)),
+                          NDArray(jnp.zeros((shape[0] + 1,), jnp.int32)),
+                          shape)
+    from . import zeros as dense_zeros
+    return dense_zeros(shape, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def retain(rsp, indices):
+    """Keep only the given rows (reference sparse_retain op)."""
+    import jax.numpy as jnp
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    want = _as_nd(indices)._data.astype(_np.int32)
+    mask = jnp.isin(rsp.indices._data, want)
+    keep = _np.where(_np.asarray(mask))[0]
+    return RowSparseNDArray(NDArray(rsp.data._data[keep]),
+                            NDArray(rsp.indices._data[keep]), rsp.shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """csr x dense and dense^T x dense -> row_sparse (reference sparse dot,
+    src/operator/tensor/dot-inl.h)."""
+    import jax.numpy as jnp
+    if isinstance(lhs, CSRNDArray):
+        if transpose_a:
+            # csr^T @ dense via scatter into output rows
+            out = jnp.zeros((lhs.shape[1], rhs.shape[1]),
+                            rhs.data._data.dtype if isinstance(rhs, CSRNDArray)
+                            else rhs._data.dtype)
+            rows = lhs._row_ids()
+            contrib = lhs.data._data[:, None] * rhs._data[rows]
+            out = out.at[lhs.indices._data].add(contrib)
+            return NDArray(out)
+        # csr @ dense: gather + segment-sum
+        rows = lhs._row_ids()
+        gathered = lhs.data._data[:, None] * rhs._data[lhs.indices._data]
+        import jax
+        out = jax.ops.segment_sum(gathered, rows,
+                                  num_segments=lhs.shape[0])
+        return NDArray(out)
+    raise MXNetError("sparse dot requires a CSR lhs")
+
+
+def sparse_add(a, b):
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        return row_sparse_combine(a, b)
+    raise MXNetError("sparse_add expects two RowSparseNDArrays")
+
+
+def sparse_embedding(x, weight, input_dim, output_dim):
+    """Embedding lookup whose weight gradient is ROW SPARSE — only touched
+    rows appear (reference Embedding sparse_grad=True,
+    src/operator/tensor/indexing_op.cc Embedding + SparseEmbedding).
+
+    Eager-only: the tape node emits a RowSparseNDArray cotangent that the
+    sparse optimizer updates consume without densifying."""
+    import weakref
+
+    import jax
+    import jax.numpy as jnp
+
+    from .. import autograd
+
+    idx = x._data.astype(jnp.int32)
+    out = NDArray(weight._data[idx])
+    if autograd.is_recording():
+        idx_flat = _np.asarray(idx).reshape(-1)
+
+        def node_vjp(cts):
+            ct = cts[0] if isinstance(cts, tuple) else cts
+            vals = jnp.reshape(ct, (-1, output_dim))
+            uniq, inv = _np.unique(idx_flat, return_inverse=True)
+            summed = jax.ops.segment_sum(
+                vals, jnp.asarray(inv, jnp.int32), num_segments=len(uniq))
+            wgrad = RowSparseNDArray(
+                NDArray(summed.astype(weight._data.dtype)),
+                NDArray(jnp.asarray(uniq, jnp.int32)),
+                (input_dim, output_dim))
+            return (wgrad,)
+
+        node = autograd.Node(node_vjp, [weight], "sparse_embedding")
+        node.out_refs = [weakref.ref(out)]
+        node.out_avals = [(out.shape, out.dtype)]
+        out._ag_node = node
+    return out
+
+
+def row_sparse_combine(a: RowSparseNDArray, b: RowSparseNDArray):
+    """Merge two row-sparse arrays (sum on duplicate rows) — gradient
+    accumulation for sparse grads (reference kAddTo on row_sparse)."""
+    import jax
+    import jax.numpy as jnp
+    if a.shape != b.shape:
+        raise MXNetError("shape mismatch in row_sparse add")
+    idx = jnp.concatenate([a.indices._data, b.indices._data])
+    vals = jnp.concatenate([a.data._data, b.data._data])
+    idx_np = _np.asarray(idx)
+    uniq = _np.unique(idx_np)
+    seg = jnp.asarray(_np.searchsorted(uniq, idx_np).astype(_np.int32))
+    summed = jax.ops.segment_sum(vals, seg, num_segments=len(uniq))
+    return RowSparseNDArray(NDArray(summed),
+                            NDArray(jnp.asarray(uniq, jnp.int32)), a.shape)

@@ -1,0 +1,94 @@
+"""NDArray save/load.
+
+Reference: python/mxnet/ndarray/utils.py:149 save/load over the dmlc::Stream
+binary container (MXNDArraySave, include/mxnet/c_api.h:656; impl
+src/ndarray/ndarray.cc). The container stores either a list or a str->NDArray
+map.
+
+TPU-native redesign: the container is a .npz (numpy zip) with a magic key for
+the format version; keys are prefixed `arg:`/`aux:`-style names exactly as the
+reference writes them, so Gluon save_parameters/load_parameters round-trips
+match. (Sharded/pod-scale checkpoints live in utils/checkpoint.py via orbax.)
+"""
+from __future__ import annotations
+
+import os
+import zipfile
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["save", "load", "from_dlpack", "to_dlpack_for_read",
+           "to_dlpack_for_write"]
+
+_MAGIC_KEY = "__mxtpu_ndarray_container__"
+_LIST_PREFIX = "__list__:"
+
+
+def save(fname: str, data):
+    """Save a list or dict of NDArrays (reference ndarray/utils.py save)."""
+    arrays = {}
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        for i, a in enumerate(data):
+            if not isinstance(a, NDArray):
+                raise MXNetError("save expects NDArrays")
+            arrays[f"{_LIST_PREFIX}{i:08d}"] = a.asnumpy()
+    elif isinstance(data, dict):
+        for k, v in data.items():
+            if not isinstance(v, NDArray):
+                raise MXNetError("save expects NDArrays")
+            arrays[k] = v.asnumpy()
+    else:
+        raise MXNetError(f"cannot save {type(data)}")
+    arrays[_MAGIC_KEY] = _np.asarray([1])
+    with open(fname, "wb") as f:
+        _np.savez(f, **arrays)
+
+
+def load(fname: str):
+    """Load a container saved by `save` (reference ndarray/utils.py load)."""
+    if not os.path.exists(fname):
+        raise MXNetError(f"no such file: {fname}")
+    with _np.load(fname, allow_pickle=False) as z:
+        keys = [k for k in z.files if k != _MAGIC_KEY]
+        if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+            return [NDArray(z[k]) for k in sorted(keys)]
+        return {k: NDArray(z[k]) for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# DLPack interchange (reference MXNDArrayToDLPack/MXNDArrayFromDLPack,
+# include/mxnet/c_api.h; python mxnet.ndarray to_dlpack_for_read/
+# to_dlpack_for_write/from_dlpack). jax.Array speaks the dlpack protocol
+# natively, so these are thin shims kept for API parity — they are the
+# zero-copy bridge to torch/cupy/numpy consumers.
+# ---------------------------------------------------------------------------
+
+def from_dlpack(ext):
+    """Wrap any object exporting __dlpack__ (torch tensor, numpy array,
+    another framework's array) as an NDArray, zero-copy when the producer
+    is on a compatible device."""
+    import jax.numpy as jnp
+    return NDArray(jnp.from_dlpack(ext))
+
+
+def to_dlpack_for_read(arr):
+    """Export an NDArray as a DLPack capsule (read intent; XLA arrays are
+    immutable so read/write intent coincide — both names kept for parity).
+    Backends without PJRT external-reference support (e.g. tunneled TPU)
+    fall back to a host copy's capsule."""
+    try:
+        return arr._data.__dlpack__()
+    except Exception:
+        return _np.asarray(arr._data).__dlpack__()
+
+
+def to_dlpack_for_write(arr):
+    """See to_dlpack_for_read — XLA buffers are immutable; a consumer that
+    mutates must copy (the reference's write capsule relied on the engine
+    write-var lock, which has no XLA analog)."""
+    return to_dlpack_for_read(arr)

@@ -1,0 +1,13 @@
+"""mxnet.numpy: NumPy-compatible array namespace (reference
+python/mxnet/numpy/, 3,559 LoC, backed by src/operator/numpy/).
+
+Usage mirrors the reference:
+
+    from incubator_mxnet_tpu import np, npx
+    x = np.ones((2, 3))
+    y = np.exp(x).sum(axis=1)
+"""
+from .multiarray import *  # noqa: F401,F403
+from .multiarray import ndarray, array, _as_np  # noqa: F401
+from . import linalg  # noqa: F401
+from . import random  # noqa: F401

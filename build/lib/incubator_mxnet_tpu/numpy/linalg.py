@@ -1,0 +1,105 @@
+"""mxnet.numpy.linalg (reference python/mxnet/numpy/linalg.py; C++ la_op
+kernels src/operator/tensor/la_op.cc are replaced by XLA's native
+cholesky/qr/svd/triangular-solve lowerings)."""
+from __future__ import annotations
+
+from ..ops.registry import apply_op
+from .multiarray import _as_np, _op, array
+
+__all__ = ["norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet",
+           "eigh", "eigvalsh", "solve", "lstsq", "matrix_rank",
+           "tensorinv", "multi_dot", "matrix_power"]
+
+
+def _jla():
+    import jax.numpy as jnp
+    return jnp.linalg
+
+
+def norm(x, ord=None, axis=None, keepdims=False):  # noqa: A002
+    op = _op("linalg_norm", lambda a, ord, axis, keepdims:
+             _jla().norm(a, ord=ord, axis=axis, keepdims=keepdims))
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_op(op, _as_np(x), ord=ord, axis=ax, keepdims=bool(keepdims))
+
+
+def svd(a, full_matrices=False, compute_uv=True):
+    op = _op("linalg_svd", lambda x, full_matrices, compute_uv:
+             _jla().svd(x, full_matrices=full_matrices,
+                        compute_uv=compute_uv))
+    return apply_op(op, _as_np(a), full_matrices=bool(full_matrices),
+                    compute_uv=bool(compute_uv))
+
+
+def cholesky(a):
+    op = _op("linalg_cholesky", lambda x: _jla().cholesky(x))
+    return apply_op(op, _as_np(a))
+
+
+def qr(a, mode="reduced"):
+    op = _op("linalg_qr", lambda x, mode: _jla().qr(x, mode=mode))
+    return apply_op(op, _as_np(a), mode=mode)
+
+
+def inv(a):
+    op = _op("linalg_inv", lambda x: _jla().inv(x))
+    return apply_op(op, _as_np(a))
+
+
+def pinv(a, rcond=1e-15):
+    op = _op("linalg_pinv", lambda x, rcond: _jla().pinv(x, rcond=rcond))
+    return apply_op(op, _as_np(a), rcond=float(rcond))
+
+
+def det(a):
+    op = _op("linalg_det", lambda x: _jla().det(x))
+    return apply_op(op, _as_np(a))
+
+
+def slogdet(a):
+    op = _op("linalg_slogdet", lambda x: tuple(_jla().slogdet(x)))
+    return apply_op(op, _as_np(a))
+
+
+def eigh(a):
+    op = _op("linalg_eigh", lambda x: tuple(_jla().eigh(x)))
+    return apply_op(op, _as_np(a))
+
+
+def eigvalsh(a):
+    op = _op("linalg_eigvalsh", lambda x: _jla().eigvalsh(x))
+    return apply_op(op, _as_np(a))
+
+
+def solve(a, b):
+    op = _op("linalg_solve", lambda x, y: _jla().solve(x, y))
+    return apply_op(op, _as_np(a), _as_np(b))
+
+
+def lstsq(a, b, rcond=None):
+    import jax.numpy as jnp
+    res = _jla().lstsq(_as_np(a)._data, _as_np(b)._data, rcond=rcond)
+    return tuple(array(r) for r in res)
+
+
+def matrix_rank(a, tol=None):
+    op = _op("linalg_matrix_rank",
+             lambda x, tol: _jla().matrix_rank(x, tol=tol), nondiff=True)
+    return apply_op(op, _as_np(a), tol=tol)
+
+
+def tensorinv(a, ind=2):
+    op = _op("linalg_tensorinv",
+             lambda x, ind: _jla().tensorinv(x, ind=ind))
+    return apply_op(op, _as_np(a), ind=int(ind))
+
+
+def multi_dot(arrays):
+    op = _op("linalg_multi_dot", lambda *xs: _jla().multi_dot(xs))
+    return apply_op(op, *[_as_np(x) for x in arrays])
+
+
+def matrix_power(a, n):
+    op = _op("linalg_matrix_power",
+             lambda x, n: _jla().matrix_power(x, n))
+    return apply_op(op, _as_np(a), n=int(n))

@@ -1,0 +1,857 @@
+"""mxnet.numpy: NumPy-semantics array + function namespace.
+
+Reference: python/mxnet/numpy/multiarray.py (3,088 LoC) — a NumPy-compatible
+`ndarray` backed by the `_np_*` operator registrations
+(src/operator/numpy/, 3,762 LoC C++), with true scalars (0-d), boolean
+indexing, and NumPy broadcasting/naming conventions.
+
+TPU-native redesign: jax.numpy IS a NumPy-semantics tensor library, so each
+function here is one OpDef wrapping the jnp function, dispatched through
+ops/registry.apply_op — which gives autograd recording, the cached-jit eager
+fast path, AMP/profiler hooks, and class preservation (an `np.ndarray` input
+produces `np.ndarray` outputs through every registered op) without
+duplicating the op surface the way the reference does.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as _onp
+
+from ..base import MXNetError, dtype_np
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import OPS, OpDef, apply_op
+
+__all__ = ["ndarray", "array"]  # extended programmatically below
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array (reference numpy/multiarray.py `ndarray`).
+
+    Inherits the full NDArray surface; registry ops preserve this class, so
+    arithmetic/indexing/reductions all stay in the numpy namespace."""
+
+    __slots__ = ()
+
+    def as_nd_ndarray(self):
+        """View as classic nd.NDArray, preserving the autograd tape."""
+        return _rewrap(NDArray, self)
+
+    def as_np_ndarray(self):
+        return self
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __matmul__(self, other):
+        return matmul(self, _as_np(other))
+
+    def __rmatmul__(self, other):
+        return matmul(_as_np(other), self)
+
+    def __floordiv__(self, other):
+        return floor_divide(self, other)
+
+    def __rfloordiv__(self, other):
+        return floor_divide(_as_np(other), self)
+
+    def __repr__(self):
+        try:
+            return repr(self.asnumpy())
+        except Exception:
+            return f"<traced {self.shape} {self.dtype}>"
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return reshape(self, shape)
+
+    def flatten(self):
+        return reshape(self, (-1,))
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return transpose(self, axes=axes if axes else None)
+
+    def astype(self, dtype, copy=True):
+        from ..base import dtype_name
+        op = _op("astype", lambda x, *, dtype: x.astype(dtype))
+        return apply_op(op, self, dtype=dtype_name(dtype_np(dtype)))
+
+    def copy(self):
+        return _rewrap(ndarray, self)
+
+    # numpy comparisons return bool arrays (the classic nd namespace keeps
+    # MXNet's float-0/1 convention, reference multiarray.py __eq__)
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return equal(self, other)
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return not_equal(self, other)
+
+    def __lt__(self, other):
+        return less(self, other)
+
+    def __le__(self, other):
+        return less_equal(self, other)
+
+    def __gt__(self, other):
+        return greater(self, other)
+
+    def __ge__(self, other):
+        return greater_equal(self, other)
+
+    __hash__ = NDArray.__hash__
+
+
+def _rewrap(cls, arr):
+    """Re-class an array without breaking the autograd tape.
+
+    The tape routes cotangents by object identity (autograd.backward
+    out_refs), so a recorded intermediate must register the new view as an
+    alias of the original output slot or its gradient would be dropped."""
+    out = cls.__new__(cls)
+    out._data = arr._data
+    out._grad = arr._grad
+    out._grad_req = arr._grad_req
+    out._ag_node = arr._ag_node
+    if arr._ag_node is not None:
+        arr._ag_node.add_alias(arr, out)
+    return out
+
+
+def _as_np(x, dtype=None):
+    if isinstance(x, ndarray):
+        return x
+    if isinstance(x, NDArray):
+        return _rewrap(ndarray, x)
+    return ndarray(_jnp().asarray(x, dtype=dtype_np(dtype) if dtype else None))
+
+
+# ---------------------------------------------------------------------------
+# op plumbing: one cached OpDef per numpy function
+# ---------------------------------------------------------------------------
+
+_np_ops: dict = {}
+
+
+def _op(name, fn, nondiff=False):
+    op = _np_ops.get(name)
+    if op is None:
+        op = OpDef("_np_" + name, fn, nondiff=nondiff)
+        OPS.register(op, name="_np_" + name)
+        _np_ops[name] = op
+    return op
+
+
+def _unary(name, jfn=None, nondiff=False):
+    def func(x, out=None, **kwargs):
+        jnp = _jnp()
+        f = jfn if jfn is not None else getattr(jnp, name)
+        op = _op(name, lambda a, **kw: f(a, **kw), nondiff=nondiff)
+        return apply_op(op, _as_np(x), out=out, **kwargs)
+
+    func.__name__ = name
+    func.__doc__ = f"numpy.{name} semantics over jnp.{name}."
+    return func
+
+
+def _binary(name, jfn=None, nondiff=False):
+    def func(x1, x2, out=None, **kwargs):
+        jnp = _jnp()
+        f = jfn if jfn is not None else getattr(jnp, name)
+        op = _op(name, lambda a, b, **kw: f(a, b, **kw), nondiff=nondiff)
+        return apply_op(op, _as_np(x1), _as_np(x2), out=out, **kwargs)
+
+    func.__name__ = name
+    func.__doc__ = f"numpy.{name} semantics over jnp.{name}."
+    return func
+
+
+def _reduction(name, jfn=None, nondiff=False):
+    def func(a, axis=None, dtype=None, keepdims=False, out=None, **kwargs):
+        jnp = _jnp()
+        f = jfn if jfn is not None else getattr(jnp, name)
+        params = dict(kwargs)
+        if axis is not None:
+            params["axis"] = tuple(axis) if isinstance(axis, list) else axis
+        if dtype is not None:
+            params["dtype"] = dtype_np(dtype)
+        if keepdims:
+            params["keepdims"] = True
+        op = _op(name, lambda x, **kw: f(x, **kw), nondiff=nondiff)
+        return apply_op(op, _as_np(a), out=out, **params)
+
+    func.__name__ = name
+    return func
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def array(obj, dtype=None, ctx=None):
+    jnp = _jnp()
+    if isinstance(obj, NDArray):
+        obj = obj._data
+    return ndarray(jnp.asarray(obj, dtype=dtype_np(dtype) if dtype else None),
+                   ctx=ctx)
+
+
+def zeros(shape, dtype="float32", ctx=None):
+    return ndarray(_jnp().zeros(shape, dtype_np(dtype)), ctx=ctx)
+
+
+def ones(shape, dtype="float32", ctx=None):
+    return ndarray(_jnp().ones(shape, dtype_np(dtype)), ctx=ctx)
+
+
+def full(shape, fill_value, dtype=None, ctx=None):
+    return ndarray(_jnp().full(shape, fill_value,
+                               dtype_np(dtype) if dtype else None), ctx=ctx)
+
+
+def empty(shape, dtype="float32", ctx=None):
+    return zeros(shape, dtype=dtype, ctx=ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    return ndarray(_jnp().arange(start, stop, step,
+                                 dtype_np(dtype) if dtype else None), ctx=ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None):
+    return ndarray(_jnp().linspace(start, stop, num, endpoint=endpoint,
+                                   dtype=dtype_np(dtype) if dtype else None),
+                   ctx=ctx)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             ctx=None):
+    return ndarray(_jnp().logspace(start, stop, num, endpoint=endpoint,
+                                   base=base,
+                                   dtype=dtype_np(dtype) if dtype else None),
+                   ctx=ctx)
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None):
+    return ndarray(_jnp().eye(N, M, k, dtype_np(dtype)), ctx=ctx)
+
+
+def identity(n, dtype="float32", ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def zeros_like(a, dtype=None):
+    op = _op("zeros_like", lambda x, **kw: _jnp().zeros_like(x, **kw),
+             nondiff=True)
+    return apply_op(op, _as_np(a),
+                    **({"dtype": dtype_np(dtype)} if dtype else {}))
+
+
+def ones_like(a, dtype=None):
+    op = _op("ones_like", lambda x, **kw: _jnp().ones_like(x, **kw),
+             nondiff=True)
+    return apply_op(op, _as_np(a),
+                    **({"dtype": dtype_np(dtype)} if dtype else {}))
+
+
+def full_like(a, fill_value, dtype=None):
+    op = _op("full_like",
+             lambda x, **kw: _jnp().full_like(x, **kw), nondiff=True)
+    return apply_op(op, _as_np(a), fill_value=float(fill_value),
+                    **({"dtype": dtype_np(dtype)} if dtype else {}))
+
+
+def meshgrid(*xi, indexing="xy"):
+    op = _op("meshgrid",
+             lambda *xs, indexing: _jnp().meshgrid(*xs, indexing=indexing))
+    return apply_op(op, *[_as_np(x) for x in xi], indexing=indexing)
+
+
+def tri(N, M=None, k=0, dtype="float32", ctx=None):
+    return ndarray(_jnp().tri(N, M, k, dtype_np(dtype)), ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# math: unary / binary / reductions (generated)
+# ---------------------------------------------------------------------------
+
+_UNARY_DIFF = [
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh",
+    "tanh", "arcsinh", "arccosh", "arctanh", "exp", "expm1", "log", "log2",
+    "log10", "log1p", "sqrt", "cbrt", "square", "reciprocal", "negative",
+    "abs", "absolute", "fabs", "sign", "degrees", "radians", "deg2rad",
+    "rad2deg", "positive",
+]
+_UNARY_NONDIFF = [
+    "floor", "ceil", "trunc", "rint", "fix", "logical_not", "isnan",
+    "isinf", "isfinite", "isposinf", "isneginf", "signbit",
+]
+_BINARY_DIFF = [
+    "add", "subtract", "multiply", "divide", "true_divide", "power",
+    "maximum", "minimum", "fmax", "fmin", "arctan2", "hypot", "logaddexp",
+    "mod", "remainder", "fmod", "copysign", "float_power",
+]
+_BINARY_NONDIFF = [
+    "floor_divide", "equal", "not_equal", "less", "less_equal", "greater",
+    "greater_equal", "logical_and", "logical_or", "logical_xor", "lcm",
+    "gcd", "bitwise_and", "bitwise_or", "bitwise_xor", "left_shift",
+    "right_shift",
+]
+_REDUCE_DIFF = ["sum", "mean", "prod", "std", "var", "min", "max", "amin",
+                "amax", "cumsum", "cumprod", "nansum", "nanmean", "median"]
+_REDUCE_NONDIFF = ["argmin", "argmax", "all", "any", "nanargmin",
+                   "nanargmax", "count_nonzero"]
+
+for _n in _UNARY_DIFF:
+    globals()[_n] = _unary(_n)
+for _n in _UNARY_NONDIFF:
+    globals()[_n] = _unary(_n, nondiff=True)
+for _n in _BINARY_DIFF:
+    globals()[_n] = _binary(_n)
+for _n in _BINARY_NONDIFF:
+    globals()[_n] = _binary(_n, nondiff=True)
+for _n in _REDUCE_DIFF:
+    globals()[_n] = _reduction(_n)
+for _n in _REDUCE_NONDIFF:
+    globals()[_n] = _reduction(_n, nondiff=True)
+
+
+def invert(x, out=None):
+    return _unary("invert", nondiff=True)(x, out=out)
+
+
+bitwise_not = invert
+
+
+def round(x, decimals=0, out=None):  # noqa: A001
+    op = _op("round", lambda a, decimals: _jnp().round(a, decimals),
+             nondiff=True)
+    return apply_op(op, _as_np(x), out=out, decimals=int(decimals))
+
+
+around = round
+round_ = round
+
+
+def clip(a, a_min=None, a_max=None, out=None):
+    if isinstance(a_min, NDArray) or isinstance(a_max, NDArray):
+        # array bounds become op inputs (broadcastable, differentiable)
+        # None bounds pass straight through so integer inputs keep their
+        # dtype (an inf array bound would promote the result to float)
+        op3 = _op("clip_arr",
+                  lambda x, lo=None, hi=None: _jnp().clip(x, lo, hi))
+        args3 = [_as_np(a)]
+        if a_min is not None:
+            args3.append(_as_np(a_min))
+            if a_max is not None:
+                args3.append(_as_np(a_max))
+            return apply_op(op3, *args3, out=out)
+        # a_min is None here, and a_max must be set (the enclosing branch
+        # requires one array bound)
+        op_hi = _op("clip_arr_hi", lambda x, hi: _jnp().clip(x, None, hi))
+        return apply_op(op_hi, _as_np(a), _as_np(a_max), out=out)
+    # scalar bounds stay static params; keep the input dtype like numpy
+    op = _op("clip", lambda x, a_min, a_max:
+             _jnp().clip(x,
+                         None if a_min is None else _jnp().asarray(a_min, x.dtype),
+                         None if a_max is None else _jnp().asarray(a_max, x.dtype)))
+    return apply_op(op, _as_np(a), out=out,
+                    a_min=None if a_min is None else float(a_min),
+                    a_max=None if a_max is None else float(a_max))
+
+
+def average(a, axis=None, weights=None):
+    if weights is None:
+        return mean(a, axis=axis)
+    op = _op("average",
+             lambda x, w, axis: _jnp().average(x, axis=axis, weights=w))
+    return apply_op(op, _as_np(a), _as_np(weights),
+                    axis=axis if axis is None or isinstance(axis, int)
+                    else tuple(axis))
+
+
+def ptp(a, axis=None, keepdims=False):
+    return subtract(max(a, axis=axis, keepdims=keepdims),
+                    min(a, axis=axis, keepdims=keepdims))
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+def reshape(a, newshape, order="C"):
+    op = _op("reshape", lambda x, shape: _jnp().reshape(x, shape))
+    shape = tuple(newshape) if isinstance(newshape, (list, tuple)) \
+        else (newshape,)
+    return apply_op(op, _as_np(a), shape=shape)
+
+
+def transpose(a, axes=None):
+    op = _op("transpose", lambda x, axes: _jnp().transpose(x, axes))
+    return apply_op(op, _as_np(a),
+                    axes=None if axes is None else tuple(axes))
+
+
+def swapaxes(a, axis1, axis2):
+    op = _op("swapaxes",
+             lambda x, axis1, axis2: _jnp().swapaxes(x, axis1, axis2))
+    return apply_op(op, _as_np(a), axis1=int(axis1), axis2=int(axis2))
+
+
+def moveaxis(a, source, destination):
+    op = _op("moveaxis", lambda x, source, destination:
+             _jnp().moveaxis(x, source, destination))
+    t = lambda v: tuple(v) if isinstance(v, (list, tuple)) else int(v)
+    return apply_op(op, _as_np(a), source=t(source), destination=t(destination))
+
+
+def expand_dims(a, axis):
+    op = _op("expand_dims", lambda x, axis: _jnp().expand_dims(x, axis))
+    return apply_op(op, _as_np(a), axis=int(axis))
+
+
+def squeeze(a, axis=None):
+    op = _op("squeeze", lambda x, axis: _jnp().squeeze(x, axis))
+    return apply_op(op, _as_np(a),
+                    axis=None if axis is None else axis)
+
+
+def broadcast_to(a, shape):
+    op = _op("broadcast_to", lambda x, shape: _jnp().broadcast_to(x, shape))
+    return apply_op(op, _as_np(a), shape=tuple(shape))
+
+
+def ravel(a, order="C"):
+    return reshape(a, (-1,))
+
+
+def concatenate(seq, axis=0, out=None):
+    op = _op("concatenate",
+             lambda *xs, axis: _jnp().concatenate(xs, axis=axis))
+    return apply_op(op, *[_as_np(x) for x in seq], out=out,
+                    axis=None if axis is None else int(axis))
+
+
+def stack(arrays, axis=0, out=None):
+    op = _op("stack", lambda *xs, axis: _jnp().stack(xs, axis=axis))
+    return apply_op(op, *[_as_np(x) for x in arrays], out=out, axis=int(axis))
+
+
+def vstack(tup):
+    op = _op("vstack", lambda *xs: _jnp().vstack(xs))
+    return apply_op(op, *[_as_np(x) for x in tup])
+
+
+def hstack(tup):
+    op = _op("hstack", lambda *xs: _jnp().hstack(xs))
+    return apply_op(op, *[_as_np(x) for x in tup])
+
+
+def dstack(tup):
+    op = _op("dstack", lambda *xs: _jnp().dstack(xs))
+    return apply_op(op, *[_as_np(x) for x in tup])
+
+
+def column_stack(tup):
+    op = _op("column_stack", lambda *xs: _jnp().column_stack(xs))
+    return apply_op(op, *[_as_np(x) for x in tup])
+
+
+def split(ary, indices_or_sections, axis=0):
+    sec = indices_or_sections
+    sec = tuple(sec) if isinstance(sec, (list, tuple)) else int(sec)
+    op = _op("split", lambda x, sec, axis: _jnp().split(x, sec, axis))
+    return apply_op(op, _as_np(ary), sec=sec, axis=int(axis))
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    sec = indices_or_sections
+    sec = tuple(sec) if isinstance(sec, (list, tuple)) else int(sec)
+    op = _op("array_split",
+             lambda x, sec, axis: _jnp().array_split(x, sec, axis))
+    return apply_op(op, _as_np(ary), sec=sec, axis=int(axis))
+
+
+def hsplit(ary, indices_or_sections):
+    return split(ary, indices_or_sections, axis=1)
+
+
+def vsplit(ary, indices_or_sections):
+    return split(ary, indices_or_sections, axis=0)
+
+
+def flip(m, axis=None):
+    op = _op("flip", lambda x, axis: _jnp().flip(x, axis))
+    return apply_op(op, _as_np(m),
+                    axis=None if axis is None else axis)
+
+
+def flipud(m):
+    return flip(m, 0)
+
+
+def fliplr(m):
+    return flip(m, 1)
+
+
+def roll(a, shift, axis=None):
+    t = lambda v: tuple(v) if isinstance(v, (list, tuple)) else v
+    op = _op("roll", lambda x, shift, axis: _jnp().roll(x, shift, axis))
+    return apply_op(op, _as_np(a), shift=t(shift), axis=t(axis))
+
+
+def rot90(m, k=1, axes=(0, 1)):
+    op = _op("rot90", lambda x, k, axes: _jnp().rot90(x, k, axes))
+    return apply_op(op, _as_np(m), k=int(k), axes=tuple(axes))
+
+
+def tile(A, reps):
+    op = _op("tile", lambda x, reps: _jnp().tile(x, reps))
+    return apply_op(op, _as_np(A),
+                    reps=tuple(reps) if isinstance(reps, (list, tuple))
+                    else int(reps))
+
+
+def repeat(a, repeats, axis=None):
+    op = _op("repeat", lambda x, repeats, axis: _jnp().repeat(x, repeats, axis))
+    reps = tuple(int(r) for r in repeats) \
+        if isinstance(repeats, (list, tuple, _onp.ndarray)) else int(repeats)
+    return apply_op(op, _as_np(a), repeats=reps,
+                    axis=None if axis is None else int(axis))
+
+
+def pad(array_, pad_width, mode="constant", **kwargs):
+    def _fn(x, pad_width, mode, kw):
+        return _jnp().pad(x, pad_width, mode=mode, **dict(kw))
+    op = _op("pad", _fn)
+    pw = tuple(tuple(p) if isinstance(p, (list, tuple)) else p
+               for p in pad_width) if isinstance(pad_width, (list, tuple)) \
+        else pad_width
+    return apply_op(op, _as_np(array_), pad_width=pw, mode=mode,
+                    kw=tuple(sorted(kwargs.items())))
+
+
+def atleast_1d(*arys):
+    res = [reshape(a, (1,)) if _as_np(a).ndim == 0 else _as_np(a)
+           for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_2d(*arys):
+    op = _op("atleast_2d", lambda x: _jnp().atleast_2d(x))
+    res = [apply_op(op, _as_np(a)) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_3d(*arys):
+    op = _op("atleast_3d", lambda x: _jnp().atleast_3d(x))
+    res = [apply_op(op, _as_np(a)) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+# ---------------------------------------------------------------------------
+# linear algebra / products
+# ---------------------------------------------------------------------------
+
+def dot(a, b, out=None):
+    op = _op("dot", lambda x, y: _jnp().dot(x, y))
+    return apply_op(op, _as_np(a), _as_np(b), out=out)
+
+
+def matmul(a, b, out=None):
+    op = _op("matmul", lambda x, y: _jnp().matmul(x, y))
+    return apply_op(op, _as_np(a), _as_np(b), out=out)
+
+
+def inner(a, b):
+    op = _op("inner", lambda x, y: _jnp().inner(x, y))
+    return apply_op(op, _as_np(a), _as_np(b))
+
+
+def outer(a, b):
+    op = _op("outer", lambda x, y: _jnp().outer(x, y))
+    return apply_op(op, _as_np(a), _as_np(b))
+
+
+def vdot(a, b):
+    op = _op("vdot", lambda x, y: _jnp().vdot(x, y))
+    return apply_op(op, _as_np(a), _as_np(b))
+
+
+def cross(a, b, axis=-1):
+    op = _op("cross", lambda x, y, axis: _jnp().cross(x, y, axis=axis))
+    return apply_op(op, _as_np(a), _as_np(b), axis=int(axis))
+
+
+def kron(a, b):
+    op = _op("kron", lambda x, y: _jnp().kron(x, y))
+    return apply_op(op, _as_np(a), _as_np(b))
+
+
+def tensordot(a, b, axes=2):
+    ax = tuple(tuple(x) if isinstance(x, (list, tuple)) else x for x in axes) \
+        if isinstance(axes, (list, tuple)) else int(axes)
+    op = _op("tensordot", lambda x, y, axes: _jnp().tensordot(x, y, axes))
+    return apply_op(op, _as_np(a), _as_np(b), axes=ax)
+
+
+def einsum(subscripts, *operands):
+    op = _op("einsum",
+             lambda *xs, subscripts: _jnp().einsum(subscripts, *xs))
+    return apply_op(op, *[_as_np(x) for x in operands], subscripts=subscripts)
+
+
+def trace(a, offset=0, axis1=0, axis2=1):
+    op = _op("trace", lambda x, offset, axis1, axis2:
+             _jnp().trace(x, offset, axis1, axis2))
+    return apply_op(op, _as_np(a), offset=int(offset), axis1=int(axis1),
+                    axis2=int(axis2))
+
+
+def diag(v, k=0):
+    op = _op("diag", lambda x, k: _jnp().diag(x, k))
+    return apply_op(op, _as_np(v), k=int(k))
+
+
+def diagonal(a, offset=0, axis1=0, axis2=1):
+    op = _op("diagonal", lambda x, offset, axis1, axis2:
+             _jnp().diagonal(x, offset, axis1, axis2))
+    return apply_op(op, _as_np(a), offset=int(offset), axis1=int(axis1),
+                    axis2=int(axis2))
+
+
+def tril(m, k=0):
+    op = _op("tril", lambda x, k: _jnp().tril(x, k))
+    return apply_op(op, _as_np(m), k=int(k))
+
+
+def triu(m, k=0):
+    op = _op("triu", lambda x, k: _jnp().triu(x, k))
+    return apply_op(op, _as_np(m), k=int(k))
+
+
+# ---------------------------------------------------------------------------
+# indexing / selection / sorting
+# ---------------------------------------------------------------------------
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    op = _op("where", lambda c, a, b: _jnp().where(c, a, b))
+    return apply_op(op, _as_np(condition), _as_np(x), _as_np(y))
+
+
+def take(a, indices, axis=None, mode="clip"):
+    op = _op("take", lambda x, idx, axis, mode:
+             _jnp().take(x, idx.astype("int32"), axis=axis, mode=mode))
+    return apply_op(op, _as_np(a), _as_np(indices),
+                    axis=None if axis is None else int(axis), mode=mode)
+
+
+def take_along_axis(arr, indices, axis):
+    op = _op("take_along_axis", lambda x, idx, axis:
+             _jnp().take_along_axis(x, idx.astype("int32"), axis=axis))
+    return apply_op(op, _as_np(arr), _as_np(indices), axis=int(axis))
+
+
+def sort(a, axis=-1):
+    op = _op("sort", lambda x, axis: _jnp().sort(x, axis=axis))
+    return apply_op(op, _as_np(a), axis=None if axis is None else int(axis))
+
+
+def argsort(a, axis=-1):
+    op = _op("argsort", lambda x, axis: _jnp().argsort(x, axis=axis),
+             nondiff=True)
+    return apply_op(op, _as_np(a), axis=None if axis is None else int(axis))
+
+
+def searchsorted(a, v, side="left"):
+    op = _op("searchsorted", lambda x, vv, side:
+             _jnp().searchsorted(x, vv, side=side), nondiff=True)
+    return apply_op(op, _as_np(a), _as_np(v), side=side)
+
+
+def nonzero(a):
+    """Data-dependent output shape: eager-only (concretizes)."""
+    res = _onp.nonzero(_as_np(a).asnumpy())
+    return tuple(array(r, dtype="int64") for r in res)
+
+
+def flatnonzero(a):
+    return nonzero(ravel(a))[0]
+
+
+def unique(ar, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    """Data-dependent output shape: eager-only (concretizes)."""
+    res = _onp.unique(_as_np(ar).asnumpy(), return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(array(r) for r in res)
+    return array(res)
+
+
+def one_hot(indices, depth, dtype="float32"):
+    import jax
+    op = _op("one_hot", lambda idx, depth, dtype:
+             jax.nn.one_hot(idx.astype("int32"), depth, dtype=dtype),
+             nondiff=True)
+    return apply_op(op, _as_np(indices), depth=int(depth),
+                    dtype=dtype_np(dtype))
+
+
+def histogram(a, bins=10, range=None):  # noqa: A002
+    jnp = _jnp()
+    h, e = jnp.histogram(_as_np(a)._data, bins=bins, range=range)
+    return array(h), array(e)
+
+
+def bincount(x, weights=None, minlength=0):
+    op = _op("bincount", lambda a, minlength:
+             _jnp().bincount(a.astype("int32"), length=None,
+                             minlength=minlength), nondiff=True)
+    if weights is not None:
+        jnp = _jnp()
+        return array(jnp.bincount(_as_np(x)._data.astype("int32"),
+                                  weights=_as_np(weights)._data,
+                                  minlength=minlength))
+    return apply_op(op, _as_np(x), minlength=int(minlength))
+
+
+def isclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    op = _op("isclose", lambda x, y, rtol, atol, equal_nan:
+             _jnp().isclose(x, y, rtol, atol, equal_nan), nondiff=True)
+    return apply_op(op, _as_np(a), _as_np(b), rtol=float(rtol),
+                    atol=float(atol), equal_nan=bool(equal_nan))
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return builtins.bool(
+        _onp.allclose(_as_np(a).asnumpy(), _as_np(b).asnumpy(),
+                      rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def array_equal(a1, a2):
+    return builtins.bool(_onp.array_equal(_as_np(a1).asnumpy(),
+                                          _as_np(a2).asnumpy()))
+
+
+def interp(x, xp, fp):
+    op = _op("interp", lambda a, b, c: _jnp().interp(a, b, c))
+    return apply_op(op, _as_np(x), _as_np(xp), _as_np(fp))
+
+
+def diff(a, n=1, axis=-1):
+    op = _op("diff", lambda x, n, axis: _jnp().diff(x, n=n, axis=axis))
+    return apply_op(op, _as_np(a), n=int(n), axis=int(axis))
+
+
+def gradient(f, *varargs, axis=None):
+    jnp = _jnp()
+    res = jnp.gradient(_as_np(f)._data, *varargs,
+                       **({} if axis is None else {"axis": axis}))
+    if isinstance(res, list):
+        return [array(r) for r in res]
+    return array(res)
+
+
+def maximum_sctype(t):
+    return _onp.float64
+
+
+def may_share_memory(a, b):
+    return False  # jax buffers are immutable; writes never alias
+
+
+def shares_memory(a, b):
+    return False
+
+
+# ---------------------------------------------------------------------------
+# misc API surface
+# ---------------------------------------------------------------------------
+
+def shape(a):
+    return _as_np(a).shape
+
+
+def ndim(a):
+    return _as_np(a).ndim
+
+
+def size(a, axis=None):
+    if axis is None:
+        return _as_np(a).size
+    return _as_np(a).shape[axis]
+
+
+def copy(a):
+    return _as_np(a).copy()
+
+
+def asarray(a, dtype=None):
+    return _as_np(a, dtype=dtype)
+
+
+def ascontiguousarray(a, dtype=None):
+    return _as_np(a, dtype=dtype)
+
+
+# dtype aliases + constants re-exported for mx.np.float32-style use
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+dtype = _onp.dtype
+
+_GENERATED = (_UNARY_DIFF + _UNARY_NONDIFF + _BINARY_DIFF + _BINARY_NONDIFF +
+              _REDUCE_DIFF + _REDUCE_NONDIFF)
+__all__ += _GENERATED + [
+    "zeros", "ones", "full", "empty", "arange", "linspace", "logspace",
+    "eye", "identity", "zeros_like", "ones_like", "full_like", "meshgrid",
+    "tri", "invert", "bitwise_not", "round", "around", "round_", "clip",
+    "average", "ptp", "reshape", "transpose", "swapaxes", "moveaxis",
+    "expand_dims", "squeeze", "broadcast_to", "ravel", "concatenate",
+    "stack", "vstack", "hstack", "dstack", "column_stack", "split",
+    "array_split", "hsplit", "vsplit", "flip", "flipud", "fliplr", "roll",
+    "rot90", "tile", "repeat", "pad", "atleast_1d", "atleast_2d",
+    "atleast_3d", "dot", "matmul", "inner", "outer", "vdot", "cross",
+    "kron", "tensordot", "einsum", "trace", "diag", "diagonal", "tril",
+    "triu", "where", "take", "take_along_axis", "sort", "argsort",
+    "searchsorted", "nonzero", "flatnonzero", "unique", "one_hot",
+    "histogram", "bincount", "isclose", "allclose", "array_equal", "interp",
+    "diff", "gradient", "shape", "ndim", "size", "copy", "asarray",
+    "ascontiguousarray", "float16", "float32", "float64", "int8", "int16",
+    "int32", "int64", "uint8", "bool_", "pi", "e", "inf", "nan", "newaxis",
+    "dtype",
+]

@@ -1,0 +1,229 @@
+"""mxnet.numpy.random (reference python/mxnet/numpy/random.py).
+
+Samplers ride the framework key chain (ndarray/random.py next_key) as
+stateful registry ops, so they are reproducible under mx.random.seed and
+trace-safe inside hybridized blocks. Distribution parameters are passed as
+traced array inputs (scalars coerced to 0-d arrays), so the jit cache is
+keyed on shapes only — changing `loc`/`scale` never recompiles."""
+from __future__ import annotations
+
+from ..base import dtype_np
+from ..ops.registry import OPS, OpDef, apply_op
+from .multiarray import _as_np, _np_ops, ndarray
+
+__all__ = ["uniform", "normal", "randn", "rand", "randint", "choice",
+           "shuffle", "permutation", "beta", "gamma", "exponential",
+           "chisquare", "multinomial", "multivariate_normal", "lognormal",
+           "laplace", "gumbel", "logistic", "pareto", "power", "rayleigh",
+           "weibull", "seed"]
+
+
+def _op_stateful(name, fn):
+    key = "random_" + name
+    op = _np_ops.get(key)
+    if op is None:
+        op = OpDef("_npi_random_" + name, fn, stateful=True)
+        OPS.register(op, name="_npi_random_" + name)
+        _np_ops[key] = op
+    return op
+
+
+def _size(size):
+    if size is None:
+        return None
+    return (size,) if isinstance(size, int) else tuple(size)
+
+
+def seed(s):
+    from ..ndarray import random as _r
+    _r.seed(s)
+
+
+def _jr():
+    import jax
+    return jax.random
+
+
+def _shape_of(shape, *params):
+    """Output shape: explicit `size`, else broadcast of parameter shapes."""
+    if shape is not None:
+        return shape
+    import numpy as _onp
+    return _onp.broadcast_shapes(*[p.shape for p in params]) if params else ()
+
+
+def _two_param(name, sample):
+    """Samplers of the form loc/scale (or low/high): out = sample over
+    broadcast shape, parameters traced."""
+
+    def func(arg1=0.0, arg2=1.0, size=None, dtype="float32", ctx=None):
+        def fn(p1, p2, *, rng, shape, dtype):
+            out_shape = _shape_of(shape, p1, p2)
+            return sample(rng, p1, p2, out_shape, dtype)
+
+        op = _op_stateful(name, fn)
+        return apply_op(op, _as_np(arg1, dtype=dtype), _as_np(arg2, dtype=dtype),
+                        shape=_size(size), dtype=dtype_np(dtype))
+
+    func.__name__ = name
+    return func
+
+
+def _one_param(name, sample):
+    def func(arg1=1.0, size=None, dtype="float32", ctx=None):
+        def fn(p1, *, rng, shape, dtype):
+            return sample(rng, p1, _shape_of(shape, p1), dtype)
+
+        op = _op_stateful(name, fn)
+        return apply_op(op, _as_np(arg1, dtype=dtype), shape=_size(size),
+                        dtype=dtype_np(dtype))
+
+    func.__name__ = name
+    return func
+
+
+def _exp(x):
+    import jax.numpy as jnp
+    return jnp.exp(x)
+
+
+uniform = _two_param(
+    "uniform", lambda rng, lo, hi, s, dt:
+    _jr().uniform(rng, s, dt) * (hi - lo) + lo)
+normal = _two_param(
+    "normal", lambda rng, loc, sc, s, dt:
+    _jr().normal(rng, s, dt) * sc + loc)
+laplace = _two_param(
+    "laplace", lambda rng, loc, sc, s, dt:
+    _jr().laplace(rng, s, dt) * sc + loc)
+gumbel = _two_param(
+    "gumbel", lambda rng, loc, sc, s, dt:
+    _jr().gumbel(rng, s, dt) * sc + loc)
+logistic = _two_param(
+    "logistic", lambda rng, loc, sc, s, dt:
+    _jr().logistic(rng, s, dt) * sc + loc)
+lognormal = _two_param(
+    "lognormal", lambda rng, mean, sig, s, dt:
+    _exp(_jr().normal(rng, s, dt) * sig + mean))
+beta = _two_param(
+    "beta", lambda rng, a, b, s, dt: _jr().beta(rng, a, b, s, dt))
+exponential = _one_param(
+    "exponential", lambda rng, sc, s, dt:
+    _jr().exponential(rng, s, dt) * sc)
+rayleigh = _one_param(
+    "rayleigh", lambda rng, sc, s, dt: _jr().rayleigh(rng, s, dt) * sc)
+pareto = _one_param(
+    "pareto", lambda rng, a, s, dt: _jr().pareto(rng, a, s, dt) - 1.0)
+power = _one_param(
+    "power", lambda rng, a, s, dt: _jr().uniform(rng, s, dt) ** (1.0 / a))
+weibull = _one_param(
+    "weibull", lambda rng, a, s, dt:
+    (-_log_u(rng, s, dt)) ** (1.0 / a))
+chisquare = _one_param(
+    "chisquare", lambda rng, df, s, dt: _jr().chisquare(rng, df, s, dt))
+
+
+def _log_u(rng, s, dt):
+    import jax.numpy as jnp
+    return jnp.log1p(-_jr().uniform(rng, s, dt))
+
+
+def gamma(shape, scale=1.0, size=None, dtype="float32", ctx=None):
+    def fn(a, sc, *, rng, shape, dtype):
+        return _jr().gamma(rng, a, _shape_of(shape, a, sc), dtype) * sc
+
+    op = _op_stateful("gamma", fn)
+    return apply_op(op, _as_np(shape, dtype=dtype), _as_np(scale, dtype=dtype),
+                    shape=_size(size), dtype=dtype_np(dtype))
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None):
+    if high is None:
+        low, high = 0, low
+
+    def fn(*, rng, shape, dtype, low, high):
+        return _jr().randint(rng, shape or (), low, high, dtype)
+
+    op = _op_stateful("randint", fn)
+    return _as_np(apply_op(op, shape=_size(size), dtype=dtype_np(dtype),
+                           low=int(low), high=int(high)))
+
+
+def rand(*size):
+    return uniform(0.0, 1.0, size=size or None)
+
+
+def randn(*size):
+    return normal(0.0, 1.0, size=size or None)
+
+
+def choice(a, size=None, replace=True, p=None):
+    if hasattr(a, "_data") or not isinstance(a, int):
+        pool = _as_np(a)
+        if p is not None:
+            def fn(arr, pp, *, rng, shape, replace):
+                return _jr().choice(rng, arr, shape or (), replace=replace,
+                                    p=pp)
+            op = _op_stateful("choice_arr_p", fn)
+            return apply_op(op, pool, _as_np(p), shape=_size(size),
+                            replace=bool(replace))
+
+        def fn(arr, *, rng, shape, replace):
+            return _jr().choice(rng, arr, shape or (), replace=replace)
+        op = _op_stateful("choice_arr", fn)
+        return apply_op(op, pool, shape=_size(size), replace=bool(replace))
+
+    if p is not None:
+        def fn(pp, *, rng, shape, replace, n):
+            return _jr().choice(rng, n, shape or (), replace=replace, p=pp)
+        op = _op_stateful("choice_n_p", fn)
+        return apply_op(op, _as_np(p), shape=_size(size),
+                        replace=bool(replace), n=int(a))
+
+    def fn(*, rng, shape, replace, n):
+        return _jr().choice(rng, n, shape or (), replace=replace)
+    op = _op_stateful("choice_n", fn)
+    return _as_np(apply_op(op, shape=_size(size), replace=bool(replace),
+                           n=int(a)))
+
+
+def shuffle(x):
+    """In-place permutation along axis 0 (matches reference semantics)."""
+    def fn(a, *, rng):
+        return _jr().permutation(rng, a, axis=0)
+
+    op = _op_stateful("shuffle", fn)
+    out = apply_op(op, _as_np(x))
+    x._data = out._data
+    return None
+
+
+def permutation(x):
+    if isinstance(x, int):
+        def fn(*, rng, n):
+            return _jr().permutation(rng, n)
+        op = _op_stateful("permutation_n", fn)
+        return _as_np(apply_op(op, n=int(x)))
+
+    def fn(a, *, rng):
+        return _jr().permutation(rng, a, axis=0)
+    op = _op_stateful("permutation", fn)
+    return apply_op(op, _as_np(x))
+
+
+def multinomial(n, pvals, size=None):
+    def fn(p, *, rng, shape, n):
+        import jax
+        return jax.random.multinomial(
+            rng, n, p, shape=(shape + p.shape) if shape else None)
+
+    op = _op_stateful("multinomial", fn)
+    return apply_op(op, _as_np(pvals), shape=_size(size), n=int(n))
+
+
+def multivariate_normal(mean, cov, size=None):
+    def fn(m, c, *, rng, shape):
+        return _jr().multivariate_normal(rng, m, c, shape)
+
+    op = _op_stateful("multivariate_normal", fn)
+    return apply_op(op, _as_np(mean), _as_np(cov), shape=_size(size))

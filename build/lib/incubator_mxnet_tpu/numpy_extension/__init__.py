@@ -1,0 +1,161 @@
+"""mxnet.numpy_extension (`npx`): framework extensions to the numpy
+namespace (reference python/mxnet/numpy_extension/ — neural-net ops,
+np-semantics switches, device helpers).
+
+The nn ops bridge to the same registered operators the nd/gluon layers use
+(ops/nn_ops.py, ops/tensor_ops.py); because registry outputs are
+class-preserving, np.ndarray in -> np.ndarray out."""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..context import cpu, gpu, num_gpus, tpu  # noqa: F401
+from ..ops.registry import get_op, apply_op
+from ..numpy.multiarray import _as_np, ndarray  # noqa: F401
+from ..util import (is_np_array, is_np_shape, np_array, np_shape,  # noqa: F401
+                    reset_np, set_np, set_np_shape, use_np, use_np_shape)
+
+__all__ = ["softmax", "log_softmax", "sigmoid", "relu", "leaky_relu",
+           "activation", "fully_connected", "convolution", "pooling",
+           "batch_norm", "layer_norm", "dropout", "embedding", "one_hot",
+           "pick", "topk", "reshape_like", "arange_like", "gamma",
+           "sequence_mask", "seed", "save", "load", "waitall",
+           "set_np", "reset_np", "is_np_array", "is_np_shape", "cpu", "gpu",
+           "tpu", "num_gpus"]
+
+
+def _bridge(op_name, *arrays, **params):
+    arrs = [_as_np(a) if not isinstance(a, ndarray) else a for a in arrays]
+    return apply_op(get_op(op_name), *arrs, **params)
+
+
+def softmax(data, axis=-1, temperature=None):
+    p = {"axis": axis}
+    if temperature is not None:
+        p["temperature"] = temperature
+    return _bridge("softmax", data, **p)
+
+
+def log_softmax(data, axis=-1):
+    return _bridge("log_softmax", data, axis=axis)
+
+
+def sigmoid(data):
+    return _bridge("sigmoid", data)
+
+
+def relu(data):
+    return _bridge("relu", data)
+
+
+def leaky_relu(data, act_type="leaky", slope=0.25):
+    return _bridge("LeakyReLU", data, act_type=act_type, slope=slope)
+
+
+def activation(data, act_type="relu"):
+    return _bridge("Activation", data, act_type=act_type)
+
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    if bias is None or no_bias:
+        return _bridge("FullyConnected", x, weight,
+                       num_hidden=num_hidden or weight.shape[0],
+                       no_bias=True, flatten=flatten)
+    return _bridge("FullyConnected", x, weight, bias,
+                   num_hidden=num_hidden or weight.shape[0],
+                   no_bias=False, flatten=flatten)
+
+
+def convolution(data, weight, bias=None, **params):
+    if bias is None:
+        return _bridge("Convolution", data, weight, no_bias=True, **params)
+    return _bridge("Convolution", data, weight, bias, **params)
+
+
+def pooling(data, **params):
+    return _bridge("Pooling", data, **params)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, **params):
+    return _bridge("BatchNorm", x, gamma, beta, running_mean, running_var,
+                   **params)
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    return _bridge("LayerNorm", data, gamma, beta, axis=axis, eps=eps)
+
+
+def dropout(data, p=0.5, **params):
+    return _bridge("Dropout", data, p=p, **params)
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, **params):
+    return _bridge("Embedding", data, weight,
+                   input_dim=input_dim or weight.shape[0],
+                   output_dim=output_dim or weight.shape[1], **params)
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _bridge("one_hot", data, depth=depth, on_value=on_value,
+                   off_value=off_value, dtype=dtype)
+
+
+def pick(data, index, axis=-1, keepdims=False):
+    return _bridge("pick", data, index, axis=axis, keepdims=keepdims)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    return _bridge("topk", data, axis=axis, k=k, ret_typ=ret_typ,
+                   is_ascend=is_ascend)
+
+
+def reshape_like(lhs, rhs):
+    from ..numpy import reshape
+    return reshape(_as_np(lhs), rhs.shape)
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    """Reference npx.arange_like: values laid out over data's full shape
+    (row-major) when axis is None, else a 1-D ramp of data.shape[axis]."""
+    import jax.numpy as jnp
+    if axis is None:
+        ramp = jnp.arange(data.size, dtype="float32") * step + start
+        return ndarray(ramp.reshape(data.shape))
+    n = data.shape[axis]
+    return ndarray(jnp.arange(n, dtype="float32") * step + start)
+
+
+def gamma(data):
+    return _bridge("gamma", data)
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if sequence_length is not None:
+        return _bridge("SequenceMask", data, sequence_length,
+                       use_sequence_length=True, value=value, axis=axis)
+    return _bridge("SequenceMask", data, use_sequence_length=False,
+                   value=value, axis=axis)
+
+
+def seed(s):
+    from ..ndarray import random as _r
+    _r.seed(s)
+
+
+def save(fname, arrays):
+    from ..ndarray.utils import save as _save
+    return _save(fname, arrays)
+
+
+def load(fname):
+    from ..ndarray.utils import load as _load
+    out = _load(fname)
+    if isinstance(out, dict):
+        return {k: _as_np(v) for k, v in out.items()}
+    return [_as_np(v) for v in out]
+
+
+def waitall():
+    from ..ndarray import waitall as _w
+    return _w()

@@ -1,0 +1,154 @@
+"""Custom operator framework: user-defined Python ops with autograd.
+
+Reference: python/mxnet/operator.py (1,160 LoC — CustomOp/CustomOpProp/
+register) + src/operator/custom/ (the CustomOperator singleton runs Python
+callbacks on its own worker thread so the GIL never blocks engine workers,
+custom-inl.h:52).
+
+TPU-native redesign: there is no engine thread to protect — eager dispatch
+is already host-side Python, so a custom op runs inline. The tape hook is
+the same one every registry op uses (autograd.Node), so custom backward
+composes with the rest of the graph. Custom ops are host-side by nature
+(arbitrary Python); inside a jit trace they are rejected with a clear
+error, mirroring the reference's constraint that custom ops break graph
+fusion boundaries.
+"""
+from __future__ import annotations
+
+import weakref
+
+from .base import MXNetError, Registry
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_CUSTOM = Registry("custom_op")
+
+
+class CustomOp:
+    """Base for user ops (reference operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring the OpReqType (reference
+        operator.py CustomOp.assign)."""
+        if req == "null":
+            return
+        src_data = src._data if hasattr(src, "_data") else src
+        if req in ("write", "inplace"):
+            dst._data = src_data
+        elif req == "add":
+            dst._data = dst._data + src_data
+        else:
+            raise MXNetError(f"invalid req {req!r}")
+
+
+class CustomOpProp:
+    """Op metadata + factory (reference operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Decorator: @operator.register("my_op") on a CustomOpProp subclass
+    (reference operator.py register)."""
+
+    def _do(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() expects a CustomOpProp subclass")
+        _CUSTOM.register(prop_cls, name=reg_name)
+        return prop_cls
+
+    return _do
+
+
+def get_all_registered():
+    return _CUSTOM.keys()
+
+
+def invoke_custom(*data, op_type, **kwargs):
+    """`nd.Custom(*data, op_type=...)` entry (reference: the `Custom` op,
+    src/operator/custom/custom.cc)."""
+    import jax
+
+    from . import autograd
+    from .ndarray import NDArray, zeros
+
+    if any(isinstance(getattr(a, "_data", a), jax.core.Tracer) for a in data):
+        raise MXNetError(
+            "custom ops run host-side Python and cannot be traced into a "
+            "compiled graph; call them eagerly (reference custom ops have "
+            "the same fusion-boundary constraint)")
+    prop_cls = _CUSTOM.get(op_type)
+    prop = prop_cls(**kwargs)
+    arg_names = prop.list_arguments()
+    if len(data) != len(arg_names):
+        raise MXNetError(f"{op_type} expects {len(arg_names)} inputs "
+                         f"({arg_names}), got {len(data)}")
+    in_shapes = [list(a.shape) for a in data]
+    in_shapes, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    in_types, out_types, aux_types = prop.infer_type(
+        [a.dtype for a in data])
+    op = prop.create_operator(None, in_shapes, in_types)
+
+    in_data = list(data)
+    out_data = [zeros(tuple(s), dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+    aux = [zeros(tuple(s), dtype=t)
+           for s, t in zip(aux_shapes, aux_types)]
+
+    op.forward(is_train=autograd.is_training() or autograd.is_recording(),
+               req=["write"] * len(out_data), in_data=in_data,
+               out_data=out_data, aux=aux)
+
+    if autograd.is_recording():
+        saved_out = [NDArray(o._data) for o in out_data]
+
+        def node_vjp(cts):
+            cts_t = cts if isinstance(cts, tuple) else (cts,)
+            out_grad = [NDArray(c) for c in cts_t]
+            in_grad = [zeros(a.shape, dtype=a.dtype) for a in in_data]
+            op.backward(req=["write"] * len(in_grad), out_grad=out_grad,
+                        in_data=in_data, out_data=saved_out,
+                        in_grad=in_grad, aux=aux)
+            return tuple(g._data for g in in_grad)
+
+        node = autograd.Node(node_vjp, list(in_data), f"custom_{op_type}")
+        node.out_refs = [weakref.ref(o) for o in out_data]
+        node.out_avals = [(o.shape, o.dtype) for o in out_data]
+        for o in out_data:
+            o._ag_node = node
+
+    return out_data[0] if len(out_data) == 1 else out_data

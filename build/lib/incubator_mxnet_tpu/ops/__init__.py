@@ -1,0 +1,10 @@
+"""Operator library (TPU-native re-design of src/operator/, see SURVEY.md §2.2).
+
+Submodules register ops into `registry.OPS`; the `nd` and `sym` namespaces
+expose them. Import order matters only in that registration must happen before
+namespace lookup — handled by ndarray/__init__.py.
+"""
+from . import registry
+from .registry import OPS, OpDef, apply_op, get_op, invoke, register
+
+__all__ = ["registry", "OPS", "OpDef", "apply_op", "get_op", "invoke", "register"]

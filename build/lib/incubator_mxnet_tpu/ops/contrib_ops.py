@@ -1,0 +1,849 @@
+"""Contrib ops: SSD detection family, ROI align, NMS, misc.
+
+Reference: src/operator/contrib/ (21,184 LoC) — multibox_prior/target/
+detection.cc (SSD anchors/matching/decode), bounding_box.cc (box_nms),
+roi_align.cc, adaptive_avg_pooling.cc, index_copy.cc.
+
+TPU-native design: everything is static-shape. NMS is a fixed-N greedy
+sweep (pairwise IoU matrix + lax.fori_loop mask updates) instead of the
+reference's dynamic workspace sort; suppressed entries become -1 exactly
+like the reference's output convention, so downstream slicing code ports
+unchanged.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior (reference multibox_prior.cc)
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",),
+          nondiff=True)
+def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes per feature-map cell: len(sizes)+len(ratios)-1 anchors,
+    corner format, normalized. Returns (1, H*W*A, 4)."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # H, W, 2
+
+    whs = []
+    for s in sizes:
+        whs.append((s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        whs.append((sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r)))
+    wh = jnp.asarray(whs, jnp.float32)  # A, 2 (w, h)
+
+    c = cyx[:, :, None, :]  # H, W, 1, 2 (cy, cx)
+    half_w = wh[None, None, :, 0] / 2
+    half_h = wh[None, None, :, 1] / 2
+    xmin = c[..., 1] - half_w
+    ymin = c[..., 0] - half_h
+    xmax = c[..., 1] + half_w
+    ymax = c[..., 0] + half_h
+    out = jnp.stack([xmin, ymin, xmax, ymax], -1).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _iou_corner(a, b):
+    """Pairwise IoU; a: (N, 4), b: (M, 4) corner format -> (N, M)."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, -1)
+    bx1, by1, bx2, by2 = (b[:, i] for i in range(4))
+    ix1 = jnp.maximum(ax1, bx1)
+    iy1 = jnp.maximum(ay1, by1)
+    ix2 = jnp.minimum(ax2, bx2)
+    iy2 = jnp.minimum(ay2, by2)
+    iw = jnp.maximum(ix2 - ix1, 0)
+    ih = jnp.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0)
+    area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget (reference multibox_target.cc)
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+          nondiff=True)
+def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets. anchor (1,N,4); label (B,M,5) rows
+    [cls, xmin, ymin, xmax, ymax] padded with -1; cls_pred (B,C,N).
+    Returns (box_target (B,N*4), box_mask (B,N*4), cls_target (B,N)).
+
+    With negative_mining_ratio > 0, unmatched anchors are hard-mined by
+    foreground confidence: the top max(ratio*num_pos, minimum_negative_
+    samples) stay background (0), the rest get ignore_label (reference
+    multibox_target.cc hard-negative path)."""
+    anchors = anchor[0]  # N, 4
+    N = anchors.shape[0]
+    v = jnp.asarray(variances, jnp.float32)
+
+    def per_batch(lab, pred):
+        valid = lab[:, 0] >= 0  # M
+        gt = lab[:, 1:5]
+        ious = _iou_corner(anchors, gt)  # N, M
+        ious = jnp.where(valid[None, :], ious, -1.0)
+        best_gt = jnp.argmax(ious, axis=1)          # per-anchor best gt
+        best_iou = jnp.max(ious, axis=1)
+        # force-match: each gt's best anchor. Padding gts must not scatter —
+        # their argmax lands on anchor 0 and duplicate-index .set would let
+        # the padding row win; route them to index N and drop.
+        best_anchor = jnp.argmax(ious, axis=0)      # M
+        scatter_to = jnp.where(valid, best_anchor, N)
+        forced = jnp.zeros((N,), bool).at[scatter_to].set(True, mode="drop")
+        forced_gt = jnp.full((N,), -1, jnp.int32).at[scatter_to].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32), mode="drop")
+        matched = forced | (best_iou >= overlap_threshold)
+        gt_idx = jnp.where(forced, forced_gt, best_gt)
+
+        m_gt = gt[gt_idx]                    # N, 4
+        # encode (reference: center-offset normalized by variances)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(m_gt[:, 2] - m_gt[:, 0], 1e-8)
+        gh = jnp.maximum(m_gt[:, 3] - m_gt[:, 1], 1e-8)
+        gcx = (m_gt[:, 0] + m_gt[:, 2]) / 2
+        gcy = (m_gt[:, 1] + m_gt[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / v[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / v[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / v[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / v[3]
+        box_t = jnp.stack([tx, ty, tw, th], -1)      # N, 4
+        box_t = jnp.where(matched[:, None], box_t, 0.0)
+        mask = jnp.where(matched[:, None],
+                         jnp.ones((N, 4), jnp.float32), 0.0)
+        cls_t = jnp.where(matched, lab[gt_idx, 0] + 1, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negatives: unmatched anchors whose best IoU stays under
+            # negative_mining_thresh, ranked by foreground confidence
+            candidate = (~matched) & (best_iou < negative_mining_thresh)
+            hardness = jnp.max(pred[1:], axis=0)  # best non-bg score per anchor
+            ranked = jnp.argsort(jnp.where(candidate, -hardness, jnp.inf))
+            rank = jnp.zeros((N,), jnp.int32).at[ranked].set(jnp.arange(N))
+            num_pos = jnp.sum(matched)
+            keep_n = jnp.maximum(negative_mining_ratio * num_pos,
+                                 minimum_negative_samples)
+            kept_neg = candidate & (rank < keep_n)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(kept_neg, 0.0, ignore_label))
+        return box_t.reshape(-1), mask.reshape(-1), cls_t
+
+    box_target, box_mask, cls_target = jax.vmap(per_batch)(label, cls_pred)
+    return box_target, box_mask, cls_target
+
+
+# ---------------------------------------------------------------------------
+# greedy NMS core (fixed N, lax loop)
+# ---------------------------------------------------------------------------
+
+def _greedy_nms_keep(boxes, scores, valid, iou_thresh, same_class):
+    """Returns bool keep mask; greedy in score order."""
+    N = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    iou = _iou_corner(boxes[order], boxes[order])
+    cls_ok = same_class[jnp.ix_(order, order)] if same_class is not None \
+        else jnp.ones((N, N), bool)
+    valid_o = valid[order]
+
+    def body(i, keep):
+        k_i = keep[i] & valid_o[i]
+        row = (iou[i] >= iou_thresh) & cls_ok[i] & k_i
+        row = row & (jnp.arange(N) > i)  # only suppress lower-scored boxes
+        return keep & ~row
+
+    keep_o = lax.fori_loop(0, N, body, valid_o)
+    keep = jnp.zeros((N,), bool).at[order].set(keep_o)
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# box_nms (reference bounding_box.cc)
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_box_nms", aliases=("box_nms",), nondiff=True)
+def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Suppressed rows become -1 (reference convention). data: (..., N, K)."""
+    if in_format != "corner":
+        raise MXNetError("only corner format is implemented")
+
+    def one(mat):
+        scores = mat[:, score_index]
+        boxes = mat[:, coord_start:coord_start + 4]
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= mat[:, id_index] != background_id
+        if id_index >= 0 and not force_suppress:
+            ids = mat[:, id_index]
+            same = ids[:, None] == ids[None, :]
+        else:
+            same = jnp.ones((mat.shape[0],) * 2, bool)
+        if topk > 0:
+            # reference semantics: NMS only considers the top-k scored
+            # candidates; the rest are suppressed outright
+            order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+            rank = jnp.zeros_like(order).at[order].set(
+                jnp.arange(order.shape[0]))
+            valid &= rank < topk
+        keep = _greedy_nms_keep(boxes, scores, valid, overlap_thresh, same)
+        return jnp.where(keep[:, None], mat, -jnp.ones_like(mat))
+
+    flat = data.reshape((-1,) + data.shape[-2:])
+    out = jax.vmap(one)(flat)
+    return out.reshape(data.shape)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection (reference multibox_detection.cc)
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+          nondiff=True)
+def multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + per-class NMS. cls_prob (B,C,N), loc_pred (B,N*4),
+    anchor (1,N,4) -> (B, N, 6) rows [cls_id, score, x1, y1, x2, y2];
+    suppressed rows are -1."""
+    B, C, N = cls_prob.shape
+    v = jnp.asarray(variances, jnp.float32)
+    anchors = anchor[0]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def per_batch(probs, loc):
+        loc = loc.reshape(N, 4)
+        cx = loc[:, 0] * v[0] * aw + acx
+        cy = loc[:, 1] * v[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * v[2]) * aw
+        h = jnp.exp(loc[:, 3] * v[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor (reference picks argmax)
+        fg = jnp.concatenate([probs[:background_id],
+                              probs[background_id + 1:]], 0) \
+            if 0 <= background_id < C else probs
+        cls_id = jnp.argmax(fg, 0)
+        # translate back to original class index space (background removed)
+        cls_id = jnp.where(cls_id >= background_id, cls_id + 1, cls_id) \
+            if 0 <= background_id < C else cls_id
+        score = jnp.max(fg, 0)
+        valid = score > threshold
+        out_cls = jnp.where(valid, (cls_id - 1).astype(jnp.float32), -1.0) \
+            if background_id == 0 else \
+            jnp.where(valid, cls_id.astype(jnp.float32), -1.0)
+        same = (out_cls[:, None] == out_cls[None, :]) \
+            if not force_suppress else jnp.ones((N, N), bool)
+        if nms_topk > 0:
+            order = jnp.argsort(-jnp.where(valid, score, -jnp.inf))
+            rank = jnp.zeros_like(order).at[order].set(jnp.arange(N))
+            valid &= rank < nms_topk
+        keep = _greedy_nms_keep(boxes, score, valid, nms_threshold, same)
+        row = jnp.concatenate([out_cls[:, None], score[:, None], boxes], -1)
+        return jnp.where(keep[:, None], row, -jnp.ones_like(row))
+
+    return jax.vmap(per_batch)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign (reference roi_align.cc) + legacy ROIPooling
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_ROIAlign", aliases=("ROIAlign",))
+def roi_align(data, rois, *, pooled_size, spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """Bilinear ROI align. data (B,C,H,W); rois (R,5) [bidx,x1,y1,x2,y2]
+    -> (R, C, PH, PW)."""
+    if position_sensitive:
+        raise MXNetError("position_sensitive ROIAlign is not implemented")
+    PH, PW = pooled_size
+    B, C, H, W = data.shape
+    s = 2 if sample_ratio <= 0 else sample_ratio
+    offset = 0.5 if aligned else 0.0
+
+    def bilinear(img, y, x):
+        # img: (C, H, W); y, x scalar grids
+        y = jnp.clip(y, 0.0, H - 1.0)
+        x = jnp.clip(x, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        wy = y - y0
+        wx = x - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def per_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        img = data[bidx]
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w = rw / PW
+        bin_h = rh / PH
+        # s x s sample grid per bin, averaged
+        iy = jnp.arange(PH, dtype=jnp.float32)
+        ix = jnp.arange(PW, dtype=jnp.float32)
+        sy = (jnp.arange(s, dtype=jnp.float32) + 0.5) / s
+        sx = (jnp.arange(s, dtype=jnp.float32) + 0.5) / s
+        ys = y1 + (iy[:, None] + sy[None, :]) * bin_h  # PH, s
+        xs = x1 + (ix[:, None] + sx[None, :]) * bin_w  # PW, s
+        yy = ys.reshape(-1)  # PH*s
+        xx = xs.reshape(-1)  # PW*s
+        grid = jax.vmap(lambda y: jax.vmap(lambda x: bilinear(img, y, x))(xx))(yy)
+        # grid: (PH*s, PW*s, C) -> average each s x s block
+        grid = grid.reshape(PH, s, PW, s, C).mean((1, 3))
+        return jnp.transpose(grid, (2, 0, 1))
+
+    return jax.vmap(per_roi)(rois)
+
+
+@register(name="ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, *, pooled_size, spatial_scale=1.0):
+    """Legacy max ROI pooling (reference src/operator/roi_pooling.cc),
+    implemented as dense-grid max over each bin."""
+    PH, PW = pooled_size
+    B, C, H, W = data.shape
+
+    def per_roi2(roi):
+        bidx = roi[0].astype(jnp.int32)
+        img = data[bidx]
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+
+        def bin_val(py, px):
+            sy = y1 + py * rh / PH
+            ey = y1 + (py + 1) * rh / PH
+            sx = x1 + px * rw / PW
+            ex = x1 + (px + 1) * rw / PW
+            my = (ys >= jnp.floor(sy)) & (ys < jnp.maximum(jnp.ceil(ey),
+                                                           jnp.floor(sy) + 1))
+            mx = (xs >= jnp.floor(sx)) & (xs < jnp.maximum(jnp.ceil(ex),
+                                                           jnp.floor(sx) + 1))
+            mask = my[:, None] & mx[None, :]
+            return jnp.where(mask[None], img, -jnp.inf).max((1, 2))
+
+        pys = jnp.arange(PH, dtype=jnp.float32)
+        pxs = jnp.arange(PW, dtype=jnp.float32)
+        grid = jax.vmap(lambda py: jax.vmap(lambda px: bin_val(py, px))(pxs))(pys)
+        return jnp.transpose(grid, (2, 0, 1))
+
+    return jax.vmap(per_roi2)(rois)
+
+
+# ---------------------------------------------------------------------------
+# misc contrib (reference adaptive_avg_pooling.cc, index_copy.cc)
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_AdaptiveAvgPooling2D",
+          aliases=("AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling(data, *, output_size=1):
+    """Reference contrib/adaptive_avg_pooling.cc."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    B, C, H, W = data.shape
+    # integral-image bins; floor(start)/ceil(end) spans always cover >= 1
+    # pixel so output_size > input size (adaptive upsampling) stays finite
+    idx_h = jnp.arange(oh, dtype=jnp.float32)
+    idx_w = jnp.arange(ow, dtype=jnp.float32)
+    ys0 = jnp.floor(idx_h * H / oh).astype(jnp.int32)
+    ys1 = jnp.ceil((idx_h + 1) * H / oh).astype(jnp.int32)
+    xs0 = jnp.floor(idx_w * W / ow).astype(jnp.int32)
+    xs1 = jnp.ceil((idx_w + 1) * W / ow).astype(jnp.int32)
+    cum = jnp.cumsum(jnp.cumsum(
+        jnp.pad(data, ((0, 0), (0, 0), (1, 0), (1, 0))), axis=2), axis=3)
+    area = ((ys1 - ys0)[:, None] * (xs1 - xs0)[None, :]).astype(data.dtype)
+    out = (cum[:, :, ys1, :][:, :, :, xs1] -
+           cum[:, :, ys0, :][:, :, :, xs1] -
+           cum[:, :, ys1, :][:, :, :, xs0] +
+           cum[:, :, ys0, :][:, :, :, xs0])
+    return out / area
+
+
+@register(name="_contrib_index_copy", aliases=("index_copy",))
+def index_copy(old, index, new):
+    """Reference contrib/index_copy.cc: rows of old replaced by new at
+    index."""
+    return old.at[index.astype(jnp.int32)].set(new.astype(old.dtype))
+
+
+@register(name="_contrib_box_iou", aliases=("box_iou",), nondiff=True)
+def box_iou(lhs, rhs, *, format="corner"):
+    """Reference bounding_box.cc box_iou."""
+    if format != "corner":
+        raise MXNetError("only corner format is implemented")
+    shape_l = lhs.shape[:-1]
+    shape_r = rhs.shape[:-1]
+    out = _iou_corner(lhs.reshape(-1, 4), rhs.reshape(-1, 4))
+    return out.reshape(shape_l + shape_r)
+
+# ---------------------------------------------------------------------------
+# RPN Proposal / MultiProposal (reference proposal.cc, multi_proposal.cc):
+# anchors + bbox deltas -> clip -> min-size filter -> top-pre_nms -> NMS ->
+# top-post_nms. Static-shape: scores of filtered boxes are -inf, output is
+# always (N*post_nms, 5) padded by repeating the best box (reference pads
+# from the kept list).
+# ---------------------------------------------------------------------------
+
+def _base_anchors(scales, ratios, stride):
+    """Anchor boxes around (0,0) cell of size `stride` (reference
+    proposal-inl.h GenerateAnchors: ratio enumeration then scales,
+    base_size=stride)."""
+    base = float(stride)
+    cx = (base - 1) / 2.0
+    cy = (base - 1) / 2.0
+    anchors = []
+    for r in ratios:
+        size = base * base
+        size_ratio = size / r
+        ws = round(size_ratio ** 0.5)
+        hs = round(ws * r)
+        for s in scales:
+            w = ws * s
+            h = hs * s
+            anchors.append([cx - (w - 1) / 2.0, cy - (h - 1) / 2.0,
+                            cx + (w - 1) / 2.0, cy + (h - 1) / 2.0])
+    return jnp.asarray(anchors, jnp.float32)          # (A, 4)
+
+
+def _proposal_impl(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n,
+                   rpn_post_nms_top_n, threshold, rpn_min_size, scales,
+                   ratios, feature_stride, output_score):
+    N, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    base = _base_anchors(tuple(scales), tuple(ratios), feature_stride)
+    sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    shift = jnp.stack(jnp.broadcast_arrays(
+        sx[None, :, None], sy[:, None, None]), -1)    # (H, W, 1, 2)? build 4
+    # anchor grid: (H, W, A, 4)
+    shifts = jnp.concatenate([shift, shift], -1)      # x1 y1 x2 y2 shifts
+    anchors = base[None, None] + shifts
+    total = H * W * A
+    pre = min(int(rpn_pre_nms_top_n), total) if rpn_pre_nms_top_n > 0 else total
+    post = int(rpn_post_nms_top_n)
+
+    def per_image(scores_fg, deltas, info):
+        # scores_fg: (A, H, W); deltas: (4A, H, W)
+        sc = jnp.transpose(scores_fg, (1, 2, 0)).reshape(-1)       # HWA
+        dl = jnp.transpose(deltas.reshape(A, 4, H, W), (2, 3, 0, 1)
+                           ).reshape(-1, 4)
+        anc = anchors.reshape(-1, 4)
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + 0.5 * (aw - 1.0)
+        acy = anc[:, 1] + 0.5 * (ah - 1.0)
+        cx = dl[:, 0] * aw + acx
+        cy = dl[:, 1] * ah + acy
+        w = jnp.exp(dl[:, 2]) * aw
+        h = jnp.exp(dl[:, 3]) * ah
+        boxes = jnp.stack([cx - 0.5 * (w - 1.0), cy - 0.5 * (h - 1.0),
+                           cx + 0.5 * (w - 1.0), cy + 0.5 * (h - 1.0)], -1)
+        im_h, im_w, im_scale = info[0], info[1], info[2]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_w - 1.0),
+                           jnp.clip(boxes[:, 1], 0, im_h - 1.0),
+                           jnp.clip(boxes[:, 2], 0, im_w - 1.0),
+                           jnp.clip(boxes[:, 3], 0, im_h - 1.0)], -1)
+        min_sz = rpn_min_size * im_scale
+        bw = boxes[:, 2] - boxes[:, 0] + 1.0
+        bh = boxes[:, 3] - boxes[:, 1] + 1.0
+        valid = (bw >= min_sz) & (bh >= min_sz)
+        sc = jnp.where(valid, sc, -jnp.inf)
+        # top-pre_nms candidates only
+        top_sc, top_idx = lax.top_k(sc, pre)
+        top_boxes = boxes[top_idx]
+        keep = _greedy_nms_keep(top_boxes, top_sc,
+                                jnp.isfinite(top_sc), threshold, None)
+        # order kept boxes first (stable by score: top_k already sorted)
+        kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        slot = jnp.where(keep, kept_rank, pre)
+        out_boxes = jnp.zeros((pre + 1, 4), boxes.dtype)
+        out_sc = jnp.full((pre + 1,), -jnp.inf, sc.dtype)
+        out_boxes = out_boxes.at[slot].set(top_boxes)
+        out_sc = out_sc.at[slot].set(jnp.where(keep, top_sc, -jnp.inf))
+        n_kept = jnp.sum(keep.astype(jnp.int32))
+        idx = jnp.arange(post)
+        # pad by repeating the first (best) kept box, reference-style
+        src = jnp.where(idx < n_kept, idx, 0)
+        return out_boxes[src], out_sc[src]
+
+    fg = cls_prob[:, A:]
+    boxes, scores = jax.vmap(per_image)(fg, bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(N, dtype=boxes.dtype), post)[:, None]
+    rois = jnp.concatenate([bidx, boxes.reshape(N * post, 4)], -1)
+    if output_score:
+        return rois, scores.reshape(N * post, 1)
+    return rois
+
+
+@register(name="_contrib_Proposal",
+          aliases=("Proposal", "_contrib_MultiProposal", "MultiProposal"),
+          nondiff=True)
+def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """RPN proposals (reference proposal.cc; multi_proposal.cc is the same
+    math vmapped over the batch — this implementation is batched already,
+    so MultiProposal is an alias)."""
+    if iou_loss:
+        raise MXNetError("iou_loss Proposal variant is not implemented")
+    return _proposal_impl(
+        cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=rpn_pre_nms_top_n,
+        rpn_post_nms_top_n=rpn_post_nms_top_n, threshold=threshold,
+        rpn_min_size=rpn_min_size, scales=scales, ratios=ratios,
+        feature_stride=feature_stride, output_score=output_score)
+
+
+# ---------------------------------------------------------------------------
+# Position-sensitive ROI pooling (reference psroi_pooling.cc) and the
+# deformable variant (deformable_psroi_pooling.cc). Bins are averaged over a
+# fixed sample grid (the deformable reference itself uses sample_per_part
+# fixed samples; for plain PSROI the reference averages integer pixels —
+# the fixed-grid average is the static-shape equivalent).
+# ---------------------------------------------------------------------------
+
+def _psroi_impl(data, rois, trans, *, spatial_scale, output_dim, pooled_size,
+                group_size, part_size=0, sample_per_part=2, trans_std=0.0):
+    B, C, H, W = data.shape
+    P = int(pooled_size)
+    G = int(group_size) or P
+    part = int(part_size) or P
+    sp = max(1, int(sample_per_part))
+    n_cls = 1 if trans is None else trans.shape[1] // 2
+    ch_per_cls = output_dim // n_cls
+
+    def per_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        img = data[bidx]
+        # reference: round then offset by 0.5 pixel, width/height >= 0.1
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / P
+        bin_h = rh / P
+        iy = jnp.arange(P, dtype=jnp.float32)
+        ix = jnp.arange(P, dtype=jnp.float32)
+        ss = (jnp.arange(sp, dtype=jnp.float32) + 0.5) / sp
+        # per output bin (ph, pw): sample grid, per-class trans offsets
+        gy = jnp.clip((iy * G / P).astype(jnp.int32), 0, G - 1)     # (P,)
+        gx = jnp.clip((ix * G / P).astype(jnp.int32), 0, G - 1)
+        py = jnp.clip((iy * part / P).astype(jnp.int32), 0, part - 1)
+        px = jnp.clip((ix * part / P).astype(jnp.int32), 0, part - 1)
+
+        def one_class(cls_id):
+            if trans is None:
+                tx = jnp.zeros((P, P))
+                ty = jnp.zeros((P, P))
+            else:
+                # per-bin (part_h, part_w) offsets, like the reference's
+                # bottom_trans[...part_h...part_w] read
+                tx = tr[2 * cls_id][py[:, None], px[None, :]] * trans_std
+                ty = tr[2 * cls_id + 1][py[:, None], px[None, :]] * trans_std
+            # full per-bin sample grids (P, P, sp): the trans offset varies
+            # with BOTH bin indices, so the grid is not separable
+            ys = (y1 + iy[:, None, None] * bin_h
+                  + ss[None, None, :] * bin_h + ty[:, :, None] * rh)
+            xs = (x1 + ix[None, :, None] * bin_w
+                  + ss[None, None, :] * bin_w + tx[:, :, None] * rw)
+            ys = jnp.clip(ys, 0.0, H - 1.0)                     # (P, P, sp)
+            xs = jnp.clip(xs, 0.0, W - 1.0)
+            y0 = jnp.floor(ys).astype(jnp.int32)
+            x0 = jnp.floor(xs).astype(jnp.int32)
+            wy = ys - y0
+            wx = xs - x0
+            y1i = jnp.minimum(y0 + 1, H - 1)
+            x1i = jnp.minimum(x0 + 1, W - 1)
+            # channel map per bin: c = (cls*ch_per_cls + k)*G*G + gy*G + gx
+            k = jnp.arange(ch_per_cls)
+            cidx = (cls_id * ch_per_cls + k)[:, None, None] * (G * G) \
+                + (gy[:, None] * G + gx[None, :])[None]        # (K, P, P)
+
+            def gather(yi, xi):
+                # channels (K,P,P); y (P,P,sp); x (P,P,sp) -> (K,P,P,sp,sp)
+                return img[cidx[:, :, :, None, None],
+                           yi[None, :, :, :, None],
+                           xi[None, :, :, None, :]]
+            wy_ = wy[None, :, :, :, None]
+            wx_ = wx[None, :, :, None, :]
+            v = (gather(y0, x0) * (1 - wy_) * (1 - wx_) +
+                 gather(y0, x1i) * (1 - wy_) * wx_ +
+                 gather(y1i, x0) * wy_ * (1 - wx_) +
+                 gather(y1i, x1i) * wy_ * wx_)
+            # v: (K, P, P, sp, sp) -> mean over samples
+            return v.mean((-1, -2))
+
+        outs = [one_class(c) for c in range(n_cls)]
+        return jnp.concatenate(outs, 0)                         # (output_dim, P, P)
+
+    if trans is None:
+        return jax.vmap(lambda r: per_roi(r, None))(rois)
+    return jax.vmap(per_roi)(rois, trans)
+
+
+@register(name="_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size,
+                  group_size=0):
+    """data (B, output_dim*G*G, H, W), rois (R,5) -> (R, output_dim, P, P)
+    (reference psroi_pooling.cc; R-FCN head)."""
+    return _psroi_impl(data, rois, None, spatial_scale=spatial_scale,
+                       output_dim=output_dim, pooled_size=pooled_size,
+                       group_size=group_size)
+
+
+@register(name="_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",))
+def deformable_psroi_pooling(data, rois, trans, *, spatial_scale, output_dim,
+                             pooled_size, group_size, part_size=0,
+                             sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    """Deformable R-FCN pooling (reference deformable_psroi_pooling.cc):
+    trans (R, 2*n_cls, part, part) shifts each bin by trans*roi_size."""
+    return _psroi_impl(data, rois, None if no_trans else trans,
+                       spatial_scale=spatial_scale, output_dim=output_dim,
+                       pooled_size=pooled_size, group_size=group_size,
+                       part_size=part_size, sample_per_part=sample_per_part,
+                       trans_std=trans_std)
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution v1 (reference deformable_convolution.cc): bilinear
+# sampling of the input at offset kernel-tap positions, then a dense
+# contraction. The im2col+offset CUDA kernel becomes a static python loop
+# over the kh*kw taps of gather-based bilinear samples — XLA fuses the taps;
+# the contraction is one einsum on the MXU.
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, *, kernel,
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=0, num_group=1, num_deformable_group=1,
+                           workspace=1024, no_bias=False, layout=None):
+    from .spatial_ops import _bilinear_gather
+    N, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride if stride else (1, 1)
+    dh, dw = dilate if dilate else (1, 1)
+    ph, pw = pad if pad else (0, 0)
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = int(num_deformable_group)
+    Cg = C // dg
+
+    oy = jnp.arange(Ho, dtype=jnp.float32) * sh - ph
+    ox = jnp.arange(Wo, dtype=jnp.float32) * sw - pw
+    taps = []
+    for ki in range(kh):
+        for kj in range(kw):
+            tap = ki * kw + kj
+            per_dg = []
+            for g in range(dg):
+                off_y = offset[:, 2 * (g * kh * kw + tap)]        # (N,Ho,Wo)
+                off_x = offset[:, 2 * (g * kh * kw + tap) + 1]
+                gy = oy[None, :, None] + ki * dh + off_y
+                gx = ox[None, None, :] + kj * dw + off_x
+                sub = data[:, g * Cg:(g + 1) * Cg]
+                per_dg.append(_bilinear_gather(sub, gx, gy))      # (N,Cg,Ho,Wo)
+            taps.append(jnp.concatenate(per_dg, 1))               # (N,C,Ho,Wo)
+    col = jnp.stack(taps, 2)                                      # (N,C,K,Ho,Wo)
+    G = int(num_group)
+    O = weight.shape[0]
+    colg = col.reshape(N, G, C // G, kh * kw, Ho, Wo)
+    wg = weight.reshape(G, O // G, C // G, kh * kw)
+    out = jnp.einsum("ngckhw,gock->ngohw", colg, wg).reshape(N, O, Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + bias[None, :, None, None]
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Misc contrib ops
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_count_sketch", aliases=("count_sketch",))
+def count_sketch(data, h, s, *, out_dim, processing_batch_size=32):
+    """Count-sketch projection (reference count_sketch.cc): out[:, h[i]] +=
+    s[i] * data[:, i]. h, s: (1, in_dim)."""
+    N, d = data.shape
+    hh = jnp.clip(h.reshape(-1).astype(jnp.int32), 0, out_dim - 1)
+    ss = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros((N, int(out_dim)), data.dtype)
+    return out.at[:, hh].add(data * ss[None, :])
+
+
+@register(name="_contrib_fft", aliases=("fft",))
+def fft(data, *, compute_size=128):
+    """Real-to-complex FFT along the last axis; output interleaves re/im
+    (reference fft.cc packs cuFFT output the same way): (..., d) -> (..., 2d)."""
+    c = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([c.real, c.imag], -1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@register(name="_contrib_ifft", aliases=("ifft",))
+def ifft(data, *, compute_size=128):
+    """Inverse of _contrib_fft, UNNORMALIZED like cuFFT/the reference
+    (ifft(fft(x)) == d * x): (..., 2d) -> (..., d) real part."""
+    d = data.shape[-1] // 2
+    pairs = data.astype(jnp.float32).reshape(data.shape[:-1] + (d, 2))
+    c = lax.complex(pairs[..., 0], pairs[..., 1])
+    return (jnp.fft.ifft(c, axis=-1).real * d).astype(data.dtype)
+
+
+@register(name="_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c (reference quadratic_op.cc — the tutorial op)."""
+    return a * data * data + b * data + c
+
+
+@register(name="_contrib_gradientmultiplier",
+          aliases=("gradientmultiplier", "GradientMultiplier"))
+def gradient_multiplier(data, *, scalar=1.0):
+    """Identity forward; backward scales the gradient by `scalar`
+    (reference gradient_multiplier_op.cc — gradient-reversal layers use
+    scalar=-lambda)."""
+    sc = float(scalar)
+
+    @jax.custom_vjp
+    def _gm(x):
+        return x
+
+    _gm.defvjp(lambda x: (x, None), lambda _, g: (g * sc,))
+    return _gm(data)
+
+
+@register(name="_contrib_index_array", aliases=("index_array",), nondiff=True)
+def index_array(data, *, axes=None):
+    """Coordinate tensor: out[i1..in, k] = i_{axes[k]} (reference
+    index_array.cc). Output dtype int64 in the reference; int32 here (XLA
+    x64 is globally disabled)."""
+    shape = data.shape
+    nd_ = len(shape)
+    sel = list(range(nd_)) if axes is None else [a % nd_ for a in axes]
+    comps = [lax.broadcasted_iota(jnp.int32, shape, a) for a in sel]
+    return jnp.stack(comps, -1)
+
+
+@register(name="khatri_rao", aliases=("_contrib_khatri_rao",))
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product (reference krprod.cc): inputs (n_i, k)
+    -> (prod n_i, k)."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        k = out.shape[1]
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, k)
+    return out
+
+
+@register(name="_contrib_getnnz", aliases=("getnnz",), nondiff=True)
+def getnnz(data, *, axis=None):
+    """Number of stored/nonzero values (reference nnz.cc, defined for CSR).
+    Dense inputs count exact nonzeros; axis=0/1 supported for 2-D."""
+    nz = (data != 0).astype(jnp.int32)
+    if axis is None:
+        return jnp.sum(nz)
+    return jnp.sum(nz, axis=int(axis))
+
+
+@register(name="_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def div_sqrt_dim(data):
+    """data / sqrt(d_last) (reference transformer.cc:33 — attention scaling)."""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Hawkes process log-likelihood (reference hawkes_ll.cc): exponential-kernel
+# multivariate Hawkes, one lax.scan over the sequence replaces the per-sample
+# C++ loop; gradients w.r.t. mu/alpha/beta come from autodiff instead of the
+# reference's hand-written backward kernel.
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_hawkesll", aliases=("hawkesll",))
+def hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+    """mu (N,K), alpha (K,), beta (K,), state (N,K), lags (N,T),
+    marks (N,T) int, valid_length (N,), max_time (N,) ->
+    (loglik (N,), out_state (N,K))."""
+    N, T = lags.shape
+    K = mu.shape[1]
+    marks_i = marks.astype(jnp.int32)
+
+    def per_sample(mu_i, state_i, lag_i, mark_i, vl, mt):
+        def step(carry, inp):
+            ll, t, st, last = carry
+            lag_j, m_j, j = inp
+            t2 = t + lag_j
+            oh = jax.nn.one_hot(m_j, K, dtype=mu_i.dtype)
+            d = t2 - last
+            ed = jnp.exp(-beta * d)
+            lda = mu_i + alpha * beta * st * ed
+            comp = mu_i * d + alpha * st * (1.0 - ed)
+            contrib = jnp.sum(oh * (jnp.log(jnp.maximum(lda, 1e-30)) - comp))
+            active = (j < vl).astype(mu_i.dtype)
+            ll2 = ll + active * contrib
+            st2 = jnp.where((oh > 0) & (j < vl), 1.0 + st * ed, st)
+            last2 = jnp.where((oh > 0) & (j < vl), t2, last)
+            t3 = jnp.where(j < vl, t2, t)
+            return (ll2, t3, st2, last2), None
+
+        init = (jnp.zeros((), mu_i.dtype), jnp.zeros((), mu_i.dtype),
+                state_i, jnp.zeros((K,), mu_i.dtype))
+        (ll, _, st, last), _ = lax.scan(
+            step, init, (lag_i, mark_i, jnp.arange(T)))
+        # remaining compensator to max_time + state decay (reference
+        # hawkesll_forward_compensator)
+        d = mt - last
+        ed = jnp.exp(-beta * d)
+        ll = ll - jnp.sum(mu_i * d + alpha * st * (1.0 - ed))
+        return ll, st * ed
+
+    return jax.vmap(per_sample)(mu, state, lags, marks_i, valid_length,
+                                max_time)

@@ -1,0 +1,277 @@
+"""DGL graph-sampling operator family.
+
+Reference: src/operator/contrib/dgl_graph.cc (~1,700 LoC) — the operator
+set MXNet exposed for the Deep Graph Library: CSR neighborhood sampling
+(uniform + weighted), induced subgraphs, subgraph compaction, edge-id
+lookup, and adjacency normalization.
+
+TPU-native placement note: the reference registers these CPU-only
+(`FComputeEx<cpu>`, dgl_graph.cc:744+) — they are data-PIPELINE operators
+(random BFS with hash sets, data-dependent shapes), not accelerator
+kernels. This port keeps them host-side over numpy exactly like
+`cast_storage` (ndarray/sparse.py): the sampled minibatch subgraphs are
+what get shipped to the chip.
+
+Exposed as mx.nd.contrib.* (ndarray/contrib.py imports this module).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample",
+           "dgl_subgraph", "edge_id", "dgl_adjacency",
+           "dgl_graph_compact"]
+
+
+def _csr_parts(csr):
+    """(data, indices, indptr, shape) as int64/np arrays."""
+    data = _np.asarray(csr.data.asnumpy()).astype(_np.int64)
+    indices = _np.asarray(csr.indices.asnumpy()).astype(_np.int64)
+    indptr = _np.asarray(csr.indptr.asnumpy()).astype(_np.int64)
+    return data, indices, indptr, csr.shape
+
+
+def _make_csr(data, indices, indptr, shape, dtype=_np.int64):
+    from ..ndarray.ndarray import NDArray
+    from ..ndarray.sparse import CSRNDArray
+    import jax.numpy as jnp
+    return CSRNDArray(NDArray(jnp.asarray(_np.asarray(data, dtype))),
+                      NDArray(jnp.asarray(_np.asarray(indices, _np.int64))),
+                      NDArray(jnp.asarray(_np.asarray(indptr, _np.int64))),
+                      shape)
+
+
+def _as_1d_int(arr):
+    from ..ndarray.ndarray import NDArray
+    a = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
+    return a.astype(_np.int64).reshape(-1)
+
+
+def _nd(a):
+    from ..ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+    return NDArray(jnp.asarray(a))
+
+
+def _sample_one(csr, seed, probability, num_hops, num_neighbor,
+                max_num_vertices, rng):
+    """One subgraph: the reference's SampleSubgraph BFS
+    (dgl_graph.cc:529-700). Returns (ver, layer, sub_csr_parts, prob_out).
+
+    BFS from the seeds; a vertex below the hop limit samples up to
+    `num_neighbor` of its neighbors (uniform without replacement, or
+    probability-weighted without replacement over the neighbor's global
+    probability). Stops growing once max_num_vertices are collected."""
+    data, indices, indptr, shape = _csr_parts(csr)
+    seeds = _as_1d_int(seed)
+    if max_num_vertices < len(seeds):
+        raise MXNetError("max_num_vertices must cover the seeds")
+
+    seen = set()
+    queue = []          # (vertex, layer) in discovery order
+    for s in seeds:
+        if int(s) not in seen:
+            seen.add(int(s))
+            queue.append((int(s), 0))
+    neigh = {}          # vertex -> (sampled neighbor ids, edge ids)
+    idx = 0
+    while idx < len(queue) and len(seen) < max_num_vertices:
+        v, lvl = queue[idx]
+        idx += 1
+        if lvl >= num_hops:
+            continue
+        lo, hi = int(indptr[v]), int(indptr[v + 1])
+        cols, eids = indices[lo:hi], data[lo:hi]
+        if len(cols) > num_neighbor:
+            if probability is None:
+                pick = _np.sort(rng.choice(len(cols), num_neighbor,
+                                           replace=False))
+                cols, eids = cols[pick], eids[pick]
+            else:
+                w = probability[cols]
+                total = w.sum()
+                if total <= 0:
+                    raise MXNetError(
+                        f"non-uniform sampling: vertex {v} has "
+                        f"{len(cols)} neighbors but zero total "
+                        "probability mass")
+                w = w / total
+                pick = rng.choice(len(cols), num_neighbor, replace=False,
+                                  p=w)
+                # reference quirk (GetNonUniformSample, dgl_graph.cc:500):
+                # vertex and edge lists are sorted INDEPENDENTLY
+                cols = _np.sort(cols[pick])
+                eids = _np.sort(eids[pick])
+        neigh[v] = (cols, eids)
+        for c in cols:
+            if len(seen) >= max_num_vertices:
+                break
+            if int(c) not in seen:
+                seen.add(int(c))
+                queue.append((int(c), lvl + 1))
+
+    order = sorted(queue)                       # sort by vertex id
+    n = len(order)
+    ver = _np.zeros(max_num_vertices + 1, _np.int64)
+    layer = _np.zeros(max_num_vertices, _np.int64)
+    ver[:n] = [v for v, _ in order]
+    ver[max_num_vertices] = n
+    layer[:n] = [l for _, l in order]
+
+    sub_data, sub_indices, sub_indptr = [], [], [0]
+    for i in range(max_num_vertices):
+        if i < n and ver[i] in neigh:
+            cols, eids = neigh[int(ver[i])]
+            sub_indices.extend(cols)
+            sub_data.extend(eids)
+        sub_indptr.append(len(sub_data))
+    prob_out = None
+    if probability is not None:
+        prob_out = _np.zeros(max_num_vertices, _np.float32)
+        prob_out[:n] = probability[ver[:n]]
+    return (ver, layer, (sub_data, sub_indices, sub_indptr,
+                         (max_num_vertices, shape[1])), prob_out)
+
+
+def dgl_csr_neighbor_uniform_sample(csr_matrix, *seed_arrays, num_args=None,
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100, rng=None,
+                                    seed=None):
+    """Uniform CSR neighborhood sampling
+    (reference _contrib_dgl_csr_neighbor_uniform_sample,
+    dgl_graph.cc:744). Returns, per seed array: a (max+1,) vertex array
+    (count in the last slot), the sampled sub-CSR with ORIGINAL edge ids,
+    and a (max,) per-vertex layer array — flattened into one list ordered
+    [vers..., csrs..., layers...]."""
+    # default keeps np.random.seed() reproducibility; pass seed= (or an
+    # rng) for isolation from global RNG state
+    rng = rng if rng is not None else (
+        _np.random.RandomState(seed) if seed is not None else _np.random)
+    outs_v, outs_c, outs_l = [], [], []
+    for seed_arr in seed_arrays:
+        ver, layer, parts, _ = _sample_one(csr_matrix, seed_arr, None, num_hops,
+                                           num_neighbor, max_num_vertices,
+                                           rng)
+        outs_v.append(_nd(ver))
+        outs_c.append(_make_csr(*parts))
+        outs_l.append(_nd(layer))
+    return outs_v + outs_c + outs_l
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr_matrix, probability,
+                                        *seed_arrays, num_args=None,
+                                        num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100, rng=None,
+                                        seed=None):
+    """Weighted sampling variant (dgl_graph.cc:838): neighbors drawn
+    without replacement proportionally to `probability[neighbor]`. Adds a
+    per-subgraph (max,) vertex-probability output after the CSRs."""
+    # default keeps np.random.seed() reproducibility; pass seed= (or an
+    # rng) for isolation from global RNG state
+    rng = rng if rng is not None else (
+        _np.random.RandomState(seed) if seed is not None else _np.random)
+    prob = _np.asarray(
+        probability.asnumpy() if hasattr(probability, "asnumpy")
+        else probability, _np.float32).reshape(-1)
+    outs_v, outs_c, outs_p, outs_l = [], [], [], []
+    for seed_arr in seed_arrays:
+        ver, layer, parts, pr = _sample_one(csr_matrix, seed_arr, prob,
+                                            num_hops, num_neighbor,
+                                            max_num_vertices, rng)
+        outs_v.append(_nd(ver))
+        outs_c.append(_make_csr(*parts))
+        outs_p.append(_nd(pr))
+        outs_l.append(_nd(layer))
+    return outs_v + outs_c + outs_p + outs_l
+
+
+def dgl_subgraph(graph, *varrays, return_mapping=False, num_args=None):
+    """Induced subgraph on each (SORTED) vertex set (dgl_graph.cc:1115
+    GetSubgraph): new vertex ids are positions in the vertex array, new
+    edge ids number the kept edges 0..nnz-1 in row-major order; with
+    return_mapping the original edge ids come back as a second CSR."""
+    data, indices, indptr, shape = _csr_parts(graph)
+    subs, maps = [], []
+    for varr in varrays:
+        v = _as_1d_int(varr)
+        if not _np.all(v[:-1] <= v[1:]):
+            raise MXNetError("the input vertex list has to be sorted")
+        pos = {int(old): i for i, old in enumerate(v)}
+        sdata, sidx, sptr, odata = [], [], [0], []
+        for old in v:
+            lo, hi = int(indptr[old]), int(indptr[old + 1])
+            for c, e in zip(indices[lo:hi], data[lo:hi]):
+                if int(c) in pos:
+                    sidx.append(pos[int(c)])
+                    sdata.append(len(sdata))    # new edge id, 0-based
+                    odata.append(e)
+            sptr.append(len(sidx))
+        n = len(v)
+        subs.append(_make_csr(sdata, sidx, sptr, (n, n)))
+        maps.append(_make_csr(odata, sidx, sptr, (n, n)))
+    return subs + maps if return_mapping else subs
+
+
+def edge_id(data, u, v):
+    """out[i] = data[u[i], v[i]] if the edge exists else -1
+    (dgl_graph.cc:1300 _contrib_edge_id). Values keep the CSR's own data
+    dtype (float edge data stays float — no int64 round trip)."""
+    dat = _np.asarray(data.data.asnumpy())
+    _, indices, indptr, _ = _csr_parts(data)
+    uu, vv = _as_1d_int(u), _as_1d_int(v)
+    out = _np.full(len(uu), -1, dat.dtype)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        lo, hi = int(indptr[a]), int(indptr[a + 1])
+        hit = _np.nonzero(indices[lo:hi] == b)[0]
+        if len(hit):
+            out[i] = dat[lo + hit[0]]
+    return _nd(out)
+
+
+def dgl_adjacency(data):
+    """Edge-id CSR -> adjacency CSR of float32 ones (dgl_graph.cc:1376)."""
+    _, indices, indptr, shape = _csr_parts(data)
+    return _make_csr(_np.ones(len(indices), _np.float32), indices, indptr,
+                     shape, dtype=_np.float32)
+
+
+def dgl_graph_compact(*args, graph_sizes=(), return_mapping=False,
+                      num_args=None):
+    """Strip the empty tail rows/columns a neighbor-sample CSR carries and
+    renumber columns to subgraph-local ids (dgl_graph.cc:1551
+    CompactSubgraph). args = graphs..., vertex_arrays... (same count);
+    graph_sizes holds each subgraph's true vertex count. New edge ids
+    number kept edges 0..nnz-1; return_mapping returns the original ids
+    as a second CSR."""
+    if isinstance(graph_sizes, int):
+        graph_sizes = (graph_sizes,)
+    num_g = len(args) // 2
+    if len(args) != 2 * num_g or num_g == 0:
+        raise MXNetError("dgl_graph_compact needs graphs + vertex arrays")
+    if len(graph_sizes) != num_g:
+        raise MXNetError("graph_sizes must have one entry per graph")
+    subs, maps = [], []
+    for g, varr, size in zip(args[:num_g], args[num_g:], graph_sizes):
+        size = int(size)
+        data, indices, indptr, shape = _csr_parts(g)
+        vids = _as_1d_int(varr)
+        if int(vids[-1]) != size:
+            raise MXNetError("vertex array count does not match graph_sizes")
+        pos = {int(old): i for i, old in enumerate(vids[:size])}
+        sdata, sidx, sptr, odata = [], [], [0], []
+        for r in range(size):
+            lo, hi = int(indptr[r]), int(indptr[r + 1])
+            for c, e in zip(indices[lo:hi], data[lo:hi]):
+                if int(c) not in pos:
+                    raise MXNetError(f"column id {int(c)} not in the "
+                                     "vertex array")
+                sidx.append(pos[int(c)])
+                sdata.append(len(sdata))
+                odata.append(e)
+            sptr.append(len(sidx))
+        subs.append(_make_csr(sdata, sidx, sptr, (size, size)))
+        maps.append(_make_csr(odata, sidx, sptr, (size, size)))
+    return subs + maps if return_mapping else subs

@@ -1,0 +1,279 @@
+"""Device-side image operators: the `nd.image.*` / `mx.sym.image.*` family.
+
+Reference: src/operator/image/image_random.cc (to_tensor, normalize, the
+flip/brightness/contrast/saturation/hue/color-jitter/lighting augmenters),
+src/operator/image/crop.cc (_image_crop), src/operator/image/resize-inl.h
+(_image_resize). The reference runs these as CPU/GPU kernels so augmentation
+can fuse into the compiled graph; here each is a pure jax function, so a
+transform pipeline jit-compiles into ONE XLA program (and can run on-chip,
+overlapping with the train step — the TPU answer to the reference's
+multi-worker CPU augmentation).
+
+Layout convention matches the reference: HWC (or NHWC batched) uint8/float
+in [0,255] for the augmenters; to_tensor converts to CHW float32 [0,1];
+normalize operates on CHW/NCHW.
+
+Known deviation: the reference's AdjustSaturationImpl computes its gray
+value with `gray = px*coef` in a loop (image_random-inl.h:757 — assignment,
+not accumulation), i.e. gray ends up as B*0.114 only. We compute the
+documented ITU-R gray (0.299R + 0.587G + 0.114B), matching torchvision and
+GluonCV's own python transforms.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_GRAY = (0.299, 0.587, 0.114)
+
+
+def _saturate(val, like):
+    """saturate_cast: clamp when the output dtype is integral."""
+    if jnp.issubdtype(like.dtype, jnp.integer):
+        info = jnp.iinfo(like.dtype)
+        return jnp.clip(jnp.round(val), info.min, info.max).astype(like.dtype)
+    return val.astype(like.dtype)
+
+
+@register(name="_image_to_tensor", aliases=("to_tensor",))
+def to_tensor(data):
+    """(H,W,C)->(C,H,W) float32/255 ((N,H,W,C) batched alike) — reference
+    image_random.cc:41."""
+    if data.ndim == 3:
+        perm = (2, 0, 1)
+    elif data.ndim == 4:
+        perm = (0, 3, 1, 2)
+    else:
+        raise MXNetError(f"to_tensor: expected 3D/4D HWC input, got "
+                         f"{data.ndim}D")
+    return jnp.transpose(data, perm).astype(jnp.float32) / 255.0
+
+
+@register(name="_image_normalize", aliases=("normalize",))
+def normalize(data, *, mean=(0.0,), std=(1.0,)):
+    """Channel-wise (x - mean) / std on CHW or NCHW float input —
+    reference image_random.cc:105."""
+    mean = tuple(mean) if isinstance(mean, (tuple, list)) else (float(mean),)
+    std = tuple(std) if isinstance(std, (tuple, list)) else (float(std),)
+    c_ax = data.ndim - 3
+    c = data.shape[c_ax]
+    m = jnp.asarray((mean * c)[:c] if len(mean) == 1 else mean,
+                    data.dtype)
+    s = jnp.asarray((std * c)[:c] if len(std) == 1 else std, data.dtype)
+    shape = [1] * data.ndim
+    shape[c_ax] = c
+    return (data - m.reshape(shape)) / s.reshape(shape)
+
+
+@register(name="_image_flip_left_right", aliases=("flip_left_right",),
+          nondiff=True)
+def flip_left_right(data):
+    return jnp.flip(data, axis=data.ndim - 2)
+
+
+@register(name="_image_flip_top_bottom", aliases=("flip_top_bottom",),
+          nondiff=True)
+def flip_top_bottom(data):
+    return jnp.flip(data, axis=data.ndim - 3)
+
+
+@register(name="_image_random_flip_left_right",
+          aliases=("random_flip_left_right",), stateful=True, nondiff=True)
+def random_flip_left_right(data, *, p=0.5, rng=None):
+    return jnp.where(jax.random.uniform(rng) < p,
+                     jnp.flip(data, axis=data.ndim - 2), data)
+
+
+@register(name="_image_random_flip_top_bottom",
+          aliases=("random_flip_top_bottom",), stateful=True, nondiff=True)
+def random_flip_top_bottom(data, *, p=0.5, rng=None):
+    return jnp.where(jax.random.uniform(rng) < p,
+                     jnp.flip(data, axis=data.ndim - 3), data)
+
+
+def _adjust_brightness(data, alpha):
+    return _saturate(data.astype(jnp.float32) * alpha, data)
+
+
+def _adjust_contrast(data, alpha):
+    x = data.astype(jnp.float32)
+    if data.shape[-1] >= 3:
+        gray = (x[..., 0] * _GRAY[0] + x[..., 1] * _GRAY[1]
+                + x[..., 2] * _GRAY[2])
+    else:
+        gray = x[..., 0]
+    # per-image mean over H,W (vectorized over any leading batch dims)
+    beta = (1.0 - alpha) * jnp.mean(gray, axis=(-2, -1), keepdims=True)
+    return _saturate(x * alpha + beta[..., None], data)
+
+
+def _adjust_saturation(data, alpha):
+    if data.shape[-1] < 3:
+        return data
+    x = data.astype(jnp.float32)
+    gray = (x[..., 0] * _GRAY[0] + x[..., 1] * _GRAY[1]
+            + x[..., 2] * _GRAY[2])
+    return _saturate(x * alpha + gray[..., None] * (1.0 - alpha), data)
+
+
+def _adjust_hue(data, alpha):
+    """Rotate hue by alpha*360 degrees through HSV (reference
+    image_random-inl.h AdjustHueImpl's HLS round-trip; HSV yields the
+    same hue rotation and vectorizes cleanly)."""
+    if data.shape[-1] < 3:
+        return data
+    x = data.astype(jnp.float32) / 255.0
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx_ = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    diff = mx_ - mn
+    safe = jnp.where(diff == 0, 1.0, diff)
+    h = jnp.where(
+        mx_ == r, (g - b) / safe,
+        jnp.where(mx_ == g, 2.0 + (b - r) / safe, 4.0 + (r - g) / safe))
+    h = jnp.where(diff == 0, 0.0, h) / 6.0
+    h = jnp.mod(h + alpha, 1.0)
+    s = jnp.where(mx_ == 0, 0.0, diff / jnp.where(mx_ == 0, 1.0, mx_))
+    v = mx_
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(jnp.int32) % 6
+    r2 = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g2 = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b2 = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    out = jnp.stack([r2, g2, b2], axis=-1) * 255.0
+    return _saturate(out, data)
+
+
+# eigenvalue * eigenvector products for AlexNet-style PCA lighting
+# (reference image_random-inl.h:1005 AdjustLightingImpl `eig`)
+_LIGHT_EIG = _np.array(
+    [[55.46 * -0.5675, 4.794 * 0.7192, 1.148 * 0.4009],
+     [55.46 * -0.5808, 4.794 * -0.0045, 1.148 * -0.8140],
+     [55.46 * -0.5836, 4.794 * -0.6948, 1.148 * 0.4203]], _np.float32)
+
+
+def _adjust_lighting(data, alpha):
+    if data.shape[-1] < 3:
+        return data
+    pca = jnp.asarray(_LIGHT_EIG) @ jnp.asarray(alpha, jnp.float32)
+    return _saturate(data.astype(jnp.float32) + pca, data)
+
+
+@register(name="_image_random_brightness", aliases=("random_brightness",),
+          stateful=True, nondiff=True)
+def random_brightness(data, *, min_factor, max_factor, rng=None):
+    a = jax.random.uniform(rng, minval=min_factor, maxval=max_factor)
+    return _adjust_brightness(data, a)
+
+
+@register(name="_image_random_contrast", aliases=("random_contrast",),
+          stateful=True, nondiff=True)
+def random_contrast(data, *, min_factor, max_factor, rng=None):
+    a = jax.random.uniform(rng, minval=min_factor, maxval=max_factor)
+    return _adjust_contrast(data, a)
+
+
+@register(name="_image_random_saturation", aliases=("random_saturation",),
+          stateful=True, nondiff=True)
+def random_saturation(data, *, min_factor, max_factor, rng=None):
+    a = jax.random.uniform(rng, minval=min_factor, maxval=max_factor)
+    return _adjust_saturation(data, a)
+
+
+@register(name="_image_random_hue", aliases=("random_hue",), stateful=True,
+          nondiff=True)
+def random_hue(data, *, min_factor, max_factor, rng=None):
+    a = jax.random.uniform(rng, minval=min_factor, maxval=max_factor)
+    return _adjust_hue(data, a)
+
+
+@register(name="_image_random_color_jitter", aliases=("random_color_jitter",),
+          stateful=True, nondiff=True)
+def random_color_jitter(data, *, brightness=0.0, contrast=0.0,
+                        saturation=0.0, hue=0.0, rng=None):
+    """Reference image_random-inl.h:944 RandomColorJitter: apply each
+    enabled adjustment with an independent uniform factor. The reference
+    shuffles application order per call; a fixed order keeps the op
+    jittable and the distributions are near-identical."""
+    keys = jax.random.split(rng, 4)
+    out = data
+    if brightness > 0:
+        a = jax.random.uniform(keys[0], minval=max(0.0, 1 - brightness),
+                               maxval=1 + brightness)
+        out = _adjust_brightness(out, a)
+    if contrast > 0:
+        a = jax.random.uniform(keys[1], minval=max(0.0, 1 - contrast),
+                               maxval=1 + contrast)
+        out = _adjust_contrast(out, a)
+    if saturation > 0:
+        a = jax.random.uniform(keys[2], minval=max(0.0, 1 - saturation),
+                               maxval=1 + saturation)
+        out = _adjust_saturation(out, a)
+    if hue > 0:
+        a = jax.random.uniform(keys[3], minval=-hue, maxval=hue)
+        out = _adjust_hue(out, a)
+    return out
+
+
+@register(name="_image_adjust_lighting", aliases=("adjust_lighting",),
+          nondiff=True)
+def adjust_lighting(data, *, alpha):
+    """Reference image_random.cc:252 — AlexNet PCA lighting with fixed
+    alpha triple."""
+    return _adjust_lighting(data, tuple(alpha))
+
+
+@register(name="_image_random_lighting", aliases=("random_lighting",),
+          stateful=True, nondiff=True)
+def random_lighting(data, *, alpha_std=0.05, rng=None):
+    a = jax.random.normal(rng, (3,)) * alpha_std
+    return _adjust_lighting(data, a)
+
+
+@register(name="_image_crop", aliases=("crop",), nondiff=True)
+def image_crop(data, *, x, y, width, height):
+    """Reference src/operator/image/crop.cc:37: HWC/NHWC crop at
+    (x,y) with size (width,height)."""
+    if data.ndim == 3:
+        return lax.dynamic_slice(
+            data, (y, x, 0), (height, width, data.shape[2]))
+    return lax.dynamic_slice(
+        data, (0, y, x, 0), (data.shape[0], height, width, data.shape[3]))
+
+
+@register(name="_image_resize", aliases=("resize",), nondiff=True)
+def image_resize(data, *, size=(), keep_ratio=False, interp=1):
+    """Reference src/operator/image/resize-inl.h: resize HWC/NHWC.
+    size = int (short edge if keep_ratio else square) or (w, h).
+    interp: 0 nearest, 1 bilinear (others map to bilinear — XLA resize
+    supports these two natively; cubic/lanczos would need a custom
+    kernel for no accuracy the zoo models care about)."""
+    hw = data.shape[-3:-1]
+    if isinstance(size, int):
+        size = (size,)
+    size = tuple(size)
+    if len(size) == 1:
+        if keep_ratio:
+            h, w = hw
+            if h < w:
+                new_h, new_w = size[0], max(1, int(round(w * size[0] / h)))
+            else:
+                new_h, new_w = max(1, int(round(h * size[0] / w))), size[0]
+        else:
+            new_h = new_w = size[0]
+    else:
+        new_w, new_h = size[0], size[1]
+    method = "nearest" if interp == 0 else "bilinear"
+    out_shape = data.shape[:-3] + (new_h, new_w, data.shape[-1])
+    out = jax.image.resize(data.astype(jnp.float32), out_shape, method)
+    return _saturate(out, data)

@@ -1,0 +1,316 @@
+"""Optimizer update operators.
+
+Reference: src/operator/optimizer_op.cc (~4K LoC with -inl.h): optimizer
+updates ARE operators (sgd_update, sgd_mom_update, adam_update, ...) so the
+engine can fuse/overlap them. Same design here: each update is a registered
+jax op — jit-cached, donate-friendly, and usable from both the eager Trainer
+path and fully-jitted train steps. Multi-weight fused variants
+(multi_sgd_update etc.) take interleaved arg lists like the reference.
+"""
+from __future__ import annotations
+
+from .registry import register
+
+import jax
+import jax.numpy as jnp
+
+
+@register(name="sgd_update", nondiff=True)
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+               lazy_update=True):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    return weight - lr * g
+
+
+@register(name="sgd_mom_update", nondiff=True)
+def sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    mom_new = momentum * mom - lr * g
+    return (weight + mom_new, mom_new)
+
+
+@register(name="nag_mom_update", nondiff=True)
+def nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    mom_new = momentum * mom + g
+    return (weight - lr * (g + momentum * mom_new), mom_new)
+
+
+@register(name="mp_sgd_update", nondiff=True)
+def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision SGD: bf16/fp16 weights with an fp32 master copy
+    (reference optimizer_op.cc MP_SGD_Update)."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight32
+    w32 = weight32 - lr * g
+    return (w32.astype(weight.dtype), w32)
+
+
+@register(name="mp_sgd_mom_update", nondiff=True)
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight32
+    mom_new = momentum * mom - lr * g
+    w32 = weight32 + mom_new
+    return (w32.astype(weight.dtype), mom_new, w32)
+
+
+@register(name="adam_update", nondiff=True)
+def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    return (weight - lr * m / (jnp.sqrt(v) + epsilon), m, v)
+
+
+@register(name="ftml_update", nondiff=True)
+def ftml_update(weight, grad, d, v, z, *, lr, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1):
+    g = grad * rescale_grad
+    if clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    g = g + wd * weight
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_new = (1 - beta1 ** t) / lr * (jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1 - beta1) * g - sigma * weight
+    return (-z_new / d_new, d_new, v_new, z_new)
+
+
+@register(name="rmsprop_update", nondiff=True)
+def rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    n_new = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    w = weight - lr * g / jnp.sqrt(n_new + epsilon)
+    if clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return (w, n_new)
+
+
+@register(name="rmspropalex_update", nondiff=True)
+def rmspropalex_update(weight, grad, n, g_s, delta, *, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    n_new = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    g_new = (1 - gamma1) * g + gamma1 * g_s
+    delta_new = gamma2 * delta - lr * g / jnp.sqrt(n_new - jnp.square(g_new) + epsilon)
+    w = weight + delta_new
+    if clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return (w, n_new, g_new, delta_new)
+
+
+@register(name="ftrl_update", nondiff=True)
+def ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    n_new = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z_new = z + g - sigma * weight
+    w = jnp.where(jnp.abs(z_new) > lamda1,
+                  -(z_new - jnp.sign(z_new) * lamda1) /
+                  ((beta + jnp.sqrt(n_new)) / lr + wd), 0.0)
+    return (w.astype(weight.dtype), z_new, n_new)
+
+
+@register(name="signsgd_update", nondiff=True)
+def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register(name="signum_update", nondiff=True)
+def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mom_new = momentum * mom - (1 - momentum) * (g + wd * weight)
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new)
+    return (w, mom_new)
+
+
+@register(name="adamw_update", nondiff=True)
+def adamw_update(weight, grad, mean, var, rescale_grad_arr=None, *, lr, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0, clip_gradient=-1.0,
+                 rescale_grad=1.0):
+    """Decoupled weight decay Adam (reference src/operator/contrib/adamw.cc)."""
+    rs = rescale_grad_arr if rescale_grad_arr is not None else rescale_grad
+    g = grad * rs
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight)
+    return (w, m, v)
+
+
+@register(name="multi_sgd_update", nondiff=True)
+def multi_sgd_update(*args, lrs, wds, rescale_grad=1.0, clip_gradient=-1.0,
+                     num_weights=1):
+    """Fused multi-weight SGD (reference optimizer_op.cc multi_sgd_update):
+    args = [w0, g0, w1, g1, ...]."""
+    outs = []
+    for i in range(num_weights):
+        w, g = args[2 * i], args[2 * i + 1]
+        outs.append(sgd_update.fn(w, g, lr=lrs[i], wd=wds[i],
+                                  rescale_grad=rescale_grad,
+                                  clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register(name="multi_sgd_mom_update", nondiff=True)
+def multi_sgd_mom_update(*args, lrs, wds, momentum=0.0, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=1):
+    outs = []
+    for i in range(num_weights):
+        w, g, m = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        outs.extend(sgd_mom_update.fn(w, g, m, lr=lrs[i], momentum=momentum,
+                                      wd=wds[i], rescale_grad=rescale_grad,
+                                      clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register(name="all_finite", nondiff=True)
+def all_finite(*arrays, init_output=True):
+    """AMP grad-scan (reference src/operator/contrib/all_finite.cc): 1.0 if
+    every element of every input is finite."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a.astype(jnp.float32))))
+    return ok.astype(jnp.float32)
+
+
+@register(name="multi_mp_sgd_update", nondiff=True)
+def multi_mp_sgd_update(*args, lrs, wds, rescale_grad=1.0, clip_gradient=-1.0,
+                        num_weights=1):
+    """Fused multi-weight multi-precision SGD (reference optimizer_op.cc
+    multi_mp_sgd_update): args = [w0, g0, w32_0, w1, g1, w32_1, ...]."""
+    outs = []
+    for i in range(num_weights):
+        w, g, w32 = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        outs.extend(mp_sgd_update.fn(w, g, w32, lr=lrs[i], wd=wds[i],
+                                     rescale_grad=rescale_grad,
+                                     clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register(name="multi_mp_sgd_mom_update", nondiff=True)
+def multi_mp_sgd_mom_update(*args, lrs, wds, momentum=0.0, rescale_grad=1.0,
+                            clip_gradient=-1.0, num_weights=1):
+    """args = [w0, g0, m0, w32_0, ...] (reference optimizer_op.cc)."""
+    outs = []
+    for i in range(num_weights):
+        w, g, m, w32 = (args[4 * i], args[4 * i + 1], args[4 * i + 2],
+                        args[4 * i + 3])
+        outs.extend(mp_sgd_mom_update.fn(w, g, m, w32, lr=lrs[i],
+                                         momentum=momentum, wd=wds[i],
+                                         rescale_grad=rescale_grad,
+                                         clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register(name="mp_nag_mom_update", nondiff=True)
+def mp_nag_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Multi-precision Nesterov momentum (reference optimizer_op.cc
+    mp_nag_mom_update)."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight32
+    mom_new = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * mom_new)
+    return (w32.astype(weight.dtype), mom_new, w32)
+
+
+@register(name="multi_all_finite", nondiff=True)
+def multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    """Fused finiteness scan over many arrays (reference
+    src/operator/contrib/all_finite.cc multi_all_finite)."""
+    return all_finite.fn(*arrays, init_output=init_output)
+
+
+@register(name="mp_adamw_update", nondiff=True)
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_arr=None,
+                    *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                    eta=1.0, clip_gradient=-1.0, rescale_grad=1.0):
+    """Multi-precision AdamW (reference src/operator/contrib/adamw.cc
+    _mp_adamw_update): fp32 master weights, bf16/fp16 working copy."""
+    rs = rescale_grad_arr if rescale_grad_arr is not None else rescale_grad
+    g = grad.astype(jnp.float32) * rs
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight32)
+    return (w32.astype(weight.dtype), m, v, w32)
+
+
+@register(name="group_adagrad_update",
+          aliases=("_contrib_group_adagrad_update",), nondiff=True)
+def group_adagrad_update(weight, grad, history, *, lr, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Group AdaGrad: ONE accumulator per row (reference
+    src/operator/contrib/optimizer_op-inl.h:46 GroupAdagradParam +
+    GroupAdagradDnsRspKernel): h[r] += mean(g[r]^2); w[r] -= lr*g[r] /
+    sqrt(h[r]+eps)."""
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    red = tuple(range(1, g.ndim))
+    h = history + jnp.mean(jnp.square(g), axis=red) if g.ndim > 1 else \
+        history + jnp.square(g)
+    scale = lr / jnp.sqrt(h + epsilon)
+    return (weight - g * scale.reshape((-1,) + (1,) * (g.ndim - 1)), h)
+
+
+@register(name="_sparse_adagrad_update", aliases=("adagrad_update",),
+          nondiff=True)
+def sparse_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7,
+                          rescale_grad=1.0, clip_gradient=-1.0, wd=0.0):
+    """AdaGrad (reference src/operator/optimizer_op-inl.h:2144
+    AdagradDnsRspDnsKernel): h += g^2; w -= lr * g / sqrt(h + eps).
+    The reference only registers the row_sparse-gradient form; the dense
+    form here touches every row, which is identical when the gradient
+    covers all rows (and the Optimizer layer handles lazy sparse skips)."""
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    h = history + jnp.square(g)
+    return (weight - lr * g / jnp.sqrt(h + epsilon), h)

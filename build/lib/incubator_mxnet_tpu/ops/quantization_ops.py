@@ -1,0 +1,303 @@
+"""INT8 quantization operators.
+
+Reference: src/operator/quantization/ (5,622 LoC): quantize(_v2)/
+dequantize/requantize + quantized conv/FC with int8 inputs and int32
+accumulation. TPU-native: int8 matmul/conv lower to the MXU via
+lax.dot_general/conv with preferred_element_type=int32 — the same
+int8-in/int32-accum contract cuDNN/MKLDNN give the reference.
+Affine scheme matches the reference: symmetric int8 ([-127, 127], zero
+point 0) and asymmetric uint8 ([0, 255]).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+__all__ = []
+
+
+def _ranges(out_type):
+    if out_type == "int8":
+        return -127.0, 127.0
+    if out_type == "uint8":
+        return 0.0, 255.0
+    raise MXNetError(f"unsupported quantized dtype {out_type!r}")
+
+
+@register(name="_contrib_quantize_v2", aliases=("quantize_v2",),
+          nondiff=True)
+def quantize_v2(data, *, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """Reference quantize_v2-inl.h: affine-quantize fp32 -> int8/uint8
+    with calibrated (or on-the-fly) ranges. Returns (qdata, min, max)."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx_ = jnp.max(data).astype(jnp.float32)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx_ = jnp.float32(max_calib_range)
+    qmin, qmax = _ranges(out_type)
+    if out_type == "int8":
+        # symmetric: scale by max(|min|, |max|) (reference
+        # quantize_v2 QuantizeToInt8)
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        scale = qmax / jnp.maximum(amax, 1e-30)
+        q = jnp.clip(jnp.round(data * scale), qmin, qmax).astype(jnp.int8)
+        return q, -amax, amax
+    scale = (qmax - qmin) / jnp.maximum(mx_ - mn, 1e-30)
+    q = jnp.clip(jnp.round((data - mn) * scale), qmin, qmax).astype(jnp.uint8)
+    return q, mn, mx_
+
+
+@register(name="_contrib_quantize", aliases=("quantize",), nondiff=True)
+def quantize(data, min_range, max_range, *, out_type="uint8"):
+    """Reference quantize-inl.h (explicit range arrays). Range inputs stay
+    traced — this op runs jitted."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx_ = jnp.asarray(max_range, jnp.float32).reshape(())
+    qmin, qmax = _ranges(out_type)
+    if out_type == "int8":
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        scale = qmax / jnp.maximum(amax, 1e-30)
+        q = jnp.clip(jnp.round(data * scale), qmin, qmax).astype(jnp.int8)
+        return q, -amax, amax
+    scale = (qmax - qmin) / jnp.maximum(mx_ - mn, 1e-30)
+    q = jnp.clip(jnp.round((data - mn) * scale), qmin, qmax).astype(jnp.uint8)
+    return q, mn, mx_
+
+
+@register(name="_contrib_dequantize", aliases=("dequantize",), nondiff=True)
+def dequantize(qdata, min_range, max_range, *, out_type="float32"):
+    """Reference dequantize-inl.h."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx_ = jnp.asarray(max_range, jnp.float32).reshape(())
+    if qdata.dtype == jnp.int8:
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        return qdata.astype(jnp.float32) * (amax / 127.0)
+    if qdata.dtype == jnp.int32:
+        # int32 accumulator from quantized_conv/FC: full-scale mapping
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        return qdata.astype(jnp.float32) * (amax / 2147483647.0)
+    scale = (mx_ - mn) / 255.0
+    return qdata.astype(jnp.float32) * scale + mn
+
+
+@register(name="_contrib_requantize", aliases=("requantize",), nondiff=True)
+def requantize(qdata, min_range, max_range, *, out_type="int8",
+               min_calib_range=None, max_calib_range=None):
+    """int32 accumulator -> int8 (reference requantize-inl.h)."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx_ = jnp.asarray(max_range, jnp.float32).reshape(())
+    real = qdata.astype(jnp.float32) * \
+        (jnp.maximum(jnp.abs(mn), jnp.abs(mx_)) / 2147483647.0)
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = max(abs(min_calib_range), abs(max_calib_range))
+    else:
+        amax = jnp.max(jnp.abs(real))
+    q = jnp.clip(jnp.round(real * (127.0 / jnp.maximum(amax, 1e-30))),
+                 -127, 127).astype(jnp.int8)
+    return q, -jnp.asarray(amax, jnp.float32), jnp.asarray(amax, jnp.float32)
+
+
+def _split_q_args(rest, no_bias):
+    """(bias, dmin, dmax, wmin, wmax, bmin, bmax) from the positional
+    tail, which omits every bias slot when the fp32 op had no bias."""
+    if no_bias:
+        r = rest[1:] if rest and rest[0] is None else rest
+        dmin, dmax, wmin, wmax = r[:4]
+        return None, dmin, dmax, wmin, wmax, None, None
+    if len(rest) < 7:
+        raise MXNetError(
+            "quantized op with a bias needs bias_min and bias_max "
+            "(positional tail: bias, data_min, data_max, weight_min, "
+            "weight_max, bias_min, bias_max); pass no_bias=True to omit "
+            "the bias slots")
+    return rest[:7]
+
+
+@register(name="_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",), nondiff=True)
+def quantized_fully_connected(data, weight, *rest, num_hidden=0,
+                              no_bias=False, flatten=True):
+    """int8 x int8 -> int32 matmul on the MXU (reference
+    quantized_fully_connected.cc). Positional tail: bias?, data_min,
+    data_max, weight_min, weight_max, bias_min?, bias_max? (bias slots
+    omitted under no_bias). Returns (out_i32, out_min, out_max)."""
+    bias, data_min, data_max, weight_min, weight_max, bias_min, bias_max = \
+        _split_q_args(rest, no_bias)
+    x = data
+    if flatten and x.ndim > 2:
+        x = jnp.reshape(x, (x.shape[0], -1))
+    out = lax.dot_general(x, weight,
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    d_amax = jnp.maximum(jnp.abs(data_min), jnp.abs(data_max)).reshape(())
+    w_amax = jnp.maximum(jnp.abs(weight_min), jnp.abs(weight_max)).reshape(())
+    out_amax = d_amax * w_amax * (2147483647.0 / (127.0 * 127.0))
+    if bias is not None and not no_bias:
+        b_amax = jnp.maximum(jnp.abs(bias_min), jnp.abs(bias_max)).reshape(())
+        # rescale bias into the output's int32 scale
+        b_real = bias.astype(jnp.float32) * (b_amax / 127.0)
+        scale = 2147483647.0 / jnp.maximum(out_amax, 1e-30)
+        out = out + jnp.round(b_real * scale).astype(jnp.int32)
+    return out, -out_amax, out_amax
+
+
+@register(name="_contrib_quantized_conv", aliases=("quantized_conv",),
+          nondiff=True)
+def quantized_conv(data, weight, *rest, kernel,
+                   stride=(), dilate=(), pad=(), num_filter=0, num_group=1,
+                   no_bias=False, layout=None, workspace=1024,
+                   cudnn_tune=None, cudnn_off=False):
+    """int8 convolution with int32 accumulation (reference
+    quantized_conv.cc). NCHW, weight OIHW like the fp op; positional tail
+    as in quantized_fully_connected."""
+    bias, data_min, data_max, weight_min, weight_max, bias_min, bias_max = \
+        _split_q_args(rest, no_bias)
+    nd_ = len(kernel)
+    stride = tuple(stride) or (1,) * nd_
+    dilate = tuple(dilate) or (1,) * nd_
+    pad = tuple(pad) or (0,) * nd_
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW") if nd_ == 2 else
+                                    ("NCW", "OIW", "NCW"))
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    d_amax = jnp.maximum(jnp.abs(data_min), jnp.abs(data_max)).reshape(())
+    w_amax = jnp.maximum(jnp.abs(weight_min), jnp.abs(weight_max)).reshape(())
+    out_amax = d_amax * w_amax * (2147483647.0 / (127.0 * 127.0))
+    if bias is not None and not no_bias:
+        b_amax = jnp.maximum(jnp.abs(bias_min), jnp.abs(bias_max)).reshape(())
+        b_real = bias.astype(jnp.float32) * (b_amax / 127.0)
+        scale = 2147483647.0 / jnp.maximum(out_amax, 1e-30)
+        out = out + jnp.round(b_real * scale).astype(jnp.int32).reshape(
+            (1, -1) + (1,) * nd_)
+    return out, -out_amax, out_amax
+
+
+# ---------------------------------------------------------------------------
+# Quantized op tail (reference src/operator/quantization/
+# quantized_pooling.cc, quantized_flatten.cc, quantized_activation.cc,
+# quantized_elemwise_add.cc, quantized_concat.cc, quantized_batch_norm.cc):
+# shape/range-preserving ops that keep a chain in int8 between the
+# matmul/conv sandwiches instead of bouncing through fp32.
+# ---------------------------------------------------------------------------
+
+@register(name="_contrib_quantized_pooling", aliases=("quantized_pooling",),
+          nondiff=True)
+def quantized_pooling(data, min_range, max_range, *, kernel=(), stride=(),
+                      pad=(), pool_type="max", global_pool=False,
+                      pooling_convention="valid", count_include_pad=True,
+                      layout=None, cudnn_off=False, p_value=2):
+    """Pool directly on the int8 lattice. max-pool is exact (max of codes
+    = code of max); avg-pool averages codes with round-to-nearest, the
+    reference's behavior. Ranges pass through unchanged."""
+    from .nn_ops import pooling as _pool_op
+    pooling = _pool_op.fn
+    if pool_type == "max":
+        out = pooling(data, kernel=kernel, pool_type="max",
+                      global_pool=global_pool, stride=stride, pad=pad,
+                      pooling_convention=pooling_convention)
+        return out, min_range, max_range
+    if pool_type != "avg":
+        raise MXNetError(f"quantized pooling supports max/avg, "
+                         f"got {pool_type!r}")
+    f = pooling(data.astype(jnp.float32), kernel=kernel, pool_type="avg",
+                global_pool=global_pool, stride=stride, pad=pad,
+                pooling_convention=pooling_convention,
+                count_include_pad=count_include_pad)
+    return jnp.round(f).astype(data.dtype), min_range, max_range
+
+
+@register(name="_contrib_quantized_flatten", aliases=("quantized_flatten",),
+          nondiff=True)
+def quantized_flatten(data, min_range, max_range):
+    return (jnp.reshape(data, (data.shape[0], -1)), min_range, max_range)
+
+
+@register(name="_contrib_quantized_act", aliases=("quantized_act",),
+          nondiff=True)
+def quantized_act(data, min_range, max_range, *, act_type="relu"):
+    """relu on symmetric int8/int32 codes: clamp negatives to the zero
+    point (0). The representable range keeps its magnitude so downstream
+    scales are unchanged (reference quantized_activation.cc)."""
+    if act_type != "relu":
+        raise MXNetError("only relu is supported quantized")
+    return jnp.maximum(data, 0), min_range, max_range
+
+
+@register(name="_contrib_quantized_elemwise_add",
+          aliases=("quantized_elemwise_add",), nondiff=True)
+def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 + int8 with independent scales -> int32 out
+    (reference quantized_elemwise_add.cc): both operands are rescaled to
+    the output's int32 scale, whose range is the sum of the input
+    magnitudes (the exact bound for a sum)."""
+    def code_max(x):
+        # int8 codes span +/-127, int32 (a conv/fc accumulator) the full
+        # int32 scale — the dequantization factor differs accordingly
+        return 127.0 if x.dtype == jnp.int8 else 2147483647.0
+
+    l_amax = jnp.maximum(jnp.abs(lhs_min), jnp.abs(lhs_max)).reshape(())
+    r_amax = jnp.maximum(jnp.abs(rhs_min), jnp.abs(rhs_max)).reshape(())
+    out_amax = l_amax + r_amax
+    scale = 2147483647.0 / jnp.maximum(out_amax, 1e-30)
+    lf = lhs.astype(jnp.float32) * (l_amax / code_max(lhs))
+    rf = rhs.astype(jnp.float32) * (r_amax / code_max(rhs))
+    out = jnp.clip(jnp.round((lf + rf) * scale), -2147483647, 2147483647)
+    return out.astype(jnp.int32), -out_amax, out_amax
+
+
+@register(name="_contrib_quantized_concat", aliases=("quantized_concat",),
+          nondiff=True)
+def quantized_concat(*args, dim=1, num_args=None):
+    """Concat n int8 inputs with per-input ranges: the output range is the
+    widest input range and every input is rescaled onto it (reference
+    quantized_concat.cc). args = data_0..data_{n-1}, then
+    min_0, max_0, min_1, max_1, ..."""
+    n = num_args or (len(args) // 3)
+    datas = args[:n]
+    mins = args[n::2]
+    maxs = args[n + 1::2]
+    amaxs = [jnp.maximum(jnp.abs(lo), jnp.abs(hi)).reshape(())
+             for lo, hi in zip(mins, maxs)]
+    out_amax = amaxs[0]
+    for a in amaxs[1:]:
+        out_amax = jnp.maximum(out_amax, a)
+    scaled = [jnp.clip(jnp.round(d.astype(jnp.float32) * (a / out_amax)),
+                       -127, 127).astype(jnp.int8)
+              for d, a in zip(datas, amaxs)]
+    return jnp.concatenate(scaled, axis=dim), -out_amax, out_amax
+
+
+@register(name="_contrib_quantized_batch_norm",
+          aliases=("quantized_batch_norm",), nondiff=True)
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_range, max_range, *, eps=1e-3, momentum=0.9,
+                         fix_gamma=False, use_global_stats=True, axis=1,
+                         output_mean_var=False, cudnn_off=False):
+    """Inference BatchNorm on int8 codes (reference
+    quantized_batchnorm.cc): the running-stat affine a*x+b is applied per
+    channel in the real domain and the result is requantized to int8 with
+    the affine image of the input range as the new range."""
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)).reshape(())
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    a = g * lax.rsqrt(moving_var + eps)
+    b = beta - moving_mean * a
+    shape = [1] * data.ndim
+    shape[axis % data.ndim] = data.shape[axis % data.ndim]
+    real = data.astype(jnp.float32) * (amax / 127.0)
+    out_real = real * jnp.reshape(a, shape) + jnp.reshape(b, shape)
+    # exact affine image of [-amax, amax] per channel, then the global hull
+    out_amax = jnp.max(jnp.abs(a) * amax + jnp.abs(b))
+    q = jnp.clip(jnp.round(out_real * (127.0 / jnp.maximum(out_amax, 1e-30))),
+                 -127, 127).astype(jnp.int8)
+    return q, -out_amax, out_amax

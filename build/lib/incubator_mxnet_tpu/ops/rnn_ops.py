@@ -1,0 +1,194 @@
+"""Fused RNN operator: relu/tanh RNN, LSTM, GRU; multi-layer, bidirectional.
+
+Reference: src/operator/rnn-inl.h (modes at :64-70, cuDNN path :704+, native
+CPU rnn_impl.h). The reference packs all parameters into ONE flat vector in
+cuDNN layout — weights for every (layer, direction) first, then biases —
+and mutates per-timestep workspaces.
+
+TPU-native redesign: the sequence loop is `lax.scan` over time with the
+input-to-hidden projection hoisted OUT of the scan (one big [T*N, in]x[in,
+G*H] matmul that rides the MXU; the scan body only does the [N,H]x[H,G*H]
+recurrent matmul). Gate order and equations match cuDNN exactly so flat
+parameter vectors from reference checkpoints drop in:
+  LSTM gates [i, f, g, o]; GRU gates [r, z, n] with the reset gate applied
+  AFTER the hidden projection (cuDNN's linear_before_reset semantics).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+__all__ = ["rnn_forward", "GATES"]
+
+GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _cell_step(mode, x_proj, h, c, w_hh, b_hh, clip=None):
+    """One timestep. x_proj already includes W_ih x + b_ih."""
+    if mode == "gru":
+        # cuDNN: r/z from the summed projections; n uses r *after* the
+        # hidden-side linear (linear_before_reset)
+        xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+        hproj = h @ w_hh.T + b_hh
+        hr, hz, hn = jnp.split(hproj, 3, axis=-1)
+        rg = jax.nn.sigmoid(xr + hr)
+        zg = jax.nn.sigmoid(xz + hz)
+        ng = jnp.tanh(xn + rg * hn)
+        return (1 - zg) * ng + zg * h, c
+    r = x_proj + h @ w_hh.T + b_hh
+    if mode == "rnn_relu":
+        return jnp.maximum(r, 0), c
+    if mode == "rnn_tanh":
+        return jnp.tanh(r), c
+    if mode == "lstm":
+        i, f, g, o = jnp.split(r, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        if clip is not None:
+            lo, hi, clip_nan = clip
+            if clip_nan:
+                c_new = jnp.nan_to_num(c_new, nan=0.0)
+            c_new = jnp.clip(c_new, lo, hi)
+        return o * jnp.tanh(c_new), c_new
+    raise MXNetError(f"unknown RNN mode {mode!r}")
+
+
+def _scan_layer(mode, xs, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse=False,
+                clip=None):
+    """Run one direction of one layer over the whole sequence.
+
+    xs: [T, N, in]; returns (out [T, N, H], h_T, c_T)."""
+    T, N = xs.shape[0], xs.shape[1]
+    # hoist the input projection out of the scan: one MXU-sized matmul
+    x_proj = (xs.reshape(T * N, -1) @ w_ih.T + b_ih).reshape(T, N, -1)
+    if reverse:
+        x_proj = jnp.flip(x_proj, axis=0)
+
+    def step(carry, xp):
+        h, c = carry
+        h_new, c_new = _cell_step(mode, xp, h, c, w_hh, b_hh, clip=clip)
+        return (h_new, c_new), h_new
+
+    (h_T, c_T), out = lax.scan(step, (h0, c0), x_proj)
+    if reverse:
+        out = jnp.flip(out, axis=0)
+    return out, h_T, c_T
+
+
+def rnn_forward(xs, h0, c0, layer_params, mode, bidirectional=False,
+                dropout=0.0, training=False, rng=None, clip=None):
+    """Functional multi-layer (bi)RNN.
+
+    xs: [T, N, input]; h0/c0: [L*D, N, H];
+    layer_params: list over (layer, direction) of (w_ih, w_hh, b_ih, b_hh).
+    Returns (out [T, N, H*D], h_T [L*D, N, H], c_T [L*D, N, H]).
+    """
+    D = 2 if bidirectional else 1
+    L = len(layer_params) // D
+    hs, cs = [], []
+    cur = xs
+    key = rng
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            idx = layer * D + d
+            w_ih, w_hh, b_ih, b_hh = layer_params[idx]
+            out, h_T, c_T = _scan_layer(mode, cur, h0[idx], c0[idx],
+                                        w_ih, w_hh, b_ih, b_hh,
+                                        reverse=(d == 1), clip=clip)
+            outs.append(out)
+            hs.append(h_T)
+            cs.append(c_T)
+        cur = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if dropout and training and layer < L - 1:
+            if key is None:
+                raise MXNetError("RNN dropout requires an rng key")
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1 - dropout, cur.shape)
+            cur = jnp.where(keep, cur / (1 - dropout), 0).astype(cur.dtype)
+    return cur, jnp.stack(hs), jnp.stack(cs)
+
+
+def _unpack_flat_params(parameters, mode, input_size, state_size, num_layers,
+                        bidirectional):
+    """Slice the cuDNN-layout flat vector (reference rnn-inl.h
+    GetRnnParamSize: all weights first, then all biases)."""
+    G = GATES[mode]
+    D = 2 if bidirectional else 1
+    H = state_size
+    shapes_w = []
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        for _ in range(D):
+            shapes_w.append((G * H, in_sz))
+            shapes_w.append((G * H, H))
+    off = 0
+    weights = []
+    for shp in shapes_w:
+        n = shp[0] * shp[1]
+        weights.append(parameters[off:off + n].reshape(shp))
+        off += n
+    biases = []
+    for _ in range(num_layers * D * 2):
+        biases.append(parameters[off:off + G * H])
+        off += G * H
+    layer_params = []
+    for i in range(num_layers * D):
+        layer_params.append((weights[2 * i], weights[2 * i + 1],
+                             biases[2 * i], biases[2 * i + 1]))
+    return layer_params
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    G = GATES[mode]
+    D = 2 if bidirectional else 1
+    H = state_size
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        size += D * (G * H * in_sz + G * H * H + 2 * G * H)
+    return size
+
+
+@register(name="RNN", aliases=("rnn",), stateful=True, train_aware=True)
+def rnn_op(data, parameters, state, state_cell=None, *, state_size,
+           num_layers, mode="lstm", bidirectional=False, p=0.0,
+           state_outputs=False, projection_size=None, use_sequence_length=False,
+           lstm_state_clip_min=None, lstm_state_clip_max=None,
+           lstm_state_clip_nan=False, training=False, rng=None):
+    """Fused RNN (reference src/operator/rnn-inl.h RNNParam).
+
+    data: [T, N, input] (TNC). parameters: flat vector in cuDNN layout.
+    state: [L*D, N, H]; state_cell: LSTM cell state.
+    Returns out, or (out, state_h[, state_cell]) when state_outputs.
+    """
+    if projection_size is not None:
+        raise MXNetError("projection_size is not supported")
+    if use_sequence_length:
+        raise MXNetError(
+            "use_sequence_length is not supported by the fused RNN op; "
+            "mask with SequenceMask/SequenceLast or use cell unroll with "
+            "valid_length")
+    layer_params = _unpack_flat_params(parameters, mode, data.shape[2],
+                                       state_size, num_layers, bidirectional)
+    c0 = state_cell if state_cell is not None else jnp.zeros_like(state)
+    # cuDNN clips the cell state at EVERY timestep (rnn-inl.h
+    # lstm_state_clip_*), so the clip threads into the scan body
+    clip = None
+    if mode == "lstm" and lstm_state_clip_min is not None \
+            and lstm_state_clip_max is not None:
+        clip = (lstm_state_clip_min, lstm_state_clip_max,
+                bool(lstm_state_clip_nan))
+    out, h_T, c_T = rnn_forward(data, state, c0, layer_params, mode,
+                                bidirectional=bidirectional, dropout=p,
+                                training=training, rng=rng, clip=clip)
+    if not state_outputs:
+        return out
+    if mode == "lstm":
+        return (out, h_T, c_T)
+    return (out, h_T)

@@ -1,0 +1,176 @@
+"""Spatial-transform op family.
+
+Reference: src/operator/spatial_transformer-inl.h, grid_generator-inl.h,
+bilinear_sampler-inl.h (cuDNN paths cudnn_spatial_transformer-inl.h,
+cudnn_bilinear_sampler), src/operator/correlation-inl.h (FlowNet
+correlation layer), src/operator/svm_output-inl.h.
+
+TPU-native design: all samplers are gather-based (vectorized advanced
+indexing lowers to XLA gather, which tiles fine) with the out-of-bounds
+zero-padding expressed as masked accumulation — no scalar loops, fully
+differentiable through jax autodiff, so no hand-written backward kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _bilinear_gather(data, gx, gy):
+    """Sample data (N,C,H,W) at pixel coords gx/gy (N,Ho,Wo) with bilinear
+    interpolation and zero padding outside the image."""
+    N, C, H, W = data.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    out = 0.0
+    bidx = jnp.arange(N)[:, None, None]
+    for dy in (0, 1):
+        for dx in (0, 1):
+            xs = x0 + dx
+            ys = y0 + dy
+            w = (1 - jnp.abs(gx - xs)) * (1 - jnp.abs(gy - ys))
+            valid = (xs >= 0) & (xs <= W - 1) & (ys >= 0) & (ys <= H - 1)
+            xc = jnp.clip(xs, 0, W - 1).astype(jnp.int32)
+            yc = jnp.clip(ys, 0, H - 1).astype(jnp.int32)
+            v = data[bidx, :, yc, xc]                 # (N, Ho, Wo, C)
+            out = out + v * (w * valid)[..., None].astype(data.dtype)
+    return jnp.moveaxis(out, -1, 1)                   # (N, C, Ho, Wo)
+
+
+@register(name="BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid, *, cudnn_off=None):
+    """data (N,C,H,W); grid (N,2,Ho,Wo) with grid[:,0]=x, grid[:,1]=y in
+    [-1,1] (reference bilinear_sampler-inl.h: -1 maps to pixel 0, +1 to
+    W-1/H-1; outside is zero-padded)."""
+    N, C, H, W = data.shape
+    gx = (grid[:, 0] + 1.0) * (W - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return _bilinear_gather(data, gx, gy)
+
+
+@register(name="GridGenerator", aliases=("grid_generator",))
+def grid_generator(data, *, transform_type="affine", target_shape=(0, 0)):
+    """affine: data (N,6) row-major 2x3 theta -> normalized sampling grid
+    (N,2,H,W). warp: data (N,2,H,W) is a pixel-unit optical flow added to
+    the identity grid, renormalized to [-1,1]."""
+    if transform_type == "affine":
+        N = data.shape[0]
+        H, W = int(target_shape[0]), int(target_shape[1])
+        theta = jnp.reshape(data, (N, 2, 3)).astype(jnp.float32)
+        ys, xs = jnp.meshgrid(jnp.linspace(-1.0, 1.0, H),
+                              jnp.linspace(-1.0, 1.0, W), indexing="ij")
+        ones = jnp.ones_like(xs)
+        src = jnp.stack([xs, ys, ones], 0).reshape(3, -1)   # (3, H*W)
+        out = jnp.einsum("nij,jk->nik", theta, src)          # (N, 2, H*W)
+        return out.reshape(N, 2, H, W).astype(data.dtype)
+    if transform_type == "warp":
+        N, _, H, W = data.shape
+        ys, xs = jnp.meshgrid(jnp.arange(H, dtype=jnp.float32),
+                              jnp.arange(W, dtype=jnp.float32), indexing="ij")
+        gx = (data[:, 0] + xs) * 2.0 / max(W - 1, 1) - 1.0
+        gy = (data[:, 1] + ys) * 2.0 / max(H - 1, 1) - 1.0
+        return jnp.stack([gx, gy], 1).astype(data.dtype)
+    raise ValueError(f"GridGenerator transform_type {transform_type!r}")
+
+
+@register(name="SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, *, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=None):
+    """STN (Jaderberg et al.): affine grid from loc (N,6), bilinear sample
+    (reference spatial_transformer-inl.h composes the same two stages)."""
+    grid = grid_generator.fn(loc, transform_type=transform_type,
+                             target_shape=tuple(target_shape))
+    return bilinear_sampler.fn(data, grid)
+
+
+@register(name="Correlation", aliases=("correlation",))
+def correlation(data1, data2, *, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation (reference correlation-inl.h): for every output
+    position, correlate a kernel_size^2 patch of data1 with displaced
+    patches of data2 over a (2*max_displacement/stride2+1)^2 grid.
+
+    The displacement grid is a static python loop (D^2 shifted elementwise
+    products — XLA fuses them); patch aggregation is an average pool.
+    Output: (N, D*D, Ho, Wo), normalized by patch volume like the
+    reference (sumelems = kernel^2 * C).
+    """
+    N, C, H, W = data1.shape
+    d = int(max_displacement)
+    pad = int(pad_size)
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    k = int(kernel_size)
+    kr = k // 2
+    bord = d + kr
+    import math
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    Ho = int(math.ceil((Hp - bord * 2) / float(stride1)))
+    Wo = int(math.ceil((Wp - bord * 2) / float(stride1)))
+
+    maps = []
+    for dy in range(-(d // stride2), d // stride2 + 1):
+        for dx in range(-(d // stride2), d // stride2 + 1):
+            sy, sx = dy * stride2, dx * stride2
+            shifted = jnp.roll(p2, (-sy, -sx), axis=(2, 3))
+            # reference accumulates fabsf(a-b) (no negation) for the
+            # subtract variant
+            prod = p1 * shifted if is_multiply else jnp.abs(p1 - shifted)
+            m = jnp.mean(prod, axis=1)                  # (N, Hp, Wp) mean over C
+            if k > 1:
+                m = lax.reduce_window(m, 0.0, lax.add, (1, k, k), (1, 1, 1),
+                                      "SAME") / (k * k)
+            maps.append(m)
+    corr = jnp.stack(maps, axis=1)                      # (N, D*D, Hp, Wp)
+    # valid output window: centers where the full displaced patch exists
+    corr = corr[:, :, bord:bord + Ho * stride1:stride1,
+                bord:bord + Wo * stride1:stride1]
+    return corr.astype(data1.dtype)
+
+
+@register(name="SVMOutput", aliases=("svm_output",))
+def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Forward is identity (scores pass through, like SoftmaxOutput);
+    the one-vs-all hinge loss shapes the BACKWARD. Expressed as a
+    straight-through custom-vjp. Matches the reference's L1_SVM gradient
+    (src/operator/svm_output.cc:31-48); for L2 the reference's L2_SVM
+    (:50-64) emits the opposite sign from its own L1 (and drops reg) —
+    here both use the consistent descent direction
+    d = -reg * sign * dviol (L1) / -2*reg * sign * viol (L2)."""
+    m = float(margin)
+    reg = float(regularization_coefficient)
+
+    @jax.custom_vjp
+    def _svm(scores, lab):
+        return scores
+
+    def fwd(scores, lab):
+        return scores, (scores, lab)
+
+    def bwd(res, g):
+        scores, lab = res
+        n, k = scores.shape
+        onehot = jax.nn.one_hot(lab.astype(jnp.int32), k,
+                                dtype=scores.dtype)
+        sign = 2.0 * onehot - 1.0              # +1 at true class, -1 else
+        viol = jnp.maximum(0.0, m - sign * scores)
+        if use_linear:
+            grad = -reg * sign * (viol > 0)
+        else:
+            grad = -2.0 * reg * sign * viol
+        # like the reference loss layers, the incoming head grad is ignored
+        if jnp.issubdtype(lab.dtype, jnp.floating):
+            zlab = jnp.zeros_like(lab)
+        else:
+            import numpy as _np
+            from jax import dtypes as _dtypes
+            zlab = _np.zeros(lab.shape, _dtypes.float0)
+        return (grad.astype(scores.dtype), zlab)
+
+    _svm.defvjp(fwd, bwd)
+    return _svm(data, label)

@@ -1,0 +1,415 @@
+"""Operator long tail: the last reference-parity ops outside the family files.
+
+Reference: src/operator/tensor/elemwise_sum.cc (add_n),
+elemwise_unary_op_basic.cc (reshape_like), matrix_op.cc (_slice_assign),
+la_op.cc (the linalg factorization/diag tail), init_op.cc (_linspace,
+_zeros_without_dtype, _contrib_arange_like), contrib/bounding_box.cc
+(_contrib_bipartite_matching), contrib/sync_batch_norm-inl.h
+(SyncBatchNorm), sparse_retain.cc, square_sum-inl.h.
+
+Each op is ONE pure jax function; gradients via jax.vjp like the rest of
+the registry. Host-sequential algorithms (bipartite matching) run eager
+like the DGL family — the reference registers them as CPU kernels too.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# ---------------------------------------------------------------------------
+# elementwise-sum / reshape / assignment tail (tensor/)
+# ---------------------------------------------------------------------------
+
+@register(name="add_n", aliases=("ElementWiseSum", "_sum"))
+def add_n(*args, num_args=None):
+    """Sum of N arrays (reference src/operator/tensor/elemwise_sum.cc:1)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register(name="_rnn_param_concat")
+def _rnn_param_concat(*args, dim=0, num_args=None):
+    """RNN parameter flattening concat (reference
+    src/operator/nn/concat.cc _rnn_param_concat): plain concat along
+    `dim`, kept as its own name for symbol-JSON parity."""
+    return jnp.concatenate(args, axis=dim)
+
+
+@register(name="reshape_like")
+def reshape_like(lhs, rhs, *, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape lhs to rhs's shape over an index window (reference
+    src/operator/tensor/elemwise_unary_op_basic.cc:1 ReshapeLikeParam)."""
+    def _win(nd_, b, e):
+        b = 0 if b is None else (b + nd_ if b < 0 else b)
+        e = nd_ if e is None else (e + nd_ if e < 0 else e)
+        return b, e
+    lb, le = _win(lhs.ndim, lhs_begin, lhs_end)
+    rb, re_ = _win(rhs.ndim, rhs_begin, rhs_end)
+    shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    if int(_np.prod(shape, dtype=_np.int64)) != lhs.size:
+        raise MXNetError(
+            f"reshape_like: target shape {shape} does not match lhs size "
+            f"{lhs.size}")
+    return jnp.reshape(lhs, shape)
+
+
+def _slice_window(shape, begin, end, step):
+    idx = []
+    for i in range(len(begin)):
+        s = (step[i] if step is not None and i < len(step)
+             and step[i] is not None else 1)
+        idx.append(slice(begin[i], end[i], s))
+    while len(idx) < len(shape):
+        idx.append(slice(None))
+    return tuple(idx)
+
+
+@register(name="_slice_assign", aliases=("_crop_assign",))
+def _slice_assign(lhs, rhs, *, begin, end, step=None):
+    """Copy of lhs with lhs[begin:end:step] = rhs (reference
+    src/operator/tensor/matrix_op.cc:532 — the in-place `x[idx] = y`
+    lowering; functional out-of-place here for XLA)."""
+    return lhs.at[_slice_window(lhs.shape, begin, end, step)].set(
+        rhs.astype(lhs.dtype))
+
+
+@register(name="_slice_assign_scalar", aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(data, *, scalar=0.0, begin=(), end=(), step=None):
+    """Reference src/operator/tensor/matrix_op.cc:557."""
+    return data.at[_slice_window(data.shape, begin, end, step)].set(
+        jnp.asarray(scalar, data.dtype))
+
+
+@register(name="_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs; rhs only pins shape/storage attrs (reference
+    elemwise_unary_op_basic.cc — used by the sparse grad plumbing)."""
+    return lhs
+
+
+@register(name="_square_sum", aliases=("square_sum",))
+def _square_sum(data, *, axis=None, keepdims=False, exclude=False):
+    """sum(x**2) fused reduction (reference src/operator/tensor/
+    square_sum-inl.h — the row_sparse fast path is moot here: XLA fuses
+    square into the reduce)."""
+    ax = None if axis is None else (tuple(axis) if isinstance(
+        axis, (tuple, list)) else (axis,))
+    if exclude and ax is not None:
+        ax = tuple(i for i in range(data.ndim) if i not in
+                   tuple(a % data.ndim for a in ax))
+    return jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims)
+
+
+@register(name="_sparse_retain", nondiff=True)
+def _sparse_retain(data, indices):
+    """Dense view of row-retention (reference
+    src/operator/tensor/sparse_retain.cc:1): zero every row of `data`
+    whose index is not in `indices`. The RowSparse-storage form lives on
+    ndarray.sparse.retain; this op is the jit-compatible dense analog."""
+    keep = jnp.zeros((data.shape[0],), jnp.bool_).at[
+        indices.astype(jnp.int32)].set(True)
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@register(name="hard_sigmoid")
+def hard_sigmoid(data, *, alpha=0.2, beta=0.5):
+    """Reference src/operator/tensor/elemwise_unary_op_basic.cc
+    hard_sigmoid."""
+    return jnp.clip(alpha * data + beta, 0.0, 1.0).astype(data.dtype)
+
+
+@register(name="_linspace", aliases=("linspace_op",), nondiff=True)
+def _linspace(*, start, stop=None, num, endpoint=True, dtype="float32",
+              ctx=None):
+    """Reference src/operator/tensor/init_op.cc _linspace."""
+    from ..base import dtype_np
+    return jnp.linspace(start, stop if stop is not None else start, int(num),
+                        endpoint=endpoint, dtype=dtype_np(dtype))
+
+
+@register(name="_zeros_without_dtype", nondiff=True)
+def _zeros_without_dtype(*, shape, ctx=None, dtype=None):
+    """Reference src/operator/tensor/init_op.cc _zeros_without_dtype:
+    zeros defaulting to float32 when no dtype is given."""
+    from ..base import dtype_np
+    return jnp.zeros(tuple(shape) if isinstance(shape, (tuple, list))
+                     else (shape,), dtype_np(dtype or "float32"))
+
+
+@register(name="arange_like", aliases=("_contrib_arange_like",),
+          nondiff=True)
+def arange_like(data, *, start=0.0, step=1.0, repeat=1, axis=None, ctx=None):
+    """Reference src/operator/contrib/../tensor/init_op.cc:104
+    _contrib_arange_like: arange shaped like `data` (flat, or along one
+    axis)."""
+    if axis is None:
+        n = data.size
+        out = start + step * jnp.repeat(jnp.arange(n // repeat,
+                                                   dtype=jnp.float32), repeat)
+        return out.reshape(data.shape).astype(data.dtype)
+    ax = axis % data.ndim
+    n = data.shape[ax]
+    return (start + step * jnp.arange(n, dtype=jnp.float32)).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# linalg factorization/diag tail (tensor/la_op.cc)
+# ---------------------------------------------------------------------------
+
+@register(name="linalg_syevd")
+def linalg_syevd(a):
+    """Eigendecomposition of symmetric A = U^T diag(L) U (reference
+    src/operator/tensor/la_op.cc:1 _linalg_syevd; rows of U are the
+    eigenvectors — the transpose of numpy's column convention)."""
+    w, v = jnp.linalg.eigh(a)
+    return (jnp.swapaxes(v, -1, -2), w)
+
+
+@register(name="linalg_potri")
+def linalg_potri(a):
+    """Inverse of B = A A^T from its Cholesky factor A (reference
+    la_op.cc _linalg_potri): B^-1 = A^-T A^-1."""
+    import jax.scipy.linalg as jsl
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    ainv = jsl.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(ainv, -1, -2), ainv)
+
+
+@register(name="linalg_slogdet")
+def linalg_slogdet(a):
+    """Reference la_op.cc _linalg_slogdet: (sign, log|det|)."""
+    sign, logabs = jnp.linalg.slogdet(a)
+    return (sign, logabs)
+
+
+@register(name="linalg_gelqf")
+def linalg_gelqf(a):
+    """LQ factorization A = L Q with orthonormal rows of Q (reference
+    la_op.cc _linalg_gelqf, requires m <= n): via QR of A^T."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+    # sign-normalize so L has a non-negative diagonal (LAPACK convention)
+    d = jnp.sign(jnp.diagonal(r, axis1=-2, axis2=-1))
+    d = jnp.where(d == 0, 1.0, d).astype(a.dtype)
+    q = q * d[..., None, :]
+    r = r * d[..., :, None]
+    return (jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2))
+
+
+@register(name="linalg_trmm")
+def linalg_trmm(a, b, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply out = alpha * op(tri(A)) B, or B op(A)
+    when rightside (reference la_op.cc _linalg_trmm)."""
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b)
+    return alpha * out
+
+
+@register(name="linalg_extractdiag")
+def linalg_extractdiag(a, *, offset=0):
+    """Reference la_op.cc _linalg_extractdiag."""
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register(name="linalg_makediag")
+def linalg_makediag(d, *, offset=0):
+    """Reference la_op.cc _linalg_makediag."""
+    n = d.shape[-1] + abs(offset)
+    base = jnp.zeros(d.shape[:-1] + (n, n), d.dtype)
+    idx = jnp.arange(d.shape[-1])
+    r = idx + max(0, -offset)
+    c = idx + max(0, offset)
+    return base.at[..., r, c].set(d)
+
+
+@register(name="linalg_extracttrian")
+def linalg_extracttrian(a, *, offset=0, lower=True):
+    """Flatten the triangle at `offset` into a vector, row-major
+    (reference la_op.cc _linalg_extracttrian)."""
+    n = a.shape[-1]
+    rows, cols = _trian_indices(n, offset, lower)
+    return a[..., rows, cols]
+
+
+@register(name="linalg_maketrian")
+def linalg_maketrian(d, *, offset=0, lower=True):
+    """Inverse of extracttrian (reference la_op.cc _linalg_maketrian)."""
+    k = d.shape[-1]
+    # triangle of side m has m*(m+1)/2 entries; with |offset| the square
+    # is m + |offset| wide
+    m = int((_np.sqrt(8 * k + 1) - 1) / 2)
+    n = m + abs(offset)
+    rows, cols = _trian_indices(n, offset, lower)
+    base = jnp.zeros(d.shape[:-1] + (n, n), d.dtype)
+    return base.at[..., rows, cols].set(d)
+
+
+def _trian_indices(n, offset, lower):
+    if lower:
+        return _np.tril_indices(n, offset)
+    return _np.triu_indices(n, offset)
+
+
+# ---------------------------------------------------------------------------
+# bipartite matching (contrib/bounding_box.cc:158)
+# ---------------------------------------------------------------------------
+
+@register(name="bipartite_matching",
+          aliases=("_contrib_bipartite_matching",), nondiff=True)
+def bipartite_matching(data, *, threshold, is_ascend=False, topk=-1):
+    """Greedy bipartite matching over a (..., rows, cols) score matrix
+    (reference src/operator/contrib/bounding_box.cc:158 + bounding_box-inl.h
+    struct bipartite_matching). Returns (row_match, col_match): for each
+    row the matched col (or -1), and vice versa. The greedy scan is
+    inherently sequential — lax.fori_loop over the sorted score list keeps
+    it on-device with static shapes."""
+    shape = data.shape
+    rows_n, cols_n = shape[-2], shape[-1]
+    flat = data.reshape((-1, rows_n * cols_n))
+
+    def one(scores):
+        order = jnp.argsort(-scores if not is_ascend else scores,
+                            stable=True)
+
+        def body(j, carry):
+            rmark, cmark, count, stop = carry
+            idx = order[j]
+            s = scores[idx]
+            r = idx // cols_n
+            c = idx % cols_n
+            good = jnp.where(is_ascend, s < threshold, s > threshold)
+            free = jnp.logical_and(rmark[r] == -1, cmark[c] == -1)
+            # the reference breaks at the first bad score among free pairs
+            stop = jnp.logical_or(stop, jnp.logical_and(
+                free, jnp.logical_not(good)))
+            do = jnp.logical_and(jnp.logical_and(free, good),
+                                 jnp.logical_not(stop))
+            if topk > 0:
+                do = jnp.logical_and(do, count < topk)
+            rmark = jnp.where(do, rmark.at[r].set(c), rmark)
+            cmark = jnp.where(do, cmark.at[c].set(r), cmark)
+            count = count + do.astype(jnp.int32)
+            return (rmark, cmark, count, stop)
+
+        init = (jnp.full((rows_n,), -1.0, data.dtype),
+                jnp.full((cols_n,), -1.0, data.dtype),
+                jnp.int32(0), jnp.bool_(False))
+        rmark, cmark, _, _ = lax.fori_loop(0, rows_n * cols_n, body, init)
+        return rmark, cmark
+
+    r, c = jax.vmap(one)(flat)
+    return (r.reshape(shape[:-2] + (rows_n,)),
+            c.reshape(shape[:-2] + (cols_n,)))
+
+
+# ---------------------------------------------------------------------------
+# SyncBatchNorm (contrib/sync_batch_norm-inl.h:56)
+# ---------------------------------------------------------------------------
+
+@register(name="SyncBatchNorm", aliases=("_contrib_SyncBatchNorm",),
+          train_aware=True)
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
+                    momentum=0.9, fix_gamma=True, use_global_stats=False,
+                    output_mean_var=False, ndev=1, key="", axis_name=None,
+                    training=False):
+    """Cross-device BatchNorm (reference
+    src/operator/contrib/sync_batch_norm-inl.h:56). The reference syncs
+    batch statistics over `ndev` GPUs with a key-matched barrier; on TPU
+    the sync is `lax.pmean` over the mesh axis named `axis_name` when the
+    op runs inside shard_map/pmap — the SPMD program IS the barrier.
+    Outside a mesh (axis_name=None) it reduces to single-device
+    BatchNorm, which is exactly the reference semantics at ndev=1."""
+    red = tuple(i for i in range(data.ndim) if i != 1)
+    if training and not use_global_stats:
+        mean = jnp.mean(data.astype(jnp.float32), axis=red)
+        sq = jnp.mean(jnp.square(data.astype(jnp.float32)), axis=red)
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            sq = lax.pmean(sq, axis_name)
+        var = sq - jnp.square(mean)
+    else:
+        mean, var = moving_mean, moving_var
+    shape = [1] * data.ndim
+    shape[1] = data.shape[1]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    out = (data - jnp.reshape(mean, shape).astype(data.dtype)) * lax.rsqrt(
+        jnp.reshape(var, shape).astype(data.dtype) + eps) \
+        * jnp.reshape(g, shape).astype(data.dtype) \
+        + jnp.reshape(beta, shape).astype(data.dtype)
+    return (out, mean, var)
+
+
+@register(name="SparseEmbedding", aliases=("_contrib_SparseEmbedding",))
+def sparse_embedding(data, weight, *, input_dim, output_dim, dtype="float32",
+                     deterministic=False):
+    """Reference src/operator/tensor/indexing_op.cc SparseEmbedding: same
+    lookup as Embedding; the 'sparse gradient' is a storage hint that has
+    no analog under XLA (gather grads are scatter-adds already)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# reference-name aliases with no existing registration
+# (elemwise_binary_scalar_op_basic.cc uses _minus/_rminus; the sparse
+# _scatter_* forms are identical computations with a storage hint)
+# ---------------------------------------------------------------------------
+
+@register(name="_minus_scalar")
+def _minus_scalar(data, *, scalar):
+    return data - jnp.asarray(scalar, data.dtype)
+
+
+@register(name="_rminus_scalar")
+def _rminus_scalar(data, *, scalar):
+    return jnp.asarray(scalar, data.dtype) - data
+
+
+@register(name="_hypot_scalar")
+def _hypot_scalar(data, *, scalar):
+    return jnp.hypot(data, jnp.asarray(scalar, data.dtype))
+
+
+@register(name="_scatter_plus_scalar")
+def _scatter_plus_scalar(data, *, scalar):
+    """Reference elemwise_binary_scalar_op_basic.cc _scatter_plus_scalar:
+    scalar add that only writes stored (nonzero) elements of a sparse
+    input. Dense tensors have every element stored, so this is + scalar;
+    the sparse-storage form lives on ndarray.sparse."""
+    return data + jnp.asarray(scalar, data.dtype)
+
+
+@register(name="_scatter_minus_scalar")
+def _scatter_minus_scalar(data, *, scalar):
+    return data - jnp.asarray(scalar, data.dtype)
+
+
+@register(name="_scatter_elemwise_div")
+def _scatter_elemwise_div(lhs, rhs):
+    return lhs / rhs
+
+
+def _logical(name, fn):
+    @register(name=name, nondiff=True)
+    def _op(lhs, rhs, _f=fn):
+        return _f(lhs != 0, rhs != 0).astype(lhs.dtype)
+
+    @register(name=name + "_scalar", nondiff=True)
+    def _ops(data, *, scalar, _f=fn):
+        return _f(data != 0, bool(scalar)).astype(data.dtype)
+
+
+_logical("_logical_and", jnp.logical_and)
+_logical("_logical_or", jnp.logical_or)
+_logical("_logical_xor", jnp.logical_xor)

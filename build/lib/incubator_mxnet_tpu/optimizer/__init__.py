@@ -1,0 +1,2 @@
+from .optimizer import *  # noqa: F401,F403
+from . import optimizer  # noqa: F401

@@ -1,0 +1,643 @@
+"""Optimizers.
+
+Reference: python/mxnet/optimizer/optimizer.py (1,901 LoC): `Optimizer` base
+with registry, lr/wd multipliers, `Updater` (state dict + serialization for
+the kvstore server), and SGD/Signum/FTML/NAG/SGLD/Adam/AdaGrad/RMSProp/
+AdaDelta/Ftrl/Adamax/Nadam/DCASGD/LBSGD — each mapping to fused update ops.
+
+TPU-native: every update calls a registered jit-cached update op
+(ops/optimizer_ops.py), so eager Trainer steps run one XLA executable per
+parameter; fully-jitted train steps reuse the same op functions inline.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as _np
+
+from .. import nd
+from ..base import MXNetError, Registry
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import invoke
+
+__all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "FTML", "NAG", "SGLD",
+           "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax",
+           "Nadam", "DCASGD", "LBSGD", "AdamW", "Test", "create", "register",
+           "Updater", "get_updater"]
+
+_REG = Registry("optimizer")
+
+
+def _is_row_sparse(grad):
+    return getattr(grad, "stype", "default") == "row_sparse"
+
+
+def _sparse_sgd_update(weight, grad, state, lr, wd, momentum, rescale,
+                       clip):
+    """Lazy row_sparse SGD (reference optimizer_op.cc SGDUpdateRsp): only
+    rows present in the gradient are touched — weight, momentum, AND the
+    fp32 master copy in multi-precision mode."""
+    import jax.numpy as jnp
+
+    idx = grad.indices._data
+    mom, w32 = (state if isinstance(state, tuple) else (state, None))
+    # multi-precision: compute on the fp32 master rows
+    master = w32 if w32 is not None else weight
+    g = grad.data._data.astype(master.dtype) * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    w_rows = master._data[idx]
+    g = g + wd * w_rows
+    if mom is not None:
+        m_rows = momentum * mom._data[idx] - lr * g
+        mom._data = mom._data.at[idx].set(m_rows)
+        master._data = master._data.at[idx].add(m_rows)
+    else:
+        master._data = master._data.at[idx].add(-lr * g)
+    if w32 is not None:
+        weight._data = weight._data.at[idx].set(
+            master._data[idx].astype(weight.dtype))
+
+
+def _sparse_adam_update(weight, grad, mean, var, lr, beta1, beta2, eps, wd,
+                        rescale, clip):
+    """Lazy row_sparse Adam (reference optimizer_op.cc AdamUpdateRsp)."""
+    import jax.numpy as jnp
+
+    idx = grad.indices._data
+    g = grad.data._data.astype(weight.dtype) * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    w_rows = weight._data[idx]
+    g = g + wd * w_rows
+    m_rows = beta1 * mean._data[idx] + (1 - beta1) * g
+    v_rows = beta2 * var._data[idx] + (1 - beta2) * g * g
+    mean._data = mean._data.at[idx].set(m_rows)
+    var._data = var._data.at[idx].set(v_rows)
+    weight._data = weight._data.at[idx].add(
+        -lr * m_rows / (jnp.sqrt(v_rows) + eps))
+
+
+def register(cls):
+    _REG.register(cls)
+    return cls
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.get(name)(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:47)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- registry-compatible helpers ---------------------------------------
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return create(name, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (_np.float16,):
+            w32 = weight.astype("float32")
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update, self._index_update_count[index])
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _clip(self):
+        return self.clip_gradient if self.clip_gradient is not None else -1.0
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        return d
+
+
+@register
+class SGD(Optimizer):
+    """(Momentum/multi-precision) SGD → sgd_update / sgd_mom_update /
+    mp_sgd_* ops (reference optimizer.py SGD, optimizer_op.cc)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if _is_row_sparse(grad):
+            # lazy update: only the rows present in the sparse grad move
+            # (reference optimizer_op.cc SGDUpdateRsp / sgd_mom row_sparse)
+            _sparse_sgd_update(weight, grad, state, lr, wd, self.momentum,
+                               self.rescale_grad, self._clip())
+            return
+        if isinstance(state, tuple):  # multi-precision
+            mom, w32 = state
+            if mom is not None:
+                w_new, m_new, w32_new = invoke(
+                    "mp_sgd_mom_update", weight, grad, mom, w32, lr=lr,
+                    momentum=self.momentum, wd=wd, rescale_grad=self.rescale_grad,
+                    clip_gradient=self._clip())
+                mom._data = m_new._data
+            else:
+                w_new, w32_new = invoke("mp_sgd_update", weight, grad, w32,
+                                        lr=lr, wd=wd,
+                                        rescale_grad=self.rescale_grad,
+                                        clip_gradient=self._clip())
+            weight._data = w_new._data
+            w32._data = w32_new._data
+        elif state is not None:
+            w_new, m_new = invoke("sgd_mom_update", weight, grad, state, lr=lr,
+                                  momentum=self.momentum, wd=wd,
+                                  rescale_grad=self.rescale_grad,
+                                  clip_gradient=self._clip())
+            weight._data = w_new._data
+            state._data = m_new._data
+        else:
+            w_new = invoke("sgd_update", weight, grad, lr=lr, wd=wd,
+                           rescale_grad=self.rescale_grad,
+                           clip_gradient=self._clip())
+            weight._data = w_new._data
+
+    update_multi_precision = update
+
+
+@register
+class SignSGD(Optimizer):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        w = invoke("signsgd_update", weight, grad, lr=self._get_lr(index),
+                   wd=self._get_wd(index), rescale_grad=self.rescale_grad,
+                   clip_gradient=self._clip())
+        weight._data = w._data
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        w, m = invoke("signum_update", weight, grad, state,
+                      lr=self._get_lr(index), momentum=self.momentum,
+                      wd=self._get_wd(index), rescale_grad=self.rescale_grad,
+                      clip_gradient=self._clip(), wd_lh=self.wd_lh)
+        weight._data, state._data = w._data, m._data
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        w, d2, v2, z2 = invoke("ftml_update", weight, grad, d, v, z,
+                               lr=self._get_lr(index), beta1=self.beta1,
+                               beta2=self.beta2, epsilon=self.epsilon,
+                               wd=self._get_wd(index),
+                               rescale_grad=self.rescale_grad,
+                               clip_grad=self._clip(), t=t)
+        weight._data, d._data, v._data, z._data = w._data, d2._data, v2._data, z2._data
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        if state is None:
+            SGD.update(self, index, weight, grad, None)  # plain sgd
+            return
+        w, m = invoke("nag_mom_update", weight, grad, state,
+                      lr=self._get_lr(index), momentum=self.momentum,
+                      wd=self._get_wd(index), rescale_grad=self.rescale_grad,
+                      clip_gradient=self._clip())
+        weight._data, state._data = w._data, m._data
+
+
+@register
+class SGLD(Optimizer):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 dtype=str(weight.dtype))
+        weight._data = (weight - lr / 2 * (g + wd * weight) + noise)._data
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        if _is_row_sparse(grad):
+            # lazy adam: moments + weight move only on touched rows
+            # (reference AdamUpdateRsp, optimizer_op.cc)
+            _sparse_adam_update(weight, grad, mean, var, lr, self.beta1,
+                                self.beta2, self.epsilon,
+                                self._get_wd(index), self.rescale_grad,
+                                self._clip())
+            return
+        w, m, v = invoke("adam_update", weight, grad, mean, var, lr=lr,
+                         beta1=self.beta1, beta2=self.beta2,
+                         epsilon=self.epsilon, wd=self._get_wd(index),
+                         rescale_grad=self.rescale_grad,
+                         clip_gradient=self._clip())
+        weight._data, mean._data, var._data = w._data, m._data, v._data
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay (reference src/operator/contrib/adamw.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon, self.eta = beta1, beta2, epsilon, eta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        mean, var = state
+        w, m, v = invoke("adamw_update", weight, grad, mean, var,
+                         lr=self._get_lr(index), beta1=self.beta1,
+                         beta2=self.beta2, epsilon=self.epsilon,
+                         wd=self._get_wd(index), eta=self.eta,
+                         rescale_grad=self.rescale_grad,
+                         clip_gradient=self._clip())
+        weight._data, mean._data, var._data = w._data, m._data, v._data
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        g = g + wd * weight
+        state._data = (state + nd.square(g))._data
+        weight._data = (weight - lr * g / (nd.sqrt(state) + self.float_stable_eps))._data
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights if clip_weights is not None else -1.0
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape, dtype=weight.dtype),
+                    nd.zeros(weight.shape, dtype=weight.dtype),
+                    nd.zeros(weight.shape, dtype=weight.dtype))
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.centered:
+            n, g_s, delta = state
+            w, n2, g2, d2 = invoke("rmspropalex_update", weight, grad, n, g_s,
+                                   delta, lr=lr, gamma1=self.gamma1,
+                                   gamma2=self.gamma2, epsilon=self.epsilon,
+                                   wd=wd, rescale_grad=self.rescale_grad,
+                                   clip_gradient=self._clip(),
+                                   clip_weights=self.clip_weights)
+            weight._data, n._data, g_s._data, delta._data = \
+                w._data, n2._data, g2._data, d2._data
+        else:
+            w, n2 = invoke("rmsprop_update", weight, grad, state, lr=lr,
+                           gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
+                           rescale_grad=self.rescale_grad,
+                           clip_gradient=self._clip(),
+                           clip_weights=self.clip_weights)
+            weight._data, state._data = w._data, n2._data
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        g = g + wd * weight
+        acc_g, acc_delta = state
+        acc_g._data = (self.rho * acc_g + (1 - self.rho) * nd.square(g))._data
+        delta = nd.sqrt(acc_delta + self.epsilon) / nd.sqrt(acc_g + self.epsilon) * g
+        acc_delta._data = (self.rho * acc_delta + (1 - self.rho) * nd.square(delta))._data
+        weight._data = (weight - delta)._data
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        z, n = state
+        w, z2, n2 = invoke("ftrl_update", weight, grad, z, n,
+                           lr=self._get_lr(index), lamda1=self.lamda1,
+                           beta=self.beta, wd=self._get_wd(index),
+                           rescale_grad=self.rescale_grad,
+                           clip_gradient=self._clip())
+        weight._data, z._data, n._data = w._data, z2._data, n2._data
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        g = g + wd * weight
+        m, u = state
+        m._data = (self.beta1 * m + (1 - self.beta1) * g)._data
+        u._data = nd.maximum(self.beta2 * u, nd.abs(g))._data
+        weight._data = (weight - lr * m / (u + 1e-8))._data
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        g = g + wd * weight
+        m_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        m_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= m_t
+        m_schedule_next = self.m_schedule * m_t_1
+        m, v = state
+        m._data = (self.beta1 * m + (1.0 - self.beta1) * g)._data
+        v._data = (self.beta2 * v + (1.0 - self.beta2) * nd.square(g))._data
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = m / (1.0 - m_schedule_next)
+        v_prime = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - m_t) * g_prime + m_t_1 * m_prime
+        weight._data = (weight - lr * m_bar / (nd.sqrt(v_prime) + self.epsilon))._data
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype) if self.momentum else None,
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        mom, prev = state
+        adj = g + wd * weight + self.lamda * g * g * (weight - prev)
+        if mom is not None:
+            mom._data = (self.momentum * mom - lr * adj)._data
+            step = mom
+        else:
+            step = -lr * adj
+        prev._data = weight._data
+        weight._data = (weight + step)._data
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD w/ LARS-style scaling (reference optimizer.py LBSGD);
+    approximated by layer-wise adaptive rate on top of SGD momentum."""
+
+    def __init__(self, momentum=0.0, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, **kwargs)
+
+
+@register
+class Test(Optimizer):
+    """reference optimizer.py Test — used by unit tests."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._data = (weight + grad * self.rescale_grad)._data
+        state._data = weight._data
+
+
+class Updater:
+    """Applies an optimizer with per-index state (reference optimizer.py
+    Updater; serialized to kvstore servers via get/set_states)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        def conv(s):
+            if isinstance(s, (list, tuple)):
+                return tuple(conv(x) for x in s)
+            return s.asnumpy() if isinstance(s, NDArray) else s
+
+        payload = {k: conv(v) for k, v in self.states.items()}
+        return pickle.dumps((payload, self.optimizer) if dump_optimizer else payload)
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple):
+            data, self.optimizer = data
+
+        def unconv(s):
+            if isinstance(s, (list, tuple)):
+                return tuple(unconv(x) for x in s)
+            return nd.array(s) if isinstance(s, _np.ndarray) else s
+
+        self.states = {k: unconv(v) for k, v in data.items()}
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,41 @@
+"""JAX API compatibility shims for the parallel stack.
+
+jax moved shard_map from `jax.experimental.shard_map` (kwarg `check_rep`) to
+`jax.shard_map` (keyword-only, kwarg `check_vma`). We feature-detect once at
+import so every caller in this package works on either API, with replication
+checking disabled (our loss reductions pmean over every mesh axis themselves).
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def _make_shard_map():
+    new = getattr(jax, "shard_map", None)
+    if new is not None:
+        sig = inspect.signature(new)
+        if "check_vma" in sig.parameters:
+            def shard_map(f, mesh, in_specs, out_specs):
+                return new(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+            return shard_map
+    from jax.experimental.shard_map import shard_map as old
+
+    sig = inspect.signature(old)
+    kw = {}
+    if "check_rep" in sig.parameters:
+        kw["check_rep"] = False
+    elif "check_vma" in sig.parameters:
+        kw["check_vma"] = False
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+    return shard_map
+
+
+shard_map = _make_shard_map()

@@ -1,0 +1,49 @@
+"""Device mesh construction.
+
+Reference analog: the device lists threaded through Module/executor_group
+(python/mxnet/module/executor_group.py decide_slices) and kvstore device
+groups. TPU-native: one jax.sharding.Mesh names every parallelism axis; axes
+order puts the fastest-varying (tp) innermost so tensor-parallel collectives
+ride the shortest ICI hops.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "local_mesh_axis_sizes"]
+
+
+def make_mesh(axis_shapes=None, devices=None, axis_names=None):
+    """Build a Mesh.
+
+    axis_shapes: dict like {"dp": 2, "tp": 4} (order = major->minor), or None
+    for all devices on a single "dp" axis. -1 means "remaining devices".
+    """
+    import numpy as _np
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axis_shapes is None:
+        axis_shapes = {"dp": n}
+    names = list(axis_shapes.keys())
+    sizes = list(axis_shapes.values())
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes[sizes.index(-1)] = n // known
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != n:
+        raise MXNetError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {n}")
+    arr = _np.array(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def local_mesh_axis_sizes(mesh):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

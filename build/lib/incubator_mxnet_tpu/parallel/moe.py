@@ -1,0 +1,193 @@
+"""Mixture-of-Experts with expert parallelism (ep).
+
+ABSENT in the reference (SURVEY §2.3 lists EP as a first-class TPU goal
+beyond parity). Design: expert WEIGHTS are sharded over an `ep` mesh
+axis; gating/dispatch run replicated (tokens are replicated across ep —
+token sharding composes via a separate dp axis), each shard computes its
+expert slice, and outputs are all-gathered for the combine. This shards
+the dominant cost (expert FFN weights + matmuls) across the axis; the
+GShard-style all_to_all token exchange, which additionally shards the
+dispatch/combine tensors, is the token-sharded extension and is not
+implemented here.
+
+Capacity discipline keeps shapes static for XLA: each expert processes at
+most `capacity` tokens; overflow tokens are dropped (their combine weight
+is 0), matching Switch-Transformer semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ._compat import shard_map
+
+__all__ = ["moe_gate", "moe_apply", "moe_apply_a2a", "moe_sharded",
+           "init_moe_params"]
+
+
+def moe_gate(x, wg, k=1, capacity_factor=1.25):
+    """Top-k gating (Switch for k=1). x: (N, d); wg: (d, E).
+    Returns (dispatch (N, E, C) one-hot, combine (N, E, C) weights,
+    aux_loss) with C = capacity."""
+    N, _ = x.shape
+    E = wg.shape[1]
+    logits = (x.astype(jnp.float32) @ wg.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)            # (N, E)
+    C = int(max(1, capacity_factor * k * N / E))
+
+    dispatch = jnp.zeros((N, E, C), jnp.bool_)
+    combine = jnp.zeros((N, E, C), jnp.float32)
+    remaining = probs
+    # queue positions are CUMULATIVE across the k rounds — restarting the
+    # count per round would assign two tokens the same (expert, slot) and
+    # sum their inputs in the expert queue
+    counts = jnp.zeros((E,), jnp.int32)
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)        # (N,)
+        gate = jnp.take_along_axis(remaining, choice[:, None], 1)[:, 0]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)
+        # position of each token within its expert's queue, offset by the
+        # slots already consumed in earlier rounds
+        pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - onehot  # (N,E)
+        in_cap = (pos < C) & onehot.astype(bool)
+        pos_c = jnp.clip(pos, 0, C - 1)
+        slot = jax.nn.one_hot(pos_c, C, dtype=jnp.bool_) & \
+            in_cap[..., None]                           # (N, E, C)
+        dispatch = dispatch | slot
+        combine = combine + slot.astype(jnp.float32) * gate[:, None, None]
+        remaining = remaining * (1.0 - onehot)
+        counts = counts + jnp.sum(onehot, axis=0)
+    # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e
+    f = jnp.mean((probs == jnp.max(probs, -1, keepdims=True)).astype(
+        jnp.float32), axis=0)
+    P = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * P)
+    return dispatch, combine, aux
+
+
+def moe_apply(x, params, axis_name=None, k=1, capacity_factor=1.25,
+              activation=jax.nn.gelu):
+    """One MoE FFN layer. x: (N, d). params: dict with
+    wg (d, E), w1 (E_local, d, dff), w2 (E_local, dff, d).
+
+    With axis_name (inside shard_map): E = E_local * ep_size; each shard
+    builds only ITS experts' input queues (gating is replicated, the
+    dispatch tensor is sliced to the local expert block before the queue
+    einsum), runs its expert FFNs, and all-gathers the expert outputs for
+    the replicated combine. Without axis_name: E = E_local (dense
+    single-shard MoE, the numeric oracle)."""
+    wg, w1, w2 = params["wg"], params["w1"], params["w2"]
+    N, d = x.shape
+    ep = 1 if axis_name is None else lax.psum(1, axis_name)
+    e_local = w1.shape[0]
+    E = e_local * ep
+
+    dispatch, combine, aux = moe_gate(x, wg, k=k,
+                                      capacity_factor=capacity_factor)
+    C = dispatch.shape[-1]
+    if axis_name is not None:
+        # slice dispatch to the local expert block FIRST so the queue
+        # einsum costs O(N * e_local * C * d) per shard, not O(N * E * C * d)
+        r = lax.axis_index(axis_name)
+        local_disp = lax.dynamic_slice_in_dim(dispatch, r * e_local,
+                                              e_local, axis=1)  # (N, e_l, C)
+        local_in = jnp.einsum("nec,nd->ecd", local_disp.astype(x.dtype), x)
+        h = activation(jnp.einsum("ecd,edf->ecf", local_in, w1))
+        local_out = jnp.einsum("ecf,efd->ecd", h, w2)   # (e_local, C, d)
+        out = lax.all_gather(local_out, axis_name, axis=0,
+                             tiled=True)                # (E, C, d)
+    else:
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+        h = activation(jnp.einsum("ecd,edf->ecf",
+                                  expert_in.reshape(e_local, C, d), w1))
+        out = jnp.einsum("ecf,efd->ecd", h, w2).reshape(E, C, d)
+    y = jnp.einsum("nec,ecd->nd", combine.astype(out.dtype), out)
+    return y, aux
+
+
+def moe_apply_a2a(x, params, axis_name, k=1, capacity_factor=1.25,
+                  activation=jax.nn.gelu):
+    """GShard-style token-sharded MoE — the all-to-all dispatch variant.
+
+    Run INSIDE shard_map with BOTH tokens and experts sharded over
+    `axis_name` (in a composed mesh this is the `ep` axis, or the `dp`
+    axis when experts ride the data-parallel groups, the GShard layout).
+
+    x: (N_local, d) — THIS shard's tokens. params as in moe_apply with
+    w1/w2 holding the local e_local = E/ep expert slices.
+
+    Wire pattern (all shapes static):
+      1. local top-k gating against the full E-expert router (wg is
+         replicated) with per-shard capacity C,
+      2. build per-(expert, slot) queues from local tokens:
+         (E, C, d) = dispatch^T @ x,
+      3. `all_to_all` over the EXPERT dim: each shard keeps its e_local
+         experts' queues from every peer -> (ep * C) slots per local
+         expert,
+      4. run the local expert FFNs,
+      5. `all_to_all` back (transpose of 3), combine locally.
+
+    The backward schedule is the transpose: autodiff turns each
+    all_to_all into the reverse all_to_all, so expert-weight grads stay
+    shard-local and token grads return to their home shard — no psum over
+    `axis_name` is needed for expert weights (and none must be applied:
+    they are sharded, not replicated, over this axis).
+
+    Returns (y (N_local, d), aux_loss). Numerics match moe_apply run
+    independently on each shard's tokens with the full expert set.
+    """
+    wg, w1, w2 = params["wg"], params["w1"], params["w2"]
+    N, d = x.shape
+    ep = lax.psum(1, axis_name)
+    e_local = w1.shape[0]
+    E = e_local * ep
+
+    dispatch, combine, aux = moe_gate(x, wg, k=k,
+                                      capacity_factor=capacity_factor)
+    C = dispatch.shape[-1]
+    # 2. per-expert queues of MY tokens: (E, C, d)
+    queues = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    # 3. exchange: split the E dim across shards, concat peers' blocks.
+    # After this, shard r holds (ep, e_local, C, d): peer p's queue for
+    # my experts [r*e_local, (r+1)*e_local).
+    queues = queues.reshape(ep, e_local, C, d)
+    queues = lax.all_to_all(queues, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)
+    # 4. local expert FFN over every peer's slots at once
+    h = activation(jnp.einsum("pecd,edf->pecf", queues, w1))
+    out = jnp.einsum("pecf,efd->pecd", h, w2)          # (ep, e_local, C, d)
+    # 5. route results back to the token-home shards (transpose of 3)
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)
+    out = out.reshape(E, C, d)
+    y = jnp.einsum("nec,ecd->nd", combine.astype(out.dtype), out)
+    return y, aux
+
+
+def init_moe_params(key, d, dff, n_experts, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale = 1.0 / jnp.sqrt(d)
+    return {
+        "wg": (jax.random.normal(k1, (d, n_experts)) * scale).astype(dtype),
+        "w1": (jax.random.normal(k2, (n_experts, d, dff)) * scale
+               ).astype(dtype),
+        "w2": (jax.random.normal(k3, (n_experts, dff, d)) *
+               (1.0 / jnp.sqrt(dff))).astype(dtype),
+    }
+
+
+def moe_sharded(x, params, mesh, axis="ep", k=1, capacity_factor=1.25):
+    """Whole-layer entry: w1/w2 sharded over `axis` on their expert dim,
+    wg and x replicated. One compiled program; the only collective is the
+    expert-output all_gather before the combine (see module docstring)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec_p = {"wg": P(), "w1": P(axis), "w2": P(axis)}
+
+    def inner(params, xx):
+        return moe_apply(xx, params, axis_name=axis, k=k,
+                         capacity_factor=capacity_factor)
+
+    return shard_map(inner, mesh, in_specs=(spec_p, P()),
+                     out_specs=(P(), P()))(params, x)

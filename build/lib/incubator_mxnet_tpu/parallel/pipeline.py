@@ -1,0 +1,132 @@
+"""Pipeline parallelism (pp): GPipe-style microbatch schedule over a mesh
+axis.
+
+The reference's only model parallelism is layer placement via `group2ctx`
+(src/executor/graph_executor.cc:986 device-placement pass + cross-device
+copies) with NO pipelining — devices idle while one executes its layers.
+TPU-native redesign: stages live on a `pp` mesh axis inside shard_map;
+microbatches flow stage-to-stage with `lax.ppermute` on a `lax.scan`
+steady-state loop, so after the fill phase every stage computes every
+step (classic GPipe bubble of (S-1)/(S-1+M)).
+
+All-XLA: no host scheduling, the whole pipeline is one compiled program
+that composes with dp/tp/sp axes of the same mesh.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ._compat import shard_map
+
+__all__ = ["pipeline_apply", "pipeline_train_apply", "pipeline_sharded"]
+
+
+def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
+    """Run INSIDE shard_map. Executes `stage_fn(stage_params, h)` on each
+    of the S pipeline stages (S = size of `axis_name`), feeding the output
+    of stage s to stage s+1, microbatch by microbatch.
+
+    stage_params: this device's stage parameters (already sharded on the
+    pp axis). x: the FULL batch (replicated across pp), split into
+    `n_microbatches` along axis 0. Returns the full batch of final-stage
+    outputs (replicated across pp ranks via a psum broadcast).
+
+    Constraint: every stage must map a (mb, ...) activation to the SAME
+    shape and dtype — the ring buffer that carries activations between
+    stages (and the collected outputs) has one static shape. Put any
+    projection to a different width inside a stage, not between stages.
+    """
+    outs, _ = pipeline_train_apply(
+        lambda p, h: (stage_fn(p, h), jnp.float32(0)),
+        stage_params, x, axis_name, n_microbatches)
+    return outs
+
+
+def pipeline_train_apply(stage_fn, stage_params, x, axis_name,
+                         n_microbatches):
+    """pipeline_apply for TRAINING stages: stage_fn(params, h) returns
+    (h_out, aux) where aux is a scalar auxiliary loss (e.g. MoE load
+    balancing). Differentiating through this function yields the pipeline
+    BACKWARD schedule automatically: the transpose of the forward scan
+    runs the stages in reverse with the ppermute ring inverted, microbatch
+    by microbatch, accumulating each stage's weight gradient across
+    microbatches in the scan-carry cotangent — the GPipe backward.
+
+    aux is only meaningful for steps where a stage holds a real microbatch
+    (during fill/drain, stages chew zeros); those contributions are masked
+    out. Returns (outputs (B, ...), aux_mean) with aux_mean the mean over
+    the S * M real (stage, microbatch) visits.
+    """
+    S = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError(f"batch {B} not divisible by {n_microbatches}")
+    mb = B // n_microbatches
+    micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    total = n_microbatches + S - 1     # fill + steady + drain
+    out0 = jnp.zeros_like(micro)
+    carry0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    aval = jax.eval_shape(stage_fn, stage_params, carry0)[0]
+    if aval.shape != carry0.shape or aval.dtype != carry0.dtype:
+        raise ValueError(
+            f"pipeline stage must preserve activation shape/dtype: got "
+            f"{aval.shape}/{aval.dtype} from {carry0.shape}/{carry0.dtype}; "
+            "move width changes inside a stage")
+
+    def step(carry, t):
+        h_prev, outs, aux_acc = carry
+        mb_idx = jnp.clip(t, 0, n_microbatches - 1)
+        inject = lax.dynamic_index_in_dim(micro, mb_idx, 0, keepdims=False)
+        h_in = jnp.where(rank == 0, inject, h_prev)
+        h_out, aux = stage_fn(stage_params, h_in)
+        # my microbatch at step t is t - rank; mask fill/drain visits
+        valid = jnp.logical_and(t - rank >= 0, t - rank < n_microbatches)
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        out_idx = jnp.clip(t - (S - 1), 0, n_microbatches - 1)
+        take = jnp.logical_and(rank == S - 1, t >= S - 1)
+        outs = lax.cond(
+            take,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, h_out.astype(o.dtype), out_idx, 0),
+            lambda o: o, outs)
+        h_next = lax.ppermute(
+            h_out, axis_name, [(i, (i + 1) % S) for i in range(S)])
+        return (h_next, outs, aux_acc), None
+
+    (_, outs, aux_acc), _ = lax.scan(
+        step, (carry0, out0, jnp.float32(0)), jnp.arange(total))
+    outs = lax.psum(jnp.where(rank == S - 1, outs, jnp.zeros_like(outs)),
+                    axis_name)
+    aux_mean = lax.psum(aux_acc, axis_name) / (S * n_microbatches)
+    return outs.reshape((B,) + outs.shape[2:]), aux_mean
+
+
+def pipeline_sharded(stage_fn, params_stacked, x, mesh, axis="pp",
+                     n_microbatches=None):
+    """Whole-pipeline entry: params_stacked has leading axis S (one slice
+    per stage) and is sharded over `axis`; x is replicated. Compiles ONE
+    program containing the full schedule."""
+    from jax.sharding import PartitionSpec as P
+
+    S = mesh.shape[axis]
+    if n_microbatches is None:
+        n_microbatches = S
+    leaves = jax.tree_util.tree_leaves(params_stacked)
+    for leaf in leaves:
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stacked params lead dim {leaf.shape[0]} != pipeline "
+                f"stages {S} (axis {axis!r}); group layers per stage "
+                "inside stage_fn instead")
+    spec_p = jax.tree_util.tree_map(lambda _: P(axis), params_stacked)
+
+    def inner(params, xx):
+        local = jax.tree_util.tree_map(lambda a: a[0], params)  # my stage
+        return pipeline_apply(stage_fn, local, xx, axis, n_microbatches)
+
+    return shard_map(inner, mesh, in_specs=(spec_p, P()),
+                     out_specs=P())(params_stacked, x)

@@ -1,0 +1,118 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+The reference has NO sequence/context parallelism (SURVEY.md §5.7 — its
+longest-sequence story is BucketingModule + fused RNN). This module is the
+TPU-native capability that replaces it at pod scale: the sequence axis lives
+on a mesh axis ("sp"); K/V blocks rotate around the ring with
+`lax.ppermute` while each device accumulates its queries' attention in
+flash-style (running max + running sum) form, so peak memory is O(seq/devices)
+and the N^2 score matrix never materializes globally.
+
+Written against jax.shard_map; compute per hop is one (q_blk x k_blk^T) MXU
+matmul, overlapping the next hop's ppermute (XLA schedules the collective
+permute concurrently with the matmul of the current block).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ring_attention_sharded", "attention_reference"]
+
+
+def attention_reference(q, k, v, causal=False, sm_scale=None):
+    """Plain single-device attention, the numeric oracle for the ring version.
+    q,k,v: (B, T, H, D)."""
+    B, T, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(D).astype(q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, k.shape[1]), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def _block_attn(q, k, v, scale, mask):
+    """Scores for one (q_block, k_block) pair + flash accumulators.
+    Returns (unnormalized out, row max, row sumexp)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                      # (B,H,Q)
+    # guard fully-masked rows
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)                      # (B,H,Q)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)      # (B,Q,H,D)
+    return o, m_safe, l, jnp.isfinite(m)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
+    """Runs INSIDE shard_map: q,k,v are the local sequence shards (B,t,H,D);
+    axis_name is the sp mesh axis. Exact (non-approximate) attention."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, t, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(D).astype(q.dtype)
+
+    o0 = jnp.zeros((B, t, H, D), jnp.float32)
+    m0 = jnp.full((B, H, t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, t), jnp.float32)
+
+    def body(i, carry):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        src_idx = (my_idx - i) % axis_size  # whose K/V block we hold this hop
+        if causal:
+            # q position block my_idx attends k block src_idx if src < mine,
+            # diagonal uses a triangular mask
+            q_pos = my_idx * t + jnp.arange(t)
+            k_pos = src_idx * t + jnp.arange(t)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        else:
+            mask = None
+        o_b, m_b, l_b, valid = _block_attn(q, k_cur, v_cur, scale, mask)
+        o_b = o_b.astype(jnp.float32)
+        m_b = m_b.astype(jnp.float32)
+        l_b = l_b.astype(jnp.float32)
+        # flash-style merge of (o_acc,m_acc,l_acc) with the new block
+        has = jnp.any(valid, axis=-1) if valid.ndim == m_b.ndim + 1 else valid
+        m_b = jnp.where(has, m_b, -jnp.inf)
+        m_new = jnp.maximum(m_acc, m_b)
+        m_new_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        c_old = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_new_safe), 0.0)
+        c_new = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - m_new_safe), 0.0)
+        l_new = l_acc * c_old + l_b * c_new
+        o_new = o_acc * jnp.transpose(c_old, (0, 2, 1))[..., None] + \
+            o_b * jnp.transpose(c_new, (0, 2, 1))[..., None]
+        # rotate K/V to the next device on the ring
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt)
+
+    o, m, l, _, _ = lax.fori_loop(0, axis_size, body, (o0, m0, l0, k, v))
+    denom = jnp.where(l > 0, l, 1.0)
+    out = o / jnp.transpose(denom, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
+                           sm_scale=None):
+    """shard_map wrapper: q,k,v (B,T,H,D) get sharded on T over `axis_name`
+    (and batch over 'dp' if present) and attention runs as a ring."""
+    from jax.sharding import PartitionSpec as P
+    from ._compat import shard_map
+
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    spec = P(batch_axis, axis_name, None, None)
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal,
+                          sm_scale=sm_scale),
+        mesh, (spec, spec, spec), spec)
+    return fn(q, k, v)

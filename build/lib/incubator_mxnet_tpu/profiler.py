@@ -1,0 +1,254 @@
+"""Profiler: op-level events + chrome-trace output + aggregate stats.
+
+Reference: src/profiler/profiler.h:251 (typed stats in per-thread buffers,
+chrome://tracing JSON at profiler.h:79,432, DumpProfile:299, aggregate
+table aggregate_stats.cc) and python/mxnet/profiler.py (set_config /
+set_state / start / stop / dump / dumps + scoped markers).
+
+TPU-native redesign: engine-op instrumentation becomes a dispatch hook on
+the op registry (the only choke point every eager/compiled call crosses),
+and kernel-level detail comes from jax.profiler (XPlane) when a tensorboard
+directory is configured. Dispatch is async under XLA — `profile_sync=True`
+(the default while profiling) blocks on each op's output so durations are
+real compute times, mirroring the reference's GPU stream-sync profiling
+mode (profiler.h kSimple vs kAccurate).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+
+from .base import MXNetError
+
+__all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
+           "pause", "resume", "Scope", "Task", "Event", "Counter", "Marker"]
+
+_lock = threading.Lock()
+_state = {
+    "running": False,
+    "paused": False,
+    "filename": "profile.json",
+    "aggregate_stats": False,
+    "sync": True,
+    "tb_dir": None,
+    "tb_active": False,
+}
+_events = []  # (name, category, start_us, dur_us, tid)
+_counters = []  # (name, ts_us, value)
+
+
+def set_config(filename="profile.json", profile_all=False,
+               profile_symbolic=True, profile_imperative=True,
+               profile_memory=False, profile_api=False,
+               aggregate_stats=False, continuous_dump=False,
+               dump_period=1.0, profile_sync=True, tensorboard_dir=None,
+               **kwargs):
+    """Reference profiler.py set_config / MXSetProcessProfilerConfig."""
+    _state["filename"] = filename
+    _state["aggregate_stats"] = aggregate_stats
+    _state["sync"] = profile_sync
+    _state["tb_dir"] = tensorboard_dir
+
+
+def set_state(state="stop", profile_process="worker"):
+    """'run' or 'stop' (reference profiler.py set_state)."""
+    if state == "run":
+        start()
+    elif state == "stop":
+        stop()
+    else:
+        raise MXNetError(f"invalid profiler state {state!r}")
+
+
+def start(profile_process="worker"):
+    from .ops import registry
+    _state["running"] = True
+    _state["paused"] = False
+    registry.PROFILER_HOOK = _op_hook
+    if _state["tb_dir"]:
+        import jax
+        os.makedirs(_state["tb_dir"], exist_ok=True)
+        jax.profiler.start_trace(_state["tb_dir"])
+        _state["tb_active"] = True
+
+
+def stop(profile_process="worker"):
+    from .ops import registry
+    _state["running"] = False
+    registry.PROFILER_HOOK = None
+    if _state.get("tb_active"):
+        import jax
+        jax.profiler.stop_trace()
+        _state["tb_active"] = False
+
+
+def pause(profile_process="worker"):
+    _state["paused"] = True
+
+
+def resume(profile_process="worker"):
+    _state["paused"] = False
+
+
+def _op_hook(name, fn, args):
+    """Installed into registry.PROFILER_HOOK: time one op dispatch."""
+    if not _state["running"] or _state["paused"]:
+        return fn(*args)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    if _state["sync"]:
+        _block(out)
+    dur = (time.perf_counter() - t0) * 1e6
+    with _lock:
+        _events.append((name, "operator", t0 * 1e6, dur,
+                        threading.get_ident()))
+    return out
+
+
+def _block(out):
+    if isinstance(out, (tuple, list)):
+        for o in out:
+            _block(o)
+    elif hasattr(out, "block_until_ready"):
+        out.block_until_ready()
+
+
+def _record(name, category, t0_us, dur_us):
+    with _lock:
+        _events.append((name, category, t0_us, dur_us,
+                        threading.get_ident()))
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON (reference MXDumpProfile;
+    profiler.h:79 'chrome tracing json')."""
+    with _lock:
+        events = list(_events)
+        counters = list(_counters)
+        if finished:
+            _events.clear()
+            _counters.clear()
+    trace = []
+    for name, cat, ts, dur, tid in events:
+        trace.append({"name": name, "cat": cat, "ph": "X", "ts": ts,
+                      "dur": dur, "pid": 0, "tid": tid})
+    for name, ts, value in counters:
+        trace.append({"name": name, "ph": "C", "ts": ts, "pid": 0,
+                      "args": {"value": value}})
+    with open(_state["filename"], "w") as f:
+        json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
+    return _state["filename"]
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate-stats table string (reference
+    MXAggregateProfileStatsPrint / aggregate_stats.cc)."""
+    with _lock:
+        events = list(_events)
+        if reset:
+            _events.clear()
+    agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+    for name, cat, ts, dur, tid in events:
+        a = agg[name]
+        a[0] += 1
+        a[1] += dur
+        a[2] = min(a[2], dur)
+        a[3] = max(a[3], dur)
+    if format == "json":
+        return json.dumps({k: {"count": v[0], "total_us": v[1],
+                               "min_us": v[2], "max_us": v[3]}
+                           for k, v in agg.items()})
+    lines = [f"{'Name':<40}{'Count':>8}{'Total(us)':>14}"
+             f"{'Min(us)':>12}{'Max(us)':>12}{'Avg(us)':>12}",
+             "-" * 98]
+    key = {"total": lambda kv: kv[1][1], "count": lambda kv: kv[1][0],
+           "min": lambda kv: kv[1][2], "max": lambda kv: kv[1][3],
+           "avg": lambda kv: kv[1][1] / max(kv[1][0], 1)}[sort_by]
+    for name, (cnt, tot, mn, mx) in sorted(agg.items(), key=key,
+                                           reverse=not ascending):
+        lines.append(f"{name:<40}{cnt:>8}{tot:>14.1f}{mn:>12.1f}"
+                     f"{mx:>12.1f}{tot / max(cnt, 1):>12.1f}")
+    return "\n".join(lines)
+
+
+class _Timed:
+    """Scoped marker base (reference profiler.py Task/Event/Frame)."""
+
+    def __init__(self, name, category):
+        self._name = name
+        self._category = category
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is None:
+            return
+        dur = (time.perf_counter() - self._t0) * 1e6
+        _record(self._name, self._category, self._t0 * 1e6, dur)
+        self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Scope(_Timed):
+    def __init__(self, name="<unk>:"):
+        super().__init__(name, "scope")
+
+
+class Task(_Timed):
+    def __init__(self, domain=None, name="task"):
+        super().__init__(name, "task")
+
+
+class Event(_Timed):
+    def __init__(self, name="event"):
+        super().__init__(name, "event")
+
+
+class Marker:
+    """Instant marker (reference profiler.py Marker.mark)."""
+
+    def __init__(self, domain=None, name="marker"):
+        self._name = name
+
+    def mark(self, scope="process"):
+        _record(self._name, "marker", time.perf_counter() * 1e6, 0)
+
+
+class Counter:
+    """Numeric counter series (reference profiler.py Counter)."""
+
+    def __init__(self, domain=None, name="counter", value=None):
+        self._name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        with _lock:
+            _counters.append((self._name, time.perf_counter() * 1e6, value))
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self

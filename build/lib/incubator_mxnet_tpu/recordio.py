@@ -1,0 +1,281 @@
+"""RecordIO: sequential & indexed record files + image record packing.
+
+Reference: python/mxnet/recordio.py (509 LoC: MXRecordIO/MXIndexedRecordIO,
+IRHeader pack/unpack, pack_img) over dmlc-core's C++ RecordIO streams.
+
+Format (kept binary-compatible with the reference so .rec datasets interop):
+  each record = [uint32 magic 0xced7230a][uint32 lrecord][data][pad to 4B]
+  where lrecord = (cflag<<29) | length; cflag encodes multi-part records.
+The C++ fast path (native/recordio.cpp via ctypes) is used when built — the
+reference's dmlc::RecordIOReader equivalent — with a pure-python fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+
+
+def _native():
+    """The C++ codec (native/recordio.cc), None if g++/load unavailable."""
+    if os.environ.get("MXTPU_NO_NATIVE"):
+        return None
+    try:
+        from . import native
+        return native if native.load() is not None else None
+    except Exception:
+        return None
+
+
+class MXRecordIO:
+    """Sequential record file reader/writer (reference recordio.py:34).
+
+    Uses the native C++ codec when available (multipart framing + buffered
+    IO in C), transparently falling back to the pure-python path."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.is_open = False
+        self._nat = None
+        self.open()
+
+    def open(self):
+        nat = _native()
+        if self.flag == "w":
+            self.writable = True
+            if nat is not None:
+                self._nat = nat.NativeRecordWriter(self.uri)
+                self.record = None
+            else:
+                self.record = open(self.uri, "wb")
+        elif self.flag == "r":
+            self.writable = False
+            if nat is not None:
+                self._nat = nat.NativeRecordReader(self.uri)
+                self.record = None
+            else:
+                self.record = open(self.uri, "rb")
+        else:
+            raise MXNetError(f"invalid flag {self.flag}")
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            if self._nat is not None:
+                self._nat.close()
+                self._nat = None
+            else:
+                self.record.close()
+            self.is_open = False
+            self.pid = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["record"] = None
+        d["_nat"] = None          # ctypes handles don't pickle
+        d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+        if self.flag == "r":
+            pass
+
+    def _check_pid(self):
+        # reference resets readers after fork (recordio.py reset on pid change)
+        if self.pid != os.getpid():
+            self.reset()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    # cflag values in the lrecord high bits (dmlc-core recordio multipart
+    # encoding): 0=complete, 1=begin, 2=middle, 3=end
+    _LEN_MASK = (1 << 29) - 1
+    _CHUNK = (1 << 29) - 4     # max payload per physical record
+
+    def _write_one(self, cflag, data):
+        lrec = (cflag << 29) | len(data)
+        self.record.write(struct.pack("<II", _MAGIC, lrec))
+        self.record.write(data)
+        pad = (4 - (len(data) % 4)) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def write(self, buf):
+        assert self.writable
+        data = bytes(buf)
+        if self._nat is not None:
+            self._nat.write(data)
+            return
+        if len(data) <= self._LEN_MASK:
+            self._write_one(0, data)
+            return
+        # oversized: split into begin/middle.../end physical records
+        chunks = [data[i:i + self._CHUNK]
+                  for i in range(0, len(data), self._CHUNK)]
+        for i, c in enumerate(chunks):
+            cflag = 1 if i == 0 else (3 if i == len(chunks) - 1 else 2)
+            self._write_one(cflag, c)
+
+    def _read_one(self):
+        header = self.record.read(8)
+        if len(header) < 8:
+            return None, None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError("invalid record magic; corrupt file?")
+        cflag = lrec >> 29
+        length = lrec & self._LEN_MASK
+        data = self.record.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.record.read(pad)
+        return cflag, data
+
+    def read(self):
+        assert not self.writable
+        self._check_pid()
+        if self._nat is not None:
+            return self._nat.read()
+        cflag, data = self._read_one()
+        if data is None:
+            return None
+        if cflag == 0:
+            return data
+        if cflag != 1:
+            raise MXNetError(f"multipart record starts with cflag {cflag}; "
+                             "corrupt or mid-stream seek")
+        parts = [data]
+        while True:
+            cflag, data = self._read_one()
+            if data is None:
+                raise MXNetError("truncated multipart record")
+            parts.append(data)
+            if cflag == 3:
+                return b"".join(parts)
+            if cflag != 2:
+                raise MXNetError(f"unexpected cflag {cflag} inside "
+                                 "multipart record")
+
+    def tell(self):
+        if self._nat is not None:
+            return self._nat.tell()
+        return self.record.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self._check_pid()
+        if self._nat is not None:
+            self._nat.seek(pos)
+        else:
+            self.record.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed record file (reference recordio.py:133): .idx maps key->offset."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.exists(self.idx_path):
+            with open(self.idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        key = self.key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+        elif self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# header for image records (reference recordio.py IRHeader)
+import collections
+
+IRHeader = collections.namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + payload into a record payload (reference recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                          header.id, header.id2)
+    else:
+        label = _np.asarray(header.label, dtype=_np.float32)
+        hdr = struct.pack(_IR_FORMAT, len(label), 0.0, header.id, header.id2)
+        hdr += label.tobytes()
+    return hdr + s
+
+
+def unpack(s):
+    """Reference recordio.py unpack."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    payload = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = _np.frombuffer(payload[:header.flag * 4], dtype=_np.float32)
+        header = header._replace(label=label)
+        payload = payload[header.flag * 4:]
+    return header, payload
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Reference recordio.py pack_img (OpenCV imencode there; PIL here)."""
+    from .image.image import imencode
+    return pack(header, imencode(img, quality=quality, fmt=img_fmt))
+
+
+def unpack_img(s, iscolor=1):
+    header, payload = unpack(s)
+    from .image.image import imdecode_np
+    return header, imdecode_np(payload, iscolor)

@@ -1,0 +1,131 @@
+"""Runtime-compiled custom kernels.
+
+Reference: python/mxnet/rtc.py (CudaModule over NVRTC, src/common/rtc.cc:
+35-61 — compile CUDA C at runtime, fetch kernels by name, launch on a
+ctx with grid/block dims). TPU-native redesign: the runtime kernel
+compiler for TPU is **Pallas/Mosaic** — a kernel is a Python function over
+`pl.Ref`s, compiled at `launch` time for the current backend. The module
+keeps CudaModule's shape (module -> get_kernel -> launch) so user code
+ports structurally, but grids/blocks become Pallas grid + BlockSpecs.
+
+    src = '''
+    def scale_add(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+    '''
+    mod = mx.rtc.PallasModule(src, exports=["scale_add"])
+    k = mod.get_kernel("scale_add", out_like=x)
+    out = k.launch([x, y])
+
+On non-TPU backends kernels run through the Pallas interpreter, so the
+same source is testable on the CPU mesh.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["PallasModule", "Kernel", "CudaModule"]
+
+
+class Kernel:
+    """One launchable kernel (reference rtc.py Kernel.launch)."""
+
+    def __init__(self, fn, name, out_shapes, out_dtypes, grid=None,
+                 in_specs=None, out_specs=None):
+        self._fn = fn
+        self._name = name
+        self._out_shapes = out_shapes
+        self._out_dtypes = out_dtypes
+        self._grid = grid
+        self._in_specs = in_specs
+        self._out_specs = out_specs
+        self._compiled = {}       # keyed by effective grid
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):
+        """Run the kernel. grid_dims maps onto the Pallas grid (block_dims/
+        shared_mem have no TPU analog — Mosaic owns tiling — and are
+        accepted but ignored for signature parity)."""
+        import jax
+        import jax.numpy as jnp
+
+        arrs = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in args]
+        grid = tuple(grid_dims) if grid_dims is not None else \
+            (tuple(self._grid) if self._grid is not None else None)
+        fn = self._compiled.get(grid)
+        if fn is None:
+            from jax.experimental import pallas as pl
+
+            out_shape = [jax.ShapeDtypeStruct(s, d) for s, d in
+                         zip(self._out_shapes, self._out_dtypes)]
+            single = len(out_shape) == 1
+            kwargs = {}
+            if grid is not None:
+                kwargs["grid"] = grid
+            if self._in_specs is not None:
+                kwargs["in_specs"] = self._in_specs
+            if self._out_specs is not None:
+                kwargs["out_specs"] = self._out_specs if not single \
+                    else self._out_specs[0]
+            interpret = jax.default_backend() != "tpu"
+            call = pl.pallas_call(
+                self._fn, out_shape=out_shape[0] if single else out_shape,
+                interpret=interpret, **kwargs)
+            fn = jax.jit(call)
+            self._compiled[grid] = fn
+        out = fn(*arrs)
+        if isinstance(out, (list, tuple)):
+            return [NDArray(o) for o in out]
+        return NDArray(out)
+
+
+class PallasModule:
+    """Compile-at-runtime kernel module (reference rtc.py CudaModule).
+
+    source: python source text defining kernel functions over pallas Refs
+    (exec'd with `pl`, `jnp`, `jax` in scope), or None to register python
+    callables directly via get_kernel(fn, ...).
+    """
+
+    def __init__(self, source=None, options=(), exports=()):
+        self._ns = {}
+        self.exports = tuple(exports)
+        if source is not None:
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            # ONE namespace as both globals and locals, so kernels can call
+            # helper functions / constants defined in the same source
+            self._ns.update({"pl": pl, "jnp": jnp, "jax": jax})
+            exec(compile(source, "<rtc>", "exec"), self._ns)
+            missing = [e for e in self.exports if e not in self._ns]
+            if missing:
+                raise MXNetError(f"exported kernels not defined: {missing}")
+
+    def get_kernel(self, name, signature=None, *, out_like=None,
+                   out_shapes=None, out_dtypes=None, grid=None,
+                   in_specs=None, out_specs=None):
+        """Fetch a kernel by name (or pass a callable). Output shapes come
+        from `out_like` (an example array) or explicit out_shapes/
+        out_dtypes; `signature` is accepted for reference-API parity but
+        unused (Pallas kernels are typed by their Refs)."""
+        fn = name if callable(name) else self._ns.get(name)
+        if fn is None:
+            raise MXNetError(f"kernel {name!r} not found in module")
+        if out_like is not None:
+            ol = out_like._data if isinstance(out_like, NDArray) else out_like
+            out_shapes = [ol.shape]
+            out_dtypes = [ol.dtype]
+        if out_shapes is None or out_dtypes is None:
+            raise MXNetError("get_kernel needs out_like or "
+                             "out_shapes+out_dtypes")
+        return Kernel(fn, getattr(fn, "__name__", str(name)), out_shapes,
+                      out_dtypes, grid=grid, in_specs=in_specs,
+                      out_specs=out_specs)
+
+
+def CudaModule(*a, **kw):
+    """CUDA RTC has no TPU analog — point users at PallasModule."""
+    raise MXNetError("CudaModule is CUDA-specific; use rtc.PallasModule "
+                     "(runtime-compiled Pallas/Mosaic kernels) on TPU")

@@ -1,0 +1,74 @@
+"""Runtime feature detection.
+
+Reference: python/mxnet/runtime.py:57 `feature_list()` over
+src/libinfo.cc:103-121 compiled-feature bits — tests and user code gate on
+what the build supports. Here features are probed from the live jax
+runtime (device kinds, dtypes, pallas availability) instead of compile
+flags.
+"""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = bool(enabled)
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _probe():
+    import jax
+    import jax.numpy as jnp
+
+    feats = {}
+    try:
+        devs = jax.devices()
+    except Exception:
+        devs = []
+    kinds = {d.platform for d in devs}
+    feats["TPU"] = "tpu" in kinds or any(
+        "tpu" in str(getattr(d, "device_kind", "")).lower() for d in devs)
+    feats["CUDA"] = "gpu" in kinds or "cuda" in kinds
+    feats["CPU"] = True
+    feats["BF16"] = True  # bfloat16 is first-class in jax on every backend
+    feats["F16C"] = True
+    feats["INT64_TENSOR_SIZE"] = jax.config.jax_enable_x64
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        feats["PALLAS"] = True
+    except Exception:
+        feats["PALLAS"] = False
+    feats["X64"] = jax.config.jax_enable_x64
+    feats["DIST_KVSTORE"] = True  # kvstore.py rides mesh collectives
+    feats["OPENMP"] = False  # XLA owns threading; no OMP pools (SURVEY §1 L2)
+    feats["SIGNAL_HANDLER"] = True
+    feats["PROFILER"] = True
+    feats["COMPILATION_CACHE"] = bool(jax.config.jax_compilation_cache_dir)
+    return feats
+
+
+class Features(dict):
+    """Reference runtime.py Features: mapping name -> Feature with
+    is_enabled."""
+
+    def __init__(self):
+        super().__init__([(k, Feature(k, v)) for k, v in _probe().items()])
+
+    def is_enabled(self, feature_name):
+        if feature_name not in self:
+            from .base import MXNetError
+            raise MXNetError(f"unknown feature {feature_name!r} "
+                             f"(known: {sorted(self)})")
+        return self[feature_name].enabled
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+
+def feature_list():
+    """Reference runtime.py:57."""
+    return list(Features().values())

@@ -1,0 +1,26 @@
+"""`mx.sym` namespace: symbolic graph composition.
+
+Reference: python/mxnet/symbol/ (7,527 LoC) over the NNVM C graph
+(src/c_api/c_api_symbolic.cc). Here a Symbol is a pure-Python DAG over the
+SAME op registry the eager path uses; `bind` compiles the graph with jax.jit
+instead of the reference's GraphExecutor (src/executor/graph_executor.cc:388).
+"""
+from __future__ import annotations
+
+from .symbol import (Group, Symbol, Variable, load, load_json, var,
+                     zeros, ones)
+
+from ..ops import registry as _registry
+from . import symbol as _symbol_mod
+
+
+def __getattr__(name):
+    if name in _registry.OPS:
+        w = _symbol_mod._make_sym_creator(_registry.OPS.get(name))
+        globals()[name] = w
+        return w
+    raise AttributeError(f"module 'symbol' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + _registry.OPS.keys()))

@@ -1,0 +1,904 @@
+"""Symbol: deferred graph composition over the op registry.
+
+Reference behavior being matched (python/mxnet/symbol/symbol.py +
+src/c_api/c_api_symbolic.cc):
+  * compose ops into a DAG with auto-created parameter variables
+    (`sym.FullyConnected(data, num_hidden=128)` creates fc0_weight/fc0_bias),
+  * `list_arguments` / `list_outputs` / `list_auxiliary_states`,
+  * `infer_shape` with bidirectional parameter-shape inference,
+  * MXNet-compatible JSON save/load (both the 1.x `attrs` format and the
+    legacy v0 `param`/`attr` format upgraded by src/nnvm/legacy_json_util.cc),
+  * `eval`, `bind`, `simple_bind` (executor.py compiles via jax.jit).
+
+TPU-native redesign: no NNVM; node attrs hold real Python values; shape/type
+inference is jax.eval_shape over the same op functions the eager path runs, so
+symbolic and imperative semantics can never drift (the reference maintains two
+dispatch paths into shared kernels for the same guarantee).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import json
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError, dtype_name, dtype_np
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones"]
+
+
+# ---------------------------------------------------------------------------
+# op metadata the symbol layer needs beyond the OpDef
+# ---------------------------------------------------------------------------
+
+# inputs that are auxiliary states (not learned via gradient; reference:
+# mutable inputs declared by the op, surfaced as list_auxiliary_states)
+AUX_INPUTS = {
+    "BatchNorm": ("moving_mean", "moving_var"),
+    "BatchNormV1": ("moving_mean", "moving_var"),
+    "SyncBatchNorm": ("moving_mean", "moving_var"),
+}
+
+# ops returning tuples where composition should see only output 0
+# (reference: FNumVisibleOutputs — BatchNorm exposes out, hides mean/var)
+_VISIBLE_ONE = {"BatchNorm", "SyncBatchNorm"}
+
+
+def _num_outputs(op, attrs):
+    """Worst-case output count of an op node (full tuple arity)."""
+    name = op.name
+    if name in ("BatchNorm", "SyncBatchNorm"):
+        return 3
+    if name == "LayerNorm":
+        return 3 if attrs.get("output_mean_var") else 1
+    if name in ("Moments", "moments"):
+        return 2
+    if name in ("split", "SliceChannel"):
+        n = int(attrs.get("num_outputs", 1))
+        return n if n > 1 else 1
+    if name == "split_v2":
+        ios = attrs.get("indices_or_sections", 1)
+        return (len(ios) + 1) if isinstance(ios, (tuple, list)) else int(ios)
+    if name == "RNN":
+        return 3 if attrs.get("state_outputs") else 1
+    return 1
+
+
+def _visible_outputs(op, attrs):
+    if op.name in _VISIBLE_ONE:
+        return 1
+    return _num_outputs(op, attrs)
+
+
+_sig_cache: dict = {}
+
+
+def _op_signature(op):
+    """(array_arg_names, has_varargs, kw_names) from the op function."""
+    got = _sig_cache.get(op.name)
+    if got is None:
+        sig = inspect.signature(op.fn)
+        arr, kw, varargs = [], set(), False
+        for p in sig.parameters.values():
+            if p.kind == inspect.Parameter.VAR_POSITIONAL:
+                varargs = True
+            elif p.kind == inspect.Parameter.POSITIONAL_OR_KEYWORD:
+                arr.append((p.name, p.default is inspect.Parameter.empty))
+            elif p.kind == inspect.Parameter.KEYWORD_ONLY:
+                kw.add(p.name)
+        got = (arr, varargs, kw)
+        _sig_cache[op.name] = got
+    return got
+
+
+# parameter-shape inference rules: fn(attrs, in_shapes_by_name) -> {arg: shape}
+# This is the forward half of the reference's bidirectional infer_shape
+# (src/executor/infer_graph_attr_pass.cc) — enough to bind real models from
+# data shapes alone.
+def _infer_fc(attrs, s):
+    d = s.get("data")
+    if d is None:
+        return {}
+    nh = int(attrs.get("num_hidden", 0))
+    ind = int(_np.prod(d[1:])) if attrs.get("flatten", True) else d[-1]
+    out = {"weight": (nh, ind)}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (nh,)
+    return out
+
+
+def _infer_conv(attrs, s):
+    d = s.get("data")
+    if d is None:
+        return {}
+    nf = int(attrs.get("num_filter", 0))
+    ng = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs.get("kernel", ()))
+    out = {"weight": (nf, d[1] // ng) + kernel}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (nf,)
+    return out
+
+
+def _infer_deconv(attrs, s):
+    d = s.get("data")
+    if d is None:
+        return {}
+    nf = int(attrs.get("num_filter", 0))
+    ng = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs.get("kernel", ()))
+    out = {"weight": (d[1], nf // ng) + kernel}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (nf,)
+    return out
+
+
+def _infer_norm(attrs, s):
+    d = s.get("data")
+    if d is None:
+        return {}
+    ax = int(attrs.get("axis", 1))
+    c = d[ax % len(d)]
+    return {k: (c,) for k in ("gamma", "beta", "moving_mean", "moving_var")}
+
+
+def _infer_lnorm(attrs, s):
+    d = s.get("data")
+    if d is None:
+        return {}
+    ax = int(attrs.get("axis", -1))
+    c = d[ax % len(d)]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _infer_embedding(attrs, s):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+INFER_PARAM_SHAPES = {
+    "FullyConnected": _infer_fc,
+    "Convolution": _infer_conv,
+    "Deconvolution": _infer_deconv,
+    "BatchNorm": _infer_norm,
+    "SyncBatchNorm": _infer_norm,
+    "InstanceNorm": _infer_lnorm,
+    "LayerNorm": _infer_lnorm,
+    # gamma/beta are per-GROUP: shape (num_groups,), reference
+    # group_norm-inl.h:163 + gluon basic_layers.py:690-695
+    "GroupNorm": lambda a, s: {"gamma": (int(a.get("num_groups", 1)),),
+                               "beta": (int(a.get("num_groups", 1)),)},
+    "Embedding": _infer_embedding,
+}
+
+
+# ---------------------------------------------------------------------------
+# graph node
+# ---------------------------------------------------------------------------
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "extra", "inputs", "arg_names")
+
+    def __init__(self, op, name, attrs, inputs, extra=None, arg_names=None):
+        self.op = op            # OpDef or None for a variable
+        self.name = name
+        self.attrs = attrs      # python-typed op params
+        self.extra = extra or {}  # non-param attrs (lr_mult, __shape__, ...)
+        self.inputs = inputs    # list[(node, out_index)]
+        # names of the array args each input binds to (for aux detection)
+        self.arg_names = arg_names or []
+
+
+class _NameManager:
+    _lock = threading.Lock()
+    _counts: dict = {}
+
+    @classmethod
+    def next(cls, hint):
+        with cls._lock:
+            i = cls._counts.get(hint, 0)
+            cls._counts[hint] = i + 1
+        return f"{hint}{i}"
+
+
+def _topo(entries):
+    """Iterative post-order over node graph; returns nodes in topo order."""
+    seen, order, stack = set(), [], [(n, False) for n, _ in reversed(entries)]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp, _ in reversed(node.inputs):
+            if id(inp) not in seen:
+                stack.append((inp, False))
+    return order
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+
+class Symbol:
+    """A handle on one or more graph outputs (reference symbol.py Symbol)."""
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # [(node, out_idx)]
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) != 1:
+            return None
+        return self._outputs[0][0].name
+
+    def __repr__(self):
+        return f"<Symbol {self.name or 'group'}>"
+
+    def __len__(self):
+        return len(self._visible_entries())
+
+    def __iter__(self):
+        ents = self._visible_entries()
+        return iter(Symbol([e]) for e in ents)
+
+    def _visible_entries(self):
+        ents = []
+        for node, idx in self._outputs:
+            ents.append((node, idx))
+        return ents
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index in names:
+                return Symbol([self._outputs[names.index(index)]])
+            # allow bare node name
+            for i, (node, idx) in enumerate(self._outputs):
+                if node.name == index:
+                    return Symbol([self._outputs[i]])
+            raise MXNetError(f"no output named {index!r} (have {names})")
+        return Symbol([self._outputs[index]])
+
+    # -- attrs --------------------------------------------------------------
+    def attr(self, key):
+        node = self._outputs[0][0]
+        v = node.extra.get(key)
+        if v is None and key in node.attrs:
+            v = str(node.attrs[key])
+        return v
+
+    def list_attr(self):
+        node = self._outputs[0][0]
+        out = {k: str(v) for k, v in node.attrs.items()}
+        out.update({k: str(v) for k, v in node.extra.items()})
+        return out
+
+    def attr_dict(self):
+        out = {}
+        for node in _topo(self._outputs):
+            d = {k: str(v) for k, v in node.attrs.items()}
+            d.update({k: str(v) for k, v in node.extra.items()})
+            if d:
+                out[node.name] = d
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0].extra.update(kwargs)
+
+    # -- listing ------------------------------------------------------------
+    def _aux_var_ids(self):
+        aux = set()
+        for node in _topo(self._outputs):
+            if node.op is None:
+                continue
+            aux_names = AUX_INPUTS.get(node.op.name, ())
+            for (inp, _), aname in zip(node.inputs, node.arg_names):
+                if inp.op is None and aname in aux_names:
+                    aux.add(id(inp))
+        return aux
+
+    def list_arguments(self):
+        aux = self._aux_var_ids()
+        return [n.name for n in _topo(self._outputs)
+                if n.op is None and id(n) not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_var_ids()
+        return [n.name for n in _topo(self._outputs)
+                if n.op is None and id(n) in aux]
+
+    def list_inputs(self):
+        return [n.name for n in _topo(self._outputs) if n.op is None]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._outputs:
+            if node.op is None:
+                out.append(node.name)
+            else:
+                nout = _num_outputs(node.op, node.attrs)
+                suffix = "output" if nout == 1 or idx == 0 else f"output{idx}"
+                out.append(f"{node.name}_{suffix}")
+        return out
+
+    def get_internals(self):
+        ents = []
+        for node in _topo(self._outputs):
+            if node.op is None:
+                ents.append((node, 0))
+            else:
+                for i in range(_visible_outputs(node.op, node.attrs)):
+                    ents.append((node, i))
+        return Symbol(ents)
+
+    def get_children(self):
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol([(n, i) for n, i in node.inputs])
+
+    # -- shape/type inference ----------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+        except Exception as e:  # mirror reference error surface
+            raise MXNetError(f"infer_shape error: {e}") from e
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+
+        if args:
+            kwargs = dict(zip(self.list_arguments(), args))
+        known = {k: tuple(v) for k, v in kwargs.items() if v is not None}
+        dtypes = {}
+        shapes, _ = self._run_inference(known, dtypes, partial)
+        if shapes is None:
+            return None, None, None
+        args_order = self.list_arguments()
+        aux_order = self.list_auxiliary_states()
+        arg_shapes = [shapes.get(n) for n in args_order]
+        aux_shapes = [shapes.get(n) for n in aux_order]
+        out_shapes = [shapes[f"__out__{i}"] for i in range(len(self._outputs))]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        if args:
+            kwargs = dict(zip(self.list_arguments(), args))
+        dtypes = {k: dtype_np(v) for k, v in kwargs.items() if v is not None}
+        args_order = self.list_arguments()
+        aux_order = self.list_auxiliary_states()
+        try:
+            _, types = self._run_inference({}, dtypes, False, want_types=True)
+        except MXNetError:
+            # no shapes available: fall back to uniform-dtype propagation
+            # (the reference's type inference is shape-free; ours rides
+            # eval_shape, so without shapes we assume one floating dtype)
+            uni = next(iter(dtypes.values()), _np.float32)
+            return ([dtypes.get(n, uni) for n in args_order],
+                    [uni] * len(self._outputs),
+                    [dtypes.get(n, uni) for n in aux_order])
+        return ([types.get(n) for n in args_order],
+                [types[f"__out__{i}"] for i in range(len(self._outputs))],
+                [types.get(n) for n in aux_order])
+
+    def _run_inference(self, known_shapes, known_dtypes, partial,
+                       want_types=False):
+        """Walk the graph with jax.eval_shape, inferring variable shapes from
+        per-op parameter rules as they become needed."""
+        import jax
+
+        var_shape = dict(known_shapes)
+        var_dtype = dict(known_dtypes)
+        entry_aval = {}
+
+        for node in _topo(self._outputs):
+            if node.op is None:
+                shp = var_shape.get(node.name)
+                if shp is None and "__shape__" in node.extra:
+                    shp = tuple(node.extra["__shape__"])
+                    var_shape[node.name] = shp
+                dt = var_dtype.get(node.name)
+                if dt is None and "__dtype__" in node.extra:
+                    dt = dtype_np(node.extra["__dtype__"])
+                entry_aval[(id(node), 0)] = (shp, dt or _np.float32)
+                continue
+
+            # try to infer still-unknown variable inputs from known ones
+            rule = INFER_PARAM_SHAPES.get(node.op.name)
+            in_shapes = {}
+            for (inp, oi), aname in zip(node.inputs, node.arg_names):
+                av = entry_aval.get((id(inp), oi))
+                if av and av[0] is not None:
+                    in_shapes[aname] = av[0]
+            if rule is not None:
+                inferred = rule(node.attrs, in_shapes)
+                for (inp, oi), aname in zip(node.inputs, node.arg_names):
+                    if inp.op is None and aname in inferred:
+                        prev = var_shape.get(inp.name)
+                        got = tuple(int(x) for x in inferred[aname])
+                        if prev is not None and tuple(prev) != got:
+                            raise MXNetError(
+                                f"shape mismatch for {inp.name}: bound "
+                                f"{prev} but inferred {got} at {node.name}")
+                        if prev is None:
+                            var_shape[inp.name] = got
+                            entry_aval[(id(inp), 0)] = (
+                                got, entry_aval[(id(inp), 0)][1])
+
+            ins = []
+            missing = False
+            for (inp, oi) in node.inputs:
+                shp, dt = entry_aval[(id(inp), oi)]
+                if shp is None:
+                    missing = True
+                    break
+                ins.append(jax.ShapeDtypeStruct(tuple(shp), dt))
+            if missing:
+                if partial:
+                    n = _num_outputs(node.op, node.attrs)
+                    for i in range(n):
+                        entry_aval[(id(node), i)] = (None, None)
+                    continue
+                unk = [inp.name for inp, oi in node.inputs
+                       if entry_aval[(id(inp), oi)][0] is None]
+                raise MXNetError(
+                    f"infer_shape: cannot infer shapes of {unk} needed by "
+                    f"op {node.op.name} '{node.name}'; provide them explicitly")
+
+            kwargs = dict(node.attrs)
+            if node.op.train_aware:
+                kwargs.setdefault("training", False)
+            fn = node.op.fn
+            if node.op.stateful:
+                key_aval = jax.ShapeDtypeStruct((2,), _np.uint32)
+                out = jax.eval_shape(
+                    lambda k, *xs, _f=fn, _kw=kwargs: _f(*xs, rng=k, **_kw),
+                    key_aval, *ins)
+            else:
+                out = jax.eval_shape(lambda *xs, _f=fn, _kw=kwargs: _f(*xs, **_kw),
+                                     *ins)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for i, o in enumerate(outs):
+                entry_aval[(id(node), i)] = (tuple(o.shape), o.dtype)
+
+        shapes, types = {}, {}
+        for name, node in [(n.name, n) for n in _topo(self._outputs)
+                           if n.op is None]:
+            av = entry_aval[(id(node), 0)]
+            shapes[name] = tuple(av[0]) if av[0] is not None else None
+            types[name] = av[1]
+        for i, (node, oi) in enumerate(self._outputs):
+            av = entry_aval[(id(node), oi)]
+            shapes[f"__out__{i}"] = tuple(av[0]) if av[0] is not None else None
+            types[f"__out__{i}"] = av[1]
+        return shapes, types if want_types else None
+
+    # -- evaluation ---------------------------------------------------------
+    def _build_eval(self, training=False):
+        """Returns fn(bindings: dict[str, jax.Array], rng) -> list[jax.Array]
+        plus the list of (node, stat_index) BatchNorm batch stats for aux
+        updates (the reference op mutates aux in the kernel; we return the
+        batch stats functionally)."""
+        order = _topo(self._outputs)
+        bn_nodes = [n for n in order
+                    if n.op is not None and n.op.name in AUX_INPUTS]
+
+        def run(bindings, rng=None):
+            import jax
+            cache = {}
+            key = rng
+
+            def key_next():
+                nonlocal key
+                if key is None:
+                    from ..ndarray import random as _rnd
+                    return _rnd.next_key()
+                key, sub = jax.random.split(key)
+                return sub
+
+            for node in order:
+                if node.op is None:
+                    if node.name not in bindings:
+                        raise MXNetError(f"unbound variable {node.name!r}")
+                    cache[id(node)] = (bindings[node.name],)
+                    continue
+                ins = [cache[id(inp)][oi] for inp, oi in node.inputs]
+                kwargs = dict(node.attrs)
+                if _registry.AMP_HOOK is not None:
+                    ins = _registry.AMP_HOOK(node.op.name, ins, kwargs)
+                if node.op.train_aware:
+                    kwargs.setdefault("training", training)
+                if node.op.stateful:
+                    kwargs["rng"] = key_next()
+                res = node.op.fn(*ins, **kwargs)
+                cache[id(node)] = tuple(res) if isinstance(res, (tuple, list)) \
+                    else (res,)
+            outs = [cache[id(n)][i] for n, i in self._outputs]
+            stats = {}
+            for n in bn_nodes:
+                got = cache[id(n)]
+                if len(got) >= 3:
+                    # (out, batch_mean, batch_var) per ops/nn_ops.py BatchNorm
+                    stats[n.name] = (got[1], got[2])
+            return outs, stats
+
+        return run
+
+    def eval_dict(self, bindings, training=None):
+        """Evaluate eagerly with a name->NDArray dict; returns NDArray list
+        (single NDArray if one output)."""
+        from .. import autograd
+        from ..ndarray import NDArray
+
+        if training is None:
+            training = autograd.is_training()
+        vals = {k: (v._data if isinstance(v, NDArray) else v)
+                for k, v in bindings.items()}
+        run = self._build_eval(training=training)
+        outs, _ = run(vals)
+        outs = [NDArray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def eval(self, ctx=None, **kwargs):
+        out = self.eval_dict(kwargs)
+        return out if isinstance(out, list) else [out]
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from ..executor import Executor
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **shapes):
+        from ..executor import Executor
+        return Executor.simple_bind(self, ctx, grad_req=grad_req,
+                                    type_dict=type_dict, **shapes)
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self):
+        order = _topo(self._outputs)
+        idx = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            attrs = {k: _attr_str(v) for k, v in n.attrs.items()}
+            attrs.update({k: _attr_str(v) for k, v in n.extra.items()})
+            entry = {
+                "op": "null" if n.op is None else n.op.name,
+                "name": n.name,
+                "inputs": [[idx[id(i)], oi, 0] for i, oi in n.inputs],
+            }
+            if attrs:
+                entry["attrs"] = attrs
+            nodes.append(entry)
+        arg_nodes = [i for i, n in enumerate(order) if n.op is None]
+        heads = [[idx[id(n)], oi, 0] for n, oi in self._outputs]
+        return json.dumps({
+            "nodes": nodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(order) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10500]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- composition sugar --------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        raise MXNetError("composition via __call__ is not supported; "
+                         "pass symbols to sym.<Op>(...) directly")
+
+    def _entry(self):
+        if len(self._outputs) != 1:
+            raise MXNetError("operation requires a single-output symbol")
+        return self._outputs[0]
+
+    def __add__(self, other):
+        return _scalar_or_broadcast(self, other, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _scalar_or_broadcast(self, other, "broadcast_sub", "_sub_scalar")
+
+    def __rsub__(self, other):
+        return _scalar_op(self, other, "_rsub_scalar")
+
+    def __mul__(self, other):
+        return _scalar_or_broadcast(self, other, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _scalar_or_broadcast(self, other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _scalar_op(self, other, "_rdiv_scalar")
+
+    def __pow__(self, other):
+        return _scalar_or_broadcast(self, other, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return _scalar_op(self, -1.0, "_mul_scalar")
+
+
+def _method(opname, self, *args, **kwargs):
+    return _create(_registry.get_op(opname), (self,) + args, kwargs)
+
+
+for _m, _op in [("reshape", "reshape"), ("transpose", "transpose"),
+                ("flatten", "flatten"), ("sum", "sum"), ("mean", "mean"),
+                ("max", "max"), ("min", "min"), ("prod", "prod"),
+                ("astype", "cast"), ("slice_axis", "slice_axis"),
+                ("expand_dims", "expand_dims"), ("squeeze", "squeeze"),
+                ("clip", "clip"), ("abs", "abs"), ("exp", "exp"),
+                ("log", "log"), ("sqrt", "sqrt"), ("square", "square"),
+                ("relu", "relu"), ("sigmoid", "sigmoid"), ("tanh", "tanh"),
+                ("softmax", "softmax"), ("log_softmax", "log_softmax"),
+                ("dot", "dot"), ("argmax", "argmax"), ("argmin", "argmin"),
+                ("take", "take"), ("tile", "tile"), ("repeat", "repeat"),
+                ("split", "split"), ("swapaxes", "swapaxes"),
+                ("broadcast_to", "broadcast_to"), ("one_hot", "one_hot")]:
+    def _bound(self, *a, _op=_op, **k):
+        return _method(_op, self, *a, **k)
+
+    _bound.__name__ = _m
+    setattr(Symbol, _m, _bound)
+
+
+def _scalar_or_broadcast(sym, other, broadcast_op, scalar_op):
+    if isinstance(other, Symbol):
+        return _create(_registry.get_op(broadcast_op), (sym, other), {})
+    return _scalar_op_impl(sym, other, scalar_op)
+
+
+def _scalar_op(sym, other, scalar_op):
+    return _scalar_op_impl(sym, other, scalar_op)
+
+
+def _scalar_op_impl(sym, scalar, opname):
+    return _create(_registry.get_op(opname), (sym,), {"scalar": float(scalar)})
+
+
+def _attr_str(v):
+    if isinstance(v, str):
+        return v
+    return str(v)
+
+
+def _coerce_attr(v):
+    """Parse a stringified attr back to a python value (MXNet JSON stores all
+    attrs as strings)."""
+    if not isinstance(v, str):
+        return v
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+# ---------------------------------------------------------------------------
+# composition
+# ---------------------------------------------------------------------------
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (reference symbol.py var/Variable)."""
+    extra = dict(attr or {})
+    extra.update(kwargs)
+    if shape is not None:
+        extra["__shape__"] = tuple(shape)
+    if dtype is not None:
+        extra["__dtype__"] = dtype_name(dtype_np(dtype))
+    if lr_mult is not None:
+        extra["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        extra["__wd_mult__"] = wd_mult
+    if init is not None:
+        extra["__init__"] = init if isinstance(init, str) else \
+            getattr(init, "dumps", lambda: str(init))()
+    node = _Node(None, name, {}, [], extra=extra)
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    ents = []
+    for s in symbols:
+        ents.extend(s._outputs)
+    return Symbol(ents)
+
+
+def zeros(shape, dtype="float32", name=None, **kwargs):
+    return _create(_registry.get_op("_zeros"), (),
+                   {"shape": tuple(shape), "dtype": dtype}, name=name)
+
+
+def ones(shape, dtype="float32", name=None, **kwargs):
+    return _create(_registry.get_op("_ones"), (),
+                   {"shape": tuple(shape), "dtype": dtype}, name=name)
+
+
+def _create(op, args, kwargs, name=None):
+    """Compose an op node from Symbol args + python attrs, auto-creating
+    missing parameter variables (reference c_api_symbolic.cc MXSymbolCompose +
+    NameManager python/mxnet/name.py)."""
+    arr_args, varargs, kw_names = _op_signature(op)
+    kwargs = dict(kwargs)
+    name = name or kwargs.pop("name", None)
+    attr = kwargs.pop("attr", None)
+
+    # split kwargs into symbol inputs vs op params
+    sym_kwargs = {}
+    attrs = {}
+    extra = dict(attr or {})
+    for k, v in list(kwargs.items()):
+        if isinstance(v, Symbol):
+            sym_kwargs[k] = v
+        elif k in kw_names:
+            attrs[k] = v
+        elif k in [a for a, _ in arr_args]:
+            if v is None:
+                continue
+            raise MXNetError(f"{op.name}: argument {k!r} must be a Symbol, "
+                             f"got {type(v).__name__}")
+        else:
+            extra[k] = v
+
+    name = name or _NameManager.next(op.name.lower().lstrip("_"))
+
+    inputs = []
+    arg_names_used = []
+
+    if varargs:
+        for i, a in enumerate(args):
+            if not isinstance(a, Symbol):
+                raise MXNetError(f"{op.name}: positional args must be Symbols")
+            inputs.append(a._entry_for_compose())
+            arg_names_used.append(f"arg{i}")
+        if "num_args" in kw_names:
+            attrs.setdefault("num_args", len(inputs))
+    else:
+        # positional symbols fill array-arg slots in order
+        pos = list(args)
+        for aname, required in arr_args:
+            s = None
+            if aname in sym_kwargs:
+                s = sym_kwargs.pop(aname)
+            elif pos:
+                nxt = pos[0]
+                if isinstance(nxt, Symbol):
+                    s = pos.pop(0)
+                elif nxt is None:
+                    # explicit "no input" slot (bias=None when use_bias=False)
+                    pos.pop(0)
+                    continue
+            if s is None:
+                # auto-create a trailing parameter variable when needed
+                if required or _wants_auto_var(op, aname, attrs):
+                    s = var(f"{name}_{aname}")
+                else:
+                    continue
+            inputs.append(s._entry_for_compose())
+            arg_names_used.append(aname)
+        if pos:
+            raise MXNetError(f"{op.name}: too many positional args")
+        if sym_kwargs:
+            raise MXNetError(f"{op.name}: unknown symbol kwargs "
+                             f"{sorted(sym_kwargs)}")
+
+    if op.train_aware:
+        # symbols carry no train-mode attr — the mode comes from the
+        # executor's is_train at run time (reference: OpContext.is_train)
+        attrs.pop("training", None)
+
+    node = _Node(op, name, attrs, inputs, extra=extra,
+                 arg_names=arg_names_used)
+    n_vis = _visible_outputs(op, attrs)
+    return Symbol([(node, i) for i in range(n_vis)])
+
+
+def _wants_auto_var(op, aname, attrs):
+    """Should an omitted optional array input become an auto variable?
+    Mirrors the reference convention: bias exists unless no_bias."""
+    if aname == "bias":
+        return not attrs.get("no_bias", False)
+    if aname == "gamma" and op.name == "LeakyReLU":
+        return attrs.get("act_type") == "prelu"
+    return False
+
+
+# patch Symbol composition entry helper
+def _entry_for_compose(self):
+    if len(self._outputs) != 1:
+        raise MXNetError(
+            "cannot use a multi-output symbol as an op input; select one "
+            "output with sym[i]")
+    return self._outputs[0]
+
+
+Symbol._entry_for_compose = _entry_for_compose
+
+
+def _make_sym_creator(opdef):
+    def creator(*args, **kwargs):
+        return _create(opdef, args, kwargs)
+
+    creator.__name__ = opdef.name
+    creator.__doc__ = opdef.fn.__doc__
+    return creator
+
+
+# ---------------------------------------------------------------------------
+# JSON load (MXNet-compatible; handles 1.x "attrs" and legacy v0 "param")
+# ---------------------------------------------------------------------------
+
+def load_json(json_str):
+    d = json.loads(json_str)
+    if "nodes" not in d:
+        raise MXNetError("not a symbol json: missing 'nodes'")
+    nodes = []
+    for nd_ in d["nodes"]:
+        opname = nd_["op"]
+        raw_attrs = {}
+        # modern: "attrs"; legacy v0: "param" (op params) + "attr" (user attrs)
+        raw_attrs.update(nd_.get("param") or {})
+        raw_attrs.update(nd_.get("attrs") or {})
+        user_attrs = dict(nd_.get("attr") or {})
+        if opname == "null":
+            node = _Node(None, nd_["name"], {}, [],
+                         extra={k: _coerce_attr(v) for k, v in
+                                {**raw_attrs, **user_attrs}.items()})
+            nodes.append(node)
+            continue
+        op = _registry.get_op(opname)
+        arr_args, varargs, kw_names = _op_signature(op)
+        attrs, extra = {}, {}
+        for k, v in {**raw_attrs, **user_attrs}.items():
+            if k in kw_names:
+                attrs[k] = _coerce_attr(v)
+            else:
+                extra[k] = _coerce_attr(v)
+        inputs = [(nodes[i[0]], i[1]) for i in nd_["inputs"]]
+        if varargs:
+            argnames = [f"arg{i}" for i in range(len(inputs))]
+        else:
+            argnames = [a for a, _ in arr_args][:len(inputs)]
+            # legacy v0 JSON omits auxiliary inputs (BatchNorm moving stats
+            # predate their appearance in the graph); materialize them
+            for aname, required in arr_args[len(inputs):]:
+                if required:
+                    vnode = _Node(None, f"{nd_['name']}_{aname}", {}, [])
+                    inputs.append((vnode, 0))
+                    argnames.append(aname)
+        node = _Node(op, nd_["name"], attrs, inputs, extra=extra,
+                     arg_names=argnames)
+        nodes.append(node)
+    heads = d.get("heads") or [[len(nodes) - 1, 0, 0]]
+    return Symbol([(nodes[h[0]], h[1] if len(h) > 1 else 0) for h in heads])
+
+
+def fromjson(json_str):
+    return load_json(json_str)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

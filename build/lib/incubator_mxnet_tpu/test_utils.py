@@ -1,0 +1,152 @@
+"""Shared test utilities, shipped in the package so all frontends/CI reuse it.
+
+Reference: python/mxnet/test_utils.py (2,212 LoC): assert_almost_equal:501,
+check_numeric_gradient:872, check_symbolic_forward:1015/backward:1097,
+check_consistency:1304, rand_ndarray, same:480, default_context().
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import autograd, nd
+from .context import Context, cpu, current_context
+
+__all__ = ["default_context", "assert_almost_equal", "same", "rand_ndarray",
+           "rand_shape_2d", "rand_shape_3d", "check_numeric_gradient",
+           "check_consistency", "almost_equal"]
+
+_default_ctx = None
+
+
+def default_context() -> Context:
+    return _default_ctx or current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def _dtype_tol(dtype):
+    d = _np.dtype(dtype) if "bfloat16" not in str(dtype) else None
+    if d is None or d == _np.float16:
+        return 1e-2, 1e-2
+    if d == _np.float64:
+        return 1e-7, 1e-9
+    return 1e-4, 1e-5
+
+
+def same(a, b):
+    return _np.array_equal(_to_np(a), _to_np(b))
+
+
+def _to_np(a):
+    return a.asnumpy() if isinstance(a, nd.NDArray) else _np.asarray(a)
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    a, b = _to_np(a), _to_np(b)
+    drt, dat = _dtype_tol(a.dtype)
+    return _np.allclose(a, b, rtol=rtol or drt, atol=atol or dat)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    """dtype-aware tolerance compare (reference test_utils.py:501)."""
+    a, b = _to_np(a), _to_np(b)
+    drt, dat = _dtype_tol(a.dtype)
+    _np.testing.assert_allclose(a, b, rtol=rtol if rtol is not None else drt,
+                                atol=atol if atol is not None else dat,
+                                err_msg=f"{names[0]} != {names[1]}")
+
+
+def rand_ndarray(shape, dtype="float32", ctx=None, scale=1.0):
+    return nd.array(_np.random.uniform(-scale, scale, shape).astype(dtype), ctx=ctx)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(_np.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(_np.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Finite-difference gradient check against autograd
+    (reference test_utils.py:872 check_numeric_gradient)."""
+    arrays = [nd.array(x) if not isinstance(x, nd.NDArray) else x for x in inputs]
+    for a in arrays:
+        a.attach_grad()
+    with autograd.record():
+        out = fn(*arrays)
+        if isinstance(out, (list, tuple)):
+            out = sum((o.sum() for o in out[1:]), out[0].sum())
+        elif out.size != 1:
+            out = out.sum()
+    out.backward()
+    analytic = [a.grad.asnumpy().copy() for a in arrays]
+
+    for ai, a in enumerate(arrays):
+        base = a.asnumpy().astype(_np.float64)
+        num = _np.zeros_like(base)
+        flat = base.reshape(-1)
+        numf = num.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            with autograd.pause():
+                fp = _scalar_eval(fn, arrays, ai, base)
+            flat[i] = orig - eps
+            with autograd.pause():
+                fm = _scalar_eval(fn, arrays, ai, base)
+            flat[i] = orig
+            numf[i] = (fp - fm) / (2 * eps)
+        _np.testing.assert_allclose(analytic[ai], num, rtol=rtol, atol=atol,
+                                    err_msg=f"gradient mismatch on input {ai}")
+
+
+def _scalar_eval(fn, arrays, ai, perturbed):
+    saved = arrays[ai]._data
+    arrays[ai]._data = nd.array(perturbed.astype(_np.float32))._data
+    try:
+        out = fn(*arrays)
+        if isinstance(out, (list, tuple)):
+            return float(sum(float(o.sum().asscalar()) for o in out))
+        return float(out.sum().asscalar())
+    finally:
+        arrays[ai]._data = saved
+
+
+def check_consistency(fn, inputs, ctx_list=None, dtype_list=None, rtol=None,
+                      atol=None, ref_dtype="float32"):
+    """Run fn across a (context x dtype) matrix and compare every run
+    against the highest-precision one — the reference's cross-device
+    oracle (test_utils.py:1304), which validates GPU kernels against CPU
+    there and bf16/f16 TPU paths against fp32 here.
+
+    Each entry of the matrix gets dtype-aware tolerances unless rtol/atol
+    are forced. Returns {(ctx, dtype): np output}.
+    """
+    ctx_list = ctx_list or [cpu(0)]
+    dtype_list = dtype_list or [ref_dtype]
+    results = {}
+    for ctx in ctx_list:
+        for dt in dtype_list:
+            arrs = [nd.array(_np.asarray(x), ctx=ctx).astype(dt)
+                    for x in inputs]
+            out = fn(*arrs)
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            results[(str(ctx), str(dt))] = _to_np(out)
+    ref_key = next((k for k in results if k[1] == str(ref_dtype)),
+                   next(iter(results)))
+    ref = results[ref_key].astype(_np.float64)
+    for key, o in results.items():
+        if key == ref_key:
+            continue
+        drt, dat = _dtype_tol(o.dtype)
+        _np.testing.assert_allclose(
+            o.astype(_np.float64), ref,
+            rtol=rtol if rtol is not None else drt,
+            atol=atol if atol is not None else dat,
+            err_msg=f"{key} inconsistent with {ref_key}")
+    return results

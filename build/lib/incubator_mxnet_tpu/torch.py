@@ -1,0 +1,66 @@
+"""PyTorch interop bridge.
+
+Reference: python/mxnet/torch.py (183 LoC) — a legacy bridge that ran
+(Lua)Torch ops on MXNet NDArrays through a C plugin. TPU-native redesign:
+the bridge is the DLPack protocol (ndarray/utils.py from_dlpack/
+to_dlpack_*): tensors move zero-copy on CPU, and any torch callable can be
+applied to NDArrays with `torch_function`. There is no C plugin — torch is
+an optional peer framework, imported lazily so the package works without it.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .ndarray.utils import from_dlpack
+
+__all__ = ["to_torch", "from_torch", "torch_function"]
+
+
+def _torch():
+    try:
+        import torch  # absolute: the real pytorch, not this module
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("pytorch is not installed") from e
+    return torch
+
+
+def to_torch(arr):
+    """NDArray -> torch.Tensor (zero-copy via dlpack when on CPU; device
+    arrays are staged through host memory)."""
+    torch = _torch()
+    try:
+        return torch.from_dlpack(arr._data)
+    except Exception:
+        return torch.from_numpy(arr.asnumpy())
+
+
+def from_torch(tensor):
+    """torch.Tensor -> NDArray (dlpack, falling back to a host copy for
+    non-contiguous / unsupported layouts)."""
+    try:
+        return from_dlpack(tensor.contiguous())
+    except Exception:
+        return NDArray(tensor.detach().cpu().numpy())
+
+
+def torch_function(fn):
+    """Wrap a torch callable so it consumes/produces NDArrays:
+
+        l2 = mx.torch.torch_function(lambda a, b: torch.nn.functional
+                                     .mse_loss(a, b))
+        out = l2(x_nd, y_nd)
+    """
+    def wrapped(*args, **kwargs):
+        conv = [to_torch(a) if isinstance(a, NDArray) else a for a in args]
+        kw = {k: to_torch(v) if isinstance(v, NDArray) else v
+              for k, v in kwargs.items()}
+        out = fn(*conv, **kw)
+        torch = _torch()
+        if isinstance(out, torch.Tensor):
+            return from_torch(out)
+        if isinstance(out, (list, tuple)):
+            return type(out)(from_torch(o) if isinstance(o, torch.Tensor)
+                             else o for o in out)
+        return out
+
+    return wrapped

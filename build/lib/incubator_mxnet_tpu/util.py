@@ -1,0 +1,132 @@
+"""Misc utilities (reference python/mxnet/util.py, 604 LoC).
+
+The reference's util.py mostly manages numpy-shape/array semantics switches
+threaded through the C API; here those are process-local flags consumed by
+the mxnet.numpy namespace, plus the small filesystem/env helpers user code
+imports.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+__all__ = ["makedirs", "set_np_shape", "is_np_shape", "use_np_shape",
+           "np_shape", "set_np_array", "is_np_array", "np_array", "use_np",
+           "set_np", "reset_np", "getenv", "setenv", "default_array"]
+
+_tls = threading.local()
+
+
+def makedirs(d):
+    """Reference util.py makedirs (py2 compat wrapper there; kept for API)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+# -- numpy-semantics switches (reference util.py set_np_shape:68 etc.) ------
+
+def _flags():
+    if not hasattr(_tls, "np_shape"):
+        _tls.np_shape = False
+        _tls.np_array = False
+    return _tls
+
+
+def set_np_shape(active):
+    """Allow zero-dim/zero-size arrays (reference util.py:68). Under jax
+    these are always expressible; the flag only controls legacy-shape
+    validation in the NDArray layer."""
+    prev = _flags().np_shape
+    _flags().np_shape = bool(active)
+    return prev
+
+
+def is_np_shape():
+    return _flags().np_shape
+
+
+def set_np_array(active):
+    prev = _flags().np_array
+    _flags().np_array = bool(active)
+    return prev
+
+
+def is_np_array():
+    return _flags().np_array
+
+
+class _NpShapeScope:
+    def __init__(self, shape=True, array=None):
+        self._shape = shape
+        self._array = array
+
+    def __enter__(self):
+        self._prev_shape = set_np_shape(self._shape)
+        if self._array is not None:
+            self._prev_array = set_np_array(self._array)
+        return self
+
+    def __exit__(self, *exc):
+        set_np_shape(self._prev_shape)
+        if self._array is not None:
+            set_np_array(self._prev_array)
+
+
+def np_shape(active=True):
+    """Context manager (reference util.py np_shape)."""
+    return _NpShapeScope(shape=active)
+
+
+def np_array(active=True):
+    return _NpShapeScope(shape=is_np_shape(), array=active)
+
+
+def use_np_shape(func):
+    """Decorator (reference util.py use_np_shape)."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func(*args, **kwargs)
+
+    return wrapper
+
+
+def use_np(func):
+    """Decorator enabling both np shape + array semantics
+    (reference util.py use_np)."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with _NpShapeScope(shape=True, array=True):
+            return func(*args, **kwargs)
+
+    return wrapper
+
+
+def set_np(shape=True, array=True):
+    set_np_shape(shape)
+    set_np_array(array)
+
+
+def reset_np():
+    set_np(False, False)
+
+
+def getenv(name):
+    """Reference util.py getenv -> MXGetEnv."""
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    os.environ[name] = value
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    """Array in the currently-active frontend semantics (reference
+    util.py default_array)."""
+    if is_np_array():
+        from . import numpy as np_mod
+        return np_mod.array(source_array, dtype=dtype)
+    from . import nd
+    return nd.array(source_array, dtype=dtype)

@@ -1,0 +1,109 @@
+"""Network visualization (reference python/mxnet/visualization.py, 427 LoC):
+`print_summary` layer/param table and `plot_network` graphviz rendering."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Text summary of a symbol graph (reference visualization.py
+    print_summary): layer name/type, output shape, params, inputs."""
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    if shape is not None:
+        _, out_shapes, _ = symbol.infer_shape(**shape)
+        internals = symbol.get_internals()
+        _, int_shapes, _ = internals.infer_shape(**shape)
+        shape_by_out = dict(zip(internals.list_outputs(), int_shapes))
+    else:
+        shape_by_out = {}
+
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {h[0] for h in conf["heads"]}
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    lines = ["_" * line_length, _row(fields, positions), "=" * line_length]
+    total_params = 0
+
+    input_names = set(shape or ())  # user-bound tensors are inputs, not params
+
+    def param_count(node):
+        # parameters are the null inputs of this node (weights/biases)
+        count = 0
+        for ip in node["inputs"]:
+            inode = nodes[ip[0]]
+            if inode["op"] == "null" and not inode["name"].endswith("label") \
+                    and inode["name"] not in input_names \
+                    and inode["name"] != "data":
+                shp = shape_by_out.get(inode["name"])
+                if shp:
+                    n = 1
+                    for s in shp:
+                        n *= s
+                    count += n
+        return count
+
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        name = f"{node['name']} ({node['op']})"
+        out_shape = shape_by_out.get(f"{node['name']}_output", "")
+        prev = ", ".join(nodes[ip[0]]["name"] for ip in node["inputs"]
+                         if nodes[ip[0]]["op"] != "null")
+        n_params = param_count(node)
+        total_params += n_params
+        lines.append(_row([name, str(out_shape), str(n_params), prev],
+                          positions))
+        lines.append("_" * line_length)
+    lines.append(f"Total params: {total_params}")
+    lines.append("_" * line_length)
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def _row(fields, positions):
+    line = ""
+    for f, p in zip(fields, positions):
+        line = (line + str(f))[:p].ljust(p)
+    return line
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz Digraph of the symbol (reference visualization.py
+    plot_network). Requires the `graphviz` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError("plot_network requires the graphviz package") from e
+
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title, format=save_format)
+    node_attrs = {"shape": "box", "fixedsize": "false", **(node_attrs or {})}
+
+    def is_param(n):
+        return n["op"] == "null" and n["name"] != "data" and \
+            not n["name"].endswith("label")
+
+    for i, node in enumerate(nodes):
+        if hide_weights and is_param(node):
+            continue
+        label = node["name"] if node["op"] == "null" else \
+            f"{node['op']}\n{node['name']}"
+        dot.node(str(i), label=label, **node_attrs)
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        for ip in node["inputs"]:
+            src = nodes[ip[0]]
+            if hide_weights and is_param(src):
+                continue
+            dot.edge(str(ip[0]), str(i))
+    return dot

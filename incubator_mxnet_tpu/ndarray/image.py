@@ -1,0 +1,35 @@
+"""`mx.nd.image` namespace: device-side image ops.
+
+Reference: python/mxnet/ndarray/image.py — generated from the C registry's
+`_image_`-prefixed ops (src/operator/image/image_random.cc). Resolved
+lazily from the Python-native registry like the parent `nd` module."""
+from __future__ import annotations
+
+from ..ops import image_ops as _image_ops  # noqa: F401 — trigger registration
+from ..ops import registry as _registry
+
+__all__ = ["to_tensor", "normalize", "resize", "crop", "flip_left_right",
+           "flip_top_bottom", "random_flip_left_right",
+           "random_flip_top_bottom", "random_brightness", "random_contrast",
+           "random_saturation", "random_hue", "random_color_jitter",
+           "adjust_lighting", "random_lighting"]
+
+
+def __getattr__(name):
+    opdef = None
+    if f"_image_{name}" in _registry.OPS:
+        opdef = _registry.OPS.get(f"_image_{name}")
+    elif name in _registry.OPS:
+        opdef = _registry.OPS.get(name)
+    if opdef is not None:
+        # parent package is fully initialized by the time an attribute
+        # is first resolved, so share its wrapper factory
+        from . import _make_wrapper
+        w = _make_wrapper(opdef)
+        globals()[name] = w
+        return w
+    raise AttributeError(f"module 'nd.image' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + __all__))

@@ -136,6 +136,14 @@ def _sample_one(csr, seed, probability, num_hops, num_neighbor,
                          (max_num_vertices, shape[1])), prob_out)
 
 
+def _resolve_rng(rng, seed):
+    """Default keeps np.random.seed() reproducibility; pass seed= (or an
+    rng) for isolation from global RNG state."""
+    if rng is not None:
+        return rng
+    return _np.random.RandomState(seed) if seed is not None else _np.random
+
+
 def dgl_csr_neighbor_uniform_sample(csr_matrix, *seed_arrays, num_args=None,
                                     num_hops=1, num_neighbor=2,
                                     max_num_vertices=100, rng=None,
@@ -146,10 +154,7 @@ def dgl_csr_neighbor_uniform_sample(csr_matrix, *seed_arrays, num_args=None,
     (count in the last slot), the sampled sub-CSR with ORIGINAL edge ids,
     and a (max,) per-vertex layer array — flattened into one list ordered
     [vers..., csrs..., layers...]."""
-    # default keeps np.random.seed() reproducibility; pass seed= (or an
-    # rng) for isolation from global RNG state
-    rng = rng if rng is not None else (
-        _np.random.RandomState(seed) if seed is not None else _np.random)
+    rng = _resolve_rng(rng, seed)
     outs_v, outs_c, outs_l = [], [], []
     for seed_arr in seed_arrays:
         ver, layer, parts, _ = _sample_one(csr_matrix, seed_arr, None, num_hops,
@@ -169,10 +174,7 @@ def dgl_csr_neighbor_non_uniform_sample(csr_matrix, probability,
     """Weighted sampling variant (dgl_graph.cc:838): neighbors drawn
     without replacement proportionally to `probability[neighbor]`. Adds a
     per-subgraph (max,) vertex-probability output after the CSRs."""
-    # default keeps np.random.seed() reproducibility; pass seed= (or an
-    # rng) for isolation from global RNG state
-    rng = rng if rng is not None else (
-        _np.random.RandomState(seed) if seed is not None else _np.random)
+    rng = _resolve_rng(rng, seed)
     prob = _np.asarray(
         probability.asnumpy() if hasattr(probability, "asnumpy")
         else probability, _np.float32).reshape(-1)

@@ -367,7 +367,8 @@ def softmin(data, *, axis=-1, temperature=None, dtype=None):
 def softmax_activation(data, *, mode="instance"):
     if mode == "channel":
         return jax.nn.softmax(data, axis=1)
-    return jax.nn.softmax(jnp.reshape(data, (data.shape[0], -1)), axis=-1).reshape(data.shape)
+    from .tensor_ops import flatten
+    return jax.nn.softmax(flatten.fn(data), axis=-1).reshape(data.shape)
 
 
 @register(name="SoftmaxOutput", aliases=("softmax_output", "Softmax"))

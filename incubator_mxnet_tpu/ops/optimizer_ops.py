@@ -169,7 +169,10 @@ def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
 def adamw_update(weight, grad, mean, var, rescale_grad_arr=None, *, lr, beta1=0.9,
                  beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0, clip_gradient=-1.0,
                  rescale_grad=1.0):
-    """Decoupled weight decay Adam (reference src/operator/contrib/adamw.cc)."""
+    """Decoupled weight decay Adam (reference src/operator/contrib/adamw.cc).
+    The tensor rescale_grad input is the dynamic-loss-scaling hook: when
+    it is non-finite (overflowed scale) the reference SKIPS the update,
+    leaving weight and state untouched — same contract here."""
     rs = rescale_grad_arr if rescale_grad_arr is not None else rescale_grad
     g = grad * rs
     if clip_gradient >= 0:
@@ -177,7 +180,9 @@ def adamw_update(weight, grad, mean, var, rescale_grad_arr=None, *, lr, beta1=0.
     m = beta1 * mean + (1 - beta1) * g
     v = beta2 * var + (1 - beta2) * jnp.square(g)
     w = weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight)
-    return (w, m, v)
+    ok = jnp.all(jnp.isfinite(jnp.asarray(rs, jnp.float32)))
+    return (jnp.where(ok, w, weight), jnp.where(ok, m, mean),
+            jnp.where(ok, v, var))
 
 
 @register(name="multi_sgd_update", nondiff=True)
@@ -214,3 +219,112 @@ def all_finite(*arrays, init_output=True):
     for a in arrays:
         ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a.astype(jnp.float32))))
     return ok.astype(jnp.float32)
+
+
+@register(name="multi_mp_sgd_update", nondiff=True)
+def multi_mp_sgd_update(*args, lrs, wds, rescale_grad=1.0, clip_gradient=-1.0,
+                        num_weights=1):
+    """Fused multi-weight multi-precision SGD (reference optimizer_op.cc
+    multi_mp_sgd_update): args = [w0, g0, w32_0, w1, g1, w32_1, ...]."""
+    outs = []
+    for i in range(num_weights):
+        w, g, w32 = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        outs.extend(mp_sgd_update.fn(w, g, w32, lr=lrs[i], wd=wds[i],
+                                     rescale_grad=rescale_grad,
+                                     clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register(name="multi_mp_sgd_mom_update", nondiff=True)
+def multi_mp_sgd_mom_update(*args, lrs, wds, momentum=0.0, rescale_grad=1.0,
+                            clip_gradient=-1.0, num_weights=1):
+    """args = [w0, g0, m0, w32_0, ...] (reference optimizer_op.cc)."""
+    outs = []
+    for i in range(num_weights):
+        w, g, m, w32 = (args[4 * i], args[4 * i + 1], args[4 * i + 2],
+                        args[4 * i + 3])
+        outs.extend(mp_sgd_mom_update.fn(w, g, m, w32, lr=lrs[i],
+                                         momentum=momentum, wd=wds[i],
+                                         rescale_grad=rescale_grad,
+                                         clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register(name="mp_nag_mom_update", nondiff=True)
+def mp_nag_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Multi-precision Nesterov momentum (reference optimizer_op.cc
+    mp_nag_mom_update)."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight32
+    mom_new = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * mom_new)
+    return (w32.astype(weight.dtype), mom_new, w32)
+
+
+@register(name="multi_all_finite", nondiff=True)
+def multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    """Fused finiteness scan over many arrays (reference
+    src/operator/contrib/all_finite.cc multi_all_finite)."""
+    return all_finite.fn(*arrays, init_output=init_output)
+
+
+@register(name="mp_adamw_update", nondiff=True)
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_arr=None,
+                    *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                    eta=1.0, clip_gradient=-1.0, rescale_grad=1.0):
+    """Multi-precision AdamW (reference src/operator/contrib/adamw.cc
+    _mp_adamw_update): fp32 master weights, bf16/fp16 working copy.
+    Like adamw_update, a non-finite rescale tensor (loss-scale overflow)
+    skips the update instead of poisoning the state with NaN."""
+    rs = rescale_grad_arr if rescale_grad_arr is not None else rescale_grad
+    g = grad.astype(jnp.float32) * rs
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight32)
+    ok = jnp.all(jnp.isfinite(jnp.asarray(rs, jnp.float32)))
+    w32 = jnp.where(ok, w32, weight32)
+    return (w32.astype(weight.dtype), jnp.where(ok, m, mean),
+            jnp.where(ok, v, var), w32)
+
+
+@register(name="group_adagrad_update",
+          aliases=("_contrib_group_adagrad_update",), nondiff=True)
+def group_adagrad_update(weight, grad, history, *, lr, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Group AdaGrad: ONE accumulator per row (reference
+    src/operator/contrib/optimizer_op-inl.h:46 GroupAdagradParam +
+    GroupAdagradDnsRspKernel): h[r] += mean(g[r]^2); w[r] -= lr*g[r] /
+    sqrt(h[r]+eps). The reference optimizer allocates its state as
+    (rows, 1); accept that shape and hand it back unchanged."""
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    red = tuple(range(1, g.ndim))
+    h_flat = history.reshape(-1)
+    h_flat = h_flat + (jnp.mean(jnp.square(g), axis=red) if g.ndim > 1
+                       else jnp.square(g))
+    scale = lr / jnp.sqrt(h_flat + epsilon)
+    return (weight - g * scale.reshape((-1,) + (1,) * (g.ndim - 1)),
+            h_flat.reshape(history.shape))
+
+
+@register(name="_sparse_adagrad_update", aliases=("adagrad_update",),
+          nondiff=True)
+def sparse_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7,
+                          rescale_grad=1.0, clip_gradient=-1.0, wd=0.0):
+    """AdaGrad (reference src/operator/optimizer_op-inl.h:2144
+    AdagradDnsRspDnsKernel): h += g^2; w -= lr * g / sqrt(h + eps).
+    The reference only registers the row_sparse-gradient form; the dense
+    form here touches every row, which is identical when the gradient
+    covers all rows (and the Optimizer layer handles lazy sparse skips)."""
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    h = history + jnp.square(g)
+    return (weight - lr * g / jnp.sqrt(h + epsilon), h)

@@ -11,6 +11,7 @@ argsort/topk default to float32 indices).
 from __future__ import annotations
 
 import functools
+import math as _math
 
 import numpy as _np
 
@@ -424,8 +425,10 @@ def squeeze(data, *, axis=None):
 
 @register(name="flatten", aliases=("Flatten",))
 def flatten(data):
-    """Reference src/operator/tensor/matrix_op.cc Flatten: (d0, rest...)->(d0, prod)."""
-    return jnp.reshape(data, (data.shape[0], -1))
+    """Reference src/operator/tensor/matrix_op.cc Flatten: (d0, rest...)->(d0, prod).
+    Explicit tail product: -1 inference divides by d0, which breaks on
+    0-size batches."""
+    return jnp.reshape(data, (data.shape[0], _math.prod(data.shape[1:])))
 
 
 @register(name="broadcast_to")

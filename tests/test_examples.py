@@ -85,3 +85,11 @@ def test_gluon_word_lm_improves():
 def test_gluon_ssd_inference_decodes():
     out = _run("example/gluon/ssd_inference.py")
     assert "2 planted objects recovered" in out
+
+
+def test_ssd_training_learns():
+    """example/ssd/train.py: multibox_prior/target + joint loss must
+    train (reference example/ssd/train.py)."""
+    out = _run("example/ssd/train.py", "--epochs", "2",
+               "--steps-per-epoch", "6")
+    assert "SSD_TRAIN_OK" in out

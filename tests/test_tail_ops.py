@@ -1,0 +1,377 @@
+"""Long-tail operator family: numeric oracles + gradients.
+
+Reference test model: tests/python/unittest/test_operator.py (numpy
+forward oracles + check_numeric_gradient).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+
+nd = mx.nd
+
+
+def test_add_n_forward_and_grad():
+    xs = [nd.array(np.random.rand(3, 4).astype(np.float32)) for _ in range(4)]
+    out = nd.add_n(*xs)
+    assert np.allclose(out.asnumpy(), sum(x.asnumpy() for x in xs))
+    for x in xs:
+        x.attach_grad()
+    with autograd.record():
+        y = nd.add_n(*xs)
+    y.backward()
+    for x in xs:
+        assert np.allclose(x.grad.asnumpy(), 1.0)
+    # alias parity
+    assert np.allclose(nd.ElementWiseSum(*xs).asnumpy(), out.asnumpy())
+
+
+def test_reshape_like_windows():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    y = nd.zeros((6, 4))
+    assert nd.reshape_like(x, y).shape == (6, 4)
+    # windowed form (reference elemwise_unary_op_basic.cc docstring case)
+    a = nd.zeros((30,))
+    b = nd.zeros((2, 3, 5))
+    out = nd.reshape_like(a, b, lhs_begin=0, lhs_end=1, rhs_begin=0,
+                          rhs_end=3)
+    assert out.shape == (2, 3, 5)
+    with pytest.raises(mx.base.MXNetError):
+        nd.reshape_like(x, nd.zeros((5, 5)))
+
+
+def test_slice_assign():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    rhs = np.full((2, 2), -1.0, np.float32)
+    out = nd._slice_assign(nd.array(x), nd.array(rhs),
+                           begin=(0, 1), end=(2, 3)).asnumpy()
+    expect = x.copy()
+    expect[0:2, 1:3] = rhs
+    assert np.array_equal(out, expect)
+    out2 = nd._slice_assign_scalar(nd.array(x), scalar=7.0,
+                                   begin=(1,), end=(3,)).asnumpy()
+    expect2 = x.copy()
+    expect2[1:3] = 7.0
+    assert np.array_equal(out2, expect2)
+    # gradient of lhs: 1 outside the window, 0 inside; rhs grad: 1
+    lhs = nd.array(x)
+    r = nd.array(rhs)
+    lhs.attach_grad()
+    r.attach_grad()
+    with autograd.record():
+        y = nd._slice_assign(lhs, r, begin=(0, 1), end=(2, 3))
+    y.backward()
+    g = np.ones_like(x)
+    g[0:2, 1:3] = 0.0
+    assert np.array_equal(lhs.grad.asnumpy(), g)
+    assert np.array_equal(r.grad.asnumpy(), np.ones_like(rhs))
+
+
+def test_sparse_retain_dense_op():
+    x = np.random.rand(5, 3).astype(np.float32)
+    out = nd._sparse_retain(nd.array(x),
+                            nd.array(np.array([0, 3], np.int64))).asnumpy()
+    expect = np.zeros_like(x)
+    expect[[0, 3]] = x[[0, 3]]
+    assert np.array_equal(out, expect)
+
+
+def test_square_sum_and_hard_sigmoid():
+    x = np.random.randn(4, 5).astype(np.float32)
+    assert np.allclose(nd._square_sum(nd.array(x), axis=1).asnumpy(),
+                       (x ** 2).sum(1), rtol=1e-5)
+    hs = nd.hard_sigmoid(nd.array(x), alpha=0.25, beta=0.4).asnumpy()
+    assert np.allclose(hs, np.clip(0.25 * x + 0.4, 0, 1), rtol=1e-5)
+
+
+def test_linspace_zeros_arange_like():
+    assert np.allclose(nd._linspace(start=2, stop=4, num=5).asnumpy(),
+                       np.linspace(2, 4, 5))
+    z = nd._zeros_without_dtype(shape=(2, 3))
+    assert z.dtype == np.float32 and z.shape == (2, 3)
+    x = nd.zeros((3, 4))
+    al = nd.arange_like(x).asnumpy()
+    assert np.array_equal(al, np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert np.array_equal(nd.arange_like(x, axis=-1).asnumpy(),
+                          np.arange(4, dtype=np.float32))
+    rep = nd.arange_like(nd.zeros((6,)), repeat=2).asnumpy()
+    assert np.array_equal(rep, np.array([0, 0, 1, 1, 2, 2], np.float32))
+
+
+class TestLinalgTail:
+    def setup_method(self, _):
+        np.random.seed(0)
+        a = np.random.randn(4, 4).astype(np.float32)
+        self.spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        self.chol = np.linalg.cholesky(self.spd)
+
+    def test_syevd(self):
+        U, L = nd.linalg_syevd(nd.array(self.spd))
+        U, L = U.asnumpy(), L.asnumpy()
+        assert np.allclose(U.T @ np.diag(L) @ U, self.spd, atol=1e-4)
+        assert np.allclose(U @ U.T, np.eye(4), atol=1e-5)
+
+    def test_potri(self):
+        out = nd.linalg_potri(nd.array(self.chol)).asnumpy()
+        assert np.allclose(out, np.linalg.inv(self.spd), atol=1e-5)
+
+    def test_slogdet(self):
+        sign, logdet = nd.linalg_slogdet(nd.array(self.spd))
+        s, l = np.linalg.slogdet(self.spd)
+        assert sign.asnumpy() == s and np.allclose(logdet.asnumpy(), l,
+                                                   rtol=1e-5)
+
+    def test_gelqf(self):
+        a = np.random.randn(3, 5).astype(np.float32)
+        L, Q = nd.linalg_gelqf(nd.array(a))
+        L, Q = L.asnumpy(), Q.asnumpy()
+        assert np.allclose(L @ Q, a, atol=1e-5)
+        assert np.allclose(Q @ Q.T, np.eye(3), atol=1e-5)
+        assert np.all(np.diag(L) >= 0)
+        assert np.allclose(np.triu(L, 1), 0, atol=1e-6)
+
+    def test_trmm(self):
+        b = np.random.randn(4, 4).astype(np.float32)
+        out = nd.linalg_trmm(nd.array(self.chol), nd.array(b),
+                             alpha=2.0).asnumpy()
+        assert np.allclose(out, 2.0 * np.tril(self.chol) @ b, atol=1e-4)
+        outr = nd.linalg_trmm(nd.array(self.chol), nd.array(b),
+                              rightside=True, transpose=True).asnumpy()
+        assert np.allclose(outr, b @ np.tril(self.chol).T, atol=1e-4)
+
+    def test_diag_trian_roundtrip(self):
+        for offset in (-1, 0, 2):
+            d = nd.linalg_extractdiag(nd.array(self.spd),
+                                      offset=offset).asnumpy()
+            assert np.allclose(d, np.diagonal(self.spd, offset))
+        v = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+        m = nd.linalg_makediag(v, offset=1).asnumpy()
+        assert m.shape == (4, 4) and m[0, 1] == 1.0 and m[2, 3] == 3.0
+        for lower in (True, False):
+            tr = nd.linalg_extracttrian(nd.array(self.spd), lower=lower)
+            back = nd.linalg_maketrian(tr, lower=lower).asnumpy()
+            expect = np.tril(self.spd) if lower else np.triu(self.spd)
+            assert np.allclose(back, expect)
+
+
+def test_bipartite_matching_against_oracle():
+    def oracle(s, threshold, is_ascend=False, topk=-1):
+        R, C = s.shape
+        rm = -np.ones(R, np.float32)
+        cm = -np.ones(C, np.float32)
+        order = np.argsort(-s.flatten() if not is_ascend else s.flatten(),
+                           kind="stable")
+        cnt = 0
+        for idx in order:
+            r, c = idx // C, idx % C
+            if rm[r] == -1 and cm[c] == -1:
+                good = (s[r, c] > threshold) if not is_ascend else \
+                    (s[r, c] < threshold)
+                if not good:
+                    break
+                rm[r] = c
+                cm[c] = r
+                cnt += 1
+                if 0 < topk < cnt + 1:
+                    break
+        return rm, cm
+
+    np.random.seed(3)
+    for shape in [(4, 6), (6, 4), (1, 5)]:
+        s = np.random.rand(*shape).astype(np.float32)
+        for kw in [dict(threshold=0.2), dict(threshold=0.7, is_ascend=True),
+                   dict(threshold=0.1, topk=2)]:
+            r, c = nd.bipartite_matching(nd.array(s), **kw)
+            orm, ocm = oracle(s, kw["threshold"], kw.get("is_ascend", False),
+                              kw.get("topk", -1))
+            assert np.array_equal(r.asnumpy(), orm), (shape, kw)
+            assert np.array_equal(c.asnumpy(), ocm), (shape, kw)
+    # batched
+    sb = np.random.rand(2, 3, 4).astype(np.float32)
+    rb, cb = nd.bipartite_matching(nd.array(sb), threshold=0.3)
+    assert rb.shape == (2, 3) and cb.shape == (2, 4)
+    for i in range(2):
+        orm, ocm = oracle(sb[i], 0.3)
+        assert np.array_equal(rb.asnumpy()[i], orm)
+
+
+def test_sync_batch_norm_single_and_mesh():
+    x = np.random.randn(4, 3, 5, 5).astype(np.float32)
+    g = np.random.rand(3).astype(np.float32) + 0.5
+    b = np.random.randn(3).astype(np.float32)
+    args = [nd.array(g), nd.array(b), nd.zeros((3,)), nd.ones((3,))]
+    out_s = nd.SyncBatchNorm(nd.array(x), *args, key="bn", fix_gamma=False,
+                             training=True)
+    out_b = nd.BatchNorm(nd.array(x), *args, fix_gamma=False, training=True)
+    assert np.allclose(out_s[0].asnumpy(), out_b[0].asnumpy(), atol=1e-5)
+
+    # cross-device sync: stats over the GLOBAL batch on a 2-way dp mesh
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from incubator_mxnet_tpu.ops.tail_ops import sync_batch_norm
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("dp",))
+    from jax.experimental.shard_map import shard_map
+
+    def f(xs):
+        out, mean, var = sync_batch_norm.fn(
+            xs, jnp.asarray(g), jnp.asarray(b), jnp.zeros(3), jnp.ones(3),
+            fix_gamma=False, training=True, axis_name="dp")
+        return out
+
+    fm = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    out_mesh = np.asarray(fm(jnp.asarray(x)))
+    # oracle: plain batch norm over the full batch
+    assert np.allclose(out_mesh, out_b[0].asnumpy(), atol=1e-4)
+
+
+def test_image_namespace_ops():
+    img = (np.random.rand(6, 8, 3) * 255).astype(np.uint8)
+    t = nd.image.to_tensor(nd.array(img)).asnumpy()
+    assert t.shape == (3, 6, 8)
+    assert np.allclose(t, img.transpose(2, 0, 1) / 255.0, atol=1e-6)
+    batch = (np.random.rand(2, 6, 8, 3) * 255).astype(np.uint8)
+    tb = nd.image.to_tensor(nd.array(batch))
+    assert tb.shape == (2, 3, 6, 8)
+
+    nrm = nd.image.normalize(nd.array(t), mean=(0.485, 0.456, 0.406),
+                             std=(0.229, 0.224, 0.225)).asnumpy()
+    expect = (t - np.array([0.485, 0.456, 0.406]).reshape(3, 1, 1)) / \
+        np.array([0.229, 0.224, 0.225]).reshape(3, 1, 1)
+    assert np.allclose(nrm, expect, atol=1e-5)
+
+    cr = nd.image.crop(nd.array(img), x=2, y=1, width=5, height=4)
+    assert np.array_equal(cr.asnumpy(), img[1:5, 2:7])
+
+    rs = nd.image.resize(nd.array(img), size=(4, 3))
+    assert rs.shape == (3, 4, 3) and rs.dtype == np.uint8
+    rs2 = nd.image.resize(nd.array(img), size=12, keep_ratio=True)
+    assert rs2.shape == (12, 16, 3)
+
+    assert np.array_equal(nd.image.flip_left_right(nd.array(img)).asnumpy(),
+                          img[:, ::-1])
+    assert np.array_equal(nd.image.flip_top_bottom(nd.array(img)).asnumpy(),
+                          img[::-1])
+
+
+def test_image_augmenters_statistical():
+    img = (np.random.rand(8, 8, 3) * 255).astype(np.float32)
+    br = nd.image.random_brightness(nd.array(img), min_factor=0.5,
+                                    max_factor=0.5).asnumpy()
+    assert np.allclose(br, img * 0.5, atol=1e-3)
+    ct = nd.image.random_contrast(nd.array(img), min_factor=1.0,
+                                  max_factor=1.0).asnumpy()
+    assert np.allclose(ct, img, atol=1e-3)
+    st = nd.image.random_saturation(nd.array(img), min_factor=0.0,
+                                    max_factor=0.0).asnumpy()
+    gray = img[..., 0] * 0.299 + img[..., 1] * 0.587 + img[..., 2] * 0.114
+    assert np.allclose(st, np.broadcast_to(gray[..., None], img.shape),
+                       atol=1e-2)
+    # hue rotation by a full turn is identity
+    hu = nd.image.random_hue(nd.array(img), min_factor=1.0,
+                             max_factor=1.0).asnumpy()
+    assert np.allclose(hu, img, atol=1.0)
+    lt = nd.image.adjust_lighting(nd.array(img), alpha=(0.0, 0.0, 0.0))
+    assert np.allclose(lt.asnumpy(), img, atol=1e-5)
+    jt = nd.image.random_color_jitter(nd.array(img), brightness=0.4,
+                                      contrast=0.4, saturation=0.4, hue=0.1)
+    assert jt.shape == img.shape
+
+
+def test_optimizer_tail_updates():
+    w32 = np.random.rand(6).astype(np.float32)
+    g = np.random.randn(6).astype(np.float32)
+    w16 = w32.astype(np.dtype("float16"))
+    out = nd.multi_mp_sgd_update(
+        nd.array(w16), nd.array(g), nd.array(w32),
+        lrs=(0.1,), wds=(0.01,), num_weights=1)
+    expect32 = w32 - 0.1 * (g + 0.01 * w32)
+    assert np.allclose(out[1].asnumpy(), expect32, rtol=1e-5)
+    assert out[0].dtype == np.float16
+
+    outm = nd.multi_mp_sgd_mom_update(
+        nd.array(w16), nd.array(g), nd.zeros((6,)), nd.array(w32),
+        lrs=(0.1,), wds=(0.0,), momentum=0.9, num_weights=1)
+    assert np.allclose(outm[2].asnumpy(), w32 - 0.1 * g, rtol=1e-5)
+
+    outn = nd.mp_nag_mom_update(nd.array(w16), nd.array(g), nd.zeros((6,)),
+                                nd.array(w32), lr=0.1, momentum=0.9)
+    assert np.allclose(outn[2].asnumpy(), w32 - 0.1 * (g + 0.9 * g),
+                       rtol=1e-5)
+
+    fin = nd.multi_all_finite(nd.ones((3,)), nd.ones((3,)), num_arrays=2)
+    assert fin.asnumpy() == 1.0
+    fin2 = nd.multi_all_finite(nd.ones((3,)),
+                               nd.array(np.array([np.nan], np.float32)),
+                               num_arrays=2)
+    assert fin2.asnumpy() == 0.0
+
+    # group adagrad: one accumulator per row
+    w = np.ones((3, 4), np.float32)
+    gr = np.random.randn(3, 4).astype(np.float32)
+    h = np.zeros(3, np.float32)
+    wn, hn = nd.group_adagrad_update(nd.array(w), nd.array(gr), nd.array(h),
+                                     lr=0.5)
+    h_exp = (gr ** 2).mean(1)
+    assert np.allclose(hn.asnumpy(), h_exp, rtol=1e-5)
+    assert np.allclose(wn.asnumpy(),
+                       w - 0.5 * gr / np.sqrt(h_exp + 1e-5)[:, None],
+                       rtol=1e-4)
+
+    # adagrad (sparse op's dense form)
+    wa, ha = nd._sparse_adagrad_update(nd.array(w), nd.array(gr),
+                                       nd.zeros((3, 4)), lr=0.5)
+    assert np.allclose(ha.asnumpy(), gr ** 2, rtol=1e-5)
+    assert np.allclose(wa.asnumpy(), w - 0.5 * gr / np.sqrt(gr ** 2 + 1e-7),
+                       rtol=1e-4)
+
+    # mp_adamw with on-device rescale tensor
+    wadam = nd.mp_adamw_update(
+        nd.array(w16), nd.array(g), nd.zeros((6,)), nd.zeros((6,)),
+        nd.array(w32), nd.array(np.array(1.0, np.float32)), lr=0.01)
+    assert wadam[3].shape == (6,)
+
+
+def test_scalar_and_logical_aliases():
+    x = np.array([1.0, 0.0, -2.0], np.float32)
+    assert np.allclose(nd._minus_scalar(nd.array(x), scalar=1).asnumpy(),
+                       x - 1)
+    assert np.allclose(nd._rminus_scalar(nd.array(x), scalar=1).asnumpy(),
+                       1 - x)
+    assert np.allclose(nd._hypot_scalar(nd.array(x), scalar=3).asnumpy(),
+                       np.hypot(x, 3), rtol=1e-6)
+    y = np.array([1.0, 1.0, 0.0], np.float32)
+    assert np.array_equal(nd._logical_and(nd.array(x), nd.array(y)).asnumpy(),
+                          np.logical_and(x, y).astype(np.float32))
+    assert np.array_equal(nd._logical_xor(nd.array(x), nd.array(y)).asnumpy(),
+                          np.logical_xor(x != 0, y != 0).astype(np.float32))
+    assert np.array_equal(
+        nd._logical_or_scalar(nd.array(x), scalar=0).asnumpy(),
+        (x != 0).astype(np.float32))
+    assert np.allclose(nd._scatter_plus_scalar(nd.array(x), scalar=2).asnumpy(),
+                       x + 2)
+    assert np.allclose(nd._scatter_elemwise_div(nd.array(x),
+                                                nd.array(y + 1)).asnumpy(),
+                       x / (y + 1))
+
+
+def test_identity_with_attr_and_rnn_param_concat():
+    x = nd.array(np.random.rand(3, 2).astype(np.float32))
+    out = nd._identity_with_attr_like_rhs(x, nd.zeros((3, 2)))
+    assert np.array_equal(out.asnumpy(), x.asnumpy())
+    a = nd.ones((2, 3))
+    b = nd.zeros((4, 3))
+    cat = nd._rnn_param_concat(a, b, dim=0)
+    assert cat.shape == (6, 3)
+
+
+def test_sparse_embedding_matches_embedding():
+    idx = nd.array(np.array([0, 2, 1], np.int64))
+    w = nd.array(np.random.rand(4, 5).astype(np.float32))
+    a = nd.SparseEmbedding(idx, w, input_dim=4, output_dim=5).asnumpy()
+    b = nd.Embedding(idx, w, input_dim=4, output_dim=5).asnumpy()
+    assert np.array_equal(a, b)
