@@ -91,6 +91,21 @@ def _stem_s2d_conv(data, weight, k):
         else None)
 
 
+def _conv_xla(data, weight, kernel, stride, dilate, pad, num_group):
+    nd_ = len(kernel)
+    dn = _conv_dnums(nd_)
+    return lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        lhs_dilation=(1,) * nd_,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.float32
+        else None)
+
+
 @register(name="Convolution", aliases=("convolution", "Convolution_v1"))
 def convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(), pad=(),
                 num_filter=0, num_group=1, workspace=1024, no_bias=False,
@@ -107,17 +122,14 @@ def convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(), pad=()
             and jax.default_backend() == "tpu"):
         out = _stem_s2d_conv(data, weight, kernel[0])
     else:
-        dn = _conv_dnums(nd_)
-        out = lax.conv_general_dilated(
-            data, weight,
-            window_strides=stride,
-            padding=[(p, p) for p in pad],
-            lhs_dilation=(1,) * nd_,
-            rhs_dilation=dilate,
-            dimension_numbers=dn,
-            feature_group_count=num_group,
-            preferred_element_type=jnp.float32 if data.dtype == jnp.float32
-            else None)
+        from ..parallel.conv_backward import conv3x3_custom, fused_eligible
+        if fused_eligible(data.shape, weight.shape, kernel, stride, dilate,
+                          pad, num_group):
+            # opt-in fused Pallas backward (interpret mode off-TPU)
+            out = conv3x3_custom(data, weight)
+        else:
+            out = _conv_xla(data, weight, kernel, stride, dilate, pad,
+                            num_group)
     if bias is not None and not no_bias:
         out = out + jnp.reshape(bias, (1, -1) + (1,) * nd_)
     return out.astype(data.dtype)

@@ -1,0 +1,106 @@
+"""Fused conv-backward Pallas kernel: exactness vs the XLA conv vjp.
+
+The kernel is a measured-negative on v5e (slower than XLA's native conv
+backward at every ResNet shape — docs/perf_notes.md round 4) and ships
+opt-in; these tests keep both formulations correct so the work is
+reusable where XLA's emitter does badly. Runs in interpret mode off-TPU.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu.parallel import conv_backward as cb
+
+
+def _oracle(x, w, go):
+    out, vjp = jax.vjp(cb._conv3x3_fwd_impl, x, w)
+    return vjp(go)
+
+
+@pytest.mark.parametrize("mode", ["patch", "taps"])
+@pytest.mark.parametrize("shape", [(4, 8, 16, 8), (2, 24, 8, 16),
+                                   (3, 16, 7, 16)])
+def test_fused_bwd_matches_xla_vjp(monkeypatch, mode, shape):
+    n, ci, h, co = shape
+    monkeypatch.setenv("MXTPU_CONV_BWD_KERNEL", mode)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, ci, h, h).astype(np.float32))
+    w = jnp.asarray(rng.randn(co, ci, 3, 3).astype(np.float32) * 0.1)
+    go = jnp.asarray(rng.randn(n, co, h, h).astype(np.float32))
+    dxr, dwr = _oracle(x, w, go)
+    dx, dw = cb.conv3x3_bwd_fused(x, w, go, bn=1)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dxr),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dwr),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_custom_vjp_grad_path(monkeypatch):
+    """conv3x3_custom must give the same grads as the plain conv under
+    jax.grad (the integration path used by ops/nn_ops.py when
+    MXTPU_FUSED_CONV_BWD=1)."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 8, 10, 10).astype(np.float32))
+    w = jnp.asarray(rng.randn(8, 8, 3, 3).astype(np.float32) * 0.1)
+
+    def loss_custom(x_, w_):
+        return jnp.sum(cb.conv3x3_custom(x_, w_) ** 2)
+
+    def loss_plain(x_, w_):
+        return jnp.sum(cb._conv3x3_fwd_impl(x_, w_) ** 2)
+
+    gx1, gw1 = jax.grad(loss_custom, argnums=(0, 1))(x, w)
+    gx2, gw2 = jax.grad(loss_plain, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_eligibility_gate(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_CONV_BWD", "1")
+    ok = cb.fused_eligible((8, 64, 56, 56), (64, 64, 3, 3), (3, 3),
+                           (1, 1), (1, 1), (1, 1), 1)
+    assert ok
+    assert not cb.fused_eligible((8, 64, 56, 56), (64, 64, 3, 3), (3, 3),
+                                 (2, 2), (1, 1), (1, 1), 1)
+    assert not cb.fused_eligible((8, 64, 56, 56), (64, 64, 1, 1), (1, 1),
+                                 (1, 1), (1, 1), (0, 0), 1)
+    monkeypatch.setenv("MXTPU_FUSED_CONV_BWD", "0")
+    assert not cb.fused_eligible((8, 64, 56, 56), (64, 64, 3, 3), (3, 3),
+                                 (1, 1), (1, 1), (1, 1), 1)
+
+
+def test_gluon_conv_trains_with_fused_backward(monkeypatch):
+    """End-to-end: a Conv2D net trains identically with the gate on
+    (off-TPU the kernel runs in interpret mode through the same path)."""
+    monkeypatch.setenv("MXTPU_FUSED_CONV_BWD", "1")
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+
+    nd = mx.nd
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(2, 4, 8, 8).astype(np.float32))
+    w = nd.array(rng.randn(4, 4, 3, 3).astype(np.float32) * 0.1)
+    w.attach_grad()
+    with autograd.record():
+        y = nd.Convolution(x, w, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                           no_bias=True)
+        loss = (y * y).sum()
+    loss.backward()
+    g_gate = w.grad.asnumpy()
+
+    monkeypatch.setenv("MXTPU_FUSED_CONV_BWD", "0")
+    w2 = nd.array(w.asnumpy())
+    w2.attach_grad()
+    with autograd.record():
+        y = nd.Convolution(x, w2, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                           no_bias=True)
+        loss = (y * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(g_gate, w2.grad.asnumpy(), rtol=1e-4,
+                               atol=1e-3)
