@@ -60,10 +60,14 @@ class Trainer:
         """Reference trainer.py:169. A kvstore is created for 'dist*'/'tpu'
         types; plain single-process training needs none (XLA reduces sharded
         grads inside the compiled step)."""
-        if self._kvstore_type and str(self._kvstore_type) not in ("None", "local",
-                                                                 "device"):
-            from .. import kvstore as kvs
+        from .. import kvstore as kvs
+        if isinstance(self._kvstore_type, kvs.KVStore):
+            # reference trainer.py accepts a live KVStore instance too
+            self._kvstore = self._kvstore_type
+        elif self._kvstore_type and str(self._kvstore_type) not in (
+                "None", "local", "device"):
             self._kvstore = kvs.create(self._kvstore_type)
+        if self._kvstore is not None:
             if self._compression_params:
                 self._kvstore.set_gradient_compression(self._compression_params)
             for i, p in enumerate(self._params):

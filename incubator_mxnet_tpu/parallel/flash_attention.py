@@ -183,13 +183,21 @@ def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     )(q, kt, v)
 
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, acc_sc, *, block_q, block_k, causal,
-                      sm_scale):
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, out_ref,
+                      dlse_ref, dq_ref, delta_ref, acc_sc, delta_sc, *,
+                      block_q, block_k, causal, sm_scale):
     """dq for one q block, streaming k/v blocks (innermost grid dim):
+      delta = rowsum(dO * O) - dlse   (computed HERE at j==0 — fused, so
+                                 no separate XLA pass re-reads dO and O;
+                                 dlse is the cotangent of the emitted
+                                 lse — d lse/d s = p, so it enters ds
+                                 with the OPPOSITE sign of delta. Zero
+                                 for plain attention; nonzero when the
+                                 ring-attention merge consumes lse.)
       p  = exp(s*scale - lse);  dp = dO V^T
       ds = p * (dp - delta);    dq = scale * sum_k ds K
-    Matmuls keep input-dtype operands with f32 accumulation."""
+    Matmuls keep input-dtype operands with f32 accumulation. delta is
+    also emitted as an output for the dk/dv kernel to consume."""
     from jax import lax
     from jax.experimental import pallas as pl
 
@@ -200,6 +208,11 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(j == 0)
     def _init():
         acc_sc[:] = jnp.zeros_like(acc_sc)
+        d = jnp.sum(do_ref[0].astype(jnp.float32)
+                    * out_ref[0].astype(jnp.float32), axis=-1,
+                    keepdims=True) - dlse_ref[0]
+        delta_sc[:] = jnp.broadcast_to(d, delta_sc.shape)
+        delta_ref[0] = d
 
     run = (j * block_k <= q_off + block_q - 1) if causal else (j < n_k)
 
@@ -222,7 +235,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              precision=lax.Precision.DEFAULT,
                             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
+        ds = p * (dp - delta_sc[:, :1])
         acc_sc[:] += lax.dot_general(ds.astype(k.dtype), k,
                                      (((1,), (0,)), ((), ())),
                                      precision=lax.Precision.DEFAULT,
@@ -286,12 +299,14 @@ def _fa_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
-def _fa_backward(q, k, v, do, lse, delta, causal, sm_scale, block_q,
+def _fa_backward(q, k, v, do, lse, out, dlse, causal, sm_scale, block_q,
                  block_k, interpret):
-    """q,k,v,do: (BH, T, D); lse/delta: (BH, Tq, 1) f32 (delta_i =
-    rowsum(dO_i * O_i); the trailing singleton satisfies the TPU block
-    rules). Returns (dq, dk, dv) via the two flash backward kernels —
-    O(block * T) memory, scores recomputed from the saved lse."""
+    """q,k,v,do,out: (BH, T, D); lse: (BH, Tq, 1) f32. Returns
+    (dq, dk, dv) via the two flash backward kernels — O(block * T)
+    memory, scores recomputed from the saved lse. delta = rowsum(dO*O)
+    is computed INSIDE the dq kernel (per q block, at its first kv step)
+    and handed to the dk/dv kernel as a (BH, Tq, 1) output — one fewer
+    full pass over dO and O than a separate XLA delta computation."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -299,7 +314,7 @@ def _fa_backward(q, k, v, do, lse, delta, causal, sm_scale, block_q,
     tk = k.shape[1]
     params = _compiler_params()
 
-    dq = pl.pallas_call(
+    dq, delta = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, causal=causal,
                           sm_scale=sm_scale),
@@ -310,14 +325,20 @@ def _fa_backward(q, k, v, do, lse, delta, causal, sm_scale, block_q,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, 128), jnp.float32)],
         compiler_params=params,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, out, dlse)
 
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, block_q=block_q,
@@ -395,15 +416,15 @@ def _flash_vjp_bwd(causal, sm_scale, res, g):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     if lse is not None:
-        bq = _pick_block(Tq, 512)
-        bk = _pick_block(Tk, 512)
+        # v5e block sweep (docs/perf_notes.md round 4): (1024,1024) runs
+        # the backward pair at 34.3 TF/s vs 28.9 at the old (512,512)
+        bq = _pick_block(Tq, 1024)
+        bk = _pick_block(Tk, 1024)
         do_bh = _to_bh(g)
-        delta = jnp.sum(do_bh.astype(jnp.float32) *
-                        _to_bh(out).astype(jnp.float32), axis=-1,
-                        keepdims=True)
         dq, dk, dv = _fa_backward(_to_bh(q), _to_bh(k), _to_bh(v), do_bh,
-                                  lse, delta, causal, sm_scale, bq, bk,
-                                  _interpret())
+                                  lse, _to_bh(out),
+                                  jnp.zeros_like(lse), causal, sm_scale,
+                                  bq, bk, _interpret())
         return (_un_bh(dq, B, H, Tq, D), _un_bh(dk, B, H, Tk, D),
                 _un_bh(dv, B, H, Tk, D))
     bq = _pick_block(Tq, 256)
@@ -461,3 +482,50 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
         import math
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     return _flash(q, k, v, bool(causal), float(sm_scale))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_hop(q, k, v, causal, sm_scale):
+    """(out, lse) pair for ONE ring-attention hop, differentiable in
+    BOTH outputs: the backward folds the lse cotangent into the kernels'
+    delta term (d lse/d s = p). q,k,v: (B, t, H, D); lse out: (B, H, t)
+    f32 with -inf on fully-masked rows."""
+    return _flash_hop_fwd_impl(q, k, v, causal, sm_scale)
+
+
+def _flash_hop_fwd_impl(q, k, v, causal, sm_scale):
+    B, T, H, D = q.shape
+    bq = _pick_block(T, 512)
+    bk = _pick_block(k.shape[1], 1024)
+    out, lse = _fa_forward(_to_bh(q), _to_bh(k), _to_bh(v), causal,
+                           sm_scale, bq, bk, _interpret())
+    lse_bht = lse.reshape(B, H, T)
+    lse_bht = jnp.where(lse_bht >= 1e29, -jnp.inf, lse_bht)
+    return (_un_bh(out, B, H, T, D).astype(jnp.float32), lse_bht)
+
+
+def _flash_hop_vjp_fwd(q, k, v, causal, sm_scale):
+    out, lse = _flash_hop_fwd_impl(q, k, v, causal, sm_scale)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_hop_vjp_bwd(causal, sm_scale, res, cts):
+    g_out, g_lse = cts
+    q, k, v, out, lse = res
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    bq = _pick_block(Tq, 1024)
+    bk = _pick_block(Tk, 1024)
+    lse_kern = jnp.where(jnp.isfinite(lse), lse, 1e30).reshape(
+        B * H, Tq, 1).astype(jnp.float32)
+    dlse = g_lse.reshape(B * H, Tq, 1).astype(jnp.float32)
+    dq, dk, dv = _fa_backward(
+        _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(g_out.astype(q.dtype)),
+        lse_kern, _to_bh(out.astype(q.dtype)), dlse, causal, sm_scale,
+        bq, bk, _interpret())
+    return (_un_bh(dq, B, H, Tq, D).astype(q.dtype),
+            _un_bh(dk, B, H, Tk, D).astype(k.dtype),
+            _un_bh(dv, B, H, Tk, D).astype(v.dtype))
+
+
+flash_hop.defvjp(_flash_hop_vjp_fwd, _flash_hop_vjp_bwd)

@@ -5,16 +5,21 @@ longest-sequence story is BucketingModule + fused RNN). This module is the
 TPU-native capability that replaces it at pod scale: the sequence axis lives
 on a mesh axis ("sp"); K/V blocks rotate around the ring with
 `lax.ppermute` while each device accumulates its queries' attention in
-flash-style (running max + running sum) form, so peak memory is O(seq/devices)
-and the N^2 score matrix never materializes globally.
+log-sum-exp form, so peak memory is O(seq/devices) and the N^2 score
+matrix never materializes globally.
 
-Written against jax.shard_map; compute per hop is one (q_blk x k_blk^T) MXU
-matmul, overlapping the next hop's ppermute (XLA schedules the collective
-permute concurrently with the matmul of the current block).
+Since round 4 each hop's local attention runs the Pallas flash-attention
+FORWARD kernel (parallel/flash_attention.py) when the local shard tiles —
+the kernel emits exactly the (out, lse) pair the ring merge needs, so the
+per-hop score matrix does not materialize even locally. Untileable
+shards keep the dense einsum hop. The hop loop is unrolled over the
+(static) ring size; XLA overlaps each hop's ppermute with the next
+block's compute either way.
 """
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -36,20 +41,53 @@ def attention_reference(q, k, v, causal=False, sm_scale=None):
     return jnp.einsum("bhqk,bkhd->bqhd", w, v)
 
 
-def _block_attn(q, k, v, scale, mask):
-    """Scores for one (q_block, k_block) pair + flash accumulators.
-    Returns (unnormalized out, row max, row sumexp)."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+def _dense_hop(q, k, v, scale, mask):
+    """One (q_shard, k_shard) attention in (normalized out, lse) form.
+    Returns out (B,t,H,D) f32 and lse (B,H,t) f32 (-inf on fully-masked
+    rows)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask, s, -jnp.inf)
-    m = jnp.max(s, axis=-1)                      # (B,H,Q)
-    # guard fully-masked rows
+    m = jnp.max(s, axis=-1)
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
     p = jnp.exp(s - m_safe[..., None])
     p = jnp.where(jnp.isfinite(s), p, 0.0)
-    l = jnp.sum(p, axis=-1)                      # (B,H,Q)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)      # (B,Q,H,D)
-    return o, m_safe, l, jnp.isfinite(m)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v,
+                   preferred_element_type=jnp.float32)
+    denom = jnp.where(l > 0, l, 1.0)
+    out = o / jnp.transpose(denom, (0, 2, 1))[..., None]
+    lse = jnp.where(l > 0, m_safe + jnp.log(denom), -jnp.inf)
+    return out, lse
+
+
+def _flash_hop(q, k, v, scale, causal):
+    """One hop through the Pallas flash forward kernel; differentiable
+    in (out, lse) — flash_attention.flash_hop carries the custom vjp
+    that runs the flash backward kernels with the lse cotangent folded
+    into delta."""
+    from .flash_attention import flash_hop
+
+    return flash_hop(q, k, v, causal, scale)
+
+
+def _flash_ok(q):
+    from .flash_attention import _pick_block, pallas_available
+
+    B, t, H, D = q.shape
+    return (pallas_available() and _pick_block(t, 1024) is not None
+            and D % 8 == 0)
+
+
+def _merge(o_acc, lse_acc, o_b, lse_b):
+    """log-sum-exp merge of two normalized partial attentions."""
+    lse_new = jnp.logaddexp(lse_acc, lse_b)
+    safe = jnp.where(jnp.isfinite(lse_new), lse_new, 0.0)
+    c_old = jnp.where(jnp.isfinite(lse_acc), jnp.exp(lse_acc - safe), 0.0)
+    c_new = jnp.where(jnp.isfinite(lse_b), jnp.exp(lse_b - safe), 0.0)
+    to_bqhd = lambda c: jnp.transpose(c, (0, 2, 1))[..., None]
+    return o_acc * to_bqhd(c_old) + o_b * to_bqhd(c_new), lse_new
 
 
 def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
@@ -58,47 +96,49 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     B, t, H, D = q.shape
-    scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(D).astype(q.dtype)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    use_flash = _flash_ok(q)
+    if use_flash:
+        try:
+            # the flash kernel bakes the scale into the compiled program;
+            # a traced scale (learned temperature) keeps the dense path,
+            # which accepts it like the pre-flash implementation did
+            scale = float(scale)
+        except jax.errors.ConcretizationTypeError:
+            use_flash = False
 
-    o0 = jnp.zeros((B, t, H, D), jnp.float32)
-    m0 = jnp.full((B, H, t), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, t), jnp.float32)
+    o_acc = jnp.zeros((B, t, H, D), jnp.float32)
+    lse_acc = jnp.full((B, H, t), -jnp.inf, jnp.float32)
+    k_cur, v_cur = k, v
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
-    def body(i, carry):
-        o_acc, m_acc, l_acc, k_cur, v_cur = carry
-        src_idx = (my_idx - i) % axis_size  # whose K/V block we hold this hop
-        if causal:
-            # q position block my_idx attends k block src_idx if src < mine,
-            # diagonal uses a triangular mask
-            q_pos = my_idx * t + jnp.arange(t)
-            k_pos = src_idx * t + jnp.arange(t)
-            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+    # hop i holds the K/V shard of device (my_idx - i) % axis_size. The
+    # loop is unrolled (axis_size is static): hop 0 is the diagonal —
+    # the only causally-masked block — so the flash kernel's causal mode
+    # applies exactly there and every other hop is an unmasked kernel
+    # call gated by src < mine.
+    for i in range(axis_size):
+        src_idx = (my_idx - i) % axis_size
+        if use_flash:
+            o_b, lse_b = _flash_hop(q, k_cur, v_cur, scale,
+                                    causal and i == 0)
         else:
-            mask = None
-        o_b, m_b, l_b, valid = _block_attn(q, k_cur, v_cur, scale, mask)
-        o_b = o_b.astype(jnp.float32)
-        m_b = m_b.astype(jnp.float32)
-        l_b = l_b.astype(jnp.float32)
-        # flash-style merge of (o_acc,m_acc,l_acc) with the new block
-        has = jnp.any(valid, axis=-1) if valid.ndim == m_b.ndim + 1 else valid
-        m_b = jnp.where(has, m_b, -jnp.inf)
-        m_new = jnp.maximum(m_acc, m_b)
-        m_new_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        c_old = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_new_safe), 0.0)
-        c_new = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - m_new_safe), 0.0)
-        l_new = l_acc * c_old + l_b * c_new
-        o_new = o_acc * jnp.transpose(c_old, (0, 2, 1))[..., None] + \
-            o_b * jnp.transpose(c_new, (0, 2, 1))[..., None]
-        # rotate K/V to the next device on the ring
-        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (o_new, m_new, l_new, k_nxt, v_nxt)
+            if causal and i == 0:
+                mask = jnp.tril(jnp.ones((t, t), bool))[None, None]
+            else:
+                mask = None
+            o_b, lse_b = _dense_hop(q, k_cur, v_cur, scale, mask)
+        if causal and i > 0:
+            # whole-shard validity: strictly-earlier shards attend fully,
+            # later shards not at all (same compute every device — the
+            # SPMD ring steps in lockstep; a masked hop just merges -inf)
+            lse_b = jnp.where(src_idx < my_idx, lse_b, -jnp.inf)
+        o_acc, lse_acc = _merge(o_acc, lse_acc, o_b, lse_b)
+        if i + 1 < axis_size:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
 
-    o, m, l, _, _ = lax.fori_loop(0, axis_size, body, (o0, m0, l0, k, v))
-    denom = jnp.where(l > 0, l, 1.0)
-    out = o / jnp.transpose(denom, (0, 2, 1))[..., None]
-    return out.astype(q.dtype)
+    return o_acc.astype(q.dtype)
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
