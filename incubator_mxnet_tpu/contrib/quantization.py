@@ -254,22 +254,27 @@ def _propagate_int8(sym):
                             [lq, rq, llo, lhi, rlo, rhi],
                             arg_names=["lhs", "rhs", "lhs_min", "lhs_max",
                                        "rhs_min", "rhs_max"])
-            elif node.op is q_v2 and is_dq(ins[0]) and \
-                    _traces_to_int32(ins[0][0].inputs[0][0],
-                                     (q_act, q_pool, q_flat),
-                                     int32_producers):
-                # dequantize(int32) -> quantize_v2 collapses to ONE
-                # requantize (reference requantize-inl.h: the int32
-                # accumulator -> int8 bridge without an fp32 round trip).
-                # quantize_v2 and requantize have the same 3-output arity,
-                # so consumers remap directly with no dequantize wrapper.
+            elif node.op is q_v2 and is_dq(ins[0]):
+                # dequantize -> quantize_v2 between quantized consumers
+                # is a round trip through fp32 (HBM-materialized + a
+                # minmax pass). Collapse to ONE code-level bridge:
+                # int32 accumulator chains take requantize (reference
+                # requantize-inl.h), already-int8 chains take the
+                # rescale_int8 range bridge (identity when calibration
+                # gave producer and consumer the same range).
+                from_int32 = _traces_to_int32(
+                    ins[0][0].inputs[0][0], (q_act, q_pool, q_flat),
+                    int32_producers)
+                op2, prefix = ((req_op, "requantized") if from_int32 else
+                               (_registry.get_op("_contrib_rescale_int8"),
+                                "rescaled"))
                 q, lo, hi = ins[0][0].inputs
                 attrs = {"out_type": node.attrs.get("out_type", "int8")}
                 for k in ("min_calib_range", "max_calib_range"):
                     if k in node.attrs:
                         attrs[k] = node.attrs[k]
                 mapping[id(node)] = (_Node(
-                    req_op, f"requantized_{node.name}", attrs, [q, lo, hi],
+                    op2, f"{prefix}_{node.name}", attrs, [q, lo, hi],
                     arg_names=["qdata", "min_range", "max_range"]), 0)
                 changed = True
                 continue
@@ -281,6 +286,57 @@ def _propagate_int8(sym):
                 changed = True
 
         if not changed:
+            return _hoist_requantize(sym)
+        sym = S.Symbol(_rebuild_mapped(sym._outputs, mapping))
+    return _hoist_requantize(sym)
+
+
+def _hoist_requantize(sym):
+    """Move requantize ABOVE range-preserving int32 ops: relu and
+    max-pool are monotone pointwise maps, so
+    requantize(act(X)) == act(requantize(X)) — but the left form runs
+    act/pool on 4-byte int32 codes while the right runs them on int8 AND
+    leaves requantize adjacent to the conv/fc accumulator, where XLA
+    fuses it into the conv epilogue (the profiled int8 graph spent 3.3x
+    bf16's time in reduce_window_max on int32 codes)."""
+    from .. import symbol as S
+    from ..symbol.symbol import _Node, _topo
+    from ..ops import registry as _registry
+
+    req_op = _registry.get_op("_contrib_requantize")
+    q_act = _registry.get_op("_contrib_quantized_act")
+    q_pool = _registry.get_op("_contrib_quantized_pooling")
+
+    def hoistable(node):
+        return (node.op is q_pool and node.attrs.get("pool_type",
+                                                     "max") == "max") \
+            or node.op is q_act
+
+    for _ in range(8):
+        mapping = {}
+        for node in _topo(sym._outputs):
+            if node.op is not req_op or id(node) in mapping:
+                continue
+            if "min_calib_range" not in node.attrs or \
+                    "max_calib_range" not in node.attrs:
+                # uncalibrated requantize computes its range from the
+                # INPUT: hoisting above relu/pool would widen that range
+                # to the raw accumulator's negative lobe and coarsen the
+                # scale — only the calibrated form commutes exactly
+                continue
+            p, p_oi = node.inputs[0]
+            if p_oi != 0 or not hoistable(p):
+                continue
+            # requantize consumes (P.q, P.lo, P.hi); P passes lo/hi
+            # through, so requantize can read P's own range inputs
+            new_req = _Node(req_op, f"hoisted_{node.name}",
+                            dict(node.attrs), list(p.inputs),
+                            arg_names=list(node.arg_names))
+            new_p = _Node(p.op, f"{p.name}_int8", dict(p.attrs),
+                          [(new_req, 0), (new_req, 1), (new_req, 2)],
+                          arg_names=list(p.arg_names))
+            mapping[id(node)] = (new_p, 0)
+        if not mapping:
             return sym
         sym = S.Symbol(_rebuild_mapped(sym._outputs, mapping))
     return sym
@@ -542,9 +598,88 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             node.extra.setdefault("__shape__",
                                   tuple(arg_params[node.name].shape))
 
-    # pre-quantize the weights/biases (int8 symmetric) so the quantize
-    # nodes on params fold to casts at run time — params stay fp32 in the
-    # returned dict (the graph quantizes on entry), matching the
-    # reference's quantize_params behavior of emitting _quantize-suffixed
-    # params; here the graph handles it uniformly.
-    return qsym, dict(arg_params), dict(aux_params or {})
+    # OFFLINE weight quantization (reference quantize_graph_pass.cc
+    # OfflineParams + quantization.py _quantize_params): every
+    # quantize_v2 whose input is a parameter variable is evaluated NOW
+    # and replaced by stored int8 codes + range scalars. Without this the
+    # fp32 weights are re-read and re-quantized on EVERY inference step —
+    # measured as the dominant extra HBM traffic of the int8 graph.
+    qsym, qparams, consumed = _offline_quantize_params(qsym, arg_params)
+    out_args = {k: v for k, v in arg_params.items() if k not in consumed}
+    out_args.update(qparams)
+    return qsym, out_args, dict(aux_params or {})
+
+
+def _offline_quantize_params(sym, arg_params):
+    """Fold param-input quantize_v2 nodes into stored int8 arrays.
+    Returns (new_sym, {new_param_name: NDArray}, {consumed fp32 names});
+    a consumed fp32 param is dropped unless something else still
+    references it."""
+    from .. import symbol as S
+    from ..symbol.symbol import _Node, _topo
+    from ..ops import registry as _registry
+    from ..ndarray import array as _nd_array
+
+    import numpy as _np2
+
+    q_v2 = _registry.get_op("_contrib_quantize_v2")
+    new_params = {}
+    repl = {}        # id(quantize_node) -> [qvar, lovar, hivar]
+    consumed = {}    # fp32 name -> count of folded consumers
+
+    for node in _topo(sym._outputs):
+        if node.op is not q_v2 or not node.inputs:
+            continue
+        inp, oi = node.inputs[0]
+        if inp.op is not None or inp.name not in arg_params or oi != 0:
+            continue
+        w = arg_params[inp.name].asnumpy()
+        kw = {"out_type": node.attrs.get("out_type", "int8")}
+        for k in ("min_calib_range", "max_calib_range"):
+            if k in node.attrs:
+                kw[k] = float(node.attrs[k])
+        import jax.numpy as _jnp
+        q, mn, mx = q_v2.fn(_jnp.asarray(w), **kw)
+        names = [f"{node.name}_weight", f"{node.name}_min",
+                 f"{node.name}_max"]
+        vars_ = []
+        for nm, val in zip(names, (q, mn, mx)):
+            new_params[nm] = _nd_array(_np2.asarray(val))
+            v = _Node(None, nm, {}, [])
+            v.extra["__shape__"] = tuple(_np2.asarray(val).shape)
+            vars_.append(v)
+        repl[id(node)] = vars_
+        consumed[inp.name] = True
+
+    if not repl:
+        return sym, {}, set()
+
+    rebuilt = {}
+    still_referenced = set()
+
+    def rebuild(node):
+        if id(node) in rebuilt:
+            return rebuilt[id(node)]
+        if node.op is None:
+            still_referenced.add(node.name)
+            rebuilt[id(node)] = node
+            return node
+        new_ins = []
+        for inp, oi in node.inputs:
+            if id(inp) in repl:
+                new_ins.append((repl[id(inp)][oi], 0))
+            else:
+                new_ins.append((rebuild(inp), oi))
+        nn = _Node(node.op, node.name, node.attrs, new_ins,
+                   extra=node.extra, arg_names=node.arg_names)
+        rebuilt[id(node)] = nn
+        return nn
+
+    outs = []
+    for n, i in sym._outputs:
+        if id(n) in repl:
+            outs.append((repl[id(n)][i], 0))
+        else:
+            outs.append((rebuild(n), i))
+    drop = {n for n in consumed if n not in still_referenced}
+    return S.Symbol(outs), new_params, drop

@@ -301,3 +301,35 @@ def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
     q = jnp.clip(jnp.round(out_real * (127.0 / jnp.maximum(out_amax, 1e-30))),
                  -127, 127).astype(jnp.int8)
     return q, -out_amax, out_amax
+
+
+@register(name="_contrib_rescale_int8", aliases=("rescale_int8",),
+          nondiff=True)
+def rescale_int8(qdata, min_range, max_range, *, out_type="int8",
+                 min_calib_range=None, max_calib_range=None):
+    """int8 -> int8 range bridge: re-express codes quantized for
+    (min_range, max_range) in the target calib range WITHOUT an fp32
+    tensor round trip. Replaces the reference's dequantize+quantize_v2
+    pair between consecutive int8 consumers (quantize_graph_pass.cc
+    inserts that pair; here the fp32 intermediate would be the single
+    largest HBM cost of the int8 graph — elementwise on codes, XLA fuses
+    it into the consumer's input read)."""
+    if out_type != "int8":
+        raise MXNetError("rescale_int8 bridges symmetric int8 codes only; "
+                         f"got out_type={out_type!r} (the affine uint8 "
+                         "form would need a zero-point path)")
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx_ = jnp.asarray(max_range, jnp.float32).reshape(())
+    amax_in = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+    if min_calib_range is not None and max_calib_range is not None:
+        amax_out = jnp.float32(max(abs(min_calib_range),
+                                   abs(max_calib_range)))
+        lo = jnp.float32(-max(abs(min_calib_range), abs(max_calib_range)))
+        hi = jnp.float32(max(abs(min_calib_range), abs(max_calib_range)))
+    else:
+        amax_out = amax_in
+        lo, hi = -amax_in, amax_in
+    scale = amax_in / jnp.maximum(amax_out, 1e-30)
+    q = jnp.clip(jnp.round(qdata.astype(jnp.float32) * scale),
+                 -127, 127).astype(jnp.int8)
+    return q, lo, hi

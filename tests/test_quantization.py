@@ -309,3 +309,77 @@ def test_requantize_fusion_in_chain():
     got = qsym.eval_dict(dict(feed))
     got = (got[0] if isinstance(got, list) else got).asnumpy()
     assert np.abs(got - ref).max() < 0.15 * max(1.0, np.abs(ref).max())
+
+
+def test_offline_weight_quantization_and_hoist():
+    """Round-4 graph passes: (1) weight quantize_v2 nodes fold to stored
+    int8 params (no per-step fp32 weight requantization); (2) requantize
+    hoists above relu/max-pool so those run on int8 codes; (3) accuracy
+    is unchanged."""
+    import json
+    import tempfile
+    from collections import Counter
+
+    import incubator_mxnet_tpu.io as mio
+    from incubator_mxnet_tpu.contrib.quantization import (fold_batchnorm,
+                                                          quantize_model)
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.zeros((1, 3, 32, 32), np.float32)))
+    with tempfile.TemporaryDirectory() as d:
+        net.export(d + "/rn")
+        sym, args, aux = mx.model.load_checkpoint(d + "/rn", 0)
+    sym, args, aux = fold_batchnorm(sym, args, aux)
+    rng = np.random.RandomState(0)
+    calib = mio.NDArrayIter(data=rng.rand(4, 3, 32, 32).astype(np.float32),
+                            batch_size=4)
+    qsym, qargs, qaux = quantize_model(
+        sym, args, aux, data_names=("data",), calib_mode="naive",
+        calib_data=calib, num_calib_examples=4, quantized_dtype="int8")
+
+    g = json.loads(qsym.tojson())
+    counts = Counter(n["op"] for n in g["nodes"] if n["op"] != "null")
+    # resnet18 has 21 weighted layers; only graph ENTRY points may keep a
+    # runtime quantize_v2 (data + the fc after the fp32 global pool)
+    assert counts["_contrib_quantize_v2"] <= 3, counts
+    # offline weights really are int8 in the param dict
+    int8_params = [k for k, v in qargs.items()
+                   if v.asnumpy().dtype == np.int8]
+    assert len(int8_params) >= 20, len(int8_params)
+    # hoist: at least one act/pool node renamed by the hoist pass
+    names = [n["name"] for n in g["nodes"]]
+    assert any(n.endswith("_int8") for n in names)
+
+    # accuracy vs the fp32 graph
+    ex = sym.simple_bind(None, grad_req="null", data=(4, 3, 32, 32))
+    ex.copy_params_from(args, aux, allow_extra_params=True)
+    x = rng.rand(4, 3, 32, 32).astype(np.float32)
+    ref = ex.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+    feed = {n: (v if hasattr(v, "_data") else nd.array(v))
+            for n, v in {**qargs, **qaux}.items()}
+    feed["data"] = nd.array(x)
+    out = qsym.eval_dict(feed)
+    out = (out[0] if isinstance(out, list) else out).asnumpy()
+    corr = np.corrcoef(ref.ravel(), out.ravel())[0, 1]
+    assert corr > 0.99, corr
+    assert (ref.argmax(1) == out.argmax(1)).mean() >= 0.75
+
+
+def test_rescale_int8_bridges_ranges():
+    """_contrib_rescale_int8: int8 codes re-expressed in a new range
+    match dequantize->quantize_v2 within one code step."""
+    x = np.random.RandomState(0).randn(64).astype(np.float32)
+    q, mn, mx_ = nd.quantize_v2(nd.array(x), min_calib_range=-3.0,
+                                max_calib_range=3.0)
+    # reference path: fp32 round trip
+    deq = nd.dequantize(q, mn, mx_)
+    q2, mn2, mx2 = nd.quantize_v2(deq, min_calib_range=-1.5,
+                                  max_calib_range=1.5)
+    # bridge path: codes only
+    q3, mn3, mx3 = nd.rescale_int8(q, mn, mx_, min_calib_range=-1.5,
+                                   max_calib_range=1.5)
+    assert np.abs(q2.asnumpy().astype(np.int32)
+                  - q3.asnumpy().astype(np.int32)).max() <= 1
+    assert float(mn3.asnumpy()) == -1.5 and float(mx3.asnumpy()) == 1.5
