@@ -644,9 +644,14 @@ def _offline_quantize_params(sym, arg_params):
                  f"{node.name}_max"]
         vars_ = []
         for nm, val in zip(names, (q, mn, mx)):
-            new_params[nm] = _nd_array(_np2.asarray(val))
+            val = _np2.asarray(val)
+            new_params[nm] = _nd_array(val)
             v = _Node(None, nm, {}, [])
-            v.extra["__shape__"] = tuple(_np2.asarray(val).shape)
+            v.extra["__shape__"] = tuple(val.shape)
+            # without the dtype hint simple_bind allocates f32 arrays for
+            # the int8 codes and copy_params_from casts them — the
+            # quantized ops then mis-scale on the real chip
+            v.extra["__dtype__"] = str(val.dtype)
             vars_.append(v)
         repl[id(node)] = vars_
         consumed[inp.name] = True

@@ -318,14 +318,17 @@ def rescale_int8(qdata, min_range, max_range, *, out_type="int8",
         raise MXNetError("rescale_int8 bridges symmetric int8 codes only; "
                          f"got out_type={out_type!r} (the affine uint8 "
                          "form would need a zero-point path)")
+    if qdata.dtype != jnp.int8:
+        raise MXNetError("rescale_int8 expects int8 codes; got "
+                         f"{qdata.dtype} — int32 accumulators take "
+                         "_contrib_requantize")
     mn = jnp.asarray(min_range, jnp.float32).reshape(())
     mx_ = jnp.asarray(max_range, jnp.float32).reshape(())
     amax_in = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
     if min_calib_range is not None and max_calib_range is not None:
         amax_out = jnp.float32(max(abs(min_calib_range),
                                    abs(max_calib_range)))
-        lo = jnp.float32(-max(abs(min_calib_range), abs(max_calib_range)))
-        hi = jnp.float32(max(abs(min_calib_range), abs(max_calib_range)))
+        lo, hi = -amax_out, amax_out
     else:
         amax_out = amax_in
         lo, hi = -amax_in, amax_in

@@ -30,22 +30,29 @@ __all__ = ["ring_attention", "ring_attention_sharded", "attention_reference"]
 
 def attention_reference(q, k, v, causal=False, sm_scale=None):
     """Plain single-device attention, the numeric oracle for the ring version.
-    q,k,v: (B, T, H, D)."""
+    q,k,v: (B, T, H, D). f32 inputs run HIGHEST-precision einsums so the
+    fallback matches the Pallas kernels' dtype-dependent precision (on
+    TPU, DEFAULT would demote f32 operands to bf16)."""
+    from jax import lax as _lax
     B, T, H, D = q.shape
+    prec = (_lax.Precision.DEFAULT if q.dtype == jnp.bfloat16
+            else _lax.Precision.HIGHEST)
     scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(D).astype(q.dtype)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=prec) * scale
     if causal:
         mask = jnp.tril(jnp.ones((T, k.shape[1]), bool))
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
     w = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v, precision=prec)
 
 
 def _dense_hop(q, k, v, scale, mask):
     """One (q_shard, k_shard) attention in (normalized out, lse) form.
     Returns out (B,t,H,D) f32 and lse (B,H,t) f32 (-inf on fully-masked
     rows)."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    prec = (jax.lax.Precision.DEFAULT if q.dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=prec,
                    preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask, s, -jnp.inf)
@@ -55,7 +62,7 @@ def _dense_hop(q, k, v, scale, mask):
     p = jnp.where(jnp.isfinite(s), p, 0.0)
     l = jnp.sum(p, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v,
-                   preferred_element_type=jnp.float32)
+                   precision=prec, preferred_element_type=jnp.float32)
     denom = jnp.where(l > 0, l, 1.0)
     out = o / jnp.transpose(denom, (0, 2, 1))[..., None]
     lse = jnp.where(l > 0, m_safe + jnp.log(denom), -jnp.inf)

@@ -10,15 +10,21 @@ import os
 import sys
 
 _plat = os.environ.get("MXTPU_TEST_PLATFORM", "cpu")
-os.environ["JAX_PLATFORMS"] = _plat
+if _plat == "tpu":
+    # real-chip rerun (the reference's test_operator_gpu.py trick): the
+    # tunneled device registers as an experimental plugin platform, so
+    # let jax auto-select it rather than forcing the native tpu path
+    os.environ.pop("JAX_PLATFORMS", None)
+else:
+    os.environ["JAX_PLATFORMS"] = _plat
 flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
+if "host_platform_device_count" not in flags and _plat != "tpu":
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 # The environment's sitecustomize may have imported jax already (with the
 # axon TPU backend forced); env vars alone are then too late — override the
 # live config so tests really run on the 8-device virtual CPU mesh.
-if "jax" in sys.modules and _plat:
+if "jax" in sys.modules and _plat and _plat != "tpu":
     import jax
     jax.config.update("jax_platforms", _plat)
 
