@@ -33,10 +33,9 @@ def attention_reference(q, k, v, causal=False, sm_scale=None):
     q,k,v: (B, T, H, D). f32 inputs run HIGHEST-precision einsums so the
     fallback matches the Pallas kernels' dtype-dependent precision (on
     TPU, DEFAULT would demote f32 operands to bf16)."""
-    from jax import lax as _lax
+    from .flash_attention import _prec
     B, T, H, D = q.shape
-    prec = (_lax.Precision.DEFAULT if q.dtype == jnp.bfloat16
-            else _lax.Precision.HIGHEST)
+    prec = _prec(q.dtype)
     scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(D).astype(q.dtype)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=prec) * scale
     if causal:
@@ -50,9 +49,8 @@ def _dense_hop(q, k, v, scale, mask):
     """One (q_shard, k_shard) attention in (normalized out, lse) form.
     Returns out (B,t,H,D) f32 and lse (B,H,t) f32 (-inf on fully-masked
     rows)."""
-    prec = (jax.lax.Precision.DEFAULT if q.dtype == jnp.bfloat16
-            else jax.lax.Precision.HIGHEST)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=prec,
+    from .flash_attention import _prec
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=(prec := _prec(q.dtype)),
                    preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask, s, -jnp.inf)
