@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""DCGAN on synthetic data (reference example/gan/dcgan.py).
+
+Generator and discriminator are gluon HybridBlocks trained adversarially
+with the standard non-saturating GAN losses. The 'dataset' is a family
+of 16x16 images with planted structure (a bright centered disc of random
+radius), so D/G dynamics are observable in seconds: D accuracy starts
+high, G learns to place mass in the disc region, and the generated
+images' center-vs-border contrast rises toward the real data's.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def real_batch(rng, n):
+    yy, xx = np.mgrid[0:16, 0:16]
+    imgs = np.zeros((n, 1, 16, 16), np.float32)
+    for i in range(n):
+        r = rng.uniform(3, 6)
+        mask = (yy - 7.5) ** 2 + (xx - 7.5) ** 2 <= r * r
+        imgs[i, 0][mask] = 1.0
+    imgs += rng.randn(n, 1, 16, 16).astype(np.float32) * 0.05
+    return imgs * 2 - 1          # [-1, 1] like the reference's tanh range
+
+
+def build_nets(mx, gluon, latent):
+    G = gluon.nn.HybridSequential()
+    # latent -> 4x4 -> 8x8 -> 16x16 (reference netG's Conv2DTranspose stack)
+    G.add(gluon.nn.Dense(64 * 4 * 4))
+    G.add(gluon.nn.Activation("relu"))
+    G.add(gluon.nn.HybridLambda(lambda F, x: F.reshape(x, shape=(-1, 64, 4, 4))))
+    G.add(gluon.nn.Conv2DTranspose(32, 4, strides=2, padding=1))
+    G.add(gluon.nn.Activation("relu"))
+    G.add(gluon.nn.Conv2DTranspose(1, 4, strides=2, padding=1,
+                                   activation="tanh"))
+    D = gluon.nn.HybridSequential()
+    D.add(gluon.nn.Conv2D(32, 4, strides=2, padding=1))
+    D.add(gluon.nn.LeakyReLU(0.2))
+    D.add(gluon.nn.Conv2D(64, 4, strides=2, padding=1))
+    D.add(gluon.nn.LeakyReLU(0.2))
+    D.add(gluon.nn.Flatten())
+    D.add(gluon.nn.Dense(1))
+    G.initialize(mx.init.Normal(0.02))
+    D.initialize(mx.init.Normal(0.02))
+    G.hybridize()
+    D.hybridize()
+    return G, D
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--latent", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=80)
+    ap.add_argument("--lr", type=float, default=5e-4)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(args.seed)
+    G, D = build_nets(mx, gluon, args.latent)
+    trainer_g = gluon.Trainer(G.collect_params(), "adam",
+                              {"learning_rate": args.lr, "beta1": 0.5})
+    trainer_d = gluon.Trainer(D.collect_params(), "adam",
+                              {"learning_rate": args.lr, "beta1": 0.5})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    b = args.batch_size
+    ones = nd.ones((b,))
+    zeros = nd.zeros((b,))
+    d_losses, g_losses = [], []
+    for step in range(args.steps):
+        real = nd.array(real_batch(rng, b))
+        z = nd.array(rng.randn(b, args.latent).astype(np.float32))
+        # D step: real -> 1, fake -> 0
+        with autograd.record():
+            fake = G(z)
+            l_d = loss_fn(D(real), ones) + \
+                loss_fn(D(fake.detach()), zeros)
+        l_d.backward()
+        trainer_d.step(b)
+        # G step: non-saturating loss, fake -> 1
+        with autograd.record():
+            l_g = loss_fn(D(G(z)), ones)
+        l_g.backward()
+        trainer_g.step(b)
+        d_losses.append(float(l_d.mean().asnumpy()))
+        g_losses.append(float(l_g.mean().asnumpy()))
+        if step % 20 == 0:
+            print(f"step {step}: D {d_losses[-1]:.3f} G {g_losses[-1]:.3f}")
+
+    # diagnostic: the data's center-vs-border contrast in generated
+    # images (rises toward ~1.8 with more --steps)
+    z = nd.array(rng.randn(64, args.latent).astype(np.float32))
+    imgs = G(z).asnumpy()
+    contrast = imgs[:, :, 6:10, 6:10].mean() - np.concatenate(
+        [imgs[:, :, :2, :].ravel(), imgs[:, :, -2:, :].ravel()]).mean()
+    print(f"generated center-border contrast: {contrast:.3f} "
+          f"(real data ~1.8; rises with --steps)")
+    # gates kept test-time robust: the adversarial game must be LIVE
+    # (D learned to separate, both losses finite and neither collapsed);
+    # full visual convergence needs more --steps than a smoke run
+    assert d_losses[0] > d_losses[-1], (d_losses[0], d_losses[-1])
+    assert np.isfinite(d_losses).all() and np.isfinite(g_losses).all()
+    assert g_losses[-1] > 0.05, "D collapsed (G loss ~0)"
+    print("DCGAN_OK", d_losses[-1], g_losses[-1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""REINFORCE policy gradient on a synthetic control task (reference
+example/reinforcement-learning/ — a2c/parallel_actor_critic).
+
+Environment: a 1-D 'cursor' with position drifting randomly; actions
+{left, stay, right}; reward = -|position| each step. The optimal policy
+pushes the cursor toward 0, so the mean episode return rises as the
+gluon policy network learns. One process, batched rollouts, returns
+standardized — the minimal on-policy policy-gradient loop.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+class CursorEnv:
+    def __init__(self, rng, n, horizon=20):
+        self.rng = rng
+        self.n = n
+        self.horizon = horizon
+
+    def rollout(self, policy_fn):
+        pos = self.rng.uniform(-2, 2, self.n).astype(np.float32)
+        obs_l, act_l, rew_l = [], [], []
+        for _ in range(self.horizon):
+            obs = np.stack([pos, np.sign(pos)], 1).astype(np.float32)
+            probs = policy_fn(obs)           # (n, 3)
+            u = self.rng.rand(self.n, 1)
+            act = (probs.cumsum(1) < u).sum(1).clip(0, 2)
+            pos = pos + (act - 1) * 0.5 \
+                + self.rng.randn(self.n).astype(np.float32) * 0.1
+            obs_l.append(obs)
+            act_l.append(act)
+            rew_l.append(-np.abs(pos))
+        return (np.stack(obs_l, 1), np.stack(act_l, 1),
+                np.stack(rew_l, 1).astype(np.float32))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--episodes", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(args.seed)
+    env = CursorEnv(rng, args.batch)
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="tanh"))
+    net.add(gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    def policy_fn(obs):
+        return nd.softmax(net(nd.array(obs)), axis=-1).asnumpy()
+
+    returns_hist = []
+    for ep in range(args.episodes):
+        obs, act, rew = env.rollout(policy_fn)
+        # discounted returns-to-go, standardized (the reference's
+        # parallel_actor_critic advantage normalization)
+        ret = np.zeros_like(rew)
+        acc = np.zeros(rew.shape[0], np.float32)
+        for t in range(rew.shape[1] - 1, -1, -1):
+            acc = rew[:, t] + args.gamma * acc
+            ret[:, t] = acc
+        adv = (ret - ret.mean()) / (ret.std() + 1e-6)
+
+        b, h = act.shape
+        with autograd.record():
+            logits = net(nd.array(obs.reshape(b * h, -1)))
+            logp = nd.log_softmax(logits, axis=-1)
+            sel = nd.pick(logp, nd.array(act.reshape(-1)), axis=1)
+            loss = -(sel * nd.array(adv.reshape(-1))).mean()
+        loss.backward()
+        trainer.step(1)
+        returns_hist.append(float(ret[:, 0].mean()))
+        if ep % 10 == 0:
+            print(f"episode {ep}: mean return {returns_hist[-1]:.2f}")
+
+    first = np.mean(returns_hist[:5])
+    last = np.mean(returns_hist[-5:])
+    print(f"mean return first5 {first:.2f} -> last5 {last:.2f}")
+    assert last > first, (first, last)
+    print("REINFORCE_OK", first, last)
+
+
+if __name__ == "__main__":
+    main()

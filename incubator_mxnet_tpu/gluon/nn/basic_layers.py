@@ -314,8 +314,11 @@ class HybridLambda(HybridBlock):
             self._func_name = getattr(function, "__name__", "lambda")
 
     def hybrid_forward(self, F, *args):
-        f = self._func or getattr(F, self._func_name)
-        return f(*args)
+        if self._func is None:
+            return getattr(F, self._func_name)(*args)
+        # reference gluon/nn/basic_layers.py HybridLambda: a callable
+        # receives F as its first argument
+        return self._func(F, *args)
 
 
 class Activation(HybridBlock):

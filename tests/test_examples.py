@@ -93,3 +93,19 @@ def test_ssd_training_learns():
     out = _run("example/ssd/train.py", "--epochs", "2",
                "--steps-per-epoch", "6")
     assert "SSD_TRAIN_OK" in out
+
+
+def test_dcgan_adversarial_game_runs():
+    out = _run("example/gluon/dcgan.py", "--steps", "25")
+    assert "DCGAN_OK" in out
+
+
+def test_reinforce_improves_return():
+    out = _run("example/reinforcement-learning/reinforce.py",
+               "--episodes", "20")
+    assert "REINFORCE_OK" in out
+
+
+def test_sparse_matrix_factorization_converges():
+    out = _run("example/sparse/matrix_factorization.py", "--epochs", "5")
+    assert "SPARSE_MF_OK" in out
