@@ -543,3 +543,19 @@ def _flash_hop_vjp_bwd(causal, sm_scale, res, cts):
 
 
 flash_hop.defvjp(_flash_hop_vjp_fwd, _flash_hop_vjp_bwd)
+
+
+def flash_attention_bh(q, k, v, causal=False, sm_scale=None):
+    """(BH, T, D)-layout flash attention for callers that already hold
+    merged batch*head arrays: a singleton-head view of flash_attention
+    (the (BH,T,1,D) reshape is free), so it shares the kernels, the
+    custom vjp, AND the O(block*T) scan fallback. Note: routing the
+    transformer through this entry to skip its _to_bh copies was
+    measured 4.4% SLOWER end to end (docs/perf_notes.md round-4
+    addendum) — the model keeps the standard layout; this entry is for
+    code that genuinely starts from (BH,T,D)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    return flash_attention(q[:, :, None, :], k[:, :, None, :],
+                           v[:, :, None, :], causal=causal,
+                           sm_scale=sm_scale)[:, :, 0, :]

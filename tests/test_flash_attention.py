@@ -276,3 +276,33 @@ def test_ring_attention_grad_matches_dense(monkeypatch):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-2, atol=2e-3)
+
+
+def test_flash_attention_bh_layout():
+    """(BH,T,D) entry matches the (B,T,H,D) one, values and grads."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.parallel.flash_attention import (
+        flash_attention, flash_attention_bh)
+
+    rng = np.random.RandomState(0)
+    B, T, H, D = 2, 64, 2, 16
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.3
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    out1 = flash_attention(q, k, v, causal=True)
+    out2 = flash_attention_bh(to_bh(q), to_bh(k), to_bh(v), causal=True)
+    np.testing.assert_allclose(np.asarray(to_bh(out1)), np.asarray(out2),
+                               rtol=1e-4, atol=1e-5)
+
+    g1 = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, causal=True) ** 2), argnums=(0, 1, 2))(
+        q, k, v)
+    g2 = jax.grad(lambda a, b, c: jnp.sum(flash_attention_bh(
+        a, b, c, causal=True) ** 2), argnums=(0, 1, 2))(
+        to_bh(q), to_bh(k), to_bh(v))
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(to_bh(a)), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
