@@ -158,6 +158,16 @@ class Block:
     def params(self):
         return self._params
 
+    def name_scope(self):
+        """Reference gluon/block.py Block.name_scope: a `with` scope that
+        prefixes children created inside it with this block's prefix.
+        Here child blocks are auto-prefixed at attribute assignment (the
+        counter-based _NameCounter naming), so the scope's only job is
+        API compatibility — it yields self and changes nothing. Kept so
+        reference model definitions run unmodified."""
+        import contextlib
+        return contextlib.nullcontext(self)
+
     def __repr__(self):
         lines = [f"{type(self).__name__}("]
         for key, child in self._children.items():

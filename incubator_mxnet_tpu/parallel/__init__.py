@@ -17,7 +17,7 @@ from .mesh import make_mesh, local_mesh_axis_sizes
 from .functional import functionalize
 from .train import TrainStep, shard_batch
 from .ring_attention import ring_attention, ring_attention_sharded
-from .flash_attention import flash_attention
+from .flash_attention import flash_attention, flash_attention_bh
 from .pipeline import pipeline_apply, pipeline_sharded
 from .moe import moe_apply, moe_sharded, init_moe_params
 from .tensor_parallel import (column_parallel_spec, row_parallel_spec,
@@ -28,7 +28,7 @@ from .compression import (quantized_allreduce, quantized_psum,
 
 __all__ = ["make_mesh", "local_mesh_axis_sizes", "functionalize", "TrainStep",
            "shard_batch", "ring_attention", "ring_attention_sharded",
-           "flash_attention", "pipeline_apply", "pipeline_sharded",
+           "flash_attention", "flash_attention_bh", "pipeline_apply", "pipeline_sharded",
            "moe_apply", "moe_sharded", "init_moe_params",
            "column_parallel_spec", "row_parallel_spec",
            "transformer_param_specs", "quantized_allreduce",

@@ -32,7 +32,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention", "pallas_available"]
+__all__ = ["flash_attention", "flash_attention_bh", "pallas_available"]
 
 _NEG_INF = -1e30
 
@@ -554,8 +554,6 @@ def flash_attention_bh(q, k, v, causal=False, sm_scale=None):
     measured 4.4% SLOWER end to end (docs/perf_notes.md round-4
     addendum) — the model keeps the standard layout; this entry is for
     code that genuinely starts from (BH,T,D)."""
-    if sm_scale is None:
-        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     return flash_attention(q[:, :, None, :], k[:, :, None, :],
                            v[:, :, None, :], causal=causal,
                            sm_scale=sm_scale)[:, :, 0, :]

@@ -109,3 +109,14 @@ def test_reinforce_improves_return():
 def test_sparse_matrix_factorization_converges():
     out = _run("example/sparse/matrix_factorization.py", "--epochs", "5")
     assert "SPARSE_MF_OK" in out
+
+
+def test_autoencoder_pretrain_finetune():
+    out = _run("example/autoencoder/train.py", "--pretrain-epochs", "5",
+               "--finetune-epochs", "8")
+    assert "AUTOENCODER_OK" in out
+
+
+def test_cnn_text_classification_learns_ngrams():
+    out = _run("example/cnn_text_classification/train.py", "--epochs", "5")
+    assert "TEXTCNN_OK" in out
