@@ -397,9 +397,10 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, want_lse=False):
 
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    # v5e-tuned: (512, 1024) measured 22.3 TF/s fwd vs 4.5 at (256, 512)
-    # and 14.8 for XLA's fused attention (docs/perf_notes.md)
-    bq = _pick_block(Tq, 512)
+    # v5e-tuned r4: (1024, 1024) — 33.8 TF/s fwd at T=2048 (vs 30.5 at
+    # the r3 (512,1024) tune) and 53.4 at T=8192 (vs 46.6); the r3 sweep
+    # predates the backward/block interplay (docs/perf_notes.md)
+    bq = _pick_block(Tq, 1024)
     bk = _pick_block(Tk, 1024)
     if not pallas_available() or bq is None or bk is None or D % 8:
         out = attention_reference(q, k, v, causal=causal,
@@ -509,7 +510,7 @@ def flash_hop(q, k, v, causal, sm_scale):
 
 def _flash_hop_fwd_impl(q, k, v, causal, sm_scale):
     B, T, H, D = q.shape
-    bq = _pick_block(T, 512)
+    bq = _pick_block(T, 1024)
     bk = _pick_block(k.shape[1], 1024)
     out, lse = _fa_forward(_to_bh(q), _to_bh(k), _to_bh(v), causal,
                            sm_scale, bq, bk, _interpret())
