@@ -120,3 +120,10 @@ def test_autoencoder_pretrain_finetune():
 def test_cnn_text_classification_learns_ngrams():
     out = _run("example/cnn_text_classification/train.py", "--epochs", "5")
     assert "TEXTCNN_OK" in out
+
+
+def test_bilstm_sort_learns():
+    out = _run("example/bi-lstm-sort/sort.py", "--epochs", "5",
+               "--batches-per-epoch", "12", "--hidden", "32",
+               "--min-acc", "0.4")
+    assert "BILSTM_SORT_OK" in out
