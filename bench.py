@@ -101,10 +101,21 @@ def bench_train(batch, dtype, steps, image_size=224):
     y = jnp.asarray(np.random.randint(0, 1000, batch).astype(np.int32))
     _sync(x), _sync(y)
     _sync(step.run_steps(steps, x, y))    # compile + warmup
-    t0 = time.perf_counter()
-    _sync(step.run_steps(steps, x, y))
-    dt = time.perf_counter() - t0
+    dt = _time_best(lambda: _sync(step.run_steps(steps, x, y)))
     return batch * steps / dt
+
+
+def _time_best(run, n=2):
+    """Best (min) of n timed dispatches of `run` (which must block until
+    results are ready). A one-off tunnel/compile-helper stall during a
+    single window was observed to misreport 59.7k tok/s as 5.3k; min-of-n
+    is the standard defense."""
+    dt = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        run()
+        dt = min(dt, time.perf_counter() - t0)
+    return dt
 
 
 def bench_inference(batch, dtype, steps, image_size=224):
@@ -139,10 +150,7 @@ def bench_inference(batch, dtype, steps, image_size=224):
 
     fwd = jax.jit(loop, compiler_options=default_compiler_options())
     _sync(fwd(params, rng, xa))
-    t0 = time.perf_counter()
-    out = fwd(params, rng, xa)
-    _sync(out)
-    dt = time.perf_counter() - t0
+    dt = _time_best(lambda: _sync(fwd(params, rng, xa)))
     return batch * steps / dt
 
 
@@ -187,10 +195,11 @@ def bench_transformer(steps=20):
     _sync(loss)
     params, opt, loss = step(params, opt, tokens, targets, steps)
     _sync(loss)
-    t0 = time.perf_counter()
-    params, opt, loss = step(params, opt, tokens, targets, steps)
-    _sync(loss)
-    dt = time.perf_counter() - t0
+    def run():
+        nonlocal params, opt
+        params, opt, loss = step(params, opt, tokens, targets, steps)
+        _sync(loss)
+    dt = _time_best(run)
     tok_s = B * T * steps / dt
     # 6*N per token over matmul+embedding-output params, plus the
     # attention quadratic: fwd 4*B*T^2*D per layer, x3 for train
@@ -232,10 +241,12 @@ def bench_transformer_longctx(steps=8):
     _sync(loss)
     params, opt, loss = step(params, opt, tokens, targets, steps)
     _sync(loss)   # second warmup: first dispatch of the n-step program
-    t0 = time.perf_counter()
-    params, opt, loss = step(params, opt, tokens, targets, steps)
-    _sync(loss)
-    return B * T * steps / (time.perf_counter() - t0), T
+    def run():
+        nonlocal params, opt
+        params, opt, loss = step(params, opt, tokens, targets, steps)
+        _sync(loss)
+    dt = _time_best(run)
+    return B * T * steps / dt, T
 
 
 def bench_int8_inference(batch, steps, image_size=224):
@@ -298,10 +309,8 @@ def bench_int8_inference(batch, steps, image_size=224):
 
     fwd = jax.jit(loop, compiler_options=default_compiler_options())
     _sync(fwd(pvals, x0))
-    t0 = time.perf_counter()
-    out = fwd(pvals, x0)
-    _sync(out)
-    return batch * steps / (time.perf_counter() - t0)
+    dt = _time_best(lambda: _sync(fwd(pvals, x0)))
+    return batch * steps / dt
 
 
 def main():
