@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""LSTM + CTC sequence recognition (reference example/ctc/lstm_ocr_train.py,
+which reads captcha images; here the 'OCR' task is synthesized so the
+example is self-contained and deterministic).
+
+Each sample is a variable-length digit string rendered as a strip of
+fixed random glyph columns. The image's pixel columns are the LSTM's
+time steps; per-step logits over {10 digits + blank} train with CTCLoss
+(alignment-free — the model must discover WHERE each digit sits), and
+greedy CTC decoding (collapse repeats, drop blanks) recovers the string.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+N_DIGITS = 10
+GLYPH_W = 6        # pixel columns per rendered digit
+IMG_H = 12         # pixel rows
+
+
+def make_glyphs(rng):
+    """A fixed random 'font': one (IMG_H, GLYPH_W) pattern per digit."""
+    return (rng.rand(N_DIGITS, IMG_H, GLYPH_W) > 0.5).astype(np.float32)
+
+
+def make_data(rng, glyphs, n, min_len, max_len):
+    """Render digit strings into (n, T, IMG_H) column-major strips padded
+    to the max width; labels padded with blank sentinel (=N_DIGITS)."""
+    max_t = max_len * GLYPH_W
+    X = np.zeros((n, max_t, IMG_H), np.float32)
+    Y = np.full((n, max_len), N_DIGITS, np.float32)   # pad = blank class
+    xlen = np.zeros((n,), np.float32)
+    ylen = np.zeros((n,), np.float32)
+    for i in range(n):
+        k = rng.randint(min_len, max_len + 1)
+        digits = rng.randint(0, N_DIGITS, k)
+        strip = np.concatenate([glyphs[d] for d in digits], axis=1)  # (H, k*W)
+        noisy = strip + 0.1 * rng.randn(*strip.shape)
+        X[i, :k * GLYPH_W] = noisy.T
+        Y[i, :k] = digits
+        xlen[i], ylen[i] = k * GLYPH_W, k
+    return X, Y, xlen, ylen
+
+
+def greedy_decode(logits, length):
+    """Collapse-repeats-then-drop-blank CTC decoding (blank = last)."""
+    best = logits[:int(length)].argmax(axis=-1)
+    out, prev = [], -1
+    for t in best:
+        if t != prev and t != N_DIGITS:
+            out.append(int(t))
+        prev = t
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--min-len", type=int, default=3)
+    ap.add_argument("--max-len", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--min-acc", type=float, default=0.5)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(args.seed)
+    glyphs = make_glyphs(rng)
+    Xtr, Ytr, xltr, yltr = make_data(rng, glyphs, 640, args.min_len,
+                                     args.max_len)
+    Xte, Yte, xlte, ylte = make_data(rng, glyphs, 160, args.min_len,
+                                     args.max_len)
+
+    class OCRNet(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.lstm = gluon.rnn.LSTM(args.hidden, layout="NTC",
+                                           bidirectional=True)
+                self.fc = gluon.nn.Dense(N_DIGITS + 1, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return self.fc(self.lstm(x))      # (B, T, 11), blank last
+
+    net = OCRNet()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    n = len(Xtr)
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n)
+        tot = 0.0
+        for s in range(0, n - args.batch_size + 1, args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            x, y = nd.array(Xtr[idx]), nd.array(Ytr[idx])
+            xl, yl = nd.array(xltr[idx]), nd.array(yltr[idx])
+            with autograd.record():
+                loss = ctc(net(x), y, xl, yl).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        print(f"epoch {epoch} ctc loss {tot / (n // args.batch_size):.3f}")
+
+    logits = net(nd.array(Xte)).asnumpy()
+    correct = sum(
+        greedy_decode(logits[i], xlte[i]) ==
+        [int(d) for d in Yte[i, :int(ylte[i])]]
+        for i in range(len(Xte)))
+    acc = correct / len(Xte)
+    print(f"sequence accuracy: {acc:.3f}")
+    assert acc >= args.min_acc, f"sequence accuracy {acc} < {args.min_acc}"
+    print("LSTM_OCR_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Fully convolutional semantic segmentation (reference
+example/fcn-xs/fcn_xs.py + symbol_fcnxs.py — FCN-32s/16s/8s: a conv
+backbone whose stride-accumulated features are upsampled back to pixel
+resolution with Deconvolution and trained with per-pixel softmax).
+
+Synthetic scenes contain axis-aligned rectangles of two object classes on
+a noisy background; the net downsamples 4x through the trunk, then a
+Conv2DTranspose chain (the fcn-xs 'upscore' layers) restores resolution,
+with a skip connection fusing the stride-2 feature map into the upsampled
+deep features — the FCN-16s trick. Scored by mean intersection-over-union,
+the segmentation literature's standard metric.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+N_CLASSES = 3      # background + 2 object classes
+IMG = 32
+
+
+def make_data(rng, n):
+    X = 0.2 * rng.randn(n, 3, IMG, IMG).astype(np.float32)
+    Y = np.zeros((n, IMG, IMG), np.float32)
+    for i in range(n):
+        for cls in (1, 2):
+            h, w = rng.randint(6, 14, 2)
+            r, c = rng.randint(0, IMG - h), rng.randint(0, IMG - w)
+            # each class paints a distinct channel signature
+            X[i, cls - 1, r:r + h, c:c + w] += 1.0
+            X[i, 2, r:r + h, c:c + w] += 0.5 if cls == 1 else -0.5
+            Y[i, r:r + h, c:c + w] = cls
+    return X, Y
+
+
+def mean_iou(pred, label):
+    ious = []
+    for c in range(N_CLASSES):
+        inter = np.logical_and(pred == c, label == c).sum()
+        union = np.logical_or(pred == c, label == c).sum()
+        if union:
+            ious.append(inter / union)
+    return float(np.mean(ious))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--min-iou", type=float, default=0.6)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(args.seed)
+    Xtr, Ytr = make_data(rng, 512)
+    Xte, Yte = make_data(rng, 128)
+
+    class FCN(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.down1 = gluon.nn.HybridSequential()   # stride 2
+                self.down1.add(
+                    gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+                    gluon.nn.Conv2D(16, 3, strides=2, padding=1,
+                                    activation="relu"))
+                self.down2 = gluon.nn.HybridSequential()   # stride 4
+                self.down2.add(
+                    gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+                    gluon.nn.Conv2D(32, 3, strides=2, padding=1,
+                                    activation="relu"))
+                # upscore layers (reference symbol_fcnxs.py Deconvolution)
+                self.up1 = gluon.nn.Conv2DTranspose(16, 4, strides=2,
+                                                    padding=1)
+                self.up2 = gluon.nn.Conv2DTranspose(16, 4, strides=2,
+                                                    padding=1)
+                self.skip = gluon.nn.Conv2D(16, 1)         # FCN-16s fuse
+                self.score = gluon.nn.Conv2D(N_CLASSES, 1)
+
+        def hybrid_forward(self, F, x):
+            f1 = self.down1(x)                 # (B,16,H/2,W/2)
+            f2 = self.down2(f1)                # (B,32,H/4,W/4)
+            u1 = F.relu(self.up1(f2) + self.skip(f1))
+            u2 = F.relu(self.up2(u1))          # (B,16,H,W)
+            return self.score(u2)              # (B,C,H,W)
+
+    net = FCN()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    sce = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    n = len(Xtr)
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n)
+        tot = 0.0
+        for s in range(0, n - args.batch_size + 1, args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            x, y = nd.array(Xtr[idx]), nd.array(Ytr[idx])
+            with autograd.record():
+                loss = sce(net(x), y).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        print(f"epoch {epoch} pixel loss {tot / (n // args.batch_size):.4f}")
+
+    pred = net(nd.array(Xte)).asnumpy().argmax(axis=1)
+    iou = mean_iou(pred, Yte)
+    print(f"mean IoU: {iou:.3f}")
+    assert iou > args.min_iou, f"mean IoU {iou} < {args.min_iou}"
+    print("FCN_XS_OK")
+
+
+if __name__ == "__main__":
+    main()

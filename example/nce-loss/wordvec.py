@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Word embeddings via noise-contrastive estimation (reference
+example/nce-loss/wordvec.py + nce.py — word2vec trained with NCE instead
+of a full-vocabulary softmax).
+
+Skip-gram with k negative samples per true (center, context) pair: the
+binary classifier score(w_c, w_o) = in_embed[w_c] . out_embed[w_o] + b
+must rank observed pairs above unigram-noise pairs — the full softmax
+never materializes (the whole point of NCE at large vocab). The synthetic
+corpus interleaves topic blocks, so words of one topic co-occur and their
+learned vectors must cluster.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_corpus(rng, n_topics, words_per_topic, length):
+    """Token stream of topic blocks: each block samples from ONE topic's
+    word set, so intra-topic co-occurrence dominates."""
+    stream = []
+    while len(stream) < length:
+        t = rng.randint(n_topics)
+        block = rng.randint(t * words_per_topic, (t + 1) * words_per_topic,
+                            rng.randint(8, 16))
+        stream.extend(block.tolist())
+    return np.array(stream[:length], np.int64)
+
+
+def make_pairs(rng, corpus, window, vocab, k_neg, n_pairs):
+    """(center, target, label) triples: one true context + k noise words
+    drawn from the unigram distribution (here uniform)."""
+    centers = np.zeros((n_pairs, 1 + k_neg), np.float32)
+    targets = np.zeros((n_pairs, 1 + k_neg), np.float32)
+    labels = np.zeros((n_pairs, 1 + k_neg), np.float32)
+    for i in range(n_pairs):
+        c = rng.randint(window, len(corpus) - window)
+        off = rng.randint(1, window + 1) * rng.choice([-1, 1])
+        centers[i, :] = corpus[c]
+        targets[i, 0] = corpus[c + off]
+        labels[i, 0] = 1.0
+        targets[i, 1:] = rng.randint(0, vocab, k_neg)
+    return centers, targets, labels
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--topics", type=int, default=4)
+    ap.add_argument("--words-per-topic", type=int, default=16)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--window", type=int, default=2)
+    ap.add_argument("--k-neg", type=int, default=5)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    vocab = args.topics * args.words_per_topic
+    rng = np.random.RandomState(args.seed)
+    corpus = make_corpus(rng, args.topics, args.words_per_topic, 20000)
+    C, T, L = make_pairs(rng, corpus, args.window, vocab, args.k_neg, 8192)
+
+    class NCEModel(gluon.nn.HybridBlock):
+        """in/out embedding tables + per-word output bias; the forward
+        scores a (B, 1+k) slate of candidate targets per center."""
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed_in = gluon.nn.Embedding(vocab, args.dim)
+                self.embed_out = gluon.nn.Embedding(vocab, args.dim)
+                self.bias = gluon.nn.Embedding(vocab, 1)
+
+        def hybrid_forward(self, F, center, target):
+            vi = self.embed_in(center)              # (B, 1+k, D)
+            vo = self.embed_out(target)             # (B, 1+k, D)
+            b = self.bias(target).reshape((0, -1))  # (B, 1+k)
+            return F.sum(vi * vo, axis=-1) + b      # logits
+
+    net = NCEModel()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    n = len(C)
+    first_loss = last_loss = None
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n)
+        tot, nb = 0.0, 0
+        for s in range(0, n - args.batch_size + 1, args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            c, t = nd.array(C[idx]), nd.array(T[idx])
+            y = nd.array(L[idx])
+            with autograd.record():
+                loss = bce(net(c, t), y).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+            nb += 1
+        avg = tot / nb
+        if first_loss is None:
+            first_loss = avg
+        last_loss = avg
+        print(f"epoch {epoch} nce loss {avg:.4f}")
+
+    # embeddings must cluster by topic: mean intra-topic cosine similarity
+    # should dominate inter-topic
+    W = net.embed_in.weight.data().asnumpy()
+    W = W / (np.linalg.norm(W, axis=1, keepdims=True) + 1e-8)
+    sim = W @ W.T
+    wpt = args.words_per_topic
+    intra, inter, cnt_a, cnt_e = 0.0, 0.0, 0, 0
+    for i in range(vocab):
+        for j in range(i + 1, vocab):
+            if i // wpt == j // wpt:
+                intra += sim[i, j]; cnt_a += 1
+            else:
+                inter += sim[i, j]; cnt_e += 1
+    intra, inter = intra / cnt_a, inter / cnt_e
+    print(f"loss first {first_loss:.4f} last {last_loss:.4f}; "
+          f"cosine intra-topic {intra:.3f} vs inter-topic {inter:.3f}")
+    assert last_loss < first_loss * 0.8, (first_loss, last_loss)
+    assert intra > inter + 0.1, (intra, inter)
+    print("NCE_OK")
+
+
+if __name__ == "__main__":
+    main()

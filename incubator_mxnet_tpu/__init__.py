@@ -25,6 +25,17 @@ def _configure_jax():
     # the idiomatic TPU way to use the MXU and is unaffected by this setting.
     import os
     import jax
+    # Honor JAX_PLATFORMS even when a site plugin (the axon TPU tunnel)
+    # re-registered itself as the forced platform at interpreter startup:
+    # without this, JAX_PLATFORMS=cpu processes still try to initialize
+    # the tunnel backend and HANG when it is unreachable — observed as
+    # example/test subprocess timeouts on a machine with a dead tunnel.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
     jax.config.update("jax_default_matmul_precision", "highest")
     # Persistent XLA compilation cache: eager mode compiles one executable per
     # (op, shape) like the reference's cudnn autotune cache persists algo

@@ -41,6 +41,7 @@ class Trainer:
         self._kvstore = None
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore
+        self._update_on_kv = False
         self._states_to_load = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
@@ -73,6 +74,20 @@ class Trainer:
             for i, p in enumerate(self._params):
                 if p.grad_req != "null" and p._data is not None:
                     self._kvstore.init(i, p.data())
+        # async mode trains update-on-kvstore: the server applies the
+        # optimizer per push and pulls return authoritative weights —
+        # a local pushpull/update split would silently drop other
+        # workers' gradients (reference trainer.py:169 forces
+        # update_on_kvstore for dist_async and sends the optimizer)
+        self._update_on_kv = (
+            self._kvstore is not None
+            and getattr(self._kvstore, "_async_client", None) is not None)
+        if self._update_on_kv:
+            if self._update_on_kvstore is False:
+                raise MXNetError(
+                    "update_on_kvstore=False is invalid with dist_async "
+                    "(updates happen on the parameter server)")
+            self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = True
 
     @property
@@ -89,13 +104,28 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce (if distributed) + optimizer update
         (reference trainer.py step)."""
+        # rescale BEFORE the first _init_kvstore so an async server
+        # receives the optimizer with the correct rescale_grad baked in
+        # (the reference shares this init-time capture)
+        self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        if self._update_on_kv:
+            # server applies the optimizer on push; pull returns the
+            # authoritative weights
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.pushpull(i, p.grad(), out=p.data())
+            return
         self.allreduce_grads()
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
+        if self._update_on_kv:
+            raise MXNetError(
+                "allreduce_grads() is meaningless when updates happen on "
+                "the kvstore server (dist_async): a push would already "
+                "apply an optimizer step; use step()")
         if self._kvstore is not None:
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
@@ -111,9 +141,12 @@ class Trainer:
                         self._kvstore.pushpull(i, g, out=g)
 
     def update(self, batch_size, ignore_stale_grad=False):
+        self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        if self._update_on_kv:
+            raise MXNetError("update() cannot run locally when updates "
+                             "happen on the kvstore server; use step()")
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
@@ -128,10 +161,22 @@ class Trainer:
             updater(i, p.grad(), p.data())
 
     def save_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()   # decide update-on-kvstore BEFORE
+            #                        choosing where states live (reference
+            #                        trainer does the same)
+        if getattr(self, "_update_on_kv", False):
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+            return
         with open(fname, "wb") as f:
             f.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if getattr(self, "_update_on_kv", False):
+            self._kvstore.load_optimizer_states(fname)
+            return
         with open(fname, "rb") as f:
             self._updaters[0].set_states(f.read())
         self._optimizer = self._updaters[0].optimizer

@@ -19,8 +19,13 @@ lowers reductions to ICI collectives. So:
   kvstore='tpu' north star of BASELINE.json. rank/num_workers come from the
   jax distributed runtime (process_index/process_count), so the same code
   is correct on a multi-host pod.
-- ``dist_async`` maps to the same sync collectives (documented non-goal:
-  TPU SPMD has no unsynchronized server mode).
+- ``dist_async``: TRUE asynchronous parameter server (kvstore_server.py)
+  once multiple OS processes exist: a host-side server thread on rank 0
+  applies the updater to every incoming push immediately with NO worker
+  barrier, and pulls return the latest weights — the reference's
+  AsyncDefault semantics (src/kvstore/kvstore_dist_server.h:346-358),
+  stale gradients and all. Single-process dist_async degenerates to the
+  local store, whose per-push updater application is already async-shaped.
 
 Push/updater semantics follow the reference exactly: push merges (sums) the
 value list; with an updater set (set_optimizer / _set_updater) the merged
@@ -140,6 +145,8 @@ class KVStore:
     which are reduced on push — the multi-device gradient case).
     """
 
+    _async_gen_counter = 0
+
     def __init__(self, kv_type="local", mesh=None):
         import jax
 
@@ -156,6 +163,41 @@ class KVStore:
         self._bigarray_bound = int(_os.environ.get(
             "MXNET_KVSTORE_BIGARRAY_BOUND", 1000 * 1000))
         self._wire_stats = {"whole": 0, "sharded": 0, "packed": 0}
+        self._async_client = None
+        self._async_gen = None
+        if kv_type == "dist_async" and jax.process_count() > 1:
+            # store GENERATION: creation index counted over multi-process
+            # dist_async stores ONLY (they are created collectively — same
+            # count/order on every process, the reference's dist protocol
+            # — so the index agrees cluster-wide; counting other kvstore
+            # types would desynchronize ranks that create extra local
+            # stores). It namespaces this store's keys/optimizer on the
+            # shared rank-0 server, so a second training run in the same
+            # cluster cannot inherit the first's weights.
+            self._async_gen = KVStore._async_gen_counter
+            KVStore._async_gen_counter += 1
+            # true async mode: host-side parameter server on rank 0, addr
+            # exchanged through the coordination service (the reference's
+            # scheduler role in ps-lite's rendezvous)
+            c = self._dist_client()
+            if c is None:
+                raise MXNetError(
+                    "dist_async with multiple processes requires the jax "
+                    "distributed runtime (jax.distributed.initialize)")
+            from . import kvstore_server as _ksrv
+            # the key is namespaced by generation so the insert-only
+            # coordination-service fallback (no allow_overwrite kwarg)
+            # still works for a SECOND store in the same cluster
+            addr_key = f"mxtpu_async_ps/addr/{self._async_gen}"
+            if jax.process_index() == 0:
+                addr = _ksrv.start_async_server()
+                try:
+                    c.key_value_set(addr_key, addr, allow_overwrite=True)
+                except TypeError:
+                    c.key_value_set(addr_key, addr)
+            else:
+                addr = c.blocking_key_value_get(addr_key, 120_000)
+            self._async_client = _ksrv.connect_async_server(addr)
         if kv_type in _TPU_TYPES and mesh is None:
             # one flat axis over every visible device; callers doing real
             # tp/sp pass their own mesh
@@ -308,6 +350,14 @@ class KVStore:
                     f"init value for key {k!r} must be a single array "
                     "(value lists are a push-time aggregation form)")
             arr = v._data if isinstance(v, NDArray) else v
+            if self._async_client is not None:
+                import jax
+                import numpy as _onp
+                self._async_client.call(
+                    "init", self._async_gen, k,
+                    _onp.asarray(jax.device_get(arr)))
+                self._store[k] = NDArray(arr)   # local bookkeeping copy
+                continue
             self._store[k] = NDArray(self._replicate(arr))
 
     def push(self, key, value, priority=0):
@@ -321,6 +371,18 @@ class KVStore:
                 raise MXNetError(f"key {k!r} not initialized")
             merged = self._merge(k, v)
             import jax
+            if self._async_client is not None:
+                # async push: locally-merged gradient goes straight to the
+                # server, which updates NOW — no collective, no barrier,
+                # no waiting for other workers (AsyncDefault,
+                # kvstore_dist_server.h:346). The reply is the server's
+                # global push count — free staleness telemetry.
+                import numpy as _onp
+                self._heartbeat()
+                self._async_client.call(
+                    "push", self._async_gen, k,
+                    _onp.asarray(jax.device_get(merged)), self.rank)
+                continue
             if self._mesh is not None and jax.process_count() > 1:
                 self._heartbeat()
                 # dist_sync aggregation: SUM over workers (reference
@@ -352,6 +414,12 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k!r} not initialized")
             tgts = o if isinstance(o, (list, tuple)) else [o]
+            if self._async_client is not None:
+                # async pull: whatever the server's weights are RIGHT NOW
+                # (other workers' pushes may land between two pulls)
+                latest = jax.numpy.asarray(
+                    self._async_client.call("pull", self._async_gen, k))
+                self._store[k]._data = latest
             for t in tgts:
                 val = self._store[k]._data
                 # land on the out array's own devices (reference pull copies
@@ -392,6 +460,10 @@ class KVStore:
         for k, o, r in zip(keys, outs, rids):
             if k not in self._store:
                 raise MXNetError(f"key {k!r} not initialized")
+            if self._async_client is not None:
+                import jax
+                self._store[k]._data = jax.numpy.asarray(
+                    self._async_client.call("pull", self._async_gen, k))
             ridx = r._data if isinstance(r, NDArray) else r
             o._data = self._store[k]._data[ridx.astype("int32")]
 
@@ -411,6 +483,13 @@ class KVStore:
         else:
             for v in self._store.values():
                 v._data.block_until_ready()
+
+    def server_stats(self):
+        """Async-server push counts {rank: n_pushes} — observable proof
+        that workers proceed unbarriered (empty outside async mode)."""
+        if self._async_client is None:
+            return {}
+        return self._async_client.call("stats", self._async_gen)
 
     # -- liveness (reference ps-lite heartbeats, kvstore_dist.h:121) -------
     @staticmethod
@@ -489,13 +568,44 @@ class KVStore:
     # -- optimizer-on-store ------------------------------------------------
     def set_optimizer(self, optimizer):
         """Run this optimizer inside the store on every push (reference
-        kvstore.py:450 — serialized to dist servers; here the 'server' is the
-        process itself, the TPU pod has no parameter-server role)."""
+        kvstore.py:450). In multi-process dist_async the optimizer is
+        SERIALIZED TO THE SERVER — exactly the reference's
+        _send_command_to_servers(kController, pickled optimizer) — and
+        updates run server-side per push; otherwise the 'server' is the
+        process itself."""
         from . import optimizer as opt
         self._optimizer = optimizer
+        if self._async_client is not None:
+            # rank 0 installs (reference gates _send_command_to_servers on
+            # rank 0); the barrier guarantees no worker's later pushes can
+            # race ahead of the updater installation (which would silently
+            # fall back to replace-mode)
+            if self.rank == 0:
+                # strip param_dict for the wire: Trainer attaches the
+                # LIVE Parameters (full device weights) there, which the
+                # server's updater doesn't need — and non-addressable
+                # multi-host arrays wouldn't pickle at all
+                saved_pd = getattr(optimizer, "param_dict", None)
+                if saved_pd is not None:
+                    optimizer.param_dict = {}
+                try:
+                    payload = pickle.dumps(
+                        optimizer, protocol=pickle.HIGHEST_PROTOCOL)
+                finally:
+                    if saved_pd is not None:
+                        optimizer.param_dict = saved_pd
+                self._async_client.call("set_optimizer", self._async_gen,
+                                        payload)
+            self.barrier()
+            return
         self._updater = opt.get_updater(optimizer)
 
     def _set_updater(self, updater):
+        if self._async_client is not None:
+            raise MXNetError(
+                "dist_async runs updates on the parameter server; a raw "
+                "updater callable cannot be serialized there — use "
+                "set_optimizer(...) instead")
         self._updater = updater
 
     def _updater_key(self, key):
@@ -517,12 +627,28 @@ class KVStore:
         self._compression = params
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._async_client is not None:
+            # the optimizer state lives ON THE SERVER in async mode
+            states = self._async_client.call("get_states", self._async_gen,
+                                             dump_optimizer)
+            with open(fname, "wb") as f:
+                f.write(states)
+            return
         if self._updater is None:
             raise MXNetError("no optimizer set")
         with open(fname, "wb") as f:
             f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
 
     def load_optimizer_states(self, fname):
+        if self._async_client is not None:
+            if self.rank == 0:      # one installer, same gate as
+                #                     set_optimizer — and only rank 0's
+                #                     host needs to have the file at all
+                with open(fname, "rb") as f:
+                    self._async_client.call("set_states", self._async_gen,
+                                            f.read())
+            self.barrier()
+            return
         if self._updater is None:
             raise MXNetError("no optimizer set")
         with open(fname, "rb") as f:
@@ -538,13 +664,4 @@ def create(name="local", mesh=None):
     name = name.lower()
     if name not in ("local", "device") + _TPU_TYPES:
         raise MXNetError(f"unknown kvstore type {name!r}")
-    if name == "dist_async":
-        import warnings
-        warnings.warn(
-            "kvstore 'dist_async' runs with SYNCHRONOUS collectives on "
-            "this backend: there is no parameter-server process to apply "
-            "per-push updates without a barrier (reference "
-            "kvstore_dist_server.h:348 AsyncDefault). Convergence behavior "
-            "matches dist_sync, not the reference's async mode.",
-            stacklevel=2)
     return KVStore(name, mesh=mesh)

@@ -1,0 +1,294 @@
+"""Host-side asynchronous parameter server for kvstore type ``dist_async``.
+
+Reference: src/kvstore/kvstore_dist_server.h — a ZeroMQ/ps-lite server
+process that owns the weights and, in async mode (AsyncDefault,
+kvstore_dist_server.h:346-358), applies the updater to EVERY incoming
+gradient immediately, with no per-key barrier across workers: workers run
+free, gradients may be stale, pulls return whatever the weights are now.
+
+TPU-native placement: the synchronous path needs no server at all (XLA
+collectives over ICI — kvstore.py), but genuine async semantics cannot be
+expressed as an SPMD collective, so this module re-creates the reference's
+*host-side* control plane: a socket server thread living in the rank-0
+process (servers and workers co-locate, like the reference's
+``tools/launch.py`` single-machine mode), length-prefixed-pickle protocol,
+one handler thread per worker connection, updates serialized by a lock (the
+reference's per-key request queue). The device never blocks on this path —
+gradients arrive as host numpy buffers, exactly like ps-lite's CPU-side
+KVServer.
+
+Wire ops (reference message vocabulary, kvstore_dist_server.h DataHandleEx):
+  init            — store an initial weight, first writer wins
+  push            — apply updater(key, grad, weight) NOW; returns the
+                    server's total push count (per-rank counts observable
+                    via ``stats`` — used by tests to prove workers run
+                    unbarriered)
+  pull            — return the latest weight bytes
+  set_optimizer   — install a pickled Optimizer server-side (the reference
+                    sends the serialized optimizer to servers,
+                    python/mxnet/kvstore.py:450 _send_command_to_servers)
+  stats / stop    — introspection / shutdown
+"""
+from __future__ import annotations
+
+import hmac
+import pickle
+import secrets
+import socket
+import struct
+import threading
+
+from .base import MXNetError
+
+__all__ = ["AsyncServer", "AsyncClient", "start_async_server",
+           "connect_async_server"]
+
+_HDR = struct.Struct("<Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _host_ip():
+    """Routable address of this host for the published server endpoint
+    (UDP-connect trick; falls back to loopback for single-machine runs)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+class AsyncServer:
+    """The parameter-server role (reference KVStoreDistServer, async mode)."""
+
+    def __init__(self):
+        # every mapping is keyed by (gen, ...): `gen` is the client-side
+        # store generation, so a SECOND dist_async KVStore created in the
+        # same cluster gets fresh weights/optimizer instead of silently
+        # inheriting the previous store's converged state
+        self._store = {}            # (gen, key) -> NDArray weight
+        self._updaters = {}         # gen -> Updater
+        self._lock = threading.Lock()   # serializes updates, like the
+        #                                 reference's executor queue
+        self._push_counts = {}      # (gen, rank) -> pushes handled
+        self._stopped = threading.Event()
+        self._sock = None
+        self._threads = []
+        # per-cluster shared secret: the wire is pickle, so an
+        # unauthenticated peer could execute arbitrary code — every
+        # connection must present this token (distributed to workers
+        # through the jax coordination service, which is already the
+        # cluster trust boundary) BEFORE any frame is unpickled
+        self.token = secrets.token_hex(16)
+
+    # -- request handling --------------------------------------------------
+    def _handle(self, msg):
+        from .ndarray.ndarray import NDArray
+        import jax.numpy as jnp
+
+        op = msg[0]
+        if op == "init":
+            _, gen, key, val = msg
+            with self._lock:
+                # first writer wins WITHIN a generation (every worker
+                # inits the same values, reference kvstore_dist.h Init)
+                self._store.setdefault((gen, key), NDArray(jnp.asarray(val)))
+            return ("ok",)
+        if op == "push":
+            _, gen, key, grad, rank = msg
+            with self._lock:
+                if (gen, key) not in self._store:
+                    return ("err", f"key {key!r} not initialized")
+                stored = self._store[(gen, key)]
+                updater = self._updaters.get(gen)
+                if updater is not None:
+                    # THE async semantics: one update per incoming push,
+                    # no cross-worker aggregation or barrier
+                    # (kvstore_dist_server.h:346 AsyncDefault)
+                    updater(_updater_key(key),
+                            NDArray(jnp.asarray(grad)), stored)
+                else:
+                    # no optimizer installed: replace, the reference
+                    # server's CopyFromTo default
+                    stored._data = jnp.asarray(grad).astype(stored.dtype)
+                ck = (gen, rank)
+                self._push_counts[ck] = self._push_counts.get(ck, 0) + 1
+                total = sum(n for (g, _), n in self._push_counts.items()
+                            if g == gen)
+            return ("ok", total)
+        if op == "pull":
+            _, gen, key = msg
+            with self._lock:
+                if (gen, key) not in self._store:
+                    return ("err", f"key {key!r} not initialized")
+                import numpy as np
+                return ("ok", np.asarray(self._store[(gen, key)].asnumpy()))
+        if op == "set_optimizer":
+            _, gen, opt_bytes = msg
+            from . import optimizer as opt
+            optimizer = pickle.loads(opt_bytes)
+            with self._lock:
+                if gen in self._updaters:
+                    # a second installer (late worker / restart) must not
+                    # wipe accumulated momentum/variance state mid-run
+                    return ("ok",)
+                self._updaters[gen] = opt.get_updater(optimizer)
+            return ("ok",)
+        if op == "stats":
+            _, gen = msg
+            with self._lock:
+                return ("ok", {r: n for (g, r), n in
+                               self._push_counts.items() if g == gen})
+        if op == "get_states":
+            _, gen, dump_optimizer = msg
+            with self._lock:
+                updater = self._updaters.get(gen)
+                if updater is None:
+                    return ("err", "no optimizer set")
+                return ("ok",
+                        updater.get_states(dump_optimizer=dump_optimizer))
+        if op == "set_states":
+            _, gen, states = msg
+            with self._lock:
+                updater = self._updaters.get(gen)
+                if updater is None:
+                    return ("err", "no optimizer set")
+                updater.set_states(states)
+            return ("ok",)
+        if op == "stop":
+            self._stopped.set()
+            return ("ok",)
+        return ("err", f"unknown op {op!r}")
+
+    # -- socket plumbing ---------------------------------------------------
+    def _client_loop(self, conn):
+        try:
+            # auth handshake first, as RAW BYTES (never unpickle from an
+            # unauthenticated peer): exactly 32 hex chars, constant-time
+            # compare, silent close on mismatch
+            try:
+                presented = _recv_exact(conn, len(self.token))
+            except (ConnectionError, OSError):
+                return
+            if not hmac.compare_digest(presented, self.token.encode()):
+                return
+            while not self._stopped.is_set():
+                try:
+                    msg = _recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:          # report, don't kill server
+                    reply = ("err", repr(e))
+                try:
+                    _send_msg(conn, reply)
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            conn.close()
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._client_loop, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def start(self):
+        """Bind, start the accept thread, return the advertised addr."""
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(64)
+        port = self._sock.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return f"{_host_ip()}:{port}"
+
+    def stop(self):
+        self._stopped.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+def _updater_key(key):
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        return key
+
+
+class AsyncClient:
+    """Worker-side connection to the async server (reference KVWorker)."""
+
+    def __init__(self, addr, token):
+        host, port = addr.rsplit(":", 1)
+        self._lock = threading.Lock()
+        self._sock = socket.create_connection((host, int(port)), timeout=120)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.sendall(token.encode())   # auth before first frame
+
+    def call(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply[0] != "ok":
+            raise MXNetError(f"async kvstore server: {reply[1]}")
+        return reply[1] if len(reply) > 1 else None
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+_SERVER_SINGLETON = {}
+
+
+def start_async_server():
+    """Start (once per process) the rank-0 server; returns "addr token"
+    (one string so it travels as a single coordination-service value)."""
+    if "server" not in _SERVER_SINGLETON:
+        srv = AsyncServer()
+        _SERVER_SINGLETON["server"] = srv
+        _SERVER_SINGLETON["addr"] = srv.start()
+    srv = _SERVER_SINGLETON["server"]
+    return f"{_SERVER_SINGLETON['addr']} {srv.token}"
+
+
+def connect_async_server(addr_token):
+    addr, token = addr_token.split(" ", 1)
+    return AsyncClient(addr, token)

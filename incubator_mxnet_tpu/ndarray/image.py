@@ -16,10 +16,13 @@ __all__ = ["to_tensor", "normalize", "resize", "crop", "flip_left_right",
 
 
 def __getattr__(name):
+    # only the _image_ op family resolves here (reference image.py is
+    # generated solely from _image_-prefixed registrations) — falling
+    # through to the full registry would expose e.g. nd.image.relu
     opdef = None
     if f"_image_{name}" in _registry.OPS:
         opdef = _registry.OPS.get(f"_image_{name}")
-    elif name in _registry.OPS:
+    elif name in __all__ and name in _registry.OPS:
         opdef = _registry.OPS.get(name)
     if opdef is not None:
         # parent package is fully initialized by the time an attribute

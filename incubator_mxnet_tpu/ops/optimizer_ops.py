@@ -321,10 +321,16 @@ def sparse_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7,
     AdagradDnsRspDnsKernel): h += g^2; w -= lr * g / sqrt(h + eps).
     The reference only registers the row_sparse-gradient form; the dense
     form here touches every row, which is identical when the gradient
-    covers all rows (and the Optimizer layer handles lazy sparse skips)."""
+    covers all rows (and the Optimizer layer handles lazy sparse skips).
+    The reference op has NO weight-decay parameter (its AdagradParam
+    checks `wd == 0`); accept the keyword for call-site compatibility but
+    reject nonzero values the same way."""
+    if wd:
+        raise ValueError("sparse_adagrad_update: wd must be 0 (the "
+                         "reference op rejects nonzero wd; apply decay "
+                         "at the Optimizer layer instead)")
     g = grad * rescale_grad
     if clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    g = g + wd * weight
     h = history + jnp.square(g)
     return (weight - lr * g / jnp.sqrt(h + epsilon), h)

@@ -220,7 +220,9 @@ def quantized_pooling(data, min_range, max_range, *, kernel=(), stride=(),
 @register(name="_contrib_quantized_flatten", aliases=("quantized_flatten",),
           nondiff=True)
 def quantized_flatten(data, min_range, max_range):
-    return (jnp.reshape(data, (data.shape[0], -1)), min_range, max_range)
+    import math
+    tail = math.prod(data.shape[1:])   # explicit: -1 breaks on 0-size batch
+    return (jnp.reshape(data, (data.shape[0], tail)), min_range, max_range)
 
 
 @register(name="_contrib_quantized_act", aliases=("quantized_act",),

@@ -65,7 +65,9 @@ def _scan_layer(mode, xs, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse=False,
     xs: [T, N, in]; returns (out [T, N, H], h_T, c_T)."""
     T, N = xs.shape[0], xs.shape[1]
     # hoist the input projection out of the scan: one MXU-sized matmul
-    x_proj = (xs.reshape(T * N, -1) @ w_ih.T + b_ih).reshape(T, N, -1)
+    # explicit sizes, not -1: inference divides by T*N, breaking N=0 batches
+    x_proj = (xs.reshape(T * N, xs.shape[2]) @ w_ih.T
+              + b_ih).reshape(T, N, w_ih.shape[0])
     if reverse:
         x_proj = jnp.flip(x_proj, axis=0)
 

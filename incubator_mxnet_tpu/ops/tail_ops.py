@@ -152,14 +152,17 @@ def arange_like(data, *, start=0.0, step=1.0, repeat=1, axis=None, ctx=None):
     """Reference src/operator/contrib/../tensor/init_op.cc:104
     _contrib_arange_like: arange shaped like `data` (flat, or along one
     axis)."""
+    # RangeCompute semantics (reference init_op.h:518): out[i] = start +
+    # (i // repeat) * step over EXACTLY n elements — a jnp.repeat of
+    # arange(n // repeat) would truncate when repeat doesn't divide n
     if axis is None:
         n = data.size
-        out = start + step * jnp.repeat(jnp.arange(n // repeat,
-                                                   dtype=jnp.float32), repeat)
+        out = start + step * (jnp.arange(n, dtype=jnp.float32) // repeat)
         return out.reshape(data.shape).astype(data.dtype)
     ax = axis % data.ndim
     n = data.shape[ax]
-    return (start + step * jnp.arange(n, dtype=jnp.float32)).astype(data.dtype)
+    out = start + step * (jnp.arange(n, dtype=jnp.float32) // repeat)
+    return out.astype(data.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -297,7 +300,11 @@ def bipartite_matching(data, *, threshold, is_ascend=False, topk=-1):
             do = jnp.logical_and(jnp.logical_and(free, good),
                                  jnp.logical_not(stop))
             if topk > 0:
-                do = jnp.logical_and(do, count < topk)
+                # reference quirk (bounding_box-inl.h): it marks the pair
+                # FIRST and then breaks when ++count > topk, so up to
+                # topk+1 pairs get marked — count may reach topk before
+                # the mark that trips the break
+                do = jnp.logical_and(do, count <= topk)
             rmark = jnp.where(do, rmark.at[r].set(c), rmark)
             cmark = jnp.where(do, cmark.at[c].set(r), cmark)
             count = count + do.astype(jnp.int32)

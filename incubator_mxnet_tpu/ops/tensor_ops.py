@@ -697,7 +697,7 @@ def argsort(data, *, axis=-1, is_ascend=True, dtype="float32"):
     return out.astype(dtype_np(dtype))
 
 
-@register(name="topk", nondiff=True)
+@register(name="topk")
 def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
     """Reference src/operator/tensor/ordering_op.cc TopK. On TPU the descending
     case lowers to lax.top_k (sorted on the MXU-adjacent VPU)."""

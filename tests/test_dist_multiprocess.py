@@ -198,6 +198,90 @@ def test_dist_worker_death_detected(tmp_path):
     assert "KILLTEST_OK" in out0
 
 
+# TRUE async mode: host-side parameter server on rank 0 applies every push
+# immediately (reference kvstore_dist_server.h:346 AsyncDefault). Workers
+# run DIFFERENT step counts at different paces with no barrier until the
+# final rendezvous; the slow worker observes the fast workers' push counts
+# running ahead mid-run (divergence proof), and async SGD on a quadratic
+# still converges to the target despite stale gradients.
+WORKER_ASYNC = textwrap.dedent("""
+    import os, sys, time
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                               num_processes=nproc, process_id=pid)
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_async")
+    assert kv.num_workers == nproc
+    target = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+    out = mx.nd.zeros((4,))
+
+    steps = 30 + 25 * pid        # deliberately different workloads
+    diverged = False
+    for i in range(steps):
+        kv.pull("w", out=out)                 # latest weights, no barrier
+        grad = 2.0 * (out.asnumpy() - target)
+        kv.push("w", mx.nd.array(grad))       # applied server-side NOW
+        if pid == 0:
+            time.sleep(0.02)                  # the slow worker
+            if i >= 5 and not diverged:
+                counts = kv.server_stats()
+                mine = counts.get(0, 0)
+                fastest = max(counts.values())
+                if mine > 0 and fastest > mine + 2:
+                    diverged = True
+    if pid == 0:
+        assert diverged, "push counts never diverged: workers look barriered"
+        sys.stdout.write("ASYNC_DIVERGED\\n")
+    kv.barrier()                 # ONLY sync point: all pushes have landed
+    kv.pull("w", out=out)
+    err = float(np.abs(out.asnumpy() - target).max())
+    assert err < 0.05, f"async SGD failed to converge: err={{err}}"
+    counts = kv.server_stats()
+    assert sum(counts.values()) == sum(30 + 25 * r for r in range(nproc)), \\
+        f"push count mismatch: {{counts}}"
+
+    # phase 2: the SAME semantics through gluon Trainer (update-on-kvstore:
+    # server optimizer, push grad / pull weight, a SECOND store generation)
+    from incubator_mxnet_tpu import autograd, gluon
+    net = gluon.nn.Dense(1, use_bias=False, in_units=1)
+    net.initialize(mx.init.Constant(0.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {{"learning_rate": 0.05}}, kvstore="dist_async")
+    rng = np.random.RandomState(100 + pid)
+    for i in range(40 + 15 * pid):       # again: unequal workloads
+        x = mx.nd.array(rng.rand(8, 1).astype(np.float32))
+        y = 3.0 * x
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(1)
+    kv2 = trainer._kvstore
+    kv2.barrier()
+    w_srv = np.asarray(kv2._async_client.call("pull", kv2._async_gen, 0))
+    assert abs(float(w_srv.reshape(-1)[0]) - 3.0) < 0.2, w_srv
+    sys.stdout.write("ASYNC_OK_%d\\n" % pid)
+    sys.stdout.flush()
+""")
+
+
+@pytest.mark.timeout(300)
+def test_dist_async_parameter_server(tmp_path):
+    outs = _launch(tmp_path, WORKER_ASYNC.format(repo=REPO), 4)
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"async worker {i} failed:\n{err[-2000:]}"
+        assert f"ASYNC_OK_{i}" in out
+    assert "ASYNC_DIVERGED" in outs[0][1]
+
+
 # preemption e2e: dist workers are SIGTERM'd mid-training, checkpoint via
 # fault.PreemptionHandler, and a relaunch resumes from the manifest and
 # finishes with the SAME parameters an uninterrupted run produces

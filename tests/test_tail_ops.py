@@ -97,6 +97,31 @@ def test_linspace_zeros_arange_like():
                           np.arange(4, dtype=np.float32))
     rep = nd.arange_like(nd.zeros((6,)), repeat=2).asnumpy()
     assert np.array_equal(rep, np.array([0, 0, 1, 1, 2, 2], np.float32))
+    # repeat applies on the axis path too (reference RangeCompute)
+    repax = nd.arange_like(nd.zeros((3, 6)), axis=1, repeat=2).asnumpy()
+    assert np.array_equal(repax, np.array([0, 0, 1, 1, 2, 2], np.float32))
+    # non-divisible repeat keeps exactly n elements (init_op.h:518 does
+    # i // repeat, never truncates)
+    odd = nd.arange_like(nd.zeros((3, 5)), axis=1, repeat=2).asnumpy()
+    assert np.array_equal(odd, np.array([0, 0, 1, 1, 2], np.float32))
+    oddf = nd.arange_like(nd.zeros((5,)), repeat=2).asnumpy()
+    assert np.array_equal(oddf, np.array([0, 0, 1, 1, 2], np.float32))
+
+
+def test_image_namespace_restricted_to_image_ops():
+    import pytest
+    with pytest.raises(AttributeError):
+        nd.image.relu  # full-registry ops must NOT leak into nd.image
+    assert nd.image.to_tensor is not None
+
+
+def test_sparse_adagrad_rejects_wd():
+    import pytest
+    w, g, h = nd.ones((3,)), nd.ones((3,)), nd.zeros((3,))
+    with pytest.raises(ValueError):
+        nd._sparse_adagrad_update(w, g, h, lr=0.1, wd=0.01)
+    out_w, out_h = nd._sparse_adagrad_update(w, g, h, lr=0.1)
+    assert np.allclose(out_h.asnumpy(), 1.0)
 
 
 class TestLinalgTail:
@@ -173,7 +198,9 @@ def test_bipartite_matching_against_oracle():
                 rm[r] = c
                 cm[c] = r
                 cnt += 1
-                if 0 < topk < cnt + 1:
+                # reference quirk (bounding_box-inl.h:705): post-increment
+                # then `count > topk`, so up to topk+1 pairs are marked
+                if 0 < topk < cnt:
                     break
         return rm, cm
 
