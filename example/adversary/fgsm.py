@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Adversarial examples via FGSM (reference example/adversary/adversary_generation.ipynb
+— fast gradient sign method on an MNIST classifier).
+
+Train a small convnet on synthetic glyph digits, then take the gradient
+of the loss WITH RESPECT TO THE INPUT IMAGE (autograd through a frozen
+net into pixels), perturb by eps*sign(grad), and measure the accuracy
+collapse; finally adversarially fine-tune on the perturbed batch and
+show robustness recovering — the full classic demonstration.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+N_CLASSES = 10
+IMG = 16
+
+
+def make_data(rng, glyphs, n):
+    y = rng.randint(0, N_CLASSES, n)
+    X = glyphs[y] + 0.35 * rng.randn(n, 1, IMG, IMG).astype(np.float32)
+    return np.clip(X, 0, 1).astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--adv-epochs", type=int, default=7)
+    ap.add_argument("--eps", type=float, default=0.32)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(args.seed)
+    glyphs = (rng.rand(N_CLASSES, 1, IMG, IMG) > 0.55).astype(np.float32)
+    Xtr, ytr = make_data(rng, glyphs, 1024)
+    Xte, yte = make_data(rng, glyphs, 256)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(N_CLASSES))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    def train_on(X, y, epochs):
+        n = len(X)
+        for _ in range(epochs):
+            perm = rng.permutation(n)
+            for s in range(0, n - args.batch_size + 1, args.batch_size):
+                idx = perm[s:s + args.batch_size]
+                with autograd.record():
+                    loss = sce(net(nd.array(X[idx])),
+                               nd.array(y[idx])).mean()
+                loss.backward()
+                trainer.step(1)
+
+    def accuracy(X, y):
+        return float((net(nd.array(X)).asnumpy().argmax(1) == y).mean())
+
+    def fgsm(X, y, eps):
+        """Perturb inputs along sign(dL/dx) — gradient wrt the IMAGE."""
+        x = nd.array(X)
+        x.attach_grad()
+        with autograd.record():
+            loss = sce(net(x), nd.array(y)).mean()
+        loss.backward()
+        adv = x + eps * nd.sign(x.grad)
+        return np.clip(adv.asnumpy(), 0, 1)
+
+    train_on(Xtr, ytr, args.epochs)
+    clean = accuracy(Xte, yte)
+    Xadv = fgsm(Xte, yte, args.eps)
+    attacked = accuracy(Xadv, yte)
+    print(f"clean accuracy {clean:.3f} -> under FGSM(eps={args.eps}) "
+          f"{attacked:.3f}")
+    assert clean > 0.85, clean
+    assert attacked < clean - 0.3, (clean, attacked)  # the attack must bite
+
+    # adversarial training: fine-tune on freshly-generated adversarial
+    # batches of the TRAIN set, then re-attack the test set
+    for _ in range(args.adv_epochs):
+        Xadv_tr = fgsm(Xtr, ytr, args.eps)
+        train_on(np.concatenate([Xtr, Xadv_tr]),
+                 np.concatenate([ytr, ytr]), 1)
+    robust = accuracy(fgsm(Xte, yte, args.eps), yte)
+    print(f"after adversarial training: FGSM accuracy {robust:.3f}")
+    assert robust > attacked + 0.2, (attacked, robust)
+    print("FGSM_OK")
+
+
+if __name__ == "__main__":
+    main()

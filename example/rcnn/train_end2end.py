@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Two-stage object detection, Faster R-CNN style (reference
+example/rcnn/train_end2end.py — RPN + region classifier trained
+jointly over a shared backbone; symbol_resnet.py wires Proposal +
+ROIPooling between the stages).
+
+Scaled to a self-contained synthetic task: each image plants ONE
+axis-aligned box of one of two object classes (distinct channel
+signatures). The pipeline is the real one —
+
+  backbone conv features (stride 4)
+  -> RPN head: per-anchor objectness + bbox deltas
+     (anchor targets = IoU-matched on host, like rpn/anchor_target)
+  -> _contrib_Proposal: decode deltas + NMS -> region proposals
+  -> ROIAlign on the shared features
+  -> region head: classify each proposal {bg, class1, class2}
+
+— and the end metric is detection accuracy: does the top-scoring
+proposal land on (IoU>=0.5) the planted box with the right class?
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+IMG = 32
+STRIDE = 4
+FEAT = IMG // STRIDE          # 8x8 feature map
+ANCHOR_SCALES = (2, 3)        # anchor sides (in feature-stride units)
+N_ANCHOR = len(ANCHOR_SCALES)
+N_CLASSES = 3                 # background + 2 object classes
+
+
+def anchors():
+    """(FEAT*FEAT*N_ANCHOR, 4) anchor boxes in image pixels."""
+    out = []
+    for fy in range(FEAT):
+        for fx in range(FEAT):
+            cx, cy = (fx + 0.5) * STRIDE, (fy + 0.5) * STRIDE
+            for s in ANCHOR_SCALES:
+                half = s * STRIDE / 2
+                out.append([cx - half, cy - half, cx + half, cy + half])
+    return np.array(out, np.float32)
+
+
+def iou(a, b):
+    x1 = np.maximum(a[:, 0], b[0]); y1 = np.maximum(a[:, 1], b[1])
+    x2 = np.minimum(a[:, 2], b[2]); y2 = np.minimum(a[:, 3], b[3])
+    inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[2] - b[0]) * (b[3] - b[1])
+    return inter / (area_a + area_b - inter + 1e-9)
+
+
+def make_data(rng, n):
+    X = 0.1 * rng.randn(n, 3, IMG, IMG).astype(np.float32)
+    boxes = np.zeros((n, 4), np.float32)
+    labels = np.zeros((n,), np.int64)
+    for i in range(n):
+        side = rng.randint(8, 17)
+        x1 = rng.randint(0, IMG - side); y1 = rng.randint(0, IMG - side)
+        cls = rng.randint(1, N_CLASSES)
+        X[i, cls - 1, y1:y1 + side, x1:x1 + side] += 1.0
+        boxes[i] = [x1, y1, x1 + side, y1 + side]
+        labels[i] = cls
+    return X, boxes, labels
+
+
+def rpn_targets(anc, box):
+    """Per-anchor (objectness in {-1,0,1}, bbox deltas) — the reference's
+    rpn/anchor_target assignment: positive above 0.5 IoU (or argmax),
+    negative below 0.2, rest ignored."""
+    ious = iou(anc, box)
+    obj = -np.ones(len(anc), np.float32)
+    obj[ious < 0.2] = 0.0
+    pos = ious >= 0.5
+    pos[np.argmax(ious)] = True
+    obj[pos] = 1.0
+    # deltas in the standard (dx, dy, dw, dh) parameterization
+    aw = anc[:, 2] - anc[:, 0]; ah = anc[:, 3] - anc[:, 1]
+    acx = anc[:, 0] + aw / 2;   acy = anc[:, 1] + ah / 2
+    bw = box[2] - box[0]; bh = box[3] - box[1]
+    bcx = box[0] + bw / 2; bcy = box[1] + bh / 2
+    deltas = np.stack([(bcx - acx) / aw, (bcy - acy) / ah,
+                       np.log(bw / aw), np.log(bh / ah)], 1).astype(np.float32)
+    return obj, deltas
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--min-acc", type=float, default=0.6)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(args.seed)
+    anc = anchors()
+    Xtr, Btr, Ltr = make_data(rng, 384)
+    Xte, Bte, Lte = make_data(rng, 128)
+    obj_t = np.stack([rpn_targets(anc, b)[0] for b in Btr])
+    del_t = np.stack([rpn_targets(anc, b)[1] for b in Btr])
+
+    class RCNN(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.backbone = gluon.nn.HybridSequential()
+                self.backbone.add(
+                    gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+                    gluon.nn.Conv2D(16, 3, strides=2, padding=1,
+                                    activation="relu"),
+                    gluon.nn.Conv2D(32, 3, strides=2, padding=1,
+                                    activation="relu"))
+                self.rpn_obj = gluon.nn.Conv2D(N_ANCHOR * 2, 1)
+                self.rpn_box = gluon.nn.Conv2D(N_ANCHOR * 4, 1)
+                self.head = gluon.nn.HybridSequential()
+                self.head.add(gluon.nn.Dense(64, activation="relu"),
+                              gluon.nn.Dense(N_CLASSES))
+
+        def features(self, x):
+            return self.backbone(x)
+
+    net = RCNN()
+    net.initialize(mx.init.Xavier())
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    huber = gluon.loss.HuberLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    def rois_from_rpn(feat, obj_logits, box_deltas, topk=8):
+        """Proposal stage (the reference's _contrib_Proposal role): decode
+        + NMS via the registered op, per image."""
+        B = feat.shape[0]
+        # Proposal expects BLOCK layout [A bg | A fg] (reference
+        # proposal-inl.h: foreground scores are channels A:2A), while the
+        # training head is (A, 2)-interleaved — reorder here
+        scores = nd.softmax(obj_logits.reshape((B, N_ANCHOR, 2, FEAT, FEAT)),
+                            axis=2)
+        cls_prob = nd.concat(scores[:, :, 0], scores[:, :, 1], dim=1)
+        im_info = nd.array(np.tile([IMG, IMG, 1.0], (B, 1)).astype(np.float32))
+        rois = nd.Proposal(cls_prob, box_deltas, im_info,
+                           rpn_pre_nms_top_n=64, rpn_post_nms_top_n=topk,
+                           threshold=0.7, rpn_min_size=4,
+                           scales=ANCHOR_SCALES, ratios=(1.0,),
+                           feature_stride=STRIDE)
+        return rois.reshape((-1, 5))       # (B*topk, 5) [bidx,x1,y1,x2,y2]
+
+    n = len(Xtr)
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n)
+        tot = 0.0
+        for s in range(0, n - args.batch_size + 1, args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            x = nd.array(Xtr[idx])
+            obj = nd.array(obj_t[idx]); dl = nd.array(del_t[idx])
+            boxes, labels = Btr[idx], Ltr[idx]
+            with autograd.record():
+                feat = net.features(x)
+                ol = net.rpn_obj(feat)      # (B, 2A, Hf, Wf)
+                bd = net.rpn_box(feat)      # (B, 4A, Hf, Wf)
+                B = len(idx)
+                # RPN losses on host-matched anchor targets
+                ol_a = ol.reshape((B, N_ANCHOR, 2, FEAT, FEAT)) \
+                         .transpose((0, 3, 4, 1, 2)).reshape((-1, 2))
+                bd_a = bd.reshape((B, N_ANCHOR, 4, FEAT, FEAT)) \
+                         .transpose((0, 3, 4, 1, 2)).reshape((-1, 4))
+                objf = obj.reshape((-1,))
+                care = (objf >= 0).astype("float32")
+                l_obj = (sce(ol_a, nd.maximum(objf, nd.zeros_like(objf)))
+                         * care).sum() / care.sum()
+                posm = (objf == 1).astype("float32").reshape((-1, 1))
+                l_box = (huber(bd_a, dl.reshape((-1, 4))) * posm.reshape((-1,))
+                         ).sum() / posm.sum()
+                # region stage: classify NMS'd proposals from the SAME
+                # features (labels matched on host by IoU)
+                rois = rois_from_rpn(feat, ol, bd)
+                rois_np = rois.asnumpy()
+                rlab = np.zeros(len(rois_np), np.float32)
+                for r, (bidx, x1, y1, x2, y2) in enumerate(rois_np):
+                    b = int(bidx)
+                    if iou(np.array([[x1, y1, x2, y2]], np.float32),
+                           boxes[b])[0] >= 0.5:
+                        rlab[r] = labels[b]
+                pooled = nd.ROIAlign(feat, rois, pooled_size=(3, 3),
+                                     spatial_scale=1.0 / STRIDE)
+                cls = net.head(pooled.reshape((pooled.shape[0], -1)))
+                l_cls = sce(cls, nd.array(rlab)).mean()
+                loss = l_obj + l_box + l_cls
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        print(f"epoch {epoch} loss {tot / (n // args.batch_size):.4f}")
+
+    # detection eval: top proposal per image, IoU + class against truth
+    feat = net.features(nd.array(Xte))
+    ol, bd = net.rpn_obj(feat), net.rpn_box(feat)
+    rois = rois_from_rpn(feat, ol, bd, topk=4)
+    pooled = nd.ROIAlign(feat, rois, pooled_size=(3, 3),
+                         spatial_scale=1.0 / STRIDE)
+    cls = net.head(pooled.reshape((pooled.shape[0], -1))).asnumpy()
+    rois_np = rois.asnumpy()
+    hit = 0
+    for b in range(len(Xte)):
+        mine = [(r, cls[r]) for r in range(len(rois_np))
+                if int(rois_np[r, 0]) == b]
+        # best non-background proposal by head score
+        best, best_s = None, -1e9
+        for r, c in mine:
+            k = int(np.argmax(c))
+            if k != 0 and c[k] > best_s:
+                best, best_s = (r, k), c[k]
+        if best is None:
+            continue
+        r, k = best
+        if k == Lte[b] and iou(rois_np[r:r + 1, 1:], Bte[b])[0] >= 0.5:
+            hit += 1
+    acc = hit / len(Xte)
+    print(f"detection accuracy (IoU>=0.5 + class): {acc:.3f}")
+    assert acc >= args.min_acc, f"detection accuracy {acc} < {args.min_acc}"
+    print("RCNN_OK")
+
+
+if __name__ == "__main__":
+    main()

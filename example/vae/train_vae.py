@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Variational autoencoder (reference example/vae-gan/ + the Gluon VAE
+tutorial — encoder emits (mu, logvar), latent sampled with the
+reparameterization trick, loss = reconstruction + KL(q||N(0,1))).
+
+Trained on synthetic two-mode glyph images. Checks the two properties a
+working VAE must show: the ELBO improves substantially, and latent-space
+DECODING of fresh N(0,1) samples produces images closer to the data
+manifold than noise (mean nearest-glyph distance drops vs an untrained
+decoder)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+IMG = 16
+LATENT = 8
+
+
+def make_data(rng, glyphs, n):
+    y = rng.randint(0, len(glyphs), n)
+    X = glyphs[y] + 0.1 * rng.randn(n, IMG * IMG).astype(np.float32)
+    return np.clip(X, 0, 1).astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(args.seed)
+    glyphs = (rng.rand(6, IMG * IMG) > 0.5).astype(np.float32)
+    Xtr = make_data(rng, glyphs, 1024)
+
+    class VAE(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.enc = gluon.nn.HybridSequential()
+                self.enc.add(gluon.nn.Dense(128, activation="relu"))
+                self.mu = gluon.nn.Dense(LATENT)
+                self.logvar = gluon.nn.Dense(LATENT)
+                self.dec = gluon.nn.HybridSequential()
+                self.dec.add(gluon.nn.Dense(128, activation="relu"),
+                             gluon.nn.Dense(IMG * IMG, activation="sigmoid"))
+
+        def encode(self, x):
+            h = self.enc(x)
+            return self.mu(h), self.logvar(h)
+
+        def decode(self, z):
+            return self.dec(z)
+
+    net = VAE()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    def elbo_loss(x):
+        mu, logvar = net.encode(x)
+        # reparameterization: z = mu + sigma * eps keeps the sample
+        # differentiable wrt the encoder
+        eps = nd.random.normal(shape=mu.shape)
+        z = mu + nd.exp(0.5 * logvar) * eps
+        recon = net.decode(z)
+        l_rec = nd.sum((recon - x) ** 2, axis=1)
+        l_kl = -0.5 * nd.sum(1 + logvar - mu ** 2 - nd.exp(logvar), axis=1)
+        return (l_rec + l_kl).mean()
+
+    def sample_quality(n=64):
+        """Mean distance of decoded N(0,1) samples to the nearest glyph."""
+        z = nd.array(np.random.RandomState(1).randn(n, LATENT)
+                     .astype(np.float32))
+        dec = net.decode(z).asnumpy()
+        d = np.linalg.norm(dec[:, None, :] - glyphs[None], axis=2)
+        return float(d.min(axis=1).mean())
+
+    q0 = sample_quality()
+    n = len(Xtr)
+    first = last = None
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n)
+        tot, nb = 0.0, 0
+        for s in range(0, n - args.batch_size + 1, args.batch_size):
+            x = nd.array(Xtr[perm[s:s + args.batch_size]])
+            with autograd.record():
+                loss = elbo_loss(x)
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy()); nb += 1
+        avg = tot / nb
+        first = first if first is not None else avg
+        last = avg
+        print(f"epoch {epoch} -ELBO {avg:.2f}")
+
+    q1 = sample_quality()
+    print(f"-ELBO first {first:.2f} last {last:.2f}; "
+          f"decoded-sample glyph distance {q0:.2f} -> {q1:.2f}")
+    assert last < first * 0.5, (first, last)
+    assert q1 < q0 * 0.8, (q0, q1)
+    print("VAE_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,10 @@ def _configure_jax():
     # bf16 passes; force full precision globally. Performance-critical paths
     # (bench, model zoo inference/training in bf16) pass bf16 inputs, which is
     # the idiomatic TPU way to use the MXU and is unaffected by this setting.
+    # Opt-in fast fp32 (MXTPU_FP32_MATMUL=fast -> bf16_3x passes, =fastest
+    # -> single bf16 pass): trades fp32 dot exactness for MXU throughput
+    # while keeping every fp32 API surface — see docs/faq/float16.md and
+    # runtime.set_fp32_matmul_mode().
     import os
     import jax
     # Honor JAX_PLATFORMS even when a site plugin (the axon TPU tunnel)
@@ -36,7 +40,8 @@ def _configure_jax():
             jax.config.update("jax_platforms", plat)
         except Exception:
             pass
-    jax.config.update("jax_default_matmul_precision", "highest")
+    from .runtime import set_fp32_matmul_mode
+    set_fp32_matmul_mode(os.environ.get("MXTPU_FP32_MATMUL", "strict"))
     # Persistent XLA compilation cache: eager mode compiles one executable per
     # (op, shape) like the reference's cudnn autotune cache persists algo
     # choices (src/operator/nn/cudnn/cudnn_algoreg*) — ours persists whole
@@ -102,6 +107,7 @@ def __getattr__(name):
         "model": ".model",
         "callback": ".callback",
         "monitor": ".monitor",
+        "mon": ".monitor",
         "profiler": ".profiler",
         "runtime": ".runtime",
         "parallel": ".parallel",

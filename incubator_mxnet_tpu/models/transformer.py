@@ -20,7 +20,29 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from jax.ad_checkpoint import checkpoint_name
+
 from ..parallel.ring_attention import attention_reference, ring_attention
+
+
+def _remat_policy(name):
+    """Map TransformerConfig.remat_policy to a jax.checkpoint policy
+    (None = recompute everything; reference analog: the
+    MXNET_BACKWARD_DO_MIRROR recompute knob, graph_executor.cc:351)."""
+    if not name:
+        return None
+    cp = jax.checkpoint_policies
+    table = {
+        "dots": cp.checkpoint_dots,
+        "dots_no_batch": cp.checkpoint_dots_with_no_batch_dims,
+        "save_attn": cp.save_only_these_names("attn_out"),
+        "save_attn_mlp": cp.save_only_these_names("attn_out", "mlp_out"),
+        "save_mlp": cp.save_only_these_names("mlp_out"),
+    }
+    if name not in table:
+        raise ValueError(f"unknown remat_policy {name!r}; "
+                         f"one of {sorted(table)}")
+    return table[name]
 
 __all__ = ["TransformerConfig", "TransformerLM"]
 
@@ -35,6 +57,14 @@ class TransformerConfig:
     max_len: int = 2048
     dtype: str = "bfloat16"
     remat: bool = True          # jax.checkpoint each block (HBM for FLOPs)
+    # Selective rematerialization policy (r4 profile: recompute is 199ms
+    # = 18% of the flagship step, the largest untried lever). None =
+    # recompute everything (baseline). "dots" / "dots_no_batch" are
+    # XLA's stock save-matmul-outputs policies; "save_attn" /
+    # "save_attn_mlp" save the named per-block outputs (attn_out, mlp_out
+    # — 1.6 GB each per 12x1024/T2048/b32 model at bf16) and recompute
+    # the rest. Measured results belong in docs/perf_notes.md.
+    remat_policy: str | None = None
     # Pallas blocked flash attention for the non-sp path (O(T) memory,
     # parallel/flash_attention.py); the sp path always uses ring
     # attention. DEFAULT ON since round 4: steady-state train at T=2048
@@ -118,11 +148,13 @@ class TransformerLM:
         attn_out = attn.reshape(B, T, d_local) @ params[prefix + "wo"]
         if tp_axis is not None:
             attn_out = jax.lax.psum(attn_out, tp_axis)
+        attn_out = checkpoint_name(attn_out, "attn_out")
         x = x + attn_out
         h = self._ln(x, params[prefix + "ln2_g"], params[prefix + "ln2_b"])
         y = jax.nn.gelu(h @ params[prefix + "w_in"]) @ params[prefix + "w_out"]
         if tp_axis is not None:
             y = jax.lax.psum(y, tp_axis)
+        y = checkpoint_name(y, "mlp_out")
         return x + y
 
     def apply(self, params, tokens, sp_axis=None, positions=None, tp_axis=None):
@@ -137,7 +169,7 @@ class TransformerLM:
         if cfg.remat:
             block = jax.checkpoint(
                 lambda p, pref, y: self._block(p, pref, y, sp_axis, tp_axis),
-                static_argnums=(1,))
+                static_argnums=(1,), policy=_remat_policy(cfg.remat_policy))
         else:
             block = lambda p, pref, y: self._block(p, pref, y, sp_axis, tp_axis)
         for i in range(cfg.n_layers):

@@ -257,9 +257,15 @@ class NDArray:
             if not 0 <= i < n:
                 raise IndexError(f"index {key} out of bounds for axis 0 "
                                  f"with size {n}")
-            import jax.numpy as jnp
-            return invoke("_index_axis0", self,
-                          NDArray(jnp.asarray(i, jnp.int32)))
+            if i < 2**31:
+                import jax.numpy as jnp
+                return invoke("_index_axis0", self,
+                              NDArray(jnp.asarray(i, jnp.int32)))
+            # >2^31: an int32 index operand would overflow (large-tensor
+            # audit). The static-key op compiles per index (fine — giant
+            # arrays are rare) and, unlike a raw lax call here, goes
+            # through invoke() so the autograd tape still records it.
+            return invoke("_getitem_static", self, key=_freeze_index(i))
         if _static_index(key):
             return invoke("_getitem_static", self, key=_freeze_index(key))
         # advanced indexing with array keys: route arrays as op inputs is

@@ -236,15 +236,41 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     ax = axis % data.ndim
     red = tuple(i for i in range(data.ndim) if i != ax)
     if training and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # ONE pass for both statistics: sibling sum/sum-of-squares
+        # reductions multi-output-fuse in XLA, where mean-then-var reads
+        # the (large) activation from HBM twice. f32 accumulation
+        # regardless of input dtype (bf16 sums would lose mass at
+        # ResNet-scale reduction counts). The reductions run on data
+        # SHIFTED by the per-channel running mean: var is shift-
+        # invariant, and the shift kills the E[x^2]-E[x]^2 catastrophic
+        # cancellation for badly-centered activations (|mean| >> std) —
+        # moving_mean tracks the batch mean in steady state, which is
+        # when large offsets persist. The subtract fuses into the same
+        # pass; still one read of the activation.
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        bshape = [1] * data.ndim
+        bshape[ax] = data.shape[ax]
+        c = jnp.reshape(moving_mean.astype(jnp.float32), bshape)
+        shifted = data.astype(jnp.float32) - c
+        s1 = jnp.sum(shifted, axis=red, dtype=jnp.float32)
+        s2 = jnp.sum(jnp.square(shifted), axis=red, dtype=jnp.float32)
+        dmean = s1 / n
+        mean = moving_mean.astype(jnp.float32) + dmean
+        var = jnp.maximum(s2 / n - jnp.square(dmean), 0.0)
+        mean = mean.astype(moving_mean.dtype)
+        var = var.astype(moving_var.dtype)
     else:
         mean, var = moving_mean, moving_var
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    out = (data - jnp.reshape(mean, shape)) * jax.lax.rsqrt(
-        jnp.reshape(var, shape) + eps) * jnp.reshape(g, shape) + jnp.reshape(beta, shape)
+    # recomposed as one multiply-add epilogue (scale/bias are C-sized —
+    # the per-channel math costs nothing; the activation is touched once)
+    scale = g * jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    bias = beta - mean * scale
+    out = data * jnp.reshape(scale, shape) + jnp.reshape(bias, shape)
     return (out.astype(data.dtype), mean, var)
 
 

@@ -259,13 +259,58 @@ def norm(data, *, ord=2, axis=None, keepdims=False):
 
 @register(nondiff=True)
 def argmax(data, *, axis=None, keepdims=False):
+    if _argext_needs_split(data, axis):
+        return _flat_argext(data, jnp.argmax, jnp.max, keepdims)
     out = jnp.argmax(data, axis=axis, keepdims=keepdims)
     return out.astype(jnp.float32)
 
 
 @register(nondiff=True)
 def argmin(data, *, axis=None, keepdims=False):
+    if _argext_needs_split(data, axis):
+        return _flat_argext(data, jnp.argmin, jnp.min, keepdims)
     return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+def _argext_needs_split(data, axis):
+    """jnp.arg{max,min} positions are int32 under default jax config —
+    a reduction spanning >=2^31 elements silently wraps negative
+    (reference large-tensor nightly class of bug). Only the flat /
+    axis-0-of-1D case can reach that size in practice."""
+    if axis is None:
+        return data.size >= 2**31
+    return data.ndim == 1 and data.shape[0] >= 2**31
+
+
+def _flat_argext(data, arg_fn, ext_fn, keepdims):
+    """Two-stage arg-extremum whose per-stage index fits int32; the flat
+    position is recombined in float32 (the op's MXNet-convention output
+    dtype — exact whenever the position is f32-representable). The
+    non-divisible tail is reduced separately rather than padded: a pad
+    would copy the whole >=2^31-element buffer (and need a dtype-aware
+    fill that bool lacks); slices fuse into the reductions under jit."""
+    flat = data.reshape(-1)
+    n = flat.shape[0]
+    inner = 1 << 22
+    rem = n % inner
+    if n < inner:           # directly testable small case; the >=2^31
+        out = arg_fn(flat).astype(jnp.float32)   # trigger never takes it
+        return out.reshape((1,) * data.ndim) if keepdims else out
+    two = flat[:n - rem].reshape(-1, inner)
+    row_ext = ext_fn(two, axis=1)
+    outer = arg_fn(row_ext)
+    inner_idx = arg_fn(two[outer])
+    best_val = row_ext[outer]
+    best = outer.astype(jnp.float32) * inner + inner_idx.astype(jnp.float32)
+    if rem:
+        tail = flat[n - rem:]
+        t_val = ext_fn(tail)
+        t_idx = arg_fn(tail).astype(jnp.float32) + float(n - rem)
+        # strict comparison: ties resolve to the EARLIER (main) position,
+        # matching numpy's first-occurrence rule
+        better = t_val > best_val if ext_fn is jnp.max else t_val < best_val
+        best = jnp.where(better, t_idx, best)
+    return best.reshape((1,) * data.ndim) if keepdims else best
 
 
 @register(nondiff=True)
@@ -547,7 +592,13 @@ def slice_like(data, like, *, axes=()):
 
 @register(name="_getitem_static")
 def _getitem_static(data, *, key):
-    return data[_thaw_index(key)]
+    k = _thaw_index(key)
+    if isinstance(k, int) and k >= 2**31:
+        # jnp basic indexing materializes the index as an int32 constant,
+        # which overflows past 2^31 (large-tensor audit); lax.slice
+        # carries start indices as static 64-bit attributes
+        return lax.squeeze(lax.slice_in_dim(data, k, k + 1, axis=0), (0,))
+    return data[k]
 
 
 @register(name="_index_axis0")

@@ -8,7 +8,36 @@ flags.
 """
 from __future__ import annotations
 
-__all__ = ["Feature", "Features", "feature_list"]
+__all__ = ["Feature", "Features", "feature_list",
+           "set_fp32_matmul_mode", "fp32_matmul_mode"]
+
+# fp32 matmul/conv execution mode -> jax matmul precision. "strict" is
+# the default (reference fp32 semantics: full-precision accumulate);
+# "fast" runs fp32 dots as three bf16 passes on the MXU (~1e-6 relative
+# error, several-fold faster on TPU); "fastest" is one bf16 pass
+# (bf16-level error, full MXU rate). bf16 inputs are unaffected —
+# this only governs what a float32 x float32 dot means.
+_FP32_MODES = {"strict": "highest", "fast": "high", "fastest": "default"}
+_fp32_mode = "strict"
+
+
+def set_fp32_matmul_mode(mode):
+    """Select fp32 matmul semantics ("strict" | "fast" | "fastest");
+    also settable at import via MXTPU_FP32_MATMUL. Applies process-wide
+    (jax_default_matmul_precision); already-compiled executables are
+    unaffected until retraced."""
+    global _fp32_mode
+    mode = (mode or "strict").lower()
+    if mode not in _FP32_MODES:
+        raise ValueError(f"fp32 matmul mode must be one of "
+                         f"{sorted(_FP32_MODES)}, got {mode!r}")
+    import jax
+    jax.config.update("jax_default_matmul_precision", _FP32_MODES[mode])
+    _fp32_mode = mode
+
+
+def fp32_matmul_mode():
+    return _fp32_mode
 
 
 class Feature:

@@ -173,6 +173,27 @@ def test_fcn_segmentation_iou():
     assert "FCN_XS_OK" in out
 
 
+def test_rcnn_two_stage_detection():
+    out = _run("example/rcnn/train_end2end.py", "--epochs", "10",
+               "--min-acc", "0.5", timeout=900)
+    assert "RCNN_OK" in out
+
+
+def test_fgsm_attack_and_adversarial_training():
+    out = _run("example/adversary/fgsm.py")
+    assert "FGSM_OK" in out
+
+
+def test_svm_head_learns():
+    out = _run("example/svm_mnist/svm_mnist.py", "--epochs", "8")
+    assert "SVM_MNIST_OK" in out
+
+
+def test_vae_elbo_and_samples():
+    out = _run("example/vae/train_vae.py", "--epochs", "10")
+    assert "VAE_OK" in out
+
+
 def test_bilstm_sort_learns():
     out = _run("example/bi-lstm-sort/sort.py", "--epochs", "5",
                "--batches-per-epoch", "12", "--hidden", "32",

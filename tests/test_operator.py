@@ -277,3 +277,38 @@ def test_cast():
     x = nd.array([1.5, 2.5])
     assert nd.cast(x, dtype="int32").dtype == np.int32
     assert nd.cast(x, dtype="float16").dtype == np.float16
+
+
+def test_batchnorm_badly_centered_variance():
+    """One-pass BN stats must survive |mean| >> std in fp32 (the
+    E[x^2]-E[x]^2 cancellation case): with the running mean tracking the
+    offset — the steady state in which large offsets persist — the batch
+    var must match the true tiny variance, not collapse to 0."""
+    rng = np.random.RandomState(0)
+    x = (1000.0 + 0.01 * rng.randn(8, 4, 6, 6)).astype(np.float32)
+    mm = np.full(4, 1000.0, np.float32)
+    out = nd.BatchNorm(nd.array(x), nd.ones((4,)), nd.zeros((4,)),
+                       nd.array(mm), nd.ones((4,)), fix_gamma=False,
+                       training=True, eps=1e-8)
+    o, mean, var = out
+    true_var = x.var(axis=(0, 2, 3))
+    np.testing.assert_allclose(var.asnumpy(), true_var, rtol=1e-3)
+    np.testing.assert_allclose(mean.asnumpy(), x.mean(axis=(0, 2, 3)),
+                               rtol=1e-6)
+    # and the normalized output has unit scale, not rsqrt(eps) blowup
+    assert 0.5 < float(np.abs(o.asnumpy()).mean()) < 2.0
+
+
+def test_flat_argext_helper_small_and_bool():
+    """The large-tensor two-stage arg-extremum helper: bool inputs (no
+    iinfo), keepdims rank preservation, and tie-to-first semantics."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.tensor_ops import _flat_argext
+
+    mask = jnp.array([False, True, False, True])
+    assert int(_flat_argext(mask, jnp.argmax, jnp.max, False)) == 1
+    a2 = jnp.arange(12.0).reshape(3, 4)
+    out = _flat_argext(a2, jnp.argmax, jnp.max, True)
+    assert out.shape == (1, 1)       # keepdims keeps the input rank
+    assert float(out.reshape(())) == 11.0

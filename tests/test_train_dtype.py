@@ -5,6 +5,7 @@ here bf16 (the TPU-native half type) via net.cast and via AMP, asserting
 convergence matches fp32 on a learnable synthetic task.
 """
 import numpy as np
+import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import autograd, gluon
@@ -74,3 +75,99 @@ def test_amp_training_converges():
         assert acc > 0.9
     finally:
         amp.amp._off()     # don't leak the AMP hook into other tests
+
+
+def test_fp32_matmul_mode_plumbing():
+    """runtime.set_fp32_matmul_mode selects jax_default_matmul_precision
+    ('strict' default, opt-in 'fast'=bf16_3x / 'fastest'=bf16 — VERDICT
+    r4 item 4's fp32 fast path); strict is restored for other tests."""
+    import jax
+
+    from incubator_mxnet_tpu import runtime
+
+    assert runtime.fp32_matmul_mode() == "strict"
+    assert jax.config.jax_default_matmul_precision == "highest"
+    try:
+        runtime.set_fp32_matmul_mode("fast")
+        assert jax.config.jax_default_matmul_precision == "high"
+        runtime.set_fp32_matmul_mode("fastest")
+        assert jax.config.jax_default_matmul_precision == "default"
+        with pytest.raises(ValueError):
+            runtime.set_fp32_matmul_mode("warp9")
+    finally:
+        runtime.set_fp32_matmul_mode("strict")
+    assert jax.config.jax_default_matmul_precision == "highest"
+
+
+def test_fp32_fast_mode_numerics_bounded():
+    """Training a small convnet in 'fast' fp32 must track strict fp32:
+    same trajectory within bf16_3x tolerance (exact on backends whose
+    fp32 dot is native; on TPU this bounds the 3-pass bf16 error)."""
+    from incubator_mxnet_tpu import runtime
+
+    def run():
+        mx.random.seed(7)
+        np.random.seed(7)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+                gluon.nn.MaxPool2D(2),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        x = mx.nd.array(np.random.rand(16, 1, 8, 8).astype(np.float32))
+        y = mx.nd.array(np.random.randint(0, 4, 16).astype(np.float32))
+        losses = []
+        for _ in range(5):
+            with autograd.record():
+                loss = loss_fn(net(x), y).mean()
+            loss.backward()
+            trainer.step(1)
+            losses.append(float(loss.asnumpy()))
+        return np.asarray(losses)
+
+    strict = run()
+    try:
+        runtime.set_fp32_matmul_mode("fast")
+        fast = run()
+    finally:
+        runtime.set_fp32_matmul_mode("strict")
+    np.testing.assert_allclose(fast, strict, rtol=5e-3, atol=1e-4)
+
+
+def test_transformer_remat_policies_compile_and_match():
+    """Every remat_policy must produce the SAME loss/gradients as full
+    remat (policies change what is saved, never the math)."""
+    import jax
+
+    from incubator_mxnet_tpu.models.transformer import (TransformerConfig,
+                                                        TransformerLM)
+
+    tokens = np.random.RandomState(0).randint(0, 64, (2, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, 1)
+
+    def loss_and_grad(policy):
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_len=16,
+                                dtype="float32", remat=True,
+                                flash_attention=False, remat_policy=policy)
+        model = TransformerLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        l, g = jax.value_and_grad(
+            lambda p: model.loss(p, tokens, targets))(params)
+        return float(l), g
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        loss_and_grad("bogus_policy")
+
+    l0, g0 = loss_and_grad(None)
+    for pol in ("dots", "dots_no_batch", "save_attn", "save_attn_mlp",
+                "save_mlp"):
+        l1, g1 = loss_and_grad(pol)
+        assert abs(l1 - l0) < 1e-5, (pol, l0, l1)
+        for k in g0:
+            np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g0[k]),
+                                       rtol=1e-4, atol=1e-5, err_msg=(pol, k))
