@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Multivariate time-series forecasting (reference
+example/multivariate_time_series/src/lstnet.py — LSTNet on the
+electricity dataset: convolutional feature extraction over a window of
+all series, recurrent aggregation, autoregressive highway).
+
+Synthetic data: coupled sinusoids + noise where each series is a lagged
+mixture of the others — so the forecaster must exploit CROSS-series
+structure, not just extrapolate one curve. The model keeps LSTNet's
+shape (Conv1D over the window -> GRU -> dense forecast, plus a linear
+autoregressive bypass) and is scored by relative absolute error (RAE)
+against the naive last-value forecast, which it must beat decisively.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+N_SERIES = 4
+WINDOW = 24
+
+
+def make_series(rng, length):
+    t = np.arange(length)
+    base = np.stack([np.sin(2 * np.pi * t / p) for p in (12, 17, 23, 31)])
+    mix = rng.rand(N_SERIES, N_SERIES) * 0.5 + 0.5 * np.eye(N_SERIES)
+    y = mix @ base + 0.05 * rng.randn(N_SERIES, length)
+    return y.astype(np.float32)            # (S, T)
+
+
+def windows(y, horizon=1):
+    S, T = y.shape
+    X, Y = [], []
+    for t in range(WINDOW, T - horizon):
+        X.append(y[:, t - WINDOW:t].T)     # (WINDOW, S)
+        Y.append(y[:, t + horizon - 1])    # (S,)
+    return np.stack(X), np.stack(Y)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(args.seed)
+    series = make_series(rng, 2200)
+    X, Y = windows(series)
+    n_train = int(len(X) * 0.8)
+    Xtr, Ytr = X[:n_train], Y[:n_train]
+    Xte, Yte = X[n_train:], Y[n_train:]
+
+    class LSTNetLite(gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.conv = gluon.nn.Conv1D(16, 3, padding=1,
+                                            activation="relu")
+                self.gru = gluon.rnn.GRU(32, layout="NTC")
+                self.fc = gluon.nn.Dense(N_SERIES)
+                self.ar = gluon.nn.Dense(N_SERIES)   # highway bypass
+
+        def hybrid_forward(self, F, x):
+            # x: (B, WINDOW, S) -> conv over time needs (B, C=S, T)
+            c = self.conv(F.transpose(x, axes=(0, 2, 1)))     # (B, 16, T)
+            h = self.gru(F.transpose(c, axes=(0, 2, 1)))      # (B, T, 32)
+            last = F.slice_axis(h, axis=1, begin=-1, end=None) \
+                    .reshape((0, -1))
+            ar_in = F.slice_axis(x, axis=1, begin=-8, end=None) \
+                     .reshape((0, -1))
+            return self.fc(last) + self.ar(ar_in)
+
+    net = LSTNetLite()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    l2 = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    n = len(Xtr)
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n)
+        tot = 0.0
+        for s in range(0, n - args.batch_size + 1, args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            with autograd.record():
+                loss = l2(net(nd.array(Xtr[idx])),
+                          nd.array(Ytr[idx])).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        print(f"epoch {epoch} mse {tot / (n // args.batch_size):.5f}")
+
+    pred = net(nd.array(Xte)).asnumpy()
+    rae = np.abs(pred - Yte).sum() / np.abs(Xte[:, -1, :] - Yte).sum()
+    print(f"relative absolute error vs naive last-value: {rae:.3f}")
+    assert rae < 0.7, rae                 # must clearly beat persistence
+    print("TIMESERIES_OK")
+
+
+if __name__ == "__main__":
+    main()

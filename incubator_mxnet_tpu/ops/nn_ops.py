@@ -236,28 +236,27 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     ax = axis % data.ndim
     red = tuple(i for i in range(data.ndim) if i != ax)
     if training and not use_global_stats:
-        # ONE pass for both statistics: sibling sum/sum-of-squares
-        # reductions multi-output-fuse in XLA, where mean-then-var reads
-        # the (large) activation from HBM twice. f32 accumulation
-        # regardless of input dtype (bf16 sums would lose mass at
-        # ResNet-scale reduction counts). The reductions run on data
-        # SHIFTED by the per-channel running mean: var is shift-
-        # invariant, and the shift kills the E[x^2]-E[x]^2 catastrophic
-        # cancellation for badly-centered activations (|mean| >> std) —
-        # moving_mean tracks the batch mean in steady state, which is
-        # when large offsets persist. The subtract fuses into the same
-        # pass; still one read of the activation.
+        # ONE pass over the full activation for both statistics: sibling
+        # sum/sum-of-squares reductions multi-output-fuse in XLA, where
+        # mean-then-var reads the (large) activation from HBM twice. f32
+        # accumulation regardless of input dtype (bf16 sums would lose
+        # mass at ResNet-scale reduction counts). The reductions run on
+        # data SHIFTED by a per-channel estimate taken from ONE slice of
+        # the reduce dims (a 1/N-cost pre-read): var is shift-invariant,
+        # and a shift within O(std) of the true mean kills the
+        # E[x^2]-E[x]^2 catastrophic cancellation for badly-centered
+        # activations (|mean| >> std) — unconditionally, unlike a
+        # moving_mean shift, which is garbage at cold start.
         n = 1
         for i in red:
             n *= data.shape[i]
-        bshape = [1] * data.ndim
-        bshape[ax] = data.shape[ax]
-        c = jnp.reshape(moving_mean.astype(jnp.float32), bshape)
+        first = lax.slice_in_dim(data, 0, 1, axis=red[0])
+        c = jnp.mean(first.astype(jnp.float32), axis=red, keepdims=True)
         shifted = data.astype(jnp.float32) - c
         s1 = jnp.sum(shifted, axis=red, dtype=jnp.float32)
         s2 = jnp.sum(jnp.square(shifted), axis=red, dtype=jnp.float32)
         dmean = s1 / n
-        mean = moving_mean.astype(jnp.float32) + dmean
+        mean = jnp.reshape(c, (-1,)) + dmean
         var = jnp.maximum(s2 / n - jnp.square(dmean), 0.0)
         mean = mean.astype(moving_mean.dtype)
         var = var.astype(moving_var.dtype)

@@ -260,7 +260,7 @@ def norm(data, *, ord=2, axis=None, keepdims=False):
 @register(nondiff=True)
 def argmax(data, *, axis=None, keepdims=False):
     if _argext_needs_split(data, axis):
-        return _flat_argext(data, jnp.argmax, jnp.max, keepdims)
+        return _flat_argext(data, jnp.argmax, jnp.max, keepdims, axis)
     out = jnp.argmax(data, axis=axis, keepdims=keepdims)
     return out.astype(jnp.float32)
 
@@ -268,49 +268,65 @@ def argmax(data, *, axis=None, keepdims=False):
 @register(nondiff=True)
 def argmin(data, *, axis=None, keepdims=False):
     if _argext_needs_split(data, axis):
-        return _flat_argext(data, jnp.argmin, jnp.min, keepdims)
+        return _flat_argext(data, jnp.argmin, jnp.min, keepdims, axis)
     return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
 
 
 def _argext_needs_split(data, axis):
     """jnp.arg{max,min} positions are int32 under default jax config —
     a reduction spanning >=2^31 elements silently wraps negative
-    (reference large-tensor nightly class of bug). Only the flat /
-    axis-0-of-1D case can reach that size in practice."""
+    (reference large-tensor nightly class of bug)."""
     if axis is None:
         return data.size >= 2**31
-    return data.ndim == 1 and data.shape[0] >= 2**31
+    return data.shape[axis % data.ndim] >= 2**31
 
 
-def _flat_argext(data, arg_fn, ext_fn, keepdims):
-    """Two-stage arg-extremum whose per-stage index fits int32; the flat
-    position is recombined in float32 (the op's MXNet-convention output
-    dtype — exact whenever the position is f32-representable). The
+def _flat_argext(data, arg_fn, ext_fn, keepdims, axis=None):
+    """Two-stage arg-extremum whose per-stage index fits int32; the
+    position along the reduced axis is recombined in float32 (the op's
+    MXNet-convention output dtype — exact whenever the position is
+    f32-representable). Works for axis=None (flat) and for a named axis
+    of any rank (the reduced axis moves last, leading dims batch). The
     non-divisible tail is reduced separately rather than padded: a pad
     would copy the whole >=2^31-element buffer (and need a dtype-aware
     fill that bool lacks); slices fuse into the reductions under jit."""
-    flat = data.reshape(-1)
-    n = flat.shape[0]
+    if axis is None:
+        rows = data.reshape(1, -1)
+
+        def restore(o):
+            return o.reshape((1,) * data.ndim) if keepdims \
+                else o.reshape(())
+    else:
+        ax = axis % data.ndim
+        moved = jnp.moveaxis(data, ax, -1)
+        lead = moved.shape[:-1]
+        rows = moved.reshape((-1, moved.shape[-1]))
+
+        def restore(o):
+            o = o.reshape(lead)
+            return jnp.expand_dims(o, ax) if keepdims else o
+
+    n = rows.shape[1]
     inner = 1 << 22
-    rem = n % inner
     if n < inner:           # directly testable small case; the >=2^31
-        out = arg_fn(flat).astype(jnp.float32)   # trigger never takes it
-        return out.reshape((1,) * data.ndim) if keepdims else out
-    two = flat[:n - rem].reshape(-1, inner)
-    row_ext = ext_fn(two, axis=1)
-    outer = arg_fn(row_ext)
-    inner_idx = arg_fn(two[outer])
-    best_val = row_ext[outer]
+        return restore(arg_fn(rows, axis=1).astype(jnp.float32))
+    rem = n % inner
+    main = rows[:, :n - rem].reshape(rows.shape[0], -1, inner)
+    blk_ext = ext_fn(main, axis=2)                       # (M, k)
+    outer = arg_fn(blk_ext, axis=1)                      # (M,)
+    sel = jnp.take_along_axis(main, outer[:, None, None], axis=1)[:, 0]
+    inner_idx = arg_fn(sel, axis=1)                      # (M,)
+    best_val = jnp.take_along_axis(blk_ext, outer[:, None], axis=1)[:, 0]
     best = outer.astype(jnp.float32) * inner + inner_idx.astype(jnp.float32)
     if rem:
-        tail = flat[n - rem:]
-        t_val = ext_fn(tail)
-        t_idx = arg_fn(tail).astype(jnp.float32) + float(n - rem)
+        tail = rows[:, n - rem:]
+        t_val = ext_fn(tail, axis=1)
+        t_idx = arg_fn(tail, axis=1).astype(jnp.float32) + float(n - rem)
         # strict comparison: ties resolve to the EARLIER (main) position,
         # matching numpy's first-occurrence rule
         better = t_val > best_val if ext_fn is jnp.max else t_val < best_val
         best = jnp.where(better, t_idx, best)
-    return best.reshape((1,) * data.ndim) if keepdims else best
+    return restore(best)
 
 
 @register(nondiff=True)

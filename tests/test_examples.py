@@ -194,6 +194,16 @@ def test_vae_elbo_and_samples():
     assert "VAE_OK" in out
 
 
+def test_ner_tagging_f1():
+    out = _run("example/named_entity_recognition/ner.py", "--epochs", "8")
+    assert "NER_OK" in out
+
+
+def test_multivariate_forecast_beats_persistence():
+    out = _run("example/multivariate_time_series/forecast.py")
+    assert "TIMESERIES_OK" in out
+
+
 def test_bilstm_sort_learns():
     out = _run("example/bi-lstm-sort/sort.py", "--epochs", "5",
                "--batches-per-epoch", "12", "--hidden", "32",

@@ -70,6 +70,16 @@ def test_argmax_past_2g(big):
     assert idx == MARK
 
 
+def test_argmax_giant_axis_of_2d(big):
+    # the same >=2^31-long axis inside a multi-dim array (axis split
+    # path): per-row positions must not wrap either
+    two = big.reshape((1, N))
+    idx = nd.argmax(two, axis=1).asnumpy()
+    assert idx.shape == (1,) and int(idx[0]) == MARK
+    idxk = nd.argmax(two, axis=1, keepdims=True).asnumpy()
+    assert idxk.shape == (1, 1) and int(idxk[0, 0]) == MARK
+
+
 def test_reshape_roundtrip_and_sum(big):
     two_d = big.reshape((N // 8, 8))
     assert two_d.shape[0] * two_d.shape[1] == N

@@ -286,17 +286,19 @@ def test_batchnorm_badly_centered_variance():
     var must match the true tiny variance, not collapse to 0."""
     rng = np.random.RandomState(0)
     x = (1000.0 + 0.01 * rng.randn(8, 4, 6, 6)).astype(np.float32)
-    mm = np.full(4, 1000.0, np.float32)
-    out = nd.BatchNorm(nd.array(x), nd.ones((4,)), nd.zeros((4,)),
-                       nd.array(mm), nd.ones((4,)), fix_gamma=False,
-                       training=True, eps=1e-8)
-    o, mean, var = out
-    true_var = x.var(axis=(0, 2, 3))
-    np.testing.assert_allclose(var.asnumpy(), true_var, rtol=1e-3)
-    np.testing.assert_allclose(mean.asnumpy(), x.mean(axis=(0, 2, 3)),
-                               rtol=1e-6)
-    # and the normalized output has unit scale, not rsqrt(eps) blowup
-    assert 0.5 < float(np.abs(o.asnumpy()).mean()) < 2.0
+    # COLD START is the hard case: moving_mean still zero-initialized,
+    # so the shift estimate must come from the batch itself
+    for mm in (np.zeros(4, np.float32), np.full(4, 1000.0, np.float32)):
+        out = nd.BatchNorm(nd.array(x), nd.ones((4,)), nd.zeros((4,)),
+                           nd.array(mm), nd.ones((4,)), fix_gamma=False,
+                           training=True, eps=1e-8)
+        o, mean, var = out
+        true_var = x.var(axis=(0, 2, 3))
+        np.testing.assert_allclose(var.asnumpy(), true_var, rtol=1e-3)
+        np.testing.assert_allclose(mean.asnumpy(), x.mean(axis=(0, 2, 3)),
+                                   rtol=1e-6)
+        # normalized output has unit scale, not an rsqrt(eps) blowup
+        assert 0.5 < float(np.abs(o.asnumpy()).mean()) < 2.0
 
 
 def test_flat_argext_helper_small_and_bool():
@@ -312,3 +314,11 @@ def test_flat_argext_helper_small_and_bool():
     out = _flat_argext(a2, jnp.argmax, jnp.max, True)
     assert out.shape == (1, 1)       # keepdims keeps the input rank
     assert float(out.reshape(())) == 11.0
+    # named-axis form matches jnp on every axis/keepdims combination
+    for ax in (0, 1, -1):
+        for kd in (False, True):
+            got = _flat_argext(a2, jnp.argmin, jnp.min, kd, ax)
+            want = jnp.argmin(a2, axis=ax, keepdims=kd)
+            assert got.shape == want.shape, (ax, kd)
+            np.testing.assert_array_equal(np.asarray(got, np.int64),
+                                          np.asarray(want))
