@@ -204,6 +204,16 @@ def test_multivariate_forecast_beats_persistence():
     assert "TIMESERIES_OK" in out
 
 
+def test_dsd_dense_sparse_dense():
+    out = _run("example/dsd/dsd_train.py")
+    assert "DSD_OK" in out
+
+
+def test_stochastic_depth_trains():
+    out = _run("example/stochastic-depth/sd_train.py")
+    assert "STOCHASTIC_DEPTH_OK" in out
+
+
 def test_bilstm_sort_learns():
     out = _run("example/bi-lstm-sort/sort.py", "--epochs", "5",
                "--batches-per-epoch", "12", "--hidden", "32",
