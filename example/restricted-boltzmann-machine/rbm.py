@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Bernoulli restricted Boltzmann machine trained with contrastive
+divergence (reference example/restricted-boltzmann-machine/
+binary_rbm_gibbs_sampling.py — CD-k on binarized MNIST).
+
+CD-1 on binarized glyph data, written directly against the nd API (the
+update is not a gradient of a differentiable loss — it is the positive
+minus negative phase statistics, so no autograd involved):
+
+    dW ~ <v h>_data - <v h>_recon
+
+Progress is measured two ways, like the reference: one-step
+reconstruction error falls, and free energy of DATA drops relative to
+free energy of RANDOM noise (the model assigns its probability mass to
+the data manifold).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+N_VIS = 64
+N_HID = 32
+
+
+def make_data(rng, glyphs, n):
+    y = rng.randint(0, len(glyphs), n)
+    probs = np.clip(glyphs[y] * 0.9 + 0.05, 0, 1)
+    return (rng.rand(n, N_VIS) < probs).astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+
+    rng = np.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+    glyphs = (rng.rand(8, N_VIS) > 0.5).astype(np.float32)
+    Xtr = make_data(rng, glyphs, 1024)
+
+    W = nd.array(0.01 * rng.randn(N_VIS, N_HID).astype(np.float32))
+    bv = nd.zeros((N_VIS,))
+    bh = nd.zeros((N_HID,))
+
+    sigmoid = nd.sigmoid          # stable framework op
+
+    def sample(p):
+        return (nd.random.uniform(shape=p.shape) < p).astype("float32")
+
+    def free_energy(v):
+        """F(v) = -v.bv - sum log(1 + exp(v W + bh)) (reference
+        binary_rbm.py free energy)."""
+        pre = nd.dot(v, W) + bh
+        # stable softplus: log(1+exp(x)) = max(x,0) + log1p(exp(-|x|))
+        softplus = nd.maximum(pre, nd.zeros_like(pre)) + \
+            nd.log1p(nd.exp(-nd.abs(pre)))
+        return (-nd.dot(v, bv.reshape((-1, 1))).reshape((-1,))
+                - nd.sum(softplus, axis=1))
+
+    def cd1(v0):
+        ph0 = sigmoid(nd.dot(v0, W) + bh)        # positive phase
+        h0 = sample(ph0)
+        pv1 = sigmoid(nd.dot(h0, W, transpose_b=True) + bv)
+        v1 = sample(pv1)
+        ph1 = sigmoid(nd.dot(v1, W) + bh)        # negative phase
+        B = v0.shape[0]
+        dW = (nd.dot(v0, ph0, transpose_a=True)
+              - nd.dot(v1, ph1, transpose_a=True)) / B
+        dbv = nd.mean(v0 - v1, axis=0)
+        dbh = nd.mean(ph0 - ph1, axis=0)
+        err = float(nd.mean((v0 - pv1) ** 2).asnumpy())
+        return dW, dbv, dbh, err
+
+    n = len(Xtr)
+    first_err = last_err = None
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n)
+        tot, nb = 0.0, 0
+        for s in range(0, n - args.batch_size + 1, args.batch_size):
+            v0 = nd.array(Xtr[perm[s:s + args.batch_size]])
+            dW, dbv, dbh, err = cd1(v0)
+            W = W + args.lr * dW
+            bv = bv + args.lr * dbv
+            bh = bh + args.lr * dbh
+            tot += err; nb += 1
+        avg = tot / nb
+        first_err = first_err if first_err is not None else avg
+        last_err = avg
+        if epoch % 3 == 0:
+            print(f"epoch {epoch} recon err {avg:.4f}")
+
+    data_fe = float(nd.mean(free_energy(nd.array(Xtr[:256]))).asnumpy())
+    noise = (rng.rand(256, N_VIS) > 0.5).astype(np.float32)
+    noise_fe = float(nd.mean(free_energy(nd.array(noise))).asnumpy())
+    print(f"recon err {first_err:.4f} -> {last_err:.4f}; "
+          f"free energy data {data_fe:.1f} vs noise {noise_fe:.1f}")
+    assert last_err < first_err * 0.7, (first_err, last_err)
+    assert data_fe < noise_fe - 5.0, (data_fe, noise_fe)
+    print("RBM_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -51,8 +51,10 @@ def main():
     Xtr, ytr = make_data(rng, glyphs, 1024)
     Xte, yte = make_data(rng, glyphs, 256)
 
-    # linearly decaying survival probabilities (reference sd_module.py)
-    survival = [1.0 - (l / (args.blocks - 1)) * (1.0 - args.p_last)
+    # linearly decaying survival probabilities (reference sd_module.py);
+    # a single block just gets p_last
+    denom = max(1, args.blocks - 1)
+    survival = [1.0 - (l / denom) * (1.0 - args.p_last)
                 for l in range(args.blocks)]
 
     # plain (non-hybrid) Blocks ON PURPOSE: the gate is Python-level
@@ -104,7 +106,7 @@ def main():
     # ~ 8% at these settings, so probe several pairs — and fail BEFORE
     # spending the training budget if the gates are dead.
     xb = nd.array(Xtr[:8])
-    with autograd.record():
+    with autograd.train_mode():      # mode flag only — no tape needed
         outs = [net(xb).asnumpy() for _ in range(8)]
     varies = any(not np.allclose(outs[0], o) for o in outs[1:])
     assert varies, "train-time depth never varied - gates are dead"

@@ -214,6 +214,11 @@ def test_stochastic_depth_trains():
     assert "STOCHASTIC_DEPTH_OK" in out
 
 
+def test_rbm_contrastive_divergence():
+    out = _run("example/restricted-boltzmann-machine/rbm.py")
+    assert "RBM_OK" in out
+
+
 def test_bilstm_sort_learns():
     out = _run("example/bi-lstm-sort/sort.py", "--epochs", "5",
                "--batches-per-epoch", "12", "--hidden", "32",
