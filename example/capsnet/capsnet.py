@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Capsule network with dynamic routing (reference example/capsnet/
+capsulenet.py — Sabour et al.: primary capsules from conv features,
+class capsules computed by routing-by-agreement, margin loss on capsule
+lengths).
+
+Scaled to synthetic glyph digits. Everything that makes CapsNet CapsNet
+is here: the squash nonlinearity, per-(primary, class) prediction
+vectors u_hat = u W, three routing iterations where coupling logits
+grow by agreement <u_hat, v>, and the m+/m- margin loss on output
+capsule LENGTHS (class presence = vector norm, not a softmax). The
+routing loop runs over nd ops under autograd — gradients flow through
+the final coupling weights exactly as in the reference implementation.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+N_CLASSES = 6
+IMG = 16
+PRIM_CAPS = 32          # number of primary capsules
+PRIM_DIM = 8
+OUT_DIM = 12
+
+
+def make_data(rng, glyphs, n):
+    y = rng.randint(0, N_CLASSES, n)
+    X = glyphs[y] + 0.25 * rng.randn(n, 1, IMG, IMG).astype(np.float32)
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--routing-iters", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--min-acc", type=float, default=0.9)
+    args = ap.parse_args()
+    if args.routing_iters < 1:
+        ap.error("--routing-iters must be >= 1")
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(args.seed)
+    glyphs = (rng.rand(N_CLASSES, 1, IMG, IMG) > 0.5).astype(np.float32)
+    Xtr, ytr = make_data(rng, glyphs, 768)
+    Xte, yte = make_data(rng, glyphs, 192)
+
+    def squash(s, axis):
+        """v = |s|^2/(1+|s|^2) * s/|s| — the capsule nonlinearity."""
+        sq = nd.sum(s ** 2, axis=axis, keepdims=True)
+        return (sq / (1.0 + sq)) * s / nd.sqrt(sq + 1e-9)
+
+    class CapsNet(gluon.nn.Block):
+        """Plain Block: the routing loop is data-dependent Python."""
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.conv = gluon.nn.Conv2D(32, 5, strides=2,
+                                            activation="relu")
+                self.prim = gluon.nn.Conv2D(PRIM_CAPS * PRIM_DIM, 3,
+                                            strides=2)
+                # W: (PRIM_TOTAL, N_CLASSES, OUT_DIM, PRIM_DIM) routing
+                # transform, one matrix per (primary capsule, class)
+                self.W = self.params.get(
+                    "routing_weight",
+                    shape=(PRIM_CAPS * 2 * 2, N_CLASSES,
+                           OUT_DIM, PRIM_DIM),
+                    init=mx.init.Xavier())
+
+        def forward(self, x):
+            B = x.shape[0]
+            h = self.prim(self.conv(x))          # (B, C*D, 2, 2)
+            n_prim = PRIM_CAPS * h.shape[2] * h.shape[3]
+            u = h.reshape((B, PRIM_CAPS, PRIM_DIM, -1))
+            u = u.transpose((0, 1, 3, 2)).reshape((B, n_prim, PRIM_DIM))
+            u = squash(u, axis=2)                # primary capsule outputs
+            W = self.W.data()                    # (P, K, OD, PD)
+            # u_hat[b,p,k,:] = W[p,k] @ u[b,p]
+            u_exp = u.reshape((B, n_prim, 1, 1, PRIM_DIM))
+            u_hat = nd.sum(W.reshape((1, n_prim, N_CLASSES,
+                                      OUT_DIM, PRIM_DIM)) * u_exp,
+                           axis=4)               # (B, P, K, OD)
+
+            # routing by agreement; logits updated OUTSIDE the grad tape
+            # except the last pass, reference-style (detach u_hat for
+            # the agreement updates)
+            b_logit = nd.zeros((B, n_prim, N_CLASSES))
+            u_hat_d = u_hat.detach()
+            for it in range(args.routing_iters):
+                c = nd.softmax(b_logit, axis=2)          # couplings
+                uh = u_hat if it == args.routing_iters - 1 else u_hat_d
+                s = nd.sum(c.reshape((B, n_prim, N_CLASSES, 1)) * uh,
+                           axis=1)               # (B, K, OD)
+                v = squash(s, axis=2)
+                if it < args.routing_iters - 1:
+                    agree = nd.sum(u_hat_d * v.reshape((B, 1, N_CLASSES,
+                                                        OUT_DIM)), axis=3)
+                    b_logit = b_logit + agree
+            return nd.sqrt(nd.sum(v ** 2, axis=2) + 1e-9)   # lengths
+
+    def margin_loss(lengths, y):
+        """L = T max(0, m+ - |v|)^2 + 0.5 (1-T) max(0, |v| - m-)^2."""
+        onehot = nd.one_hot(y, depth=N_CLASSES)
+        pos = nd.maximum(0.9 - lengths, nd.zeros_like(lengths)) ** 2
+        neg = nd.maximum(lengths - 0.1, nd.zeros_like(lengths)) ** 2
+        return nd.mean(nd.sum(onehot * pos + 0.5 * (1 - onehot) * neg,
+                              axis=1))
+
+    np.random.seed(args.seed)
+    net = CapsNet()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    n = len(Xtr)
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n)
+        tot = 0.0
+        for s in range(0, n - args.batch_size + 1, args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            with autograd.record():
+                loss = margin_loss(net(nd.array(Xtr[idx])),
+                                   nd.array(ytr[idx]))
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        print(f"epoch {epoch} margin loss {tot / (n // args.batch_size):.4f}")
+
+    lengths = net(nd.array(Xte)).asnumpy()
+    acc = float((lengths.argmax(1) == yte).mean())
+    print(f"capsule-length accuracy {acc:.3f}")
+    assert acc >= args.min_acc, acc
+    print("CAPSNET_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -62,11 +62,9 @@ def main():
         """F(v) = -v.bv - sum log(1 + exp(v W + bh)) (reference
         binary_rbm.py free energy)."""
         pre = nd.dot(v, W) + bh
-        # stable softplus: log(1+exp(x)) = max(x,0) + log1p(exp(-|x|))
-        softplus = nd.maximum(pre, nd.zeros_like(pre)) + \
-            nd.log1p(nd.exp(-nd.abs(pre)))
-        return (-nd.dot(v, bv.reshape((-1, 1))).reshape((-1,))
-                - nd.sum(softplus, axis=1))
+        # overflow-stable softplus via the framework's softrelu
+        softplus = nd.Activation(pre, act_type="softrelu")
+        return -nd.dot(v, bv) - nd.sum(softplus, axis=1)
 
     def cd1(v0):
         ph0 = sigmoid(nd.dot(v0, W) + bh)        # positive phase

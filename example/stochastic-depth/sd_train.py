@@ -53,9 +53,11 @@ def main():
 
     # linearly decaying survival probabilities (reference sd_module.py);
     # a single block just gets p_last
-    denom = max(1, args.blocks - 1)
-    survival = [1.0 - (l / denom) * (1.0 - args.p_last)
-                for l in range(args.blocks)]
+    if args.blocks == 1:
+        survival = [args.p_last]
+    else:
+        survival = [1.0 - (l / (args.blocks - 1)) * (1.0 - args.p_last)
+                    for l in range(args.blocks)]
 
     # plain (non-hybrid) Blocks ON PURPOSE: the gate is Python-level
     # randomness, which hybridize() would trace ONCE and freeze into the

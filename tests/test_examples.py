@@ -214,6 +214,11 @@ def test_stochastic_depth_trains():
     assert "STOCHASTIC_DEPTH_OK" in out
 
 
+def test_capsnet_dynamic_routing():
+    out = _run("example/capsnet/capsnet.py")
+    assert "CAPSNET_OK" in out
+
+
 def test_rbm_contrastive_divergence():
     out = _run("example/restricted-boltzmann-machine/rbm.py")
     assert "RBM_OK" in out
