@@ -214,6 +214,16 @@ def test_stochastic_depth_trains():
     assert "STOCHASTIC_DEPTH_OK" in out
 
 
+def test_dec_unsupervised_clustering():
+    out = _run("example/deep-embedded-clustering/dec.py")
+    assert "DEC_OK" in out
+
+
+def test_sgld_posterior_sampling():
+    out = _run("example/bayesian-methods/sgld.py")
+    assert "SGLD_OK" in out
+
+
 def test_capsnet_dynamic_routing():
     out = _run("example/capsnet/capsnet.py")
     assert "CAPSNET_OK" in out
