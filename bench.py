@@ -356,6 +356,104 @@ def bench_int8_inference(batch, steps, image_size=224):
     return batch * steps / dt
 
 
+def bench_lstm_ptb(steps, batch=32, bptt=35):
+    """LSTM word-LM train step (BASELINE config 3: example/rnn/word_lm/
+    train.py, the cuDNN-RNN path there; ops/rnn_ops.py scan kernels here).
+    Reference small config: vocab 10k, 2x200 LSTM, bptt 35. The fused
+    fwd+bwd+SGD step runs `steps` times inside one XLA program via
+    TrainStep.run_steps, same discipline as bench_train. Returns tok/s."""
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import nn, rnn
+    from incubator_mxnet_tpu.parallel import TrainStep
+
+    vocab, emsize, nhid, nlayers = 10000, 200, 200, 2
+
+    class WordLM(gluon.Block):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.embed = nn.Embedding(vocab, emsize)
+            self.lstm = rnn.LSTM(nhid, num_layers=nlayers, layout="NTC")
+            self.decoder = nn.Dense(vocab, flatten=False)
+
+        def forward(self, x):
+            return self.decoder(self.lstm(self.embed(x)))
+
+    net = WordLM()
+    net.initialize(mx.init.Xavier())
+
+    def loss_fn(out, label):
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, label[..., None],
+                                             axis=-1))
+
+    rng = np.random.RandomState(0)
+    x0 = mx.nd.array(rng.randint(0, vocab, (batch, bptt)).astype(np.int32))
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 1.0},
+                     example_inputs=[x0])
+    x = jnp.asarray(rng.randint(0, vocab, (batch, bptt)).astype(np.int32))
+    y = jnp.asarray(np.roll(np.asarray(x), -1, 1))
+    _sync(step.run_steps(steps, x, y))    # compile + warmup
+    dt = _time_best(lambda: _sync(step.run_steps(steps, x, y)))
+    return batch * bptt * steps / dt
+
+
+def bench_ssd_detection(steps, batch=8, image_size=128):
+    """SSD detection train step (BASELINE config 4: example/ssd/train.py,
+    SSD-VGG16 there, the ToySSD of our example here). Exercises the
+    multibox op stack end to end — MultiBoxPrior anchors, MultiBoxTarget
+    assignment with hard-negative mining, joint cls+box loss — through
+    the eager autograd path the example trains with (per-op compiled
+    executables; the target-assignment op has data-dependent shapes that
+    keep it off the scanned-program path). Returns img/s."""
+    import importlib.util
+    import os as _os
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+
+    spec = importlib.util.spec_from_file_location(
+        "ssd_train", _os.path.join(_os.path.dirname(
+            _os.path.abspath(__file__)), "example", "ssd", "train.py"))
+    ssd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ssd)
+
+    rng = np.random.RandomState(0)
+    model = ssd.ToySSD(mx, gluon, num_classes=1)
+    trainer = gluon.Trainer(model.params(gluon), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    box_loss = gluon.loss.HuberLoss(rho=1.0)
+    xb, lb = ssd.make_batch(rng, batch, image_size)
+    x, label = nd.array(xb), nd.array(lb)
+
+    def one_step():
+        with autograd.record():
+            anchors, cls_pred, box_pred = model.forward(nd, x)
+            box_t, box_m, cls_t = nd.contrib.MultiBoxTarget(
+                anchors, label, cls_pred.transpose((0, 2, 1)),
+                overlap_threshold=0.5, negative_mining_ratio=3.0,
+                minimum_negative_samples=0,
+                variances=(0.1, 0.1, 0.2, 0.2))
+            loss = (cls_loss(cls_pred, cls_t)
+                    + box_loss(box_pred * box_m, box_t * box_m))
+        loss.backward()
+        trainer.step(batch)
+        return loss
+
+    _sync(one_step())                     # compile + warmup
+
+    def run():
+        for _ in range(steps):
+            loss = one_step()
+        _sync(loss)
+
+    dt = _time_best(run)
+    return batch * steps / dt
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=None,
@@ -444,6 +542,31 @@ def main():
                 "value": results[-1]["img_per_sec"], "unit": "img/s",
                 "vs_baseline": results[-1]["vs_baseline"]}), flush=True)
             head_printed = True
+
+    if args.full or on_tpu:
+        # BASELINE configs 3 + 4: every workload family in BASELINE.json
+        # now has a bench row (LeNet/ResNet via train/inference above,
+        # distributed via tools/bandwidth)
+        try:
+            tok_s = bench_lstm_ptb(steps_for("train", "float32"))
+            results.append({"mode": "lstm_ptb_train", "batch": 32,
+                            "dtype": "float32",
+                            "tokens_per_sec": round(tok_s, 1),
+                            "vs_baseline": None})
+            print(f"[bench] lstm word-lm (2x200, bptt 35, b32) "
+                  f"{tok_s:9.0f} tok/s", file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] lstm_ptb: FAILED {e!r}", file=sys.stderr)
+        try:
+            ips = bench_ssd_detection(steps_for("train", "float32"))
+            results.append({"mode": "ssd_detection_train", "batch": 8,
+                            "dtype": "float32",
+                            "img_per_sec": round(ips, 2),
+                            "vs_baseline": None})
+            print(f"[bench] ssd detection train (multibox stack, b8) "
+                  f"{ips:9.2f} img/s", file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] ssd_detection: FAILED {e!r}", file=sys.stderr)
 
     if on_tpu:
         try:

@@ -112,6 +112,7 @@ def __getattr__(name):
         "runtime": ".runtime",
         "parallel": ".parallel",
         "models": ".models",
+        "serve": ".serve",
         "util": ".util",
         "utils": ".util",
         "test_utils": ".test_utils",

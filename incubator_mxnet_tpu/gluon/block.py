@@ -226,11 +226,19 @@ class Block:
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False, dtype_source="current"):
-        """Reference gluon/block.py:361."""
+        """Reference gluon/block.py:361. Also accepts Module-checkpoint /
+        `export`-style files whose keys are `arg:name`/`aux:name` (the
+        reference's legacy-loading branch): those match by Parameter.name
+        instead of the structured dotted path."""
         loaded = nd.load(filename)
         if not isinstance(loaded, dict):
             raise MXNetError("not a parameter dict file")
-        params = self._collect_params_with_prefix()
+        if loaded and all(k.startswith(("arg:", "aux:")) for k in loaded):
+            loaded = {k.split(":", 1)[1]: v for k, v in loaded.items()}
+            by_name = {p.name: p for p in self.collect_params().values()}
+            params = {name: by_name[name] for name in by_name}
+        else:
+            params = self._collect_params_with_prefix()
         for name, p in params.items():
             if name in loaded:
                 p._infer_shape(loaded[name].shape)

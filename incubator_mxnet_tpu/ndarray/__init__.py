@@ -11,8 +11,8 @@ from __future__ import annotations
 from . import random
 from .ndarray import (NDArray, arange, array, concatenate, empty, eye, from_jax,
                       full, linspace, moveaxis, ones, waitall, zeros)
-from .utils import (from_dlpack, load, save, to_dlpack_for_read,
-                    to_dlpack_for_write)
+from .utils import (from_dlpack, load, load_frombuffer, save,
+                    to_dlpack_for_read, to_dlpack_for_write)
 from . import sparse
 from .sparse import cast_storage
 from . import contrib

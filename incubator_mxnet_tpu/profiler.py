@@ -145,11 +145,16 @@ def dump(finished=True, profile_process="worker"):
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
     """Aggregate-stats table string (reference
-    MXAggregateProfileStatsPrint / aggregate_stats.cc)."""
+    MXAggregateProfileStatsPrint / aggregate_stats.cc). Counter series
+    (profiler.Counter — op counts, serving queue depth / shed totals from
+    serve/stats.py) are aggregated into their own section: last value +
+    sample count per counter name."""
     with _lock:
         events = list(_events)
+        counters = list(_counters)
         if reset:
             _events.clear()
+            _counters.clear()
     agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
     for name, cat, ts, dur, tid in events:
         a = agg[name]
@@ -157,10 +162,17 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         a[1] += dur
         a[2] = min(a[2], dur)
         a[3] = max(a[3], dur)
+    cagg = {}
+    for name, ts, value in counters:
+        cnt = cagg[name][0] + 1 if name in cagg else 1
+        cagg[name] = (cnt, value)
     if format == "json":
-        return json.dumps({k: {"count": v[0], "total_us": v[1],
-                               "min_us": v[2], "max_us": v[3]}
-                           for k, v in agg.items()})
+        return json.dumps({
+            "stats": {k: {"count": v[0], "total_us": v[1],
+                          "min_us": v[2], "max_us": v[3]}
+                      for k, v in agg.items()},
+            "counters": {k: {"samples": c, "value": v}
+                         for k, (c, v) in cagg.items()}})
     lines = [f"{'Name':<40}{'Count':>8}{'Total(us)':>14}"
              f"{'Min(us)':>12}{'Max(us)':>12}{'Avg(us)':>12}",
              "-" * 98]
@@ -171,6 +183,12 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
                                            reverse=not ascending):
         lines.append(f"{name:<40}{cnt:>8}{tot:>14.1f}{mn:>12.1f}"
                      f"{mx:>12.1f}{tot / max(cnt, 1):>12.1f}")
+    if cagg:
+        lines += ["", f"{'Counter':<48}{'Samples':>10}{'Value':>16}",
+                  "-" * 74]
+        for name, (cnt, val) in sorted(cagg.items()):
+            sval = f"{val:.3f}" if isinstance(val, float) else f"{val}"
+            lines.append(f"{name:<48}{cnt:>10}{sval:>16}")
     return "\n".join(lines)
 
 

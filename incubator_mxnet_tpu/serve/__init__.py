@@ -1,0 +1,38 @@
+"""Predict-only inference serving (reference c_predict ABI, grown into a
+serving subsystem).
+
+Reference: include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc —
+a training-free Predictor over an exported (symbol.json, .params) pair.
+On top of that surface this package adds what production TPU serving
+actually needs (TensorFlow paper §Serving; Ragged Paged Attention's
+shape-bucketing discipline):
+
+  predictor.py  Predictor — cached per-bucket jit executables over the
+                exported graph; reference-compatible .params input.
+  batcher.py    DynamicBatcher — coalesce concurrent requests into a
+                fixed ladder of padded bucket shapes (max-latency +
+                max-batch triggers), bounded admission queue, load-shed
+                with retryable errors.
+  server.py     ModelServer — stdlib threaded HTTP front end mapping the
+                shed/deadline policy onto 503/504.
+  stats.py      ServingStats — p50/p95/p99 histograms, queue/shed/
+                occupancy counters, published via profiler.Counter so
+                profiler.dumps() shows the serving table.
+
+Typical use::
+
+    import incubator_mxnet_tpu as mx
+    net.export("model")                       # training side
+    pred = mx.serve.Predictor.from_artifact("model",
+                                            bucket_sizes=(4, 8, 16, 32))
+    with mx.serve.ModelServer(pred, port=8080) as srv:
+        ...                                   # POST /predict
+"""
+from .predictor import BucketLadder, Predictor
+from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
+from .server import ModelServer
+from .stats import LatencyHistogram, ServingStats
+
+__all__ = ["Predictor", "BucketLadder", "DynamicBatcher", "ModelServer",
+           "ServingStats", "LatencyHistogram", "Overloaded",
+           "DeadlineExceeded"]

@@ -1,0 +1,319 @@
+"""Predict-only inference entry point (reference c_predict ABI).
+
+Reference: include/mxnet/c_predict_api.h (350 LoC over
+src/c_api/c_predict_api.cc): MXPredCreate(symbol json + .params payload),
+MXPredSetInput, MXPredForward, MXPredGetOutput(Shape), MXPredReshape — a
+deliberately tiny surface that needs no training runtime, so it can sit
+in a serving binary.
+
+TPU-native redesign: the NNVM graph executor becomes one cached `jax.jit`
+executable per input-shape signature over the Symbol's functional
+evaluator (`symbol._build_eval`, the same path `SymbolBlock` uses), with
+parameters held on device and passed as traced arguments. Shape discipline
+is the serving-critical part (Ragged Paged Attention, arXiv:2604.15464:
+TPU serving wins come from a SMALL FIXED set of compiled bucket shapes):
+a `bucket_sizes` ladder pads every batch up to the next bucket, so the
+executable count is bounded by the ladder length — never by traffic — and
+`max_executables` hard-fails instead of silently compiling per shape.
+"""
+from __future__ import annotations
+
+import json as _json
+import os
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["Predictor", "BucketLadder"]
+
+
+class BucketLadder:
+    """A fixed ascending ladder of batch sizes; requests pad up to the
+    smallest bucket that fits (one compiled executable per bucket)."""
+
+    def __init__(self, sizes=(1, 2, 4, 8, 16, 32)):
+        sizes = sorted({int(s) for s in sizes})
+        if not sizes or sizes[0] < 1:
+            raise MXNetError(f"invalid bucket ladder {sizes}")
+        self.sizes = tuple(sizes)
+
+    @property
+    def max_size(self):
+        return self.sizes[-1]
+
+    def bucket_for(self, n):
+        """Smallest bucket >= n, or None when n exceeds the ladder (the
+        caller must split the batch)."""
+        for s in self.sizes:
+            if s >= n:
+                return s
+        return None
+
+    def __len__(self):
+        return len(self.sizes)
+
+    def __repr__(self):
+        return f"BucketLadder{self.sizes}"
+
+
+def _strip_param_prefix(params):
+    """Reference .params artifacts name entries `arg:w`/`aux:m`
+    (module checkpoint convention, also written by HybridBlock.export)."""
+    return {k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k: v
+            for k, v in params.items()}
+
+
+class Predictor:
+    """Predict-only executor over an exported (symbol.json, .params) pair.
+
+    Stateful surface (`set_input`/`forward`/`get_output`) mirrors the
+    reference predictor one-to-one for porting ease; the stateless
+    `predict(inputs)` is the thread-safe hot path the serving batcher
+    uses — it touches no per-handle state, so any number of batcher and
+    client threads can share one Predictor (XLA executables are
+    reentrant).
+    """
+
+    def __init__(self, symbol, params=None, input_shapes=None, ctx=None,
+                 bucket_sizes=(1, 2, 4, 8, 16, 32), max_executables=None,
+                 batch_axis=0):
+        from .. import symbol as _sym
+        from .. import nd
+
+        # -- symbol: Symbol object, path to -symbol.json, or json text --
+        if isinstance(symbol, str):
+            if os.path.exists(symbol):
+                symbol = _sym.load(symbol)
+            elif symbol.lstrip().startswith("{"):
+                symbol = _sym.load_json(symbol)
+            else:
+                raise MXNetError(f"no such symbol file: {symbol}")
+        self._sym = symbol
+
+        # -- params: dict, .params path, or raw container bytes ---------
+        if params is None:
+            params = {}
+        elif isinstance(params, (bytes, bytearray)):
+            params = nd.load_frombuffer(bytes(params))
+        elif isinstance(params, str):
+            params = nd.load(params)
+        if not isinstance(params, dict):
+            raise MXNetError(".params payload must be a name->NDArray map")
+        params = _strip_param_prefix(params)
+
+        args = list(self._sym.list_arguments())
+        aux = list(self._sym.list_auxiliary_states())
+        known = set(args) | set(aux)
+        if input_shapes is not None:
+            self._input_names = list(input_shapes)
+            self._input_shapes = {k: tuple(v) if v is not None else None
+                                  for k, v in dict(input_shapes).items()}
+        else:
+            self._input_names = [a for a in args if a not in params]
+            self._input_shapes = {}
+        missing = [a for a in args + aux
+                   if a not in params and a not in self._input_names]
+        if missing:
+            raise MXNetError(
+                f"graph inputs {missing[:5]} are neither in .params nor "
+                f"declared as inputs {self._input_names}")
+        unknown = [k for k in self._input_names if k not in known]
+        if unknown:
+            raise MXNetError(
+                f"declared inputs {unknown} are not arguments of the "
+                f"graph (arguments: {sorted(known)[:8]}...)")
+
+        import jax
+        dev = ctx.jax_device if ctx is not None else None
+        self._param_vals = {}
+        for name in args + aux:
+            if name in params:
+                v = params[name]
+                a = v._data if isinstance(v, NDArray) else jax.numpy.asarray(v)
+                self._param_vals[name] = (jax.device_put(a, dev)
+                                          if dev is not None else a)
+
+        self.ladder = (BucketLadder(bucket_sizes)
+                       if bucket_sizes is not None else None)
+        # default cap: one executable per bucket, or 16 for free-shape use
+        self._max_executables = (max_executables if max_executables
+                                 else (len(self.ladder) if self.ladder
+                                       else 16))
+        self._batch_axis = batch_axis
+        self._executables = {}
+        self._compile_lock = threading.Lock()
+        self._run = self._sym._build_eval(training=False)
+        self._inputs = {}
+        self._outputs = None
+
+    # ------------------------------------------------------------------
+    # compiled-executable management
+    # ------------------------------------------------------------------
+    @property
+    def num_executables(self):
+        return len(self._executables)
+
+    @property
+    def output_names(self):
+        return self._sym.list_outputs()
+
+    def _executable_for(self, sig):
+        fn = self._executables.get(sig)
+        if fn is not None:
+            return fn
+        with self._compile_lock:
+            fn = self._executables.get(sig)
+            if fn is not None:
+                return fn
+            if len(self._executables) >= self._max_executables:
+                raise MXNetError(
+                    f"predictor executable cache full "
+                    f"({self._max_executables}): refusing to compile for "
+                    f"signature {sig} — serving must stay within the "
+                    f"bucket ladder {self.ladder}")
+            import jax
+
+            run = self._run
+
+            def call(param_vals, input_vals):
+                outs, _ = run({**param_vals, **input_vals})
+                return tuple(outs)
+
+            fn = jax.jit(call)
+            self._executables[sig] = fn
+            return fn
+
+    def _pad_batch(self, arrays):
+        """Pad dict of batched host/device arrays up the bucket ladder.
+        Returns (padded, real_n). Padding rows are zeros; row independence
+        of inference graphs makes them inert, and the exactness of the
+        real rows is enforced by tests/test_serve.py."""
+        n = None
+        for name, a in arrays.items():
+            if a.ndim <= self._batch_axis:
+                raise MXNetError(f"input {name!r} has no batch axis")
+            bn = a.shape[self._batch_axis]
+            if n is None:
+                n = bn
+            elif bn != n:
+                raise MXNetError(
+                    f"inconsistent batch sizes across inputs ({bn} vs {n})")
+        if n is None:
+            raise MXNetError("no inputs bound")
+        if self.ladder is None:
+            return arrays, n
+        bucket = self.ladder.bucket_for(n)
+        if bucket is None:
+            raise MXNetError(
+                f"batch {n} exceeds the bucket ladder max "
+                f"{self.ladder.max_size}; split the request")
+        if bucket == n:
+            return arrays, n
+        padded = {}
+        for name, a in arrays.items():
+            widths = [(0, 0)] * a.ndim
+            widths[self._batch_axis] = (0, bucket - n)
+            padded[name] = _np.pad(_np.asarray(a), widths)
+        return padded, n
+
+    # ------------------------------------------------------------------
+    # stateless hot path (used by the batcher/server)
+    # ------------------------------------------------------------------
+    def predict(self, inputs):
+        """Run one batched forward: name->array (numpy or jax, batch on
+        `batch_axis`) -> list of jax arrays sliced back to the real batch.
+        Pure function of its arguments — safe from many threads."""
+        import jax.numpy as jnp
+
+        arrays = {}
+        for name in self._input_names:
+            if name not in inputs:
+                raise MXNetError(f"missing input {name!r}")
+            a = inputs[name]
+            a = a._data if isinstance(a, NDArray) else _np.asarray(a)
+            arrays[name] = a
+        extra = set(inputs) - set(self._input_names)
+        if extra:
+            raise MXNetError(f"unknown inputs {sorted(extra)}")
+        padded, n = self._pad_batch(arrays)
+        sig = tuple((name, tuple(a.shape), str(a.dtype))
+                    for name, a in sorted(padded.items()))
+        fn = self._executable_for(sig)
+        outs = fn(self._param_vals,
+                  {k: jnp.asarray(v) for k, v in padded.items()})
+        sliced = []
+        for o in outs:
+            if o.ndim > self._batch_axis and \
+                    o.shape[self._batch_axis] != n:
+                idx = [slice(None)] * o.ndim
+                idx[self._batch_axis] = slice(0, n)
+                o = o[tuple(idx)]
+            sliced.append(o)
+        return sliced
+
+    # ------------------------------------------------------------------
+    # reference c_predict stateful surface
+    # ------------------------------------------------------------------
+    def set_input(self, key, value):
+        """MXPredSetInput."""
+        if key not in self._input_names:
+            raise MXNetError(
+                f"unknown input {key!r} (inputs: {self._input_names})")
+        value = value.asnumpy() if isinstance(value, NDArray) \
+            else _np.asarray(value)
+        want = self._input_shapes.get(key)
+        if want is not None and tuple(value.shape) != tuple(want):
+            raise MXNetError(
+                f"input {key!r} shape {value.shape} != declared {want} "
+                "(use reshape() to change the signature)")
+        self._inputs[key] = value
+        return self
+
+    def forward(self, **kwargs):
+        """MXPredForward; keyword inputs are a set_input shorthand."""
+        for k, v in kwargs.items():
+            self.set_input(k, v)
+        missing = [k for k in self._input_names if k not in self._inputs]
+        if missing:
+            raise MXNetError(f"inputs not set: {missing}")
+        self._outputs = self.predict(self._inputs)
+        return self
+
+    def get_output(self, index=0):
+        """MXPredGetOutput -> NDArray."""
+        if self._outputs is None:
+            raise MXNetError("call forward() before get_output()")
+        return NDArray(self._outputs[index])
+
+    def get_output_shape(self, index=0):
+        """MXPredGetOutputShape."""
+        if self._outputs is None:
+            raise MXNetError("call forward() before get_output_shape()")
+        return tuple(self._outputs[index].shape)
+
+    def reshape(self, new_input_shapes):
+        """MXPredReshape: re-declare the input signature. Executables are
+        per-shape already, so this just validates + clears bound state;
+        the reference returned a new handle for the same reason."""
+        self._input_shapes.update({k: tuple(v) for k, v
+                                   in dict(new_input_shapes).items()})
+        self._inputs.clear()
+        self._outputs = None
+        return self
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_artifact(cls, prefix, epoch=0, **kwargs):
+        """Load a `HybridBlock.export` / `Module.save_checkpoint` artifact
+        pair `{prefix}-symbol.json` + `{prefix}-{epoch:04d}.params`."""
+        return cls(f"{prefix}-symbol.json",
+                   f"{prefix}-{epoch:04d}.params", **kwargs)
+
+    def __repr__(self):
+        return (f"Predictor(inputs={self._input_names}, "
+                f"outputs={len(self._sym.list_outputs())}, "
+                f"ladder={self.ladder}, "
+                f"executables={self.num_executables})")

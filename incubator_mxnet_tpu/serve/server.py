@@ -1,0 +1,168 @@
+"""Threaded HTTP front end for a Predictor — stdlib only.
+
+The reference never shipped a server (c_predict was embedded into user
+binaries); the north star ("serve heavy traffic") needs one. This is a
+deliberately small threaded front end over the DynamicBatcher: admission
+control lives in the batcher's bounded queue, and the server's job is to
+map the serving protocol onto HTTP honestly:
+
+  200  result
+  503  Overloaded       (queue full)           Retry-After + retryable:true
+  504  DeadlineExceeded (expired in queue/wait)            retryable:true
+  400  malformed request                                   retryable:false
+  500  predict raised                                      retryable:false
+
+A saturating burst therefore degrades into fast 503s (clients retry
+elsewhere/later) instead of collapsing into unbounded queueing — the same
+shed-don't-stall policy the kvstore server and fault.py use.
+
+Protocol (JSON):
+  POST /predict   {"inputs": {"data": [[...]]}, "deadline_ms": 250}
+                  -> {"outputs": [[...], ...]}   (one list per output,
+                     sample-shaped — requests are UNBATCHED samples)
+  GET  /healthz   -> {"status": "ok", "queue_depth": n}
+  GET  /stats     -> ServingStats.snapshot()
+"""
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import TimeoutError as _FutTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as _np
+
+from ..base import MXNetError
+from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
+from .stats import ServingStats
+
+__all__ = ["ModelServer"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "mxtpu-serve/0.1"
+
+    # the ModelServer instance is attached to the socket server
+    @property
+    def _ms(self):
+        return self.server.model_server
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _reply(self, code, payload, retry_after=None):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            self.send_header("Retry-After", str(retry_after))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        ms = self._ms
+        if self.path == "/healthz":
+            self._reply(200, {"status": "ok",
+                              "queue_depth": ms.stats.queue_depth})
+        elif self.path == "/stats":
+            self._reply(200, ms.stats.snapshot())
+        else:
+            self._reply(404, {"error": "not found", "retryable": False})
+
+    def do_POST(self):
+        if self.path != "/predict":
+            self._reply(404, {"error": "not found", "retryable": False})
+            return
+        ms = self._ms
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            raw = req["inputs"]
+            inputs = {k: _np.asarray(v, dtype=_np.float32)
+                      for k, v in raw.items()}
+            deadline_ms = req.get("deadline_ms", ms.default_deadline_ms)
+        except (KeyError, ValueError, TypeError) as e:
+            self._reply(400, {"error": f"malformed request: {e}",
+                              "retryable": False})
+            return
+        try:
+            fut = ms.batcher.submit(inputs, deadline_ms=deadline_ms)
+            timeout = (deadline_ms / 1e3 + 1.0) if deadline_ms else None
+            outs = fut.result(timeout=timeout)
+        except Overloaded as e:
+            self._reply(e.status, {"error": str(e), "retryable": True},
+                        retry_after="0.05")
+            return
+        except (DeadlineExceeded, _FutTimeout) as e:
+            self._reply(504, {"error": str(e) or "deadline exceeded",
+                              "retryable": True})
+            return
+        except Exception as e:  # noqa: BLE001 — predict failure -> 500
+            self._reply(500, {"error": str(e), "retryable": False})
+            return
+        self._reply(200, {"outputs": [o.tolist() for o in outs]})
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    # accept backlog must exceed the admission queue: shedding is the
+    # batcher's job (fast 503), not the kernel's (silent RST under bursts)
+    request_queue_size = 256
+    daemon_threads = True
+
+
+class ModelServer:
+    """Serve a Predictor over HTTP with dynamic batching + admission
+    control. `port=0` binds an ephemeral port (returned by start())."""
+
+    def __init__(self, predictor, host="127.0.0.1", port=0,
+                 max_latency_ms=5.0, max_queue=128,
+                 default_deadline_ms=1000.0, stats=None, name="serve"):
+        self.predictor = predictor
+        buckets = (predictor.ladder.sizes if predictor.ladder is not None
+                   else (1, 2, 4, 8, 16, 32))
+        self.stats = stats if stats is not None else ServingStats(name)
+        self.batcher = DynamicBatcher(
+            predictor.predict, buckets=buckets,
+            max_latency_ms=max_latency_ms, max_queue=max_queue,
+            default_deadline_ms=default_deadline_ms, stats=self.stats)
+        self.default_deadline_ms = default_deadline_ms
+        self._host, self._port = host, port
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def address(self):
+        if self._httpd is None:
+            raise MXNetError("server not started")
+        return self._httpd.server_address[:2]
+
+    def start(self):
+        if self._httpd is not None:
+            return self.address
+        self.batcher.start()
+        self._httpd = _HTTPServer((self._host, self._port), _Handler)
+        self._httpd.model_server = self
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="mxtpu-serve-http",
+                                        daemon=True)
+        self._thread.start()
+        return self.address
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.batcher.stop()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -13,7 +13,8 @@ from .context import Context, cpu, current_context
 
 __all__ = ["default_context", "assert_almost_equal", "same", "rand_ndarray",
            "rand_shape_2d", "rand_shape_3d", "check_numeric_gradient",
-           "check_consistency", "almost_equal"]
+           "check_consistency", "check_symbolic_forward",
+           "check_symbolic_backward", "almost_equal"]
 
 _default_ctx = None
 
@@ -150,3 +151,95 @@ def check_consistency(fn, inputs, ctx_list=None, dtype_list=None, rtol=None,
             atol=atol if atol is not None else dat,
             err_msg=f"{key} inconsistent with {ref_key}")
     return results
+
+
+def _parse_location(sym, location, dtype):
+    """list/dict of arrays -> ordered {arg_name: NDArray}
+    (reference test_utils.py:178 _parse_location)."""
+    arg_names = sym.list_arguments()
+    if isinstance(location, dict):
+        unknown = set(location) - set(arg_names)
+        if unknown:
+            raise ValueError(f"location has keys {sorted(unknown)} not in "
+                             f"list_arguments()={arg_names}")
+        pairs = [(n, location[n]) for n in arg_names if n in location]
+    else:
+        if len(location) != len(arg_names):
+            raise ValueError(f"expected {len(arg_names)} location entries "
+                             f"({arg_names}), got {len(location)}")
+        pairs = list(zip(arg_names, location))
+    out = {}
+    for n, v in pairs:
+        if not isinstance(v, nd.NDArray):
+            v = nd.array(_np.asarray(v, dtype=dtype))
+        out[n] = v
+    return out
+
+
+def check_symbolic_forward(sym, location, expected, rtol=None, atol=None,
+                           aux_states=None, ctx=None, dtype="float32"):
+    """Bind `sym`, run one inference forward, compare each output against
+    `expected` (reference test_utils.py:1015). Returns the outputs as
+    numpy arrays so callers can chain further checks."""
+    ctx = ctx or default_context()
+    args = _parse_location(sym, location, dtype)
+    if aux_states is not None and not isinstance(aux_states, dict):
+        aux_states = dict(zip(sym.list_auxiliary_states(), aux_states))
+    exe = sym.bind(ctx=ctx, args=args, grad_req="null",
+                   aux_states={k: nd.array(_np.asarray(v, dtype=dtype))
+                               if not isinstance(v, nd.NDArray) else v
+                               for k, v in (aux_states or {}).items()})
+    outputs = [o.asnumpy() for o in exe.forward(is_train=False)]
+    if isinstance(expected, dict):
+        expected = [expected[n] for n in sym.list_outputs()]
+    if len(expected) != len(outputs):
+        raise ValueError(f"symbol has {len(outputs)} outputs, expected "
+                         f"list has {len(expected)}")
+    for i, (got, want) in enumerate(zip(outputs, expected)):
+        assert_almost_equal(got, _to_np(want), rtol=rtol, atol=atol,
+                            names=(f"output[{i}]", f"expected[{i}]"))
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=None,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None, dtype="float32"):
+    """Bind `sym`, run forward + backward with `out_grads` as head
+    gradients, compare argument gradients against `expected`
+    (reference test_utils.py:1097). `expected` may be a dict keyed by
+    argument name (args with grad_req null are not checked) or a full
+    list. Returns {arg_name: grad ndarray-as-numpy}."""
+    ctx = ctx or default_context()
+    args = _parse_location(sym, location, dtype)
+    arg_names = sym.list_arguments()
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(arg_names, expected))
+    if isinstance(grad_req, str):
+        grad_req = {n: grad_req for n in arg_names}
+    elif isinstance(grad_req, (list, tuple)):
+        grad_req = dict(zip(arg_names, grad_req))
+    if aux_states is not None and not isinstance(aux_states, dict):
+        aux_states = dict(zip(sym.list_auxiliary_states(), aux_states))
+    exe = sym.bind(ctx=ctx, args=args, grad_req=grad_req,
+                   aux_states={k: nd.array(_np.asarray(v, dtype=dtype))
+                               if not isinstance(v, nd.NDArray) else v
+                               for k, v in (aux_states or {}).items()})
+    exe.forward(is_train=True)
+    if out_grads is not None:
+        if isinstance(out_grads, dict):
+            out_grads = [out_grads[n] for n in sym.list_outputs()]
+        if not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+        out_grads = [g if isinstance(g, nd.NDArray)
+                     else nd.array(_np.asarray(g, dtype=dtype))
+                     for g in out_grads]
+    exe.backward(out_grads)
+    grads = {n: g.asnumpy() for n, g in exe.grad_dict.items()}
+    for name, want in expected.items():
+        if grad_req.get(name, "write") == "null":
+            continue
+        if name not in grads:
+            raise ValueError(f"no gradient produced for argument {name!r}")
+        assert_almost_equal(grads[name], _to_np(want), rtol=rtol, atol=atol,
+                            names=(f"grad[{name}]", f"expected[{name}]"))
+    return grads

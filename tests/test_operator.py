@@ -322,3 +322,42 @@ def test_flat_argext_helper_small_and_bool():
             assert got.shape == want.shape, (ax, kd)
             np.testing.assert_array_equal(np.asarray(got, np.int64),
                                           np.asarray(want))
+
+
+def test_check_symbolic_forward_fc_relu():
+    """FullyConnected+Activation through the symbolic forward checker
+    (reference test_operator.py uses check_symbolic_forward this way)."""
+    from incubator_mxnet_tpu.test_utils import check_symbolic_forward
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    b = mx.sym.Variable("b")
+    net = mx.sym.Activation(
+        mx.sym.FullyConnected(data, weight=w, bias=b, num_hidden=5,
+                              name="fc"),
+        act_type="relu")
+    x, wv, bv = _rand(4, 3), _rand(5, 3), _rand(5)
+    want = np.maximum(x @ wv.T + bv, 0.0)
+    check_symbolic_forward(net, {"data": x, "w": wv, "b": bv}, [want],
+                           rtol=1e-5, atol=1e-6)
+
+
+def test_check_symbolic_backward_square_sum():
+    """d/dx sum(x^2) = 2x, via the symbolic backward checker."""
+    from incubator_mxnet_tpu.test_utils import check_symbolic_backward
+    x = _rand(3, 4)
+    sym = mx.sym.square(mx.sym.Variable("x"))
+    out_grad = _rand(3, 4)
+    check_symbolic_backward(sym, {"x": x}, [out_grad],
+                            {"x": 2.0 * x * out_grad},
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_check_symbolic_backward_grad_req_null():
+    """grad_req null args get no gradient and are not checked."""
+    from incubator_mxnet_tpu.test_utils import check_symbolic_backward
+    a, b = _rand(2, 3), _rand(2, 3)
+    sym = mx.sym.Variable("a") * mx.sym.Variable("b")
+    grads = check_symbolic_backward(
+        sym, {"a": a, "b": b}, [np.ones((2, 3), np.float32)],
+        {"a": b}, grad_req={"a": "write", "b": "null"})
+    assert "b" not in grads

@@ -1,0 +1,404 @@
+"""serve/ subsystem: Predictor, DynamicBatcher, ModelServer, ServingStats.
+
+Acceptance criteria from the serving milestone:
+  * >= 64 concurrent client threads through the batcher produce outputs
+    bit-identical to the unbatched Predictor.forward path,
+  * the bucket ladder compiles at most the configured number of
+    executables,
+  * a saturating burst sheds with a retryable status (no deadlock, no
+    unbounded queue),
+  * profiler.dumps() shows the serving latency/queue/shed counters.
+"""
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, serve
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.serve import (DeadlineExceeded, DynamicBatcher,
+                                       ModelServer, Overloaded, Predictor)
+from incubator_mxnet_tpu.serve.predictor import BucketLadder
+from incubator_mxnet_tpu.serve.stats import LatencyHistogram, ServingStats
+
+IN_DIM, OUT_DIM = 6, 4
+
+
+@pytest.fixture(scope="module")
+def artifact():
+    """One exported MLP shared by the module (compilation is the slow part)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(OUT_DIM))
+    net.initialize()
+    net(nd.array(np.zeros((1, IN_DIM), np.float32)))
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "model")
+    net.export(path)
+    return path, net
+
+
+@pytest.fixture(scope="module")
+def predictor(artifact):
+    path, _ = artifact
+    return Predictor.from_artifact(path, bucket_sizes=(2, 4, 8, 16, 32, 64))
+
+
+# -- BucketLadder ------------------------------------------------------
+
+
+def test_bucket_ladder():
+    lad = BucketLadder((8, 2, 4))
+    assert lad.sizes == (2, 4, 8)
+    assert lad.bucket_for(1) == 2
+    assert lad.bucket_for(2) == 2
+    assert lad.bucket_for(3) == 4
+    assert lad.bucket_for(8) == 8
+    assert lad.bucket_for(9) is None
+    assert len(lad) == 3
+
+
+# -- Predictor ---------------------------------------------------------
+
+
+def test_predictor_from_artifact_matches_net(artifact, predictor):
+    _, net = artifact
+    x = np.random.rand(3, IN_DIM).astype(np.float32)
+    want = net(nd.array(x)).asnumpy()
+    outs = predictor.predict({"data": x})
+    np.testing.assert_allclose(np.asarray(outs[0]), want, rtol=1e-6)
+    # c_predict-style stateful surface agrees with the stateless one
+    predictor.set_input("data", x)
+    predictor.forward()
+    got = predictor.get_output(0).asnumpy()
+    np.testing.assert_array_equal(got, np.asarray(outs[0]))
+    assert predictor.get_output_shape(0) == (3, OUT_DIM)
+
+
+def test_predictor_rejects_bad_inputs(predictor):
+    with pytest.raises(mx.MXNetError):
+        predictor.predict({"not_an_input": np.zeros((1, IN_DIM), np.float32)})
+    with pytest.raises(mx.MXNetError):  # batch beyond the largest bucket
+        predictor.predict({"data": np.zeros((65, IN_DIM), np.float32)})
+
+
+def test_predictor_accepts_reference_params_wire(artifact):
+    """A .params file in the reference binary container format (satellite:
+    the c_predict ABI consumes exactly what MXNDArraySave emits)."""
+    path, net = artifact
+    params = {}
+    for name, p in net.collect_params().items():
+        params["arg:" + p.name] = p.data()
+    d = tempfile.mkdtemp()
+    pfile = os.path.join(d, "wire.params")
+    nd.save(pfile, params)
+    with open(pfile, "rb") as f:
+        magic = int.from_bytes(f.read(8), "little")
+    assert magic == 0x112  # kMXAPINDArrayListMagic
+    pred = Predictor(path + "-symbol.json", pfile, bucket_sizes=(2, 4))
+    x = np.random.rand(2, IN_DIM).astype(np.float32)
+    want = net(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(
+        np.asarray(pred.predict({"data": x})[0]), want, rtol=1e-6)
+
+
+def test_predictor_executable_cap(artifact):
+    path, _ = artifact
+    pred = Predictor.from_artifact(path, bucket_sizes=(2, 4))
+    pred.predict({"data": np.zeros((1, IN_DIM), np.float32)})
+    pred.predict({"data": np.zeros((2, IN_DIM), np.float32)})
+    pred.predict({"data": np.zeros((3, IN_DIM), np.float32)})
+    pred.predict({"data": np.zeros((4, IN_DIM), np.float32)})
+    assert pred.num_executables <= len(pred.ladder)  # 2 buckets -> <= 2
+    assert pred.num_executables == 2
+
+
+def test_predictor_reshape(artifact):
+    path, _ = artifact
+    pred = Predictor.from_artifact(path, bucket_sizes=(2, 4, 8))
+    pred.set_input("data", np.random.rand(2, IN_DIM).astype(np.float32))
+    pred.forward()
+    pred.reshape({"data": (8, IN_DIM)})
+    pred.set_input("data", np.random.rand(8, IN_DIM).astype(np.float32))
+    pred.forward()
+    assert pred.get_output_shape(0) == (8, OUT_DIM)
+
+
+# -- DynamicBatcher: the bit-identical concurrency criterion -----------
+
+
+def test_batcher_64_threads_bit_identical(predictor):
+    """>= 64 concurrent clients through the batcher must be BIT-identical
+    to the unbatched forward path — guaranteed because Predictor pads
+    every call (even single-sample) onto the same bucket ladder, so both
+    paths run the identical gemm executables."""
+    n_threads = 64
+    xs = [np.random.rand(1, IN_DIM).astype(np.float32)
+          for _ in range(n_threads)]
+    want = [np.asarray(predictor.predict({"data": x})[0][0]) for x in xs]
+
+    results = [None] * n_threads
+    errors = []
+    with DynamicBatcher(predictor.predict, buckets=predictor.ladder.sizes,
+                        max_latency_ms=10.0, max_queue=256) as bat:
+        barrier = threading.Barrier(n_threads)
+
+        def client(i):
+            try:
+                barrier.wait(timeout=30)
+                results[i] = bat({"data": xs[i][0]}, timeout=60)
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "batcher deadlocked"
+    assert not errors, errors[:3]
+    for i in range(n_threads):
+        got = np.asarray(results[i][0])
+        assert got.tobytes() == want[i].tobytes(), \
+            f"request {i} not bit-identical to unbatched forward"
+    snap = bat.stats.snapshot()
+    assert snap["responses_ok"] == n_threads
+    assert snap["batches_total"] >= 1
+    # coalescing actually happened: far fewer batches than requests
+    assert snap["batches_total"] < n_threads
+
+
+def test_batcher_shed_on_saturation(predictor):
+    """A saturating burst must shed with the retryable Overloaded status
+    and never deadlock or queue without bound."""
+    import queue as _q
+
+    gate = threading.Event()
+
+    def slow_predict(inputs):
+        gate.wait(timeout=30)
+        return predictor.predict(inputs)
+
+    bat = DynamicBatcher(slow_predict, buckets=(2, 4), max_latency_ms=1.0,
+                         max_queue=4)
+    bat.start()
+    try:
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        futs, shed = [], 0
+        for _ in range(64):
+            try:
+                futs.append(bat.submit({"data": x}))
+            except Overloaded as e:
+                assert e.retryable and e.status == 503
+                shed += 1
+        assert shed > 0, "bounded queue never shed"
+        assert len(futs) <= 4 + bat._max_batch  # queue bound + in-flight
+        gate.set()
+        for f in futs:
+            f.result(timeout=30)  # drains without deadlock
+        assert bat.stats.snapshot()["shed_queue_full"] == shed
+    finally:
+        gate.set()
+        bat.stop()
+
+
+def test_batcher_deadline_exceeded(predictor):
+    gate = threading.Event()
+
+    def slow_predict(inputs):
+        gate.wait(timeout=30)
+        return predictor.predict(inputs)
+
+    bat = DynamicBatcher(slow_predict, buckets=(2,), max_latency_ms=1.0,
+                         max_queue=8)
+    bat.start()
+    try:
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        blocker = bat.submit({"data": x})  # occupies the dispatch loop
+        time.sleep(0.05)
+        doomed = bat.submit({"data": x}, deadline_ms=1.0)
+        time.sleep(0.05)
+        gate.set()
+        blocker.result(timeout=30)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=30)
+        assert bat.stats.snapshot()["shed_deadline"] >= 1
+    finally:
+        gate.set()
+        bat.stop()
+
+
+def test_batcher_mixed_shapes_grouped(artifact):
+    """Mixed sample shapes dispatch as separate shape buckets, never one
+    ragged batch (the RPA shape-bucketing discipline)."""
+    path, net = artifact
+    pred = Predictor.from_artifact(path, bucket_sizes=(2, 4, 8))
+    with DynamicBatcher(pred.predict, buckets=(2, 4, 8),
+                        max_latency_ms=20.0, max_queue=64) as bat:
+        futs = [bat.submit({"data": np.full((IN_DIM,), i, np.float32)})
+                for i in range(3)]
+        outs = [f.result(timeout=60) for f in futs]
+    for i, o in enumerate(outs):
+        want = net(nd.array(np.full((1, IN_DIM), i, np.float32))).asnumpy()
+        np.testing.assert_allclose(np.asarray(o[0]), want[0], rtol=1e-6)
+
+
+# -- profiler integration ----------------------------------------------
+
+
+def test_profiler_dumps_serving_counters(predictor):
+    from incubator_mxnet_tpu import profiler
+    profiler.set_config(profile_all=True)
+    profiler.set_state("run")
+    try:
+        stats = ServingStats("srvtest")
+        with DynamicBatcher(predictor.predict, buckets=(2, 4),
+                            max_latency_ms=2.0, max_queue=32,
+                            stats=stats) as bat:
+            x = np.random.rand(IN_DIM).astype(np.float32)
+            bat({"data": x}, timeout=60)
+        table = profiler.dumps()
+    finally:
+        profiler.set_state("stop")
+        profiler.dumps(reset=True)
+    for key in ("srvtest:latency_p95_ms", "srvtest:queue_depth",
+                "srvtest:shed_total", "srvtest:batch_occupancy"):
+        assert key in table, f"{key} missing from profiler.dumps()"
+
+
+def test_latency_histogram_percentiles():
+    h = LatencyHistogram()
+    for ms in range(1, 101):
+        h.observe(ms / 1e3)
+    p50, p95, p99 = h.percentile(50), h.percentile(95), h.percentile(99)
+    assert 0.03 < p50 < 0.08
+    assert p50 < p95 < p99 <= 0.15
+    assert h.count == 100
+
+
+# -- ModelServer HTTP --------------------------------------------------
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url + "/predict", json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_model_server_roundtrip(artifact, predictor):
+    _, net = artifact
+    with ModelServer(predictor, max_latency_ms=2.0, max_queue=64) as srv:
+        host, port = srv.address
+        url = f"http://{host}:{port}"
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        code, body = _post(url, {"inputs": {"data": x.tolist()}})
+        assert code == 200
+        want = net(nd.array(x[None])).asnumpy()[0]
+        np.testing.assert_allclose(
+            np.asarray(body["outputs"][0], np.float32), want, rtol=1e-5)
+        code, body = _post(url, {"inputs": {"nope": [1.0]}})
+        assert code == 500 or code == 400  # unknown input name
+        code, body = _post(url, {"wrong_key": 1})
+        assert code == 400 and body["retryable"] is False
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+            snap = json.loads(r.read())
+            assert snap["responses_ok"] >= 1
+            assert "latency_p99_ms" in snap
+
+
+def test_model_server_sheds_under_burst(predictor):
+    """Saturate a tiny admission queue: every response must be 200 or a
+    retryable 503/504 — and the server must answer them all (no hang)."""
+    srv = ModelServer(predictor, max_latency_ms=2.0, max_queue=2,
+                      default_deadline_ms=5000)
+    host, port = srv.start()
+    url = f"http://{host}:{port}"
+    codes, lock = [], threading.Lock()
+
+    def hammer():
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        try:
+            code, body = _post(url, {"inputs": {"data": x.tolist()}})
+        except OSError:
+            code, body = -1, {}
+        with lock:
+            codes.append((code, body.get("retryable")))
+
+    try:
+        threads = [threading.Thread(target=hammer) for _ in range(48)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "server hung"
+    finally:
+        srv.stop()
+    assert len(codes) == 48
+    assert all(c in (200, 503, 504) for c, _ in codes), codes
+    assert all(r is True for c, r in codes if c in (503, 504))
+    if any(c == 503 for c, _ in codes):
+        assert srv.stats.snapshot()["shed_queue_full"] > 0
+
+
+# -- skip-list audit (CI satellite) ------------------------------------
+
+# every pytest.skip in tests/ must state an allowlisted gate: a missing
+# environment capability (egress, device count, native lib, reference
+# artifacts) — never a silenced failure.
+_SKIP_ALLOWLIST = (
+    r"integer-domain op",
+    r"LAPACK factorization",
+    r"non-elementwise base",
+    r"mixed-shape binary op",
+    r"needs \d+ virtual devices",
+    r"needs multi-device mesh",
+    r"needs 4 virtual devices",
+    r"native jpeg unavailable",
+    r"native library|libmxtpu",
+    r"params artifact not in cache",
+    r"no zoo goldens captured yet \(zero-egress\)",
+    r"reference (artifact|json|file|checkout) not (present|available|found)",
+    r"zero-egress",
+    r"requires /root/reference",
+    r"large-tensor",
+    r"MXTPU_TEST_LARGE",
+    r"needs ~\d+ GB free host RAM",
+    r"native toolchain unavailable",
+)
+
+
+def test_skip_reasons_are_allowlisted():
+    import re
+    here = os.path.dirname(os.path.abspath(__file__))
+    pat = re.compile(
+        r"pytest\.(?:skip|skipif)|pytest\.mark\.skipif\s*\(")
+    reason_pat = re.compile(
+        r"""(?:pytest\.skip\(|reason\s*=\s*)\s*f?(['"])(.*?)\1""",
+        re.S)
+    offenders = []
+    for fn in sorted(os.listdir(here)):
+        if not (fn.startswith("test_") and fn.endswith(".py")):
+            continue
+        src = open(os.path.join(here, fn), encoding="utf-8").read()
+        for m in reason_pat.finditer(src):
+            reason = m.group(2)
+            if not any(re.search(a, reason) for a in _SKIP_ALLOWLIST):
+                offenders.append(f"{fn}: {reason!r}")
+    assert not offenders, (
+        "skip reasons outside the environment-gate allowlist "
+        "(silenced failures are not allowed):\n  " + "\n  ".join(offenders))
