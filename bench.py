@@ -454,6 +454,55 @@ def bench_ssd_detection(steps, batch=8, image_size=128):
     return batch * steps / dt
 
 
+def bench_fused_step(steps, n_params=64, dim=64):
+    """Aggregated eager train step: the dispatch-bound regime the fused
+    optimizer path targets (many small params — embeddings, norms, biases).
+    Times the same eager loop with aggregation on (bucketed fused updates +
+    flat-packed gradient collectives, gluon/trainer.py) and off
+    (engine.bulk(1): one jit dispatch + one collective per parameter).
+    Returns (fused_steps_per_s, unfused_steps_per_s, fused_dispatches,
+    unfused_dispatches) — dispatch counts per step from the Trainer's
+    observability counters."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, engine, gluon, nd
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(dim, dim).astype(np.float32))
+
+    def make_trainer():
+        params = gluon.ParameterDict()
+        for j in range(n_params):
+            p = params.get(f"w{j:03d}", shape=(dim, dim), init="zeros")
+            p.initialize()
+            p.set_data(nd.array(rng.randn(dim, dim).astype(np.float32)))
+        tr = gluon.Trainer(params, "sgd",
+                           {"learning_rate": 0.01, "momentum": 0.9},
+                           kvstore="tpu")
+        return tr, [params[k] for k in sorted(params.keys())]
+
+    def loop(tr, plist, n):
+        for _ in range(n):
+            with autograd.record():
+                loss = plist[0].data().reshape(-1)[0] * 0
+                for p in plist:
+                    loss = loss + (p.data() * x).sum()
+            loss.backward()
+            tr.step(1)
+        _sync(plist[-1].data())
+
+    tr_f, pl_f = make_trainer()
+    loop(tr_f, pl_f, 1)                   # compile + warmup
+    dt_f = _time_best(lambda: loop(tr_f, pl_f, steps))
+    disp_f = tr_f._last_step_dispatches
+
+    tr_u, pl_u = make_trainer()
+    with engine.bulk(1):
+        loop(tr_u, pl_u, 1)
+        dt_u = _time_best(lambda: loop(tr_u, pl_u, steps))
+        disp_u = tr_u._last_step_dispatches
+    return steps / dt_f, steps / dt_u, disp_f, disp_u
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=None,
@@ -567,6 +616,24 @@ def main():
                   f"{ips:9.2f} img/s", file=sys.stderr)
         except Exception as e:
             print(f"[bench] ssd_detection: FAILED {e!r}", file=sys.stderr)
+        try:
+            f_sps, u_sps, f_d, u_d = bench_fused_step(
+                steps_for("train", "float32"))
+            results.append({"mode": "fused_eager_step", "batch": 64,
+                            "dtype": "float32",
+                            "fused_steps_per_sec": round(f_sps, 2),
+                            "unfused_steps_per_sec": round(u_sps, 2),
+                            "dispatches_fused": f_d,
+                            "dispatches_unfused": u_d,
+                            "speedup": round(f_sps / u_sps, 3)
+                            if u_sps else None,
+                            "vs_baseline": None})
+            print(f"[bench] fused eager step (64 params)     "
+                  f"{f_sps:9.2f} step/s ({f_d} dispatches) vs "
+                  f"{u_sps:9.2f} unfused ({u_d}): "
+                  f"{f_sps / u_sps:5.2f}x", file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] fused_step: FAILED {e!r}", file=sys.stderr)
 
     if on_tpu:
         try:

@@ -2,15 +2,19 @@
 
 The reference exposes `bulk(size)` to batch engine ops and reduce dispatch
 overhead (MXEngineSetBulkSize). XLA's async runtime already pipelines
-dispatch, so bulking is a no-op here — the context manager is kept so
-reference code runs unchanged, and `set_bulk_size` returns the previous
-value like the C API did.
+dispatch, so the closest analog of op bulking here is the Trainer's
+aggregated optimizer step: a nonzero bulk size overrides
+`MXNET_OPTIMIZER_AGGREGATION_SIZE` as the per-bucket parameter count
+(gluon/trainer.py), so reference code wrapping its update loop in
+`engine.bulk(n)` actually changes batching behavior. `set_bulk_size`
+returns the previous value like the C API did, and `bulk(size)` restores
+it on exit.
 """
 from __future__ import annotations
 
 from contextlib import contextmanager
 
-__all__ = ["bulk", "set_bulk_size"]
+__all__ = ["bulk", "bulk_size", "set_bulk_size"]
 
 _bulk_size = 0
 
@@ -20,6 +24,12 @@ def set_bulk_size(size):
     global _bulk_size
     prev, _bulk_size = _bulk_size, int(size)
     return prev
+
+
+def bulk_size():
+    """Current bulk size; 0 means 'unset' (the Trainer then falls back to
+    MXNET_OPTIMIZER_AGGREGATION_SIZE)."""
+    return _bulk_size
 
 
 @contextmanager

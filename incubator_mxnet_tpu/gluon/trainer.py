@@ -11,11 +11,26 @@ XLA inserted the collectives inside the compiled step.
 """
 from __future__ import annotations
 
+import os
+
 from .. import optimizer as opt
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
+
+
+def _aggregation_size():
+    """Per-bucket parameter count for the aggregated optimizer step.
+    engine.bulk(n) / engine.set_bulk_size(n) take precedence (the
+    reference's op-bulking knob, repurposed as documented in engine.py);
+    otherwise MXNET_OPTIMIZER_AGGREGATION_SIZE (reference default 4).
+    <= 1 disables aggregation — the per-param oracle path."""
+    from .. import engine
+    n = engine.bulk_size()
+    if n > 0:
+        return n
+    return int(os.environ.get("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4))
 
 
 class Trainer:
@@ -43,6 +58,12 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._update_on_kv = False
         self._states_to_load = None
+        # last-step observability (profiler counters publish these when the
+        # profiler is running; always readable for tests/tools)
+        self._last_step_dispatches = 0
+        self._last_step_collectives = 0
+        self._last_step_collective_bytes = 0
+        self._counters = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -119,26 +140,60 @@ class Trainer:
             return
         self.allreduce_grads()
         self._update(ignore_stale_grad)
+        self._publish_counters()
 
     def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
         if self._update_on_kv:
             raise MXNetError(
                 "allreduce_grads() is meaningless when updates happen on "
                 "the kvstore server (dist_async): a push would already "
                 "apply an optimizer step; use step()")
-        if self._kvstore is not None:
-            for i, p in enumerate(self._params):
-                if p.grad_req != "null":
-                    g = p.grad()
-                    if getattr(g, "stype", "default") == "row_sparse":
-                        # the kvstore reduce path is dense; densify for the
-                        # collective and keep the dense result (the lazy
-                        # single-process path never reaches here)
-                        dense = g.todense()
-                        self._kvstore.pushpull(i, dense, out=dense)
-                        p.data()._grad = dense
-                    else:
-                        self._kvstore.pushpull(i, g, out=g)
+        if self._kvstore is None:
+            self._last_step_collectives = 0
+            self._last_step_collective_bytes = 0
+            return
+        before = self._kvstore.collective_stats()
+        keys, grads = [], []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            g = p.grad()
+            if getattr(g, "stype", "default") == "row_sparse":
+                # the kvstore reduce path is dense; densify for the
+                # collective and keep the dense result (the lazy
+                # single-process path never reaches here). The reduced
+                # value must land where Parameter.grad() reads it — the
+                # attached `_grad` slot on the data array — reusing the
+                # attached buffer in place when one exists so autograd's
+                # alias to it stays valid.
+                dense = g.todense()
+                self._kvstore.pushpull(i, dense, out=dense)
+                d = p.data()
+                if (d._grad is not None
+                        and getattr(d._grad, "stype", "default") == "default"):
+                    d._grad._data = dense._data
+                else:
+                    d._grad = dense
+                    d._grad_req = p.grad_req
+            else:
+                keys.append(i)
+                grads.append(g)
+        if keys:
+            if _aggregation_size() > 1:
+                # one flat-packed collective per same-dtype bucket instead
+                # of one per gradient
+                self._kvstore.pushpull_list(keys, grads)
+            else:
+                # engine.bulk(1) / MXNET_OPTIMIZER_AGGREGATION_SIZE=1 turn
+                # the whole step back into the per-tensor oracle
+                for k, g in zip(keys, grads):
+                    self._kvstore.pushpull(k, g, out=g)
+        after = self._kvstore.collective_stats()
+        self._last_step_collectives = \
+            after["collectives"] - before["collectives"]
+        self._last_step_collective_bytes = after["bytes"] - before["bytes"]
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -148,9 +203,16 @@ class Trainer:
             raise MXNetError("update() cannot run locally when updates "
                              "happen on the kvstore server; use step()")
         self._update(ignore_stale_grad)
+        self._publish_counters()
 
     def _update(self, ignore_stale_grad=False):
+        """Aggregated optimizer step: bucket live params by (dtype,
+        grad_req) into groups of up to _aggregation_size() and hand each
+        bucket to the updater's list form — ONE fused jit dispatch per
+        bucket when the optimizer supports it (Optimizer._fused_spec),
+        per-param fallback otherwise. Sparse grads always go alone."""
         updater = self._updaters[0]
+        live = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
@@ -158,7 +220,42 @@ class Trainer:
                 if ignore_stale_grad:
                     continue
                 raise MXNetError(f"parameter {p.name} not initialized")
-            updater(i, p.grad(), p.data())
+            live.append((i, p))
+        agg = _aggregation_size()
+        dispatches = 0
+        if agg <= 1:
+            for i, p in live:
+                dispatches += updater(i, p.grad(), p.data())
+        else:
+            groups = {}     # (dtype, grad_req) -> [(i, grad, weight)]
+            for i, p in live:
+                g = p.grad()
+                if getattr(g, "stype", "default") != "default":
+                    dispatches += updater(i, g, p.data())
+                    continue
+                w = p.data()
+                groups.setdefault((str(w.dtype), p.grad_req), []).append(
+                    (i, g, w))
+            for members in groups.values():
+                for s in range(0, len(members), agg):
+                    chunk = members[s:s + agg]
+                    dispatches += updater([m[0] for m in chunk],
+                                          [m[1] for m in chunk],
+                                          [m[2] for m in chunk])
+        self._last_step_dispatches = dispatches
+
+    def _publish_counters(self):
+        from .. import profiler
+        if not profiler.is_running():
+            return
+        if self._counters is None:
+            self._counters = (
+                profiler.Counter(name="trainer_dispatches_per_step"),
+                profiler.Counter(name="kvstore_collectives_per_step"),
+                profiler.Counter(name="kvstore_collective_bytes"))
+        self._counters[0].set_value(self._last_step_dispatches)
+        self._counters[1].set_value(self._last_step_collectives)
+        self._counters[2].set_value(self._last_step_collective_bytes)
 
     def save_states(self, fname):
         if not self._kv_initialized:

@@ -138,6 +138,46 @@ def _two_bit_fn():
     return jax.jit(quantize)
 
 
+@functools.lru_cache(maxsize=64)
+def _flat_pack_fn(shapes):
+    """Jitted flat-pack for one pushpull_list bucket: ravel + concatenate
+    `len(shapes)` same-dtype arrays into ONE contiguous buffer on the
+    values' own devices. The caller then moves/reduces that single buffer
+    (mesh broadcast or cross-process all-reduce) — one fabric transfer for
+    the whole bucket, the reference's many-tensors-per-server-request
+    packing."""
+    import jax
+    import jax.numpy as jnp
+
+    def pack(*xs):
+        return jnp.concatenate([x.reshape(-1) for x in xs])
+
+    return jax.jit(pack)
+
+
+@functools.lru_cache(maxsize=64)
+def _flat_unpack_fn(shapes):
+    """Jitted inverse of _flat_pack_fn: static slice offsets derived from
+    the bucket's shape tuple (part of the cache key)."""
+    import jax
+
+    sizes = []
+    for s in shapes:
+        n = 1
+        for d in s:
+            n *= d
+        sizes.append(n)
+
+    def unpack(flat):
+        outs, off = [], 0
+        for s, n in zip(shapes, sizes):
+            outs.append(flat[off:off + n].reshape(s))
+            off += n
+        return tuple(outs)
+
+    return jax.jit(unpack)
+
+
 class KVStore:
     """Single-interface key-value store over eager arrays or a device mesh.
 
@@ -163,6 +203,16 @@ class KVStore:
         self._bigarray_bound = int(_os.environ.get(
             "MXNET_KVSTORE_BIGARRAY_BOUND", 1000 * 1000))
         self._wire_stats = {"whole": 0, "sharded": 0, "packed": 0}
+        # cumulative reduction-round observability (Trainer snapshots
+        # per-step deltas into the kvstore_collectives_per_step /
+        # kvstore_collective_bytes profiler counters): one round per
+        # per-key push, one per pushpull_list flat-pack bucket
+        self._collective_stats = {"collectives": 0, "bytes": 0}
+        # flat-pack bucket byte cap for pushpull_list (a few dozen MB keeps
+        # per-bucket latency bounded, same spirit as the reference's
+        # bigarray server striping)
+        self._flatpack_bound = int(_os.environ.get(
+            "MXNET_KVSTORE_FLATPACK_BOUND", 32 << 20))
         self._async_client = None
         self._async_gen = None
         if kv_type == "dist_async" and jax.process_count() > 1:
@@ -370,6 +420,10 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k!r} not initialized")
             merged = self._merge(k, v)
+            # every per-key push is one reduction round on the wire
+            self._collective_stats["collectives"] += 1
+            self._collective_stats["bytes"] += int(
+                getattr(merged, "nbytes", 0))
             import jax
             if self._async_client is not None:
                 # async push: locally-merged gradient goes straight to the
@@ -445,6 +499,93 @@ class KVStore:
         self.push(key, value, priority=priority)
         if out is not None:
             self.pull(key, out=out, priority=priority)
+
+    def collective_stats(self):
+        """Cumulative {'collectives': n, 'bytes': b} reduction-round stats
+        (Trainer diffs these per step for profiler counters)."""
+        return dict(self._collective_stats)
+
+    def pushpull_list(self, keys, values, outs=None, priority=0):
+        """Bucketed allreduce over many keys: flat-pack same-dtype dense
+        values into contiguous buckets of at most
+        MXNET_KVSTORE_FLATPACK_BOUND bytes (default 32 MB) and move each
+        bucket through ONE collective, unpacking inside the same jitted
+        call — O(num_buckets) reduction rounds instead of O(num_keys).
+        Reference analog: the dist kvstore packing many small tensors per
+        server request vs one RPC per key.
+
+        `outs` defaults to `values` (the in-place gradient-allreduce form).
+        Falls back to per-key pushpull whenever bucket semantics could
+        diverge: an updater/optimizer on the store, async mode, gradient
+        compression (residuals are per-key), sparse values, or per-key
+        value LISTS (the multi-device merge form)."""
+        keys = list(keys)
+        values = list(values)
+        outs = values if outs is None else list(outs)
+        if len(keys) != len(values) or len(keys) != len(outs):
+            raise MXNetError("pushpull_list: key/value/out length mismatch")
+        fused_ok = (self._updater is None and self._async_client is None
+                    and self._compression is None)
+        if fused_ok:
+            for v, o in zip(values, outs):
+                if (isinstance(v, (list, tuple)) or isinstance(o, (list, tuple))
+                        or not isinstance(v, NDArray)
+                        or getattr(v, "stype", "default") != "default"):
+                    fused_ok = False
+                    break
+        if not fused_ok:
+            for k, v, o in zip(keys, values, outs):
+                self.pushpull(k, v, out=o, priority=priority)
+            return
+        for k in keys:
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+
+        # same-dtype, byte-capped buckets (greedy, in caller order)
+        buckets, cur, cur_dt, cur_bytes = [], [], None, 0
+        for k, v, o in zip(keys, values, outs):
+            dt = str(v._data.dtype)
+            nb = int(v._data.nbytes)
+            if cur and (dt != cur_dt or cur_bytes + nb > self._flatpack_bound):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur_dt = dt
+            cur.append((k, v, o))
+            cur_bytes += nb
+        if cur:
+            buckets.append(cur)
+
+        import jax
+        multi = jax.process_count() > 1
+        for bucket in buckets:
+            shapes = tuple(tuple(v._data.shape) for _, v, _ in bucket)
+            arrs = [v._data for _, v, _ in bucket]
+            flat = _flat_pack_fn(shapes)(*arrs)
+            if multi:
+                self._heartbeat()
+                # ONE cross-process all-reduce for the whole bucket
+                flat = self._cross_process_mean(flat, scale_to_sum=True)
+            else:
+                # single process: the packed buffer crosses the fabric once
+                # (mesh broadcast); unpacked parts inherit its placement
+                flat = self._replicate(flat)
+            parts = _flat_unpack_fn(shapes)(flat)
+            self._collective_stats["collectives"] += 1
+            self._collective_stats["bytes"] += int(flat.nbytes)
+            for (k, v, o), arr in zip(bucket, parts):
+                stored = self._store[k]
+                stored._data = self._replicate(arr.astype(stored.dtype))
+                tgt_sharding = getattr(o._data, "sharding", None)
+                val = stored._data
+                if not val.is_fully_addressable:
+                    val = jax.device_get(val)
+                    val = (jax.device_put(val, tgt_sharding)
+                           if tgt_sharding is not None
+                           else jax.numpy.asarray(val))
+                elif (tgt_sharding is not None
+                      and val.sharding != tgt_sharding):
+                    val = jax.device_put(val, tgt_sharding)
+                o._data = val.astype(o.dtype)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows — the sparse-embedding path

@@ -9,7 +9,7 @@ path and fully-jitted train steps. Multi-weight fused variants
 """
 from __future__ import annotations
 
-from .registry import register
+from .registry import get_op, register
 
 import jax
 import jax.numpy as jnp
@@ -83,6 +83,22 @@ def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
     m = beta1 * mean + (1 - beta1) * g
     v = beta2 * var + (1 - beta2) * jnp.square(g)
     return (weight - lr * m / (jnp.sqrt(v) + epsilon), m, v)
+
+
+@register(name="mp_adam_update", nondiff=True)
+def mp_adam_update(weight, grad, mean, var, weight32, *, lr, beta1=0.9,
+                   beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision Adam: bf16/fp16 weights, fp32 master copy + fp32
+    moments (reference optimizer_op.cc MP_AdamUpdate pattern)."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight32
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - lr * m / (jnp.sqrt(v) + epsilon)
+    return (w32.astype(weight.dtype), m, v, w32)
 
 
 @register(name="ftml_update", nondiff=True)
@@ -250,6 +266,41 @@ def multi_mp_sgd_mom_update(*args, lrs, wds, momentum=0.0, rescale_grad=1.0,
     return tuple(outs)
 
 
+@register(name="multi_adam_update", nondiff=True)
+def multi_adam_update(*args, lrs, wds, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                      rescale_grad=1.0, clip_gradient=-1.0, num_weights=1):
+    """Fused multi-weight Adam (reference multi-tensor pattern,
+    optimizer_op.cc multi_sgd_* family): args = [w0, g0, m0, v0, w1, ...].
+    lrs carry any per-index bias correction already folded in."""
+    outs = []
+    for i in range(num_weights):
+        w, g, m, v = args[4 * i], args[4 * i + 1], args[4 * i + 2], args[4 * i + 3]
+        outs.extend(adam_update.fn(w, g, m, v, lr=lrs[i], wd=wds[i],
+                                   beta1=beta1, beta2=beta2, epsilon=epsilon,
+                                   rescale_grad=rescale_grad,
+                                   clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register(name="multi_mp_adam_update", aliases=("multi_mp_adam",),
+          nondiff=True)
+def multi_mp_adam_update(*args, lrs, wds, beta1=0.9, beta2=0.999,
+                         epsilon=1e-8, rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=1):
+    """args = [w0, g0, m0, v0, w32_0, w1, ...]: fused multi-weight
+    multi-precision Adam."""
+    outs = []
+    for i in range(num_weights):
+        w, g, m, v, w32 = (args[5 * i], args[5 * i + 1], args[5 * i + 2],
+                           args[5 * i + 3], args[5 * i + 4])
+        outs.extend(mp_adam_update.fn(w, g, m, v, w32, lr=lrs[i], wd=wds[i],
+                                      beta1=beta1, beta2=beta2,
+                                      epsilon=epsilon,
+                                      rescale_grad=rescale_grad,
+                                      clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
 @register(name="mp_nag_mom_update", nondiff=True)
 def mp_nag_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
@@ -334,3 +385,143 @@ def sparse_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7,
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     h = history + jnp.square(g)
     return (weight - lr * g / jnp.sqrt(h + epsilon), h)
+
+
+# ---------------------------------------------------------------------------
+# Generic multi-tensor fused dispatch (the engine behind the Trainer's
+# aggregated step). Reference: optimizer_op.cc registers hand-written
+# multi_* variants and the python layer buckets params up to
+# MXNET_OPTIMIZER_AGGREGATION_SIZE; here ONE builder pytree-maps ANY
+# registered single-tensor update op over a bucket inside a single jitted
+# executable, so every optimizer that names its op gets aggregation for
+# free. lr/wd arrive as traced (n,)-vectors — an lr_scheduler step does NOT
+# recompile; clip/momentum/betas are static and key the jit cache.
+# ---------------------------------------------------------------------------
+
+_fused_cache = {}
+_FUSED_CACHE_MAX = 128
+
+
+def _donation_supported():
+    import jax
+    return jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
+
+
+def _fused_fn(op_name, n, arity, static_items, dyn_keys):
+    """Build (and cache) the fused executable for a bucket shape-family.
+
+    Call form: f(dyn_vectors_tuple, rescale, *flat) where flat interleaves
+    [w0, g0, s0a, ..., w1, g1, ...] (arity arrays per weight). Outputs are
+    the interleaved [new_w0, new_s0a, ..., new_w1, ...] — each single op
+    returns (weight, *states) in exactly that order. Weight/state buffers
+    are donated on backends that support donation (grads are NOT donated:
+    the autograd buffers are reused by the next backward)."""
+    import jax
+
+    donate = _donation_supported()
+    key = (op_name, n, arity, static_items, dyn_keys, donate)
+    f = _fused_cache.get(key)
+    if f is not None:
+        return f
+    op = get_op(op_name)
+    static = dict(static_items)
+
+    def fused(dyn, rescale, *flat):
+        outs = []
+        for i in range(n):
+            args = flat[arity * i:arity * (i + 1)]
+            kw = {k: dyn[j][i] for j, k in enumerate(dyn_keys)}
+            res = op.fn(*args, rescale_grad=rescale, **kw, **static)
+            outs.extend(res if isinstance(res, tuple) else (res,))
+        return tuple(outs)
+
+    if donate:
+        # flat starts at position 2; within each weight's arity-slot,
+        # position 1 is the gradient — everything else is donatable
+        argnums = tuple(2 + j for j in range(arity * n) if j % arity != 1)
+        f = jax.jit(fused, donate_argnums=argnums)
+    else:
+        f = jax.jit(fused)
+    if len(_fused_cache) >= _FUSED_CACHE_MAX:
+        _fused_cache.pop(next(iter(_fused_cache)))
+    _fused_cache[key] = f
+    return f
+
+
+def _probe_bucket(optimizer, indices, weights, grads, states):
+    """Dry-run the bucket WITHOUT touching optimizer step counters: every
+    param must map to the same (op, static-kwargs, dyn-keys) and carry a
+    dense gradient. Returns the common (op_name, static_items) or None —
+    the caller falls back to the per-param oracle."""
+    from ..ndarray.ndarray import NDArray
+
+    common = None
+    for i, w, g, s in zip(indices, weights, grads, states):
+        if not isinstance(w, NDArray) or not isinstance(g, NDArray):
+            return None
+        if getattr(g, "stype", "default") != "default":
+            return None
+        if str(w.dtype) != str(weights[0].dtype):
+            return None
+        spec = optimizer._fused_spec(i, w, s)
+        if spec is None:
+            return None
+        op_name, static = spec[0], tuple(sorted(spec[2].items()))
+        if common is None:
+            common = (op_name, static)
+        elif common != (op_name, static):
+            return None
+    return common
+
+
+def fused_apply(optimizer, indices, weights, grads, states):
+    """Apply `optimizer` to a whole bucket in ONE jitted dispatch.
+
+    Commits the per-index update counts only once the bucket is known to be
+    fusable, then gathers the step's dynamic hyperparams (lr with any bias
+    correction folded in, wd) into traced vectors and runs the cached fused
+    executable. Returns True when the fused path ran; False means nothing
+    happened and the caller must run the per-param oracle."""
+    common = _probe_bucket(optimizer, indices, weights, grads, states)
+    if common is None:
+        return False
+    op_name, static_items = common
+    for i in indices:
+        optimizer._update_count(i)
+
+    n = len(indices)
+    dyn_rows = []               # one {key: value} per param, post-count
+    state_rows = []             # ordered extra-array operands per param
+    for i, w, g, s in zip(indices, weights, grads, states):
+        _, st_arrs, _, dyn = optimizer._fused_spec(i, w, s)
+        state_rows.append(st_arrs)
+        dyn_rows.append(dyn)
+    dyn_keys = tuple(sorted(dyn_rows[0]))
+    arity = 2 + len(state_rows[0])
+    # mp ops compute on the fp32 master copy — their hyperparams are fp32;
+    # plain ops follow the weight dtype (weak-typing parity with the
+    # python-float constants the per-param oracle bakes in)
+    hdt = jnp.float32 if op_name.startswith("mp_") else weights[0]._data.dtype
+    dyn_vecs = tuple(jnp.asarray([row[k] for row in dyn_rows], dtype=hdt)
+                     for k in dyn_keys)
+    rescale = jnp.asarray(optimizer.rescale_grad, dtype=hdt)
+    flat = []
+    for w, g, st_arrs in zip(weights, grads, state_rows):
+        flat.append(w._data)
+        flat.append(g._data)
+        flat.extend(a._data for a in st_arrs)
+
+    f = _fused_fn(op_name, n, arity, static_items, dyn_keys)
+    from . import registry as _registry
+    if _registry.PROFILER_HOOK is not None:
+        out = _registry.PROFILER_HOOK(f"multi:{op_name}[{n}]", f,
+                                      (dyn_vecs, rescale) + tuple(flat))
+    else:
+        out = f(dyn_vecs, rescale, *flat)
+
+    per = arity - 1             # outputs per weight: new_w + new states
+    for j, (w, st_arrs) in enumerate(zip(weights, state_rows)):
+        w._data = out[per * j]
+        for k, a in enumerate(st_arrs):
+            a._data = out[per * j + 1 + k]
+    return True

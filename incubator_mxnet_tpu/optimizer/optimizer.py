@@ -122,7 +122,11 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype in (_np.float16,):
+        # str compare: numpy has no bfloat16 scalar type, but ml_dtypes'
+        # bfloat16 stringifies to "bfloat16" — both half formats get an
+        # fp32 master copy (reference optimizer.py multi_precision fp16)
+        if self.multi_precision and str(weight.dtype) in ("float16",
+                                                          "bfloat16"):
             w32 = weight.astype("float32")
             return (self.create_state(index, w32), w32)
         return self.create_state(index, weight)
@@ -132,6 +136,33 @@ class Optimizer:
 
     def update_multi_precision(self, index, weight, grad, state):
         self.update(index, weight, grad, state)
+
+    def _fused_spec(self, index, weight, state):
+        """Aggregation protocol: describe this param's update as ONE call of
+        a registered single-tensor op so ops.optimizer_ops.fused_apply can
+        bucket it. Returns (op_name, state_arrays, static_kwargs,
+        dyn_kwargs) — static_kwargs key the jit cache, dyn_kwargs (lr with
+        any bias correction folded in, wd) become traced vectors so
+        lr_scheduler steps don't recompile. None opts out (eager-math
+        optimizers, randomized updates, time-static kwargs like FTML's t).
+
+        Called twice per step: a probe BEFORE update counts commit (must
+        not raise on unseen indices) and again after, for the final lr."""
+        return None
+
+    def update_multi(self, indices, weights, grads, states):
+        """Aggregated bucket update (reference optimizer.py aggregate_num
+        branch of _update_impl): ONE fused dispatch when every param in the
+        bucket maps to the same single-tensor op, else the per-param
+        oracle. Returns the number of jit dispatches issued — the
+        Trainer's trainer_dispatches_per_step counter sums these."""
+        from ..ops.optimizer_ops import fused_apply
+        if len(indices) > 1 and fused_apply(self, indices, weights, grads,
+                                            states):
+            return 1
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self.update_multi_precision(i, w, g, s)
+        return len(indices)
 
     def set_learning_rate(self, lr):
         self.lr = lr
@@ -236,6 +267,22 @@ class SGD(Optimizer):
 
     update_multi_precision = update
 
+    def _fused_spec(self, index, weight, state):
+        dyn = {"lr": self._get_lr(index), "wd": self._get_wd(index)}
+        if isinstance(state, tuple):  # multi-precision (mom-or-None, w32)
+            mom, w32 = state
+            if mom is not None:
+                return ("mp_sgd_mom_update", [mom, w32],
+                        {"momentum": self.momentum,
+                         "clip_gradient": self._clip()}, dyn)
+            return ("mp_sgd_update", [w32],
+                    {"clip_gradient": self._clip()}, dyn)
+        if state is not None:
+            return ("sgd_mom_update", [state],
+                    {"momentum": self.momentum,
+                     "clip_gradient": self._clip()}, dyn)
+        return ("sgd_update", [], {"clip_gradient": self._clip()}, dyn)
+
 
 @register
 class SignSGD(Optimizer):
@@ -245,6 +292,10 @@ class SignSGD(Optimizer):
                    wd=self._get_wd(index), rescale_grad=self.rescale_grad,
                    clip_gradient=self._clip())
         weight._data = w._data
+
+    def _fused_spec(self, index, weight, state):
+        return ("signsgd_update", [], {"clip_gradient": self._clip()},
+                {"lr": self._get_lr(index), "wd": self._get_wd(index)})
 
 
 @register
@@ -264,6 +315,12 @@ class Signum(Optimizer):
                       wd=self._get_wd(index), rescale_grad=self.rescale_grad,
                       clip_gradient=self._clip(), wd_lh=self.wd_lh)
         weight._data, state._data = w._data, m._data
+
+    def _fused_spec(self, index, weight, state):
+        return ("signum_update", [state],
+                {"momentum": self.momentum, "wd_lh": self.wd_lh,
+                 "clip_gradient": self._clip()},
+                {"lr": self._get_lr(index), "wd": self._get_wd(index)})
 
 
 @register
@@ -312,6 +369,14 @@ class NAG(Optimizer):
                       clip_gradient=self._clip())
         weight._data, state._data = w._data, m._data
 
+    def _fused_spec(self, index, weight, state):
+        dyn = {"lr": self._get_lr(index), "wd": self._get_wd(index)}
+        if state is None:  # momentum==0 degenerates to plain sgd
+            return ("sgd_update", [], {"clip_gradient": self._clip()}, dyn)
+        return ("nag_mom_update", [state],
+                {"momentum": self.momentum,
+                 "clip_gradient": self._clip()}, dyn)
+
 
 @register
 class SGLD(Optimizer):
@@ -358,6 +423,52 @@ class Adam(Optimizer):
                          clip_gradient=self._clip())
         weight._data, mean._data, var._data = w._data, m._data, v._data
 
+    def update_multi_precision(self, index, weight, grad, state):
+        # mp state layout from create_state_multi_precision:
+        # ((mean32, var32), w32); plain state is just (mean, var)
+        if not (isinstance(state, tuple) and len(state) == 2
+                and isinstance(state[0], tuple)):
+            return self.update(index, weight, grad, state)
+        (mean, var), w32 = state
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        if _is_row_sparse(grad):
+            # lazy rows on the fp32 master, then refresh the working copy
+            _sparse_adam_update(w32, grad, mean, var, lr, self.beta1,
+                                self.beta2, self.epsilon,
+                                self._get_wd(index), self.rescale_grad,
+                                self._clip())
+            idx = grad.indices._data
+            weight._data = weight._data.at[idx].set(
+                w32._data[idx].astype(weight.dtype))
+            return
+        w, m, v, w32n = invoke("mp_adam_update", weight, grad, mean, var,
+                               w32, lr=lr, beta1=self.beta1,
+                               beta2=self.beta2, epsilon=self.epsilon,
+                               wd=self._get_wd(index),
+                               rescale_grad=self.rescale_grad,
+                               clip_gradient=self._clip())
+        weight._data, mean._data, var._data, w32._data = \
+            w._data, m._data, v._data, w32n._data
+
+    def _fused_spec(self, index, weight, state):
+        # the probe runs before counts commit — .get keeps it from raising
+        # on unseen indices; its lr is discarded, the post-commit call sees
+        # the real t
+        t = self._index_update_count.get(index, self.begin_num_update + 1)
+        lr = self._get_lr(index)
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        dyn = {"lr": lr, "wd": self._get_wd(index)}
+        static = {"beta1": self.beta1, "beta2": self.beta2,
+                  "epsilon": self.epsilon, "clip_gradient": self._clip()}
+        if isinstance(state[0], tuple):  # multi-precision
+            (mean, var), w32 = state
+            return ("mp_adam_update", [mean, var, w32], static, dyn)
+        mean, var = state
+        return ("adam_update", [mean, var], static, dyn)
+
 
 @register
 class AdamW(Optimizer):
@@ -382,6 +493,14 @@ class AdamW(Optimizer):
                          rescale_grad=self.rescale_grad,
                          clip_gradient=self._clip())
         weight._data, mean._data, var._data = w._data, m._data, v._data
+
+    def _fused_spec(self, index, weight, state):
+        mean, var = state
+        return ("adamw_update", [mean, var],
+                {"beta1": self.beta1, "beta2": self.beta2,
+                 "epsilon": self.epsilon, "eta": self.eta,
+                 "clip_gradient": self._clip()},
+                {"lr": self._get_lr(index), "wd": self._get_wd(index)})
 
 
 @register
@@ -442,6 +561,20 @@ class RMSProp(Optimizer):
                            clip_weights=self.clip_weights)
             weight._data, state._data = w._data, n2._data
 
+    def _fused_spec(self, index, weight, state):
+        dyn = {"lr": self._get_lr(index), "wd": self._get_wd(index)}
+        if self.centered:
+            n, g_s, delta = state
+            return ("rmspropalex_update", [n, g_s, delta],
+                    {"gamma1": self.gamma1, "gamma2": self.gamma2,
+                     "epsilon": self.epsilon,
+                     "clip_gradient": self._clip(),
+                     "clip_weights": self.clip_weights}, dyn)
+        return ("rmsprop_update", [state],
+                {"gamma1": self.gamma1, "epsilon": self.epsilon,
+                 "clip_gradient": self._clip(),
+                 "clip_weights": self.clip_weights}, dyn)
+
 
 @register
 class AdaDelta(Optimizer):
@@ -486,6 +619,13 @@ class Ftrl(Optimizer):
                            rescale_grad=self.rescale_grad,
                            clip_gradient=self._clip())
         weight._data, z._data, n._data = w._data, z2._data, n2._data
+
+    def _fused_spec(self, index, weight, state):
+        z, n = state
+        return ("ftrl_update", [z, n],
+                {"lamda1": self.lamda1, "beta": self.beta,
+                 "clip_gradient": self._clip()},
+                {"lr": self._get_lr(index), "wd": self._get_wd(index)})
 
 
 @register
@@ -611,11 +751,25 @@ class Updater:
         self.states_synced = {}
 
     def __call__(self, index, grad, weight):
+        """Apply one update; returns the number of jit dispatches issued.
+
+        Also accepts the reference's aggregated form — lists of
+        (indices, grads, weights) — which routes through
+        Optimizer.update_multi for ONE fused dispatch per bucket."""
+        if isinstance(index, (list, tuple)):
+            for i, w in zip(index, weight):
+                if i not in self.states:
+                    self.states[i] = \
+                        self.optimizer.create_state_multi_precision(i, w)
+            return self.optimizer.update_multi(
+                list(index), list(weight), list(grad),
+                [self.states[i] for i in index])
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(
                 index, weight)
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
+        return 1
 
     def get_states(self, dump_optimizer=False):
         def conv(s):

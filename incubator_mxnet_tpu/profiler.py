@@ -24,7 +24,8 @@ from collections import defaultdict
 from .base import MXNetError
 
 __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
-           "pause", "resume", "Scope", "Task", "Event", "Counter", "Marker"]
+           "pause", "resume", "is_running", "Scope", "Task", "Event",
+           "Counter", "Marker"]
 
 _lock = threading.Lock()
 _state = {
@@ -83,6 +84,14 @@ def stop(profile_process="worker"):
         import jax
         jax.profiler.stop_trace()
         _state["tb_active"] = False
+
+
+def is_running():
+    """True while the profiler is collecting (started and not paused).
+    Periodic publishers (Trainer step counters, serving stats) gate their
+    Counter.set_value calls on this so an idle profiler doesn't accumulate
+    an unbounded counter series."""
+    return _state["running"] and not _state["paused"]
 
 
 def pause(profile_process="worker"):

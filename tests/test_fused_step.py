@@ -1,0 +1,336 @@
+"""Aggregated train step: bucketed collectives + fused optimizer dispatch.
+
+Covers the reference's multi-tensor update surface (optimizer_op.cc
+multi_sgd_* / multi_mp_* families, MXNET_OPTIMIZER_AGGREGATION_SIZE) as
+reimplemented here: ops/optimizer_ops.py fused_apply + multi_* ops,
+Updater list overload, Trainer bucketing with observability counters,
+kvstore.pushpull_list flat-packed collectives, engine.bulk as the
+aggregation override, and the row_sparse densify fix in allreduce_grads.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, engine, gluon, nd, profiler
+from incubator_mxnet_tpu import optimizer as opt
+from incubator_mxnet_tpu.ops.registry import get_op, invoke
+
+
+SHAPE = (3, 2)
+
+
+def _f32(a):
+    return a.astype("float32").asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# multi_* op surface
+# ---------------------------------------------------------------------------
+
+def test_multi_sgd_mom_invoke_parity():
+    rng = np.random.RandomState(1)
+    n = 3
+    ws = [rng.randn(*SHAPE).astype(np.float32) for _ in range(n)]
+    gs = [rng.randn(*SHAPE).astype(np.float32) for _ in range(n)]
+    ms = [rng.randn(*SHAPE).astype(np.float32) for _ in range(n)]
+    flat = []
+    for w, g, m in zip(ws, gs, ms):
+        flat += [nd.array(w), nd.array(g), nd.array(m)]
+    lrs, wds = [0.1, 0.2, 0.3], [0.0, 0.01, 0.0]
+    outs = invoke("multi_sgd_mom_update", *flat, lrs=lrs, wds=wds,
+                  momentum=0.9, num_weights=n)
+    assert len(outs) == 2 * n
+    for i in range(n):
+        w1, m1 = invoke("sgd_mom_update", nd.array(ws[i]), nd.array(gs[i]),
+                        nd.array(ms[i]), lr=lrs[i], wd=wds[i], momentum=0.9)
+        np.testing.assert_array_equal(outs[2 * i].asnumpy(), w1.asnumpy())
+        np.testing.assert_array_equal(outs[2 * i + 1].asnumpy(), m1.asnumpy())
+
+
+def test_multi_adam_invoke_parity_and_mp_alias():
+    rng = np.random.RandomState(2)
+    n = 2
+    arrs = [rng.randn(*SHAPE).astype(np.float32) for _ in range(4 * n)]
+    flat = [nd.array(a) for a in arrs]
+    lrs, wds = [0.01, 0.02], [0.0, 0.001]
+    outs = invoke("multi_adam_update", *flat, lrs=lrs, wds=wds, num_weights=n)
+    assert len(outs) == 3 * n
+    for i in range(n):
+        ref = invoke("adam_update", *flat[4 * i:4 * i + 4],
+                     lr=lrs[i], wd=wds[i])
+        for a, b in zip(outs[3 * i:3 * i + 3], ref):
+            np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+    # reference registers the mp multi-tensor op under both names
+    assert get_op("multi_mp_adam") is get_op("multi_mp_adam_update")
+
+
+# ---------------------------------------------------------------------------
+# fused vs per-param oracle parity (Updater list overload)
+# ---------------------------------------------------------------------------
+
+def _run_pair(opt_name, opt_kwargs, n=5, steps=3, dtype="float32", seed=0):
+    """Drive the same updates through the aggregated Updater list call and
+    through the per-param oracle; return both weight sets."""
+    rng = np.random.RandomState(seed)
+    w_np = [rng.randn(*SHAPE).astype(np.float32) for _ in range(n)]
+    g_np = [[rng.randn(*SHAPE).astype(np.float32) for _ in range(n)]
+            for _ in range(steps)]
+
+    def make():
+        upd = opt.get_updater(opt.create(opt_name, **opt_kwargs))
+        return upd, [nd.array(w).astype(dtype) for w in w_np]
+
+    upd_f, ws_f = make()
+    for s in range(steps):
+        upd_f(list(range(n)),
+              [nd.array(g).astype(dtype) for g in g_np[s]], ws_f)
+
+    upd_o, ws_o = make()
+    for s in range(steps):
+        for i in range(n):
+            upd_o(i, nd.array(g_np[s][i]).astype(dtype), ws_o[i])
+    return ws_f, ws_o
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 0.01, "wd": 1e-4}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("signum", {"learning_rate": 0.01, "momentum": 0.9}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01, "centered": True}),
+])
+def test_fused_parity_bit_identical(name, kwargs):
+    ws_f, ws_o = _run_pair(name, kwargs)
+    for a, b in zip(ws_f, ws_o):
+        np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    # division-heavy updates: the oracle bakes lr in as a compile-time
+    # constant and XLA folds /lr into *(1/lr); the fused path traces lr,
+    # keeping a true divide -> 1-ulp drift
+    ("ftrl", {"learning_rate": 0.1}),
+    ("adamw", {"learning_rate": 0.01, "wd": 0.01}),
+])
+def test_fused_parity_tolerant(name, kwargs):
+    ws_f, ws_o = _run_pair(name, kwargs)
+    for a, b in zip(ws_f, ws_o):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                   rtol=5e-7, atol=1e-7)
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_fused_parity_mp_bf16(name, kwargs):
+    kwargs = dict(kwargs, multi_precision=True)
+    ws_f, ws_o = _run_pair(name, kwargs, dtype="bfloat16")
+    for a, b in zip(ws_f, ws_o):
+        assert str(a.dtype) == "bfloat16"
+        np.testing.assert_array_equal(_f32(a), _f32(b))
+
+
+def test_updater_list_overload_and_fallback_count():
+    rng = np.random.RandomState(3)
+    ws = [nd.array(rng.randn(*SHAPE).astype(np.float32)) for _ in range(5)]
+    gs = [nd.array(rng.randn(*SHAPE).astype(np.float32)) for _ in range(5)]
+    # sgd exposes _fused_spec: the whole bucket is ONE dispatch
+    upd = opt.get_updater(opt.create("sgd", learning_rate=0.1))
+    assert upd(list(range(5)), gs, ws) == 1
+    # adagrad has no fused spec: per-param fallback, one dispatch each
+    upd = opt.get_updater(opt.create("adagrad", learning_rate=0.1))
+    assert upd(list(range(5)), gs, ws) == 5
+    # single-index form still reports one dispatch
+    upd = opt.get_updater(opt.create("sgd", learning_rate=0.1))
+    assert upd(0, gs[0], ws[0]) == 1
+
+
+def test_updater_states_roundtrip_after_aggregated_updates():
+    rng = np.random.RandomState(4)
+    ws = [nd.array(rng.randn(*SHAPE).astype(np.float32)) for _ in range(4)]
+    gs = [nd.array(rng.randn(*SHAPE).astype(np.float32)) for _ in range(4)]
+    upd = opt.get_updater(opt.create("adam", learning_rate=0.01))
+    upd(list(range(4)), gs, ws)
+    blob = upd.get_states(dump_optimizer=True)
+    upd2 = opt.get_updater(opt.create("sgd", learning_rate=1.0))
+    upd2.set_states(blob)
+    assert set(upd2.states) == set(upd.states)
+    assert upd2.optimizer.__class__.__name__ == "Adam"
+    # restored counts must continue the bias-correction schedule
+    assert upd2.optimizer._index_update_count == \
+        upd.optimizer._index_update_count
+
+
+# ---------------------------------------------------------------------------
+# Trainer-level aggregation, counters, engine.bulk
+# ---------------------------------------------------------------------------
+
+N_PARAMS = 50
+PSHAPE = (4, 3)
+
+
+def _make_trainer(n=N_PARAMS, opt_name="sgd", opt_kwargs=None,
+                  kvstore="tpu", seed=0):
+    rng = np.random.RandomState(seed)
+    params = gluon.ParameterDict()
+    for j in range(n):
+        p = params.get(f"w{j:03d}", shape=PSHAPE, init="zeros")
+        p.initialize()
+        p.set_data(nd.array(rng.randn(*PSHAPE).astype(np.float32)))
+    tr = gluon.Trainer(
+        params, opt_name,
+        dict(opt_kwargs or {"learning_rate": 0.05, "momentum": 0.9}),
+        kvstore=kvstore)
+    return tr, [params[k] for k in sorted(params.keys())]
+
+
+def _step(tr, plist, x):
+    with autograd.record():
+        loss = plist[0].data().reshape(-1)[0] * 0
+        for p in plist:
+            loss = loss + (p.data() * x).sum()
+    loss.backward()
+    tr.step(1)
+
+
+def test_tripwire_dispatches_and_collectives_per_step():
+    """50 params, agg size 4 -> ceil(50/4)=13 fused dispatches and ONE
+    flat-packed collective per step (all f32 fits one bucket). This is the
+    O(num_buckets) tripwire: any regression to per-param dispatch shows up
+    as 50/50."""
+    x = nd.array(np.random.RandomState(9).randn(*PSHAPE).astype(np.float32))
+    tr, plist = _make_trainer()
+    profiler.set_config(aggregate_stats=True)
+    profiler.start()
+    try:
+        for _ in range(2):
+            _step(tr, plist, x)
+        assert tr._last_step_dispatches == 13
+        assert tr._last_step_collectives == 1
+        # one flat f32 buffer: 50 params * 12 elems * 4 bytes
+        assert tr._last_step_collective_bytes == N_PARAMS * 12 * 4
+        import json
+        stats = json.loads(profiler.dumps(format="json"))
+        ctr = stats["counters"]
+        assert ctr["trainer_dispatches_per_step"]["value"] == 13
+        assert ctr["trainer_dispatches_per_step"]["samples"] == 2
+        assert ctr["kvstore_collectives_per_step"]["value"] == 1
+        assert ctr["kvstore_collective_bytes"]["value"] == N_PARAMS * 12 * 4
+    finally:
+        profiler.stop()
+        profiler.dumps(reset=True)
+
+
+def test_engine_bulk_overrides_aggregation_size():
+    x = nd.array(np.random.RandomState(9).randn(*PSHAPE).astype(np.float32))
+    tr, plist = _make_trainer()
+    assert engine.bulk_size() == 0
+    with engine.bulk(8):
+        assert engine.bulk_size() == 8
+        _step(tr, plist, x)
+    assert tr._last_step_dispatches == 7          # ceil(50/8)
+    assert engine.bulk_size() == 0                # restored on exit
+    # set_bulk_size returns the previous value like MXEngineSetBulkSize
+    assert engine.set_bulk_size(3) == 0
+    assert engine.set_bulk_size(0) == 3
+
+
+def test_bulk1_oracle_matches_fused_trainer():
+    """engine.bulk(1) de-aggregates the entire step (per-param dispatch,
+    per-tensor collectives) and must produce bit-identical weights."""
+    x = nd.array(np.random.RandomState(9).randn(*PSHAPE).astype(np.float32))
+    tr_f, pl_f = _make_trainer()
+    tr_u, pl_u = _make_trainer()
+    for _ in range(3):
+        _step(tr_f, pl_f, x)
+    with engine.bulk(1):
+        for _ in range(3):
+            _step(tr_u, pl_u, x)
+    assert tr_f._last_step_dispatches == 13
+    assert tr_u._last_step_dispatches == N_PARAMS
+    assert tr_u._last_step_collectives == N_PARAMS
+    for a, b in zip(pl_f, pl_u):
+        np.testing.assert_array_equal(a.data().asnumpy(), b.data().asnumpy())
+
+
+def test_aggregation_size_env_knob(monkeypatch):
+    x = nd.array(np.random.RandomState(9).randn(*PSHAPE).astype(np.float32))
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "25")
+    tr, plist = _make_trainer()
+    _step(tr, plist, x)
+    assert tr._last_step_dispatches == 2
+
+
+def test_fused_trainer_without_kvstore_matches_oracle():
+    x = nd.array(np.random.RandomState(9).randn(*PSHAPE).astype(np.float32))
+    tr_f, pl_f = _make_trainer(n=10, kvstore=None)
+    tr_u, pl_u = _make_trainer(n=10, kvstore=None)
+    for _ in range(2):
+        _step(tr_f, pl_f, x)
+    with engine.bulk(1):
+        for _ in range(2):
+            _step(tr_u, pl_u, x)
+    assert tr_f._last_step_collectives == 0
+    for a, b in zip(pl_f, pl_u):
+        np.testing.assert_array_equal(a.data().asnumpy(), b.data().asnumpy())
+
+
+def test_trainer_save_load_states_aggregated(tmp_path):
+    x = nd.array(np.random.RandomState(9).randn(*PSHAPE).astype(np.float32))
+    tr, plist = _make_trainer(n=6, opt_name="adam",
+                              opt_kwargs={"learning_rate": 0.01})
+    for _ in range(2):
+        _step(tr, plist, x)
+    fname = str(tmp_path / "trainer.states")
+    tr.save_states(fname)
+    tr2, _ = _make_trainer(n=6, opt_name="adam",
+                           opt_kwargs={"learning_rate": 0.01})
+    tr2._init_kvstore()
+    tr2.load_states(fname)
+    u, u2 = tr._updaters[0], tr2._updaters[0]
+    assert set(u2.states) == set(u.states)
+    assert u2.optimizer._index_update_count == u.optimizer._index_update_count
+    # the loaded trainer continues stepping through the fused path
+    _step(tr2, plist, x)
+    assert tr2._last_step_dispatches == 2          # ceil(6/4)
+
+
+# ---------------------------------------------------------------------------
+# row_sparse densify in allreduce_grads (regression)
+# ---------------------------------------------------------------------------
+
+def test_allreduce_densifies_row_sparse_grad():
+    """allreduce_grads must leave the reduced DENSE gradient where
+    Parameter.grad() reads it; previously the densified buffer bypassed
+    the attach path and the next p.grad() still returned the stale
+    row_sparse value."""
+    from incubator_mxnet_tpu.gluon import nn
+    emb = nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize()
+    tr = gluon.Trainer(emb.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="tpu")
+    w0 = emb.weight.data().asnumpy().copy()
+    xi = nd.array(np.array([1, 3, 3], dtype=np.int64))
+    with autograd.record():
+        loss = emb(xi).sum()
+    loss.backward()
+    p = emb.weight
+    assert getattr(p.grad(), "stype", "default") == "row_sparse"
+    tr.allreduce_grads()
+    g = p.grad()
+    assert getattr(g, "stype", "default") == "default"
+    expect = np.zeros((10, 4), np.float32)
+    expect[1] += 1.0
+    expect[3] += 2.0
+    np.testing.assert_allclose(g.asnumpy(), expect, rtol=1e-6)
+    # and the update consumes the reduced dense value
+    tr._update()
+    np.testing.assert_allclose(emb.weight.data().asnumpy(),
+                               w0 - 0.1 * expect, rtol=1e-6)
