@@ -63,6 +63,13 @@ class Trainer:
         self._last_step_dispatches = 0
         self._last_step_collectives = 0
         self._last_step_collective_bytes = 0
+        self._last_step_recompiles = 0
+        # recompile window baseline: everything compiled after this point
+        # is charged to the next step() — the window spans consecutive
+        # steps so forward/backward retraces (new data shape) count, not
+        # just the optimizer update
+        from .. import profiler
+        self._prev_compile_misses = profiler.compile_totals()[1]
         self._counters = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
@@ -246,16 +253,29 @@ class Trainer:
 
     def _publish_counters(self):
         from .. import profiler
+        # XLA recompiles charged to this step: delta of the profiler's
+        # global compile-miss total since the previous step, so
+        # forward/backward retraces (new data shape between steps) count
+        # alongside optimizer-update ones. Steady-state training publishes
+        # 0; a shape-bucket miss / leaked-scalar recompile shows up here
+        # every step (the silent TPU wall-clock killer). max(0, ...)
+        # guards against profiler.start() clearing the registry mid-run.
+        _, misses = profiler.compile_totals()
+        self._last_step_recompiles = max(
+            0, misses - self._prev_compile_misses)
+        self._prev_compile_misses = misses
         if not profiler.is_running():
             return
         if self._counters is None:
             self._counters = (
                 profiler.Counter(name="trainer_dispatches_per_step"),
                 profiler.Counter(name="kvstore_collectives_per_step"),
-                profiler.Counter(name="kvstore_collective_bytes"))
+                profiler.Counter(name="kvstore_collective_bytes"),
+                profiler.Counter(name="recompiles_per_step"))
         self._counters[0].set_value(self._last_step_dispatches)
         self._counters[1].set_value(self._last_step_collectives)
         self._counters[2].set_value(self._last_step_collective_bytes)
+        self._counters[3].set_value(self._last_step_recompiles)
 
     def save_states(self, fname):
         if not self._kv_initialized:

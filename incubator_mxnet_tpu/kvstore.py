@@ -152,7 +152,9 @@ def _flat_pack_fn(shapes):
     def pack(*xs):
         return jnp.concatenate([x.reshape(-1) for x in xs])
 
-    return jax.jit(pack)
+    from . import profiler as _prof
+    return _prof.track_jit(f"kvstore:flat_pack[{len(shapes)}]",
+                           jax.jit(pack))
 
 
 @functools.lru_cache(maxsize=64)
@@ -175,7 +177,9 @@ def _flat_unpack_fn(shapes):
             off += n
         return tuple(outs)
 
-    return jax.jit(unpack)
+    from . import profiler as _prof
+    return _prof.track_jit(f"kvstore:flat_unpack[{len(shapes)}]",
+                           jax.jit(unpack))
 
 
 class KVStore:

@@ -73,7 +73,11 @@ class Monitor:
 
     def toc(self):
         """Close the window: sweep matching executor arguments (weights),
-        then return all rows as (batch, name, stat_string)."""
+        then return all rows as (batch, name, stat_string). Scalar stats
+        are additionally published as `monitor:<name>` profiler counter
+        series while the profiler is running, so activation/weight health
+        lands in the same chrome trace / /metrics surface as everything
+        else."""
         if not self._capturing:
             return []
         self._capturing = False
@@ -86,7 +90,21 @@ class Monitor:
         rows, self._rows = self._rows, []
         if self.sort:
             rows.sort(key=lambda r: r[1])
+        self._publish(rows)
         return rows
+
+    @staticmethod
+    def _publish(rows):
+        from . import profiler
+        if not profiler.is_running():
+            return
+        for _batch, name, stat in rows:
+            head = stat.split(None, 1)[0] if stat else ""
+            try:
+                value = float(head)
+            except ValueError:
+                continue
+            profiler._counter_sample(f"monitor:{name}", value)
 
     def toc_print(self):
         for batch, name, stat in self.toc():

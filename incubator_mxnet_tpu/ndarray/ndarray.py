@@ -29,6 +29,14 @@ def _jnp():
     return jnp
 
 
+# Memory-profiler hook (profiler.py): fn(jax_array) accounting one device
+# buffer. Installed only while `profiler.set_config(profile_memory=True)`
+# is active, None otherwise — NDArray construction is the choke point every
+# eager op output and user array crosses (the reference instead hooks
+# StorageManager::Alloc, src/profiler/storage_profiler.h).
+MEMORY_HOOK = None
+
+
 class NDArray:
     """n-dimensional array on a device (cpu/gpu/tpu)."""
 
@@ -49,6 +57,8 @@ class NDArray:
         self._grad = None
         self._grad_req = "null"
         self._ag_node = None
+        if MEMORY_HOOK is not None and not _is_tracer(data):
+            MEMORY_HOOK(data)
 
     # ---- basic properties -------------------------------------------------
     @property

@@ -26,6 +26,20 @@ __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
            "retain", "dot", "sparse_add", "row_sparse_combine"]
 
 
+def _note_buffers(sp):
+    """Memory-profiler tagging for sparse containers: the component
+    NDArrays already crossed the construction hook, but tagging here names
+    the allocation after the sparse stype (the reference's storage
+    profiler distinguishes kRowSparseStorage/kCSRStorage chunks)."""
+    from .. import profiler as _prof
+    if not _prof.memory_enabled():
+        return
+    for part in ("data", "indices", "indptr"):
+        nd = getattr(sp, part, None)
+        if nd is not None:
+            _prof.memory_event(nd, tag=f"sparse:{sp.stype}")
+
+
 class BaseSparseNDArray:
     stype = None
 
@@ -52,6 +66,7 @@ class RowSparseNDArray(BaseSparseNDArray):
         self._shape = tuple(int(s) for s in shape)
         if self.data.shape[0] != self.indices.shape[0]:
             raise MXNetError("row_sparse data/indices row-count mismatch")
+        _note_buffers(self)
 
     @property
     def shape(self):
@@ -130,6 +145,7 @@ class CSRNDArray(BaseSparseNDArray):
         self.indptr = indptr if isinstance(indptr, NDArray) else \
             NDArray(jnp.asarray(indptr, jnp.int32))
         self._shape = tuple(int(s) for s in shape)
+        _note_buffers(self)
 
     @property
     def shape(self):

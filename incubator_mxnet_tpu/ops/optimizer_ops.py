@@ -442,6 +442,8 @@ def _fused_fn(op_name, n, arity, static_items, dyn_keys):
         f = jax.jit(fused, donate_argnums=argnums)
     else:
         f = jax.jit(fused)
+    from .. import profiler as _prof
+    f = _prof.track_jit(f"fused:{op_name}[n={n}]", f)
     if len(_fused_cache) >= _FUSED_CACHE_MAX:
         _fused_cache.pop(next(iter(_fused_cache)))
     _fused_cache[key] = f
@@ -524,4 +526,11 @@ def fused_apply(optimizer, indices, weights, grads, states):
         w._data = out[per * j]
         for k, a in enumerate(st_arrs):
             a._data = out[per * j + 1 + k]
+    from .. import profiler as _prof
+    if _prof.memory_enabled():
+        # donation path swaps raw jax buffers into live NDArrays without
+        # constructing wrappers — account the fresh buffers explicitly
+        # (donated inputs decrement through their finalizers on release)
+        for o in out:
+            _prof.memory_event(o, tag=f"fused_apply:{op_name}")
     return True

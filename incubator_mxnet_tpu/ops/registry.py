@@ -115,7 +115,8 @@ class OpDef:
                 out, vjp_fn = jax.vjp(fwd, *primals)
                 return vjp_fn(_match_ct_dtypes(cts, out))
 
-            f = jax.jit(bwd)
+            from .. import profiler as _prof
+            f = _prof.track_jit(f"op:{self.name}:vjp", jax.jit(bwd))
             self._cache_put(key, f)
         return f
 
@@ -143,6 +144,10 @@ class OpDef:
                 f = jax.jit(f_rng)
             else:
                 f = jax.jit(functools.partial(self.fn, **params))
+            # compile telemetry: every call through the cached executable
+            # reports hit/recompile to the profiler's jit tracker
+            from .. import profiler as _prof
+            f = _prof.track_jit(f"op:{self.name}", f)
             self._cache_put(key, f)
         return f
 

@@ -1,9 +1,12 @@
-"""Profiler: op-level events + chrome-trace output + aggregate stats.
+"""Profiler: op-level events + chrome-trace output + aggregate stats +
+runtime telemetry (memory profiler, jit-recompile tracker, Prometheus
+scrape surface).
 
 Reference: src/profiler/profiler.h:251 (typed stats in per-thread buffers,
 chrome://tracing JSON at profiler.h:79,432, DumpProfile:299, aggregate
-table aggregate_stats.cc) and python/mxnet/profiler.py (set_config /
-set_state / start / stop / dump / dumps + scoped markers).
+table aggregate_stats.cc, GPU memory profiler behind profile_memory) and
+python/mxnet/profiler.py (set_config / set_state / start / stop / dump /
+dumps + scoped markers + Domain/Task/Event/Counter/Marker).
 
 TPU-native redesign: engine-op instrumentation becomes a dispatch hook on
 the op registry (the only choke point every eager/compiled call crosses),
@@ -12,20 +15,45 @@ directory is configured. Dispatch is async under XLA — `profile_sync=True`
 (the default while profiling) blocks on each op's output so durations are
 real compute times, mirroring the reference's GPU stream-sync profiling
 mode (profiler.h kSimple vs kAccurate).
+
+Three telemetry layers beyond the reference:
+
+- **Memory profiler** (`profile_memory=True`): NDArray construction and the
+  fused-step donation path report device buffers here; live/peak bytes are
+  accounted per device in pure python (finalizers decrement on free) and
+  emitted as `ph:"C"` counter tracks in the chrome trace plus a Memory
+  section in dumps(). The reference's analog is the GpuDeviceStorageProfiler
+  (storage_profiler.h) behind the same config flag.
+- **Jit/compile tracker**: every cached-jit choke point the framework owns
+  (op registry, fused optimizer dispatch, kvstore flat-pack, serving
+  executables) wraps its compiled callable in `track_jit(key, fn)`, which
+  detects XLA recompilation per call (via the jit cache size) and records
+  it through `compile_event(key, cache_hit, compile_ms)`. A cache key
+  recompiling more than MXNET_COMPILE_WARN_THRESHOLD times logs a warning —
+  the classic leaked-python-scalar / unbucketed-shape bug.
+- **Scrape surface**: `render_prometheus()` serializes the counter/gauge
+  registry in Prometheus text exposition format (served at GET /metrics by
+  serve/server.py), and `continuous_dump`/`dump_period` run a daemon thread
+  writing rolling chrome traces for long training runs.
 """
 from __future__ import annotations
 
 import json
+import logging
 import os
+import re
 import threading
 import time
+import weakref
 from collections import defaultdict
 
 from .base import MXNetError
 
 __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
            "pause", "resume", "is_running", "Scope", "Task", "Event",
-           "Counter", "Marker"]
+           "Counter", "Marker", "Domain", "compile_event", "compile_stats",
+           "compile_totals", "track_jit", "memory_event", "memory_stats",
+           "memory_enabled", "render_prometheus"]
 
 _lock = threading.Lock()
 _state = {
@@ -36,9 +64,14 @@ _state = {
     "sync": True,
     "tb_dir": None,
     "tb_active": False,
+    "profile_memory": False,
+    "continuous": False,
+    "dump_period": 1.0,
 }
-_events = []  # (name, category, start_us, dur_us, tid)
-_counters = []  # (name, ts_us, value)
+# event dicts: {"name","cat","ts","dur","tid","ph"} (+optional "s","args")
+_events = []
+_counters = []       # (name, ts_us, value) sample series
+_counter_last = {}   # name -> latest value (the Prometheus gauge registry)
 
 
 def set_config(filename="profile.json", profile_all=False,
@@ -52,6 +85,9 @@ def set_config(filename="profile.json", profile_all=False,
     _state["aggregate_stats"] = aggregate_stats
     _state["sync"] = profile_sync
     _state["tb_dir"] = tensorboard_dir
+    _state["profile_memory"] = bool(profile_memory)
+    _state["continuous"] = bool(continuous_dump)
+    _state["dump_period"] = max(float(dump_period), 0.05)
 
 
 def set_state(state="stop", profile_process="worker"):
@@ -68,7 +104,19 @@ def start(profile_process="worker"):
     from .ops import registry
     _state["running"] = True
     _state["paused"] = False
+    # a start() opens a fresh profiling window: compile telemetry gathered
+    # before it (the registry records always-on) belongs to the previous
+    # window and would pollute this session's dumps()/compile table
+    with _clock:
+        _compile.clear()
+        _compile_warned.clear()
     registry.PROFILER_HOOK = _op_hook
+    if _state["profile_memory"]:
+        _mem["enabled"] = True
+        from .ndarray import ndarray as _ndmod
+        _ndmod.MEMORY_HOOK = _note_alloc
+    if _state["continuous"]:
+        _start_dump_thread()
     if _state["tb_dir"]:
         import jax
         os.makedirs(_state["tb_dir"], exist_ok=True)
@@ -80,6 +128,11 @@ def stop(profile_process="worker"):
     from .ops import registry
     _state["running"] = False
     registry.PROFILER_HOOK = None
+    # uninstall the allocation hook (accounting stays readable in dumps())
+    _mem["enabled"] = False
+    from .ndarray import ndarray as _ndmod
+    _ndmod.MEMORY_HOOK = None
+    _stop_dump_thread()
     if _state.get("tb_active"):
         import jax
         jax.profiler.stop_trace()
@@ -102,6 +155,46 @@ def resume(profile_process="worker"):
     _state["paused"] = False
 
 
+# ---------------------------------------------------------------------------
+# continuous dump (reference profiler.h continuous_dump_: rolling traces so
+# a long run that never reaches a clean exit still leaves profile data)
+# ---------------------------------------------------------------------------
+
+_dump_thread = None
+_dump_stop = threading.Event()
+
+
+def _start_dump_thread():
+    global _dump_thread
+    if _dump_thread is not None and _dump_thread.is_alive():
+        return
+    _dump_stop.clear()
+
+    def _loop():
+        while not _dump_stop.wait(_state["dump_period"]):
+            if _state["running"]:
+                try:
+                    dump(finished=False)
+                except Exception:       # noqa: BLE001 — never kill the run
+                    logging.exception("profiler continuous dump failed")
+
+    _dump_thread = threading.Thread(target=_loop, name="mxtpu-profiler-dump",
+                                    daemon=True)
+    _dump_thread.start()
+
+
+def _stop_dump_thread():
+    global _dump_thread
+    _dump_stop.set()
+    t, _dump_thread = _dump_thread, None
+    if t is not None and t.is_alive():
+        t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# event recording
+# ---------------------------------------------------------------------------
+
 def _op_hook(name, fn, args):
     """Installed into registry.PROFILER_HOOK: time one op dispatch."""
     if not _state["running"] or _state["paused"]:
@@ -112,8 +205,8 @@ def _op_hook(name, fn, args):
         _block(out)
     dur = (time.perf_counter() - t0) * 1e6
     with _lock:
-        _events.append((name, "operator", t0 * 1e6, dur,
-                        threading.get_ident()))
+        _events.append({"name": name, "cat": "operator", "ts": t0 * 1e6,
+                        "dur": dur, "tid": threading.get_ident(), "ph": "X"})
     return out
 
 
@@ -125,15 +218,304 @@ def _block(out):
         out.block_until_ready()
 
 
-def _record(name, category, t0_us, dur_us):
+def _record(name, category, t0_us, dur_us, ph="X", scope=None, args=None):
+    ev = {"name": name, "cat": category, "ts": t0_us, "dur": dur_us,
+          "tid": threading.get_ident(), "ph": ph}
+    if scope is not None:
+        ev["s"] = scope
+    if args is not None:
+        ev["args"] = args
     with _lock:
-        _events.append((name, category, t0_us, dur_us,
-                        threading.get_ident()))
+        _events.append(ev)
 
+
+def _counter_sample(name, value):
+    """Append one sample to the counter series and refresh the last-value
+    registry. Callers that need atomic read-modify-write (Counter) hold
+    `_lock` already and use `_counter_sample_locked`."""
+    with _lock:
+        _counter_sample_locked(name, value)
+
+
+def _counter_sample_locked(name, value):
+    _counters.append((name, time.perf_counter() * 1e6, value))
+    _counter_last[name] = value
+
+
+# ---------------------------------------------------------------------------
+# jit/compile tracker
+# ---------------------------------------------------------------------------
+
+_clock = threading.Lock()
+_compile = {}            # key -> [hits, misses, compile_ms_total, last_ms]
+_compile_warned = set()
+
+
+def _warn_threshold():
+    try:
+        return int(os.environ.get("MXNET_COMPILE_WARN_THRESHOLD", "8"))
+    except ValueError:
+        return 8
+
+
+def compile_event(key, cache_hit, compile_ms=0.0):
+    """Record one lookup against a compiled-executable cache.
+
+    key:       stable cache identity ("op:dot", "fused:adam_update[n=4]",
+               "kvstore:flat_pack[13]", "serve:exec[8x6]", ...)
+    cache_hit: True when an already-compiled executable served the call
+    compile_ms: trace+compile wall time charged to a miss
+
+    Always-on (independent of start/stop): recompile pathologies are
+    exactly the thing you need visibility into *before* deciding to
+    profile. pause() still suppresses it — pause is the explicit "don't
+    record this region" request. A key whose miss count passes
+    MXNET_COMPILE_WARN_THRESHOLD logs one warning — the classic
+    silent-recompile-per-step bug (leaked python scalar in a param,
+    shape bucket miss, donation failure).
+    """
+    if _state["paused"]:
+        return
+    warn = None
+    with _clock:
+        rec = _compile.get(key)
+        if rec is None:
+            rec = _compile[key] = [0, 0, 0.0, 0.0]
+        if cache_hit:
+            rec[0] += 1
+        else:
+            rec[1] += 1
+            rec[2] += float(compile_ms)
+            rec[3] = float(compile_ms)
+            if rec[1] > _warn_threshold() and key not in _compile_warned:
+                _compile_warned.add(key)
+                warn = rec[1]
+    if warn is not None:
+        logging.warning(
+            "profiler: cache key %r has compiled %d times "
+            "(MXNET_COMPILE_WARN_THRESHOLD=%d) — a python scalar leaking "
+            "into a traced program or an unbucketed shape is recompiling "
+            "every step", key, warn, _warn_threshold())
+
+
+def compile_stats():
+    """Snapshot {key: {hits, misses, compile_ms, last_compile_ms}}."""
+    with _clock:
+        return {k: {"hits": v[0], "misses": v[1],
+                    "compile_ms": v[2], "last_compile_ms": v[3]}
+                for k, v in _compile.items()}
+
+
+def compile_totals():
+    """(total_hits, total_misses) over every tracked cache. The Trainer
+    diffs the miss total around each step into `recompiles_per_step`."""
+    with _clock:
+        h = m = 0
+        for v in _compile.values():
+            h += v[0]
+            m += v[1]
+        return h, m
+
+
+def track_jit(key, fn):
+    """Wrap a jax.jit-compiled callable so every call records a
+    compile_event: a call that grows the executable's internal cache (new
+    shape/dtype signature -> XLA retrace+compile) is a miss charged with
+    the call's wall time; a steady-state call is a hit.
+
+    Falls back to first-call-is-the-miss accounting when the jit internals
+    don't expose a cache size (older jax, non-jit callables).
+    """
+    probe = getattr(fn, "_cache_size", None)
+    state = {"called": False}
+
+    def wrapped(*args, **kwargs):
+        before = None
+        if probe is not None:
+            try:
+                before = probe()
+            except Exception:       # noqa: BLE001
+                before = None
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        after = None
+        if probe is not None:
+            try:
+                after = probe()
+            except Exception:       # noqa: BLE001
+                after = None
+        if before is None or after is None:
+            first = not state["called"]
+            state["called"] = True
+            compile_event(key, cache_hit=not first,
+                          compile_ms=dt_ms if first else 0.0)
+        elif after > before:
+            compile_event(key, cache_hit=False, compile_ms=dt_ms)
+        else:
+            compile_event(key, cache_hit=True)
+        return out
+
+    wrapped.__wrapped__ = fn
+    wrapped._compile_key = key
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# memory profiler (reference storage_profiler.h GpuDeviceStorageProfiler,
+# enabled by the same `profile_memory` config flag the reference uses)
+# ---------------------------------------------------------------------------
+
+# RLock: registering a buffer can allocate (dict resize) and thereby run a
+# pending finalizer (_note_free) on this same thread mid-critical-section
+_mlock = threading.RLock()
+_mem = {
+    "enabled": False,
+    "live": defaultdict(int),     # device label -> live bytes
+    "peak": defaultdict(int),     # device label -> peak bytes
+    "buffers": {},                # id(buf) -> (nbytes, device label)
+    "allocs": 0,                  # cumulative allocation events
+    "frees": 0,
+}
+
+_scope_tls = threading.local()
+
+
+def _current_scope():
+    stack = getattr(_scope_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def memory_enabled():
+    return _mem["enabled"]
+
+
+def _device_of(buf):
+    try:
+        devs = buf.devices()
+        if len(devs) == 1:
+            return str(next(iter(devs)))
+        return f"mesh[{len(devs)}]"
+    except Exception:       # noqa: BLE001 — committed-less / host arrays
+        return "uncommitted"
+
+
+def _note_free(key):
+    with _mlock:
+        rec = _mem["buffers"].pop(key, None)
+        if rec is None:
+            return
+        nbytes, dev = rec
+        _mem["live"][dev] -= nbytes
+        _mem["frees"] += 1
+        live = _mem["live"][dev]
+    if is_running():
+        _counter_sample(f"memory:live_bytes:{dev}", live)
+
+
+def _note_alloc(buf, tag=None):
+    """Account one device buffer (installed as ndarray.MEMORY_HOOK while
+    profile_memory is active; also called explicitly from donation paths
+    that swap raw jax buffers without constructing an NDArray). Duplicate
+    registrations of the same live buffer are no-ops, so wrapper churn
+    (views, out= rebinds) never double-counts."""
+    if not _mem["enabled"]:
+        return
+    try:
+        nbytes = int(buf.nbytes)
+    except Exception:       # noqa: BLE001 — tracers, abstract values
+        return
+    key = id(buf)
+    with _mlock:
+        if key in _mem["buffers"]:
+            return
+    try:
+        weakref.finalize(buf, _note_free, key)
+    except TypeError:
+        return              # not weakref-able: cannot track its lifetime
+    dev = _device_of(buf)
+    with _mlock:
+        if key in _mem["buffers"]:      # lost a thread race — already in
+            return
+        _mem["buffers"][key] = (nbytes, dev)
+        _mem["live"][dev] += nbytes
+        if _mem["live"][dev] > _mem["peak"][dev]:
+            _mem["peak"][dev] = _mem["live"][dev]
+        _mem["allocs"] += 1
+        live = _mem["live"][dev]
+    if is_running():
+        now = time.perf_counter() * 1e6
+        scope = tag or _current_scope() or "global"
+        with _lock:
+            _counter_sample_locked(f"memory:live_bytes:{dev}", live)
+            _events.append({"name": f"alloc:{scope}", "cat": "memory",
+                            "ts": now, "dur": 0,
+                            "tid": threading.get_ident(), "ph": "i",
+                            "s": "t",
+                            "args": {"bytes": nbytes, "device": dev}})
+
+
+def memory_event(arr, tag=None):
+    """Explicitly account a buffer created outside NDArray construction
+    (fused-step donation outputs, sparse containers). `arr` may be an
+    NDArray or a raw jax array."""
+    data = getattr(arr, "_data", arr)
+    _note_alloc(data, tag=tag)
+
+
+def memory_stats():
+    """Pure-python accounting snapshot: per-device live/peak bytes plus
+    whatever the backend itself reports (jax.live_arrays byte total,
+    device memory_stats) when available."""
+    with _mlock:
+        snap = {
+            "live_bytes": dict(_mem["live"]),
+            "peak_bytes": dict(_mem["peak"]),
+            "tracked_buffers": len(_mem["buffers"]),
+            "alloc_events": _mem["allocs"],
+            "free_events": _mem["frees"],
+        }
+    try:
+        import jax
+        snap["jax_live_bytes"] = int(sum(
+            getattr(a, "nbytes", 0) for a in jax.live_arrays()))
+        dev_stats = {}
+        for d in jax.local_devices():
+            try:
+                s = d.memory_stats()
+            except Exception:       # noqa: BLE001
+                s = None
+            if s:
+                dev_stats[str(d)] = {
+                    k: int(v) for k, v in s.items()
+                    if k in ("bytes_in_use", "peak_bytes_in_use",
+                             "bytes_limit")}
+        if dev_stats:
+            snap["device_memory_stats"] = dev_stats
+    except Exception:       # noqa: BLE001 — no backend, headless dumps
+        pass
+    return snap
+
+
+def _reset_memory_locked():
+    """reset=True semantics: peaks collapse to the current live level and
+    the event counts restart; live accounting keeps tracking the buffers
+    that are still alive (dropping them would corrupt the books)."""
+    with _mlock:
+        for dev, live in _mem["live"].items():
+            _mem["peak"][dev] = live
+        _mem["allocs"] = 0
+        _mem["frees"] = 0
+
+
+# ---------------------------------------------------------------------------
+# dump / dumps
+# ---------------------------------------------------------------------------
 
 def dump(finished=True, profile_process="worker"):
     """Write chrome://tracing JSON (reference MXDumpProfile;
-    profiler.h:79 'chrome tracing json')."""
+    profiler.h:79 'chrome tracing json'). `finished=False` (the continuous
+    dump path) keeps the buffers for the next rolling snapshot."""
     with _lock:
         events = list(_events)
         counters = list(_counters)
@@ -141,23 +523,45 @@ def dump(finished=True, profile_process="worker"):
             _events.clear()
             _counters.clear()
     trace = []
-    for name, cat, ts, dur, tid in events:
-        trace.append({"name": name, "cat": cat, "ph": "X", "ts": ts,
-                      "dur": dur, "pid": 0, "tid": tid})
+    for ev in events:
+        e = {"name": ev["name"], "cat": ev["cat"], "ph": ev["ph"],
+             "ts": ev["ts"], "pid": 0, "tid": ev["tid"]}
+        if ev["ph"] == "X":
+            e["dur"] = ev["dur"]
+        if "s" in ev:
+            e["s"] = ev["s"]
+        if "args" in ev:
+            e["args"] = ev["args"]
+        trace.append(e)
     for name, ts, value in counters:
         trace.append({"name": name, "ph": "C", "ts": ts, "pid": 0,
-                      "args": {"value": value}})
+                      "args": {"value": _finite(value, 0)}})
     with open(_state["filename"], "w") as f:
         json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
     return _state["filename"]
 
 
+def _finite(v, default=None):
+    """Strict-JSON guard: bare Infinity/NaN from json.dumps is rejected by
+    conforming parsers; non-finite aggregates serialize as `default`."""
+    if isinstance(v, float) and (v != v or v in (float("inf"),
+                                                 float("-inf"))):
+        return default
+    return v
+
+
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    """Aggregate-stats table string (reference
-    MXAggregateProfileStatsPrint / aggregate_stats.cc). Counter series
-    (profiler.Counter — op counts, serving queue depth / shed totals from
-    serve/stats.py) are aggregated into their own section: last value +
-    sample count per counter name."""
+    """Aggregate-stats string (reference MXAggregateProfileStatsPrint /
+    aggregate_stats.cc). Sections:
+
+    - per-op event table (count/total/min/max/avg us)
+    - counter series (last value + sample count per name)
+    - compile cache table (hits/misses/compile ms per tracked jit cache)
+    - memory table (per-device live/peak bytes) when profile_memory ran
+
+    format="json" returns the same data as a strict-JSON object (non-finite
+    aggregates are null, so json.loads in strict consumers round-trips).
+    """
     with _lock:
         events = list(_events)
         counters = list(_counters)
@@ -165,23 +569,38 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             _events.clear()
             _counters.clear()
     agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
-    for name, cat, ts, dur, tid in events:
-        a = agg[name]
+    for ev in events:
+        a = agg[ev["name"]]
         a[0] += 1
-        a[1] += dur
-        a[2] = min(a[2], dur)
-        a[3] = max(a[3], dur)
+        a[1] += ev["dur"]
+        a[2] = min(a[2], ev["dur"])
+        a[3] = max(a[3], ev["dur"])
     cagg = {}
     for name, ts, value in counters:
         cnt = cagg[name][0] + 1 if name in cagg else 1
         cagg[name] = (cnt, value)
+    comp = compile_stats()
+    mem = memory_stats() if (_mem["enabled"] or _mem["allocs"]
+                             or _mem["peak"]) else None
+    if reset:
+        with _clock:
+            _compile.clear()
+            _compile_warned.clear()
+        _reset_memory_locked()
     if format == "json":
-        return json.dumps({
-            "stats": {k: {"count": v[0], "total_us": v[1],
-                          "min_us": v[2], "max_us": v[3]}
+        out = {
+            "stats": {k: {"count": v[0], "total_us": _finite(v[1], 0.0),
+                          "min_us": _finite(v[2]), "max_us": _finite(v[3])}
                       for k, v in agg.items()},
-            "counters": {k: {"samples": c, "value": v}
-                         for k, (c, v) in cagg.items()}})
+            "counters": {k: {"samples": c, "value": _finite(v)}
+                         for k, (c, v) in cagg.items()},
+            "compile": comp,
+        }
+        if mem is not None:
+            out["memory"] = {"live_bytes": mem["live_bytes"],
+                             "peak_bytes": mem["peak_bytes"],
+                             "alloc_events": mem["alloc_events"]}
+        return json.dumps(out)
     lines = [f"{'Name':<40}{'Count':>8}{'Total(us)':>14}"
              f"{'Min(us)':>12}{'Max(us)':>12}{'Avg(us)':>12}",
              "-" * 98]
@@ -190,6 +609,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
            "avg": lambda kv: kv[1][1] / max(kv[1][0], 1)}[sort_by]
     for name, (cnt, tot, mn, mx) in sorted(agg.items(), key=key,
                                            reverse=not ascending):
+        mn = 0.0 if mn == float("inf") else mn
         lines.append(f"{name:<40}{cnt:>8}{tot:>14.1f}{mn:>12.1f}"
                      f"{mx:>12.1f}{tot / max(cnt, 1):>12.1f}")
     if cagg:
@@ -198,7 +618,149 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         for name, (cnt, val) in sorted(cagg.items()):
             sval = f"{val:.3f}" if isinstance(val, float) else f"{val}"
             lines.append(f"{name:<48}{cnt:>10}{sval:>16}")
+    if comp:
+        lines += ["", f"{'Compile cache':<48}{'Hits':>8}{'Misses':>8}"
+                      f"{'Compile(ms)':>14}",
+                  "-" * 78]
+        for name, rec in sorted(comp.items()):
+            lines.append(f"{name:<48}{rec['hits']:>8}{rec['misses']:>8}"
+                         f"{rec['compile_ms']:>14.1f}")
+    if mem is not None and (mem["live_bytes"] or mem["peak_bytes"]):
+        lines += ["", f"{'Memory (device)':<48}{'Live(bytes)':>14}"
+                      f"{'Peak(bytes)':>14}",
+                  "-" * 76]
+        devs = sorted(set(mem["live_bytes"]) | set(mem["peak_bytes"]))
+        for dev in devs:
+            lines.append(f"{dev:<48}{mem['live_bytes'].get(dev, 0):>14}"
+                         f"{mem['peak_bytes'].get(dev, 0):>14}")
+        lines.append(f"{'(alloc events)':<48}"
+                     f"{mem['alloc_events']:>14}{mem['free_events']:>14}")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (the /metrics scrape surface)
+# ---------------------------------------------------------------------------
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_label(value):
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def render_prometheus():
+    """Serialize the live telemetry registries in Prometheus text
+    exposition format (served by serve/server.py at GET /metrics):
+
+    - every profiler Counter's last value as
+      mxnet_profiler_counter{name="..."}
+    - per-cache compile hits/misses/compile-time totals
+    - per-device live/peak memory bytes (when profile_memory ran)
+    - profiler liveness + buffered event/sample gauges
+    """
+    lines = []
+
+    def family(name, mtype, help_text):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+
+    family("mxnet_profiler_running", "gauge",
+           "1 while the profiler is collecting")
+    lines.append(f"mxnet_profiler_running {1 if is_running() else 0}")
+
+    with _lock:
+        last = dict(_counter_last)
+        n_events = len(_events)
+        n_samples = len(_counters)
+    family("mxnet_profiler_buffered_events", "gauge",
+           "trace events buffered since the last dump")
+    lines.append(f"mxnet_profiler_buffered_events {n_events}")
+    lines.append(f"mxnet_profiler_buffered_counter_samples {n_samples}")
+
+    if last:
+        family("mxnet_profiler_counter", "gauge",
+               "last value of each profiler counter series")
+        for name in sorted(last):
+            val = _finite(last[name])
+            if not isinstance(val, (int, float)) or isinstance(val, bool):
+                continue
+            lines.append(
+                f'mxnet_profiler_counter{{name="{_prom_label(name)}"}} '
+                f'{val}')
+
+    comp = compile_stats()
+    if comp:
+        family("mxnet_compile_cache_hits_total", "counter",
+               "compiled-executable reuses per jit cache key")
+        for name in sorted(comp):
+            lines.append(
+                f'mxnet_compile_cache_hits_total'
+                f'{{key="{_prom_label(name)}"}} {comp[name]["hits"]}')
+        family("mxnet_compile_cache_misses_total", "counter",
+               "XLA (re)compilations per jit cache key")
+        for name in sorted(comp):
+            lines.append(
+                f'mxnet_compile_cache_misses_total'
+                f'{{key="{_prom_label(name)}"}} {comp[name]["misses"]}')
+        family("mxnet_compile_time_ms_total", "counter",
+               "wall-clock ms spent tracing+compiling per jit cache key")
+        for name in sorted(comp):
+            lines.append(
+                f'mxnet_compile_time_ms_total'
+                f'{{key="{_prom_label(name)}"}} '
+                f'{comp[name]["compile_ms"]:.3f}')
+
+    with _mlock:
+        live = dict(_mem["live"])
+        peak = dict(_mem["peak"])
+    if live or peak:
+        family("mxnet_memory_live_bytes", "gauge",
+               "python-accounted live device bytes (profile_memory)")
+        for dev in sorted(live):
+            lines.append(
+                f'mxnet_memory_live_bytes{{device="{_prom_label(dev)}"}} '
+                f'{live[dev]}')
+        family("mxnet_memory_peak_bytes", "gauge",
+               "python-accounted peak device bytes (profile_memory)")
+        for dev in sorted(peak):
+            lines.append(
+                f'mxnet_memory_peak_bytes{{device="{_prom_label(dev)}"}} '
+                f'{peak[dev]}')
+
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# user objects: Domain / Scope / Task / Event / Marker / Counter
+# ---------------------------------------------------------------------------
+
+class Domain:
+    """Named grouping for Tasks/Counters/Markers (reference profiler.py
+    Domain / MXProfileCreateDomain): events carry the domain as their
+    chrome-trace category, so traces group per domain."""
+
+    def __init__(self, name):
+        self.name = str(name)
+
+    def new_task(self, name="task"):
+        return Task(self, name)
+
+    def new_counter(self, name="counter", value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name="marker"):
+        return Marker(self, name)
+
+    def __repr__(self):
+        return f"Domain({self.name!r})"
+
+
+def _domain_name(domain):
+    if domain is None:
+        return None
+    return getattr(domain, "name", str(domain))
 
 
 class _Timed:
@@ -228,13 +790,31 @@ class _Timed:
 
 
 class Scope(_Timed):
+    """Named scope; while active it also tags memory-allocation events on
+    this thread (the reference's profiler scope strings in
+    storage_profiler alloc names)."""
+
     def __init__(self, name="<unk>:"):
         super().__init__(name, "scope")
+
+    def start(self):
+        super().start()
+        stack = getattr(_scope_tls, "stack", None)
+        if stack is None:
+            stack = _scope_tls.stack = []
+        stack.append(self._name)
+
+    def stop(self):
+        stack = getattr(_scope_tls, "stack", None)
+        if stack and stack[-1] == self._name:
+            stack.pop()
+        super().stop()
 
 
 class Task(_Timed):
     def __init__(self, domain=None, name="task"):
-        super().__init__(name, "task")
+        dom = _domain_name(domain)
+        super().__init__(name, dom if dom else "task")
 
 
 class Event(_Timed):
@@ -242,35 +822,47 @@ class Event(_Timed):
         super().__init__(name, "event")
 
 
+_MARK_SCOPES = {"process": "p", "thread": "t", "global": "g"}
+
+
 class Marker:
-    """Instant marker (reference profiler.py Marker.mark)."""
+    """Instant marker (reference profiler.py Marker.mark): `ph:"i"` with
+    the chrome instant-scope flag derived from mark(scope=...)."""
 
     def __init__(self, domain=None, name="marker"):
         self._name = name
+        self._category = _domain_name(domain) or "marker"
 
     def mark(self, scope="process"):
-        _record(self._name, "marker", time.perf_counter() * 1e6, 0)
+        _record(self._name, self._category, time.perf_counter() * 1e6, 0,
+                ph="i", scope=_MARK_SCOPES.get(scope, "t"))
 
 
 class Counter:
-    """Numeric counter series (reference profiler.py Counter)."""
+    """Numeric counter series (reference profiler.py Counter). increment/
+    decrement are atomic: the read-modify-write happens under the module
+    lock, so concurrent bumps from serve/batcher threads never lose
+    updates."""
 
     def __init__(self, domain=None, name="counter", value=None):
-        self._name = name
+        dom = _domain_name(domain)
+        self._name = f"{dom}::{name}" if dom else name
         self._value = 0
         if value is not None:
             self.set_value(value)
 
     def set_value(self, value):
-        self._value = value
         with _lock:
-            _counters.append((self._name, time.perf_counter() * 1e6, value))
+            self._value = value
+            _counter_sample_locked(self._name, value)
 
     def increment(self, delta=1):
-        self.set_value(self._value + delta)
+        with _lock:
+            self._value += delta
+            _counter_sample_locked(self._name, self._value)
 
     def decrement(self, delta=1):
-        self.set_value(self._value - delta)
+        self.increment(-delta)
 
     def __iadd__(self, v):
         self.increment(v)

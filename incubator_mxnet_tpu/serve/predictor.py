@@ -182,7 +182,10 @@ class Predictor:
                 outs, _ = run({**param_vals, **input_vals})
                 return tuple(outs)
 
-            fn = jax.jit(call)
+            from .. import profiler as _prof
+            shapes = ",".join("x".join(map(str, shape))
+                              for _, shape, _ in sig)
+            fn = _prof.track_jit(f"serve:exec[{shapes}]", jax.jit(call))
             self._executables[sig] = fn
             return fn
 

@@ -22,6 +22,9 @@ Protocol (JSON):
                      sample-shaped — requests are UNBATCHED samples)
   GET  /healthz   -> {"status": "ok", "queue_depth": n}
   GET  /stats     -> ServingStats.snapshot()
+  GET  /metrics   -> Prometheus text exposition (serving counters +
+                     trainer counters + compile-cache + memory gauges,
+                     profiler.render_prometheus())
 """
 from __future__ import annotations
 
@@ -61,6 +64,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, code, text, content_type="text/plain"):
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         ms = self._ms
         if self.path == "/healthz":
@@ -68,6 +79,14 @@ class _Handler(BaseHTTPRequestHandler):
                               "queue_depth": ms.stats.queue_depth})
         elif self.path == "/stats":
             self._reply(200, ms.stats.snapshot())
+        elif self.path == "/metrics":
+            from .. import profiler
+            # refresh this endpoint's serving counters so a scrape always
+            # sees current values regardless of batch cadence
+            ms.stats.publish()
+            self._reply_text(
+                200, profiler.render_prometheus(),
+                content_type="text/plain; version=0.0.4; charset=utf-8")
         else:
             self._reply(404, {"error": "not found", "retryable": False})
 

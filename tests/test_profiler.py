@@ -1,0 +1,450 @@
+"""Profiler core + runtime telemetry (memory, compile tracker, /metrics).
+
+Covers the observability milestone:
+  * Counter increment/decrement is atomic under thread contention,
+  * dumps(format="json") is strict JSON (no bare Infinity/NaN),
+  * Domain/Task categories and Marker instant scopes land in the trace,
+  * dump() output round-trips tools/validate_trace.py (X/i/C phases),
+  * pause/resume suppression, is_running gating, dumps(reset=True),
+  * the compile table shows cache hits after a steady-state fused-Adam
+    loop and a deliberate shape change increments recompiles_per_step,
+  * profile_memory accounts per-device live/peak bytes within 10% of
+    test-side accounting and emits live-bytes counter tracks,
+  * GET /metrics serves valid Prometheus text exposition with serving
+    and trainer counters.
+"""
+import gc
+import json
+import os
+import re
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd, profiler
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+from validate_trace import TraceFormatError, validate_trace  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler():
+    """Every test starts and ends with a stopped, empty profiler."""
+    profiler.stop()
+    profiler.dumps(reset=True)
+    yield
+    profiler.stop()
+    profiler.set_config()        # restore defaults (filename, memory off)
+    profiler.dumps(reset=True)
+
+
+# ---------------------------------------------------------------------------
+# Counter atomicity (the increment read-modify-write race)
+# ---------------------------------------------------------------------------
+
+def test_counter_increment_is_atomic_across_threads():
+    c = profiler.Counter(name="race")
+    n_threads, n_incr = 8, 1000
+    start = threading.Barrier(n_threads)
+
+    def bump():
+        start.wait()
+        for _ in range(n_incr):
+            c.increment(1)
+
+    threads = [threading.Thread(target=bump) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c._value == n_threads * n_incr
+    j = json.loads(profiler.dumps(format="json"))
+    assert j["counters"]["race"]["value"] == n_threads * n_incr
+    assert j["counters"]["race"]["samples"] == n_threads * n_incr
+    c.decrement(8000)
+    assert c._value == 0
+
+
+# ---------------------------------------------------------------------------
+# strict JSON
+# ---------------------------------------------------------------------------
+
+def _loads_strict(s):
+    def boom(tok):
+        raise AssertionError(f"non-strict JSON token {tok!r} in output")
+    return json.loads(s, parse_constant=boom)
+
+
+def test_dumps_json_is_strict_with_counters_only():
+    # counters but zero events used to serialize min_us as bare Infinity
+    profiler.Counter(name="lonely").set_value(3)
+    j = _loads_strict(profiler.dumps(format="json"))
+    assert j["counters"]["lonely"] == {"samples": 1, "value": 3}
+    assert j["stats"] == {}
+
+
+def test_dumps_json_sanitizes_nonfinite_counter_values():
+    profiler.Counter(name="inf").set_value(float("inf"))
+    profiler.Counter(name="nan").set_value(float("nan"))
+    j = _loads_strict(profiler.dumps(format="json"))
+    assert j["counters"]["inf"]["value"] is None
+    assert j["counters"]["nan"]["value"] is None
+    # the table renderer also survives them
+    assert "inf" in profiler.dumps()
+
+
+# ---------------------------------------------------------------------------
+# Domain / Task / Marker semantics
+# ---------------------------------------------------------------------------
+
+def test_domain_threads_into_category_and_marker_scope():
+    profiler.start()
+    dom = profiler.Domain("dataload")
+    with dom.new_task(name="decode"):
+        time.sleep(0.001)
+    dom.new_marker("epoch_end").mark(scope="global")
+    profiler.Marker(name="plain").mark(scope="process")
+    profiler.Marker(name="weird").mark(scope="not-a-scope")
+    profiler.stop()
+    profiler.dump(finished=True)
+    with open("profile.json") as f:
+        trace = json.load(f)["traceEvents"]
+    by_name = {e["name"]: e for e in trace}
+    assert by_name["decode"]["cat"] == "dataload"
+    assert by_name["decode"]["ph"] == "X"
+    assert by_name["epoch_end"] == {
+        **by_name["epoch_end"], "ph": "i", "s": "g", "cat": "dataload"}
+    assert by_name["plain"]["s"] == "p"
+    assert by_name["weird"]["s"] == "t"      # unknown scope -> thread
+    os.remove("profile.json")
+    # domain-scoped counters get a namespaced series
+    dom.new_counter("items", value=7)
+    j = json.loads(profiler.dumps(format="json"))
+    assert j["counters"]["dataload::items"]["value"] == 7
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace round trip through the schema validator
+# ---------------------------------------------------------------------------
+
+def test_dump_round_trips_schema_validator(tmp_path):
+    out = tmp_path / "trace.json"
+    profiler.set_config(filename=str(out), profile_memory=True)
+    profiler.start()
+    x = nd.ones((32, 32))
+    (x * 3).sum().asnumpy()
+    profiler.Marker(name="mid").mark()
+    profiler.Counter(name="gauge").set_value(5)
+    profiler.stop()
+    path = profiler.dump()
+    assert path == str(out)
+    n = validate_trace(str(out))
+    assert n > 0
+    with open(out) as f:
+        phases = {e["ph"] for e in json.load(f)["traceEvents"]}
+    assert {"X", "i", "C"} <= phases
+
+
+def test_validate_trace_rejects_malformed():
+    with pytest.raises(TraceFormatError):
+        validate_trace({"nope": []})
+    with pytest.raises(TraceFormatError):
+        validate_trace({"traceEvents": [{"name": "a", "ph": "Z", "ts": 0}]})
+    with pytest.raises(TraceFormatError):    # X without dur
+        validate_trace({"traceEvents": [{"name": "a", "ph": "X", "ts": 1}]})
+    with pytest.raises(TraceFormatError):    # instant with dur
+        validate_trace(
+            {"traceEvents": [{"name": "a", "ph": "i", "ts": 1, "dur": 2}]})
+    with pytest.raises(TraceFormatError):    # non-numeric counter value
+        validate_trace(
+            {"traceEvents": [{"name": "a", "ph": "C", "ts": 1,
+                              "args": {"value": "high"}}]})
+    assert validate_trace('{"traceEvents": []}') == 0
+
+
+# ---------------------------------------------------------------------------
+# pause / resume / reset
+# ---------------------------------------------------------------------------
+
+def test_is_running_and_reset_lifecycle():
+    assert not profiler.is_running()
+    profiler.start()
+    assert profiler.is_running()
+    profiler.pause()
+    assert not profiler.is_running()
+    nd.tanh(nd.ones((4,))).asnumpy()      # suppressed: events AND compile
+    profiler.resume()
+    assert profiler.is_running()
+    nd.sigmoid(nd.ones((4,))).asnumpy()
+    profiler.stop()
+    assert not profiler.is_running()
+    j = json.loads(profiler.dumps(format="json"))
+    assert "sigmoid" in j["stats"] and "tanh" not in j["stats"]
+    assert any(k.startswith("op:sigmoid") for k in j["compile"])
+    assert not any(k.startswith("op:tanh") for k in j["compile"])
+    # reset clears events, counters, and the compile table
+    profiler.Counter(name="c").set_value(1)
+    profiler.dumps(reset=True)
+    j = json.loads(profiler.dumps(format="json"))
+    assert j["stats"] == {} and j["counters"] == {} and j["compile"] == {}
+
+
+# ---------------------------------------------------------------------------
+# compile tracker through a real fused-Adam training loop
+# ---------------------------------------------------------------------------
+
+PSHAPE = (4, 3)
+
+
+def _make_trainer(n=6, shape=PSHAPE, seed=0):
+    rng = np.random.RandomState(seed)
+    params = gluon.ParameterDict()
+    for j in range(n):
+        p = params.get(f"w{j:03d}", shape=shape, init="zeros")
+        p.initialize()
+        p.set_data(nd.array(rng.randn(*shape).astype(np.float32)))
+    tr = gluon.Trainer(params, "adam", {"learning_rate": 0.01},
+                       kvstore="tpu")
+    return tr, [params[k] for k in sorted(params.keys())]
+
+
+def _step(tr, plist, x):
+    with autograd.record():
+        loss = plist[0].data().reshape(-1)[0] * 0
+        for p in plist:
+            loss = loss + (p.data() * x).sum()
+    loss.backward()
+    tr.step(1)
+
+
+def test_compile_table_hits_and_recompiles_per_step():
+    x = nd.array(np.random.RandomState(3).randn(*PSHAPE).astype(np.float32))
+    tr, plist = _make_trainer()
+    profiler.start()
+    try:
+        for _ in range(3):
+            _step(tr, plist, x)
+    finally:
+        profiler.stop()
+    comp = profiler.compile_stats()
+    fused = {k: v for k, v in comp.items() if k.startswith("fused:adam")}
+    assert fused, f"no fused-adam cache keys tracked: {sorted(comp)}"
+    # step 1 compiles, steps 2-3 reuse: the cache-hit columns are non-zero
+    assert sum(v["hits"] for v in fused.values()) >= 2
+    assert sum(v["misses"] for v in fused.values()) >= 1
+    assert "fused:adam" in profiler.dumps()
+    assert "Compile cache" in profiler.dumps()
+    # steady state: the last step recompiled nothing
+    assert tr._last_step_recompiles == 0
+    # a deliberate shape change forces XLA retraces and is charged to the
+    # step that caused it
+    tr2, plist2 = _make_trainer(n=6, shape=(5, 2), seed=1)
+    x2 = nd.array(np.random.RandomState(4).randn(5, 2).astype(np.float32))
+    _step(tr2, plist2, x2)
+    assert tr2._last_step_recompiles > 0
+    # the window is a *global* miss delta between a trainer's consecutive
+    # steps, so tr's first step after tr2's compiles absorbs them; the
+    # next one shows the original trainer still runs hot
+    _step(tr, plist, x)
+    _step(tr, plist, x)
+    assert tr._last_step_recompiles == 0
+
+
+def test_compile_warn_threshold(caplog):
+    import logging
+    old = os.environ.get("MXNET_COMPILE_WARN_THRESHOLD")
+    os.environ["MXNET_COMPILE_WARN_THRESHOLD"] = "3"
+    try:
+        with caplog.at_level(logging.WARNING):
+            for i in range(5):
+                profiler.compile_event("test:hotkey", cache_hit=False,
+                                       compile_ms=1.0)
+        assert any("test:hotkey" in r.message for r in caplog.records)
+        assert sum("test:hotkey" in r.message
+                   for r in caplog.records) == 1   # warn once per key
+    finally:
+        if old is None:
+            del os.environ["MXNET_COMPILE_WARN_THRESHOLD"]
+        else:
+            os.environ["MXNET_COMPILE_WARN_THRESHOLD"] = old
+
+
+def test_track_jit_detects_shape_retrace():
+    import jax
+
+    calls = []
+    fn = profiler.track_jit("test:square", jax.jit(lambda a: a * a))
+    fn(np.ones((4,), np.float32))           # compile
+    fn(np.ones((4,), np.float32))           # hit
+    fn(np.ones((8,), np.float32))           # retrace: new shape
+    calls = profiler.compile_stats()["test:square"]
+    assert calls["misses"] == 2
+    assert calls["hits"] == 1
+    assert calls["compile_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# memory profiler
+# ---------------------------------------------------------------------------
+
+def test_memory_accounting_live_peak_and_counter_track(tmp_path):
+    out = tmp_path / "mem.json"
+    profiler.set_config(filename=str(out), profile_memory=True)
+    profiler.start()
+    try:
+        arrays = [nd.array(np.zeros((256, 1024), np.float32))  # 1 MiB each
+                  for _ in range(4)]
+        expect = sum(4 * 256 * 1024 for _ in arrays)
+        with profiler.Scope("bigalloc:"):
+            arrays.append(nd.array(np.zeros((256, 1024), np.float32)))
+            expect += 4 * 256 * 1024
+    finally:
+        profiler.stop()
+    stats = profiler.memory_stats()
+    peak = sum(stats["peak_bytes"].values())
+    live = sum(stats["live_bytes"].values())
+    # within 10% of test-side accounting (the window allocates nothing
+    # else of consequence on CPU)
+    assert expect <= peak <= expect * 1.1
+    assert expect <= live <= expect * 1.1
+    assert stats["alloc_events"] >= 5
+    j = json.loads(profiler.dumps(format="json"))
+    assert sum(j["memory"]["peak_bytes"].values()) == peak
+    assert "Memory (device)" in profiler.dumps()
+    # the chrome trace carries per-device live-bytes counter tracks and
+    # scope-tagged allocation instants
+    profiler.dump()
+    validate_trace(str(out))
+    with open(out) as f:
+        trace = json.load(f)["traceEvents"]
+    assert any(e["ph"] == "C" and e["name"].startswith("memory:live_bytes:")
+               for e in trace)
+    assert any(e["name"] == "alloc:bigalloc:" for e in trace)
+    # frees bring live back down but never touch the peak
+    del arrays
+    gc.collect()
+    stats = profiler.memory_stats()
+    assert sum(stats["live_bytes"].values()) < peak * 0.5
+    assert sum(stats["peak_bytes"].values()) == peak
+
+
+def test_memory_hook_uninstalled_after_stop():
+    from incubator_mxnet_tpu.ndarray import ndarray as ndmod
+    profiler.set_config(profile_memory=True)
+    profiler.start()
+    assert ndmod.MEMORY_HOOK is not None
+    assert profiler.memory_enabled()
+    profiler.stop()
+    assert ndmod.MEMORY_HOOK is None
+    assert not profiler.memory_enabled()
+    before = profiler.memory_stats()["alloc_events"]
+    nd.ones((16, 16)).asnumpy()
+    assert profiler.memory_stats()["alloc_events"] == before
+
+
+# ---------------------------------------------------------------------------
+# continuous dump
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_continuous_dump_writes_rolling_traces(tmp_path):
+    out = tmp_path / "rolling.json"
+    profiler.set_config(filename=str(out), continuous_dump=True,
+                        dump_period=0.2)
+    profiler.start()
+    try:
+        nd.ones((8, 8)).asnumpy()
+        deadline = time.time() + 5
+        while not out.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert out.exists(), "dump thread never wrote the rolling trace"
+        validate_trace(str(out))
+    finally:
+        profiler.stop()
+    # the buffers survived the rolling (finished=False) dumps
+    assert "_ones" in profiler.dumps()
+
+
+# ---------------------------------------------------------------------------
+# /metrics scrape surface
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [-+]?[0-9.eE+naif]+$")
+
+
+def _assert_prometheus_text(text):
+    assert text.endswith("\n")
+    seen_types = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            seen_types.add(line.split()[3])
+            continue
+        if line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+    assert seen_types >= {"gauge"}
+
+
+def test_render_prometheus_exposition_format():
+    profiler.Counter(name='odd"name\\x').set_value(2)
+    profiler.compile_event("op:test", cache_hit=True)
+    text = profiler.render_prometheus()
+    _assert_prometheus_text(text)
+    assert "mxnet_profiler_running 0" in text
+    assert 'mxnet_compile_cache_hits_total{key="op:test"} 1' in text
+    # label escaping keeps quotes/backslashes inside the label legal
+    assert 'name="odd\\"name\\\\x"' in text
+
+
+def test_metrics_endpoint_serves_serving_and_trainer_counters(tmp_path):
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.serve import ModelServer, Predictor
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net(nd.array(np.zeros((1, 6), np.float32)))
+    path = os.path.join(str(tmp_path), "model")
+    net.export(path)
+    predictor = Predictor.from_artifact(path, bucket_sizes=(2, 4, 8))
+
+    profiler.start()
+    try:
+        tr, plist = _make_trainer(n=3)
+        _step(tr, plist, nd.ones(PSHAPE))
+        with ModelServer(predictor, max_latency_ms=2.0,
+                         max_queue=32) as srv:
+            host, port = srv.address
+            url = f"http://{host}:{port}"
+            x = np.random.rand(6).astype(np.float32).tolist()
+            req = urllib.request.Request(
+                url + "/predict",
+                data=json.dumps({"inputs": {"data": x}}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+            with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+                assert r.status == 200
+                ctype = r.headers.get("Content-Type", "")
+                text = r.read().decode()
+    finally:
+        profiler.stop()
+    assert ctype.startswith("text/plain")
+    assert "version=0.0.4" in ctype
+    _assert_prometheus_text(text)
+    assert "mxnet_profiler_running 1" in text
+    assert 'mxnet_profiler_counter{name="serve:requests_total"}' in text
+    assert 'name="trainer_dispatches_per_step"' in text
+    assert 'name="recompiles_per_step"' in text
+    assert "mxnet_compile_cache_misses_total" in text
+    assert 'key="serve:exec[' in text

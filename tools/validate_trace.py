@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Schema checker for profiler chrome-trace dumps.
+
+chrome://tracing and Perfetto fail *silently* on malformed traces (events
+just vanish from the timeline), so "the file loads" is not a test. This
+validates the subset of the Trace Event Format the profiler emits —
+https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU
+— and is what tests/test_profiler.py asserts against.
+
+Checked invariants:
+  * top level is {"traceEvents": [...]} (dict events)
+  * every event has string "name"/"ph" and numeric "ts" >= 0
+  * "ph" is one of the phases the profiler emits: X, i, C, M
+  * X (complete) events carry a numeric "dur" >= 0
+  * i (instant) events carry no "dur"; an "s" flag must be p/t/g
+  * C (counter) events carry numeric args values (the counter track)
+  * "pid"/"tid", when present, are int or string
+
+Usable as a library (`validate_trace(path_or_dict)` returns the event
+count, raises TraceFormatError) or a CLI (`python tools/validate_trace.py
+trace.json ...` exits non-zero on the first invalid file).
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+__all__ = ["TraceFormatError", "validate_trace"]
+
+_PHASES = {"X", "i", "C", "M"}
+_INSTANT_SCOPES = {"p", "t", "g"}
+
+
+class TraceFormatError(ValueError):
+    """A trace event violates the chrome Trace Event Format subset."""
+
+
+def _fail(i, ev, why):
+    raise TraceFormatError(f"event[{i}] {why}: {json.dumps(ev)[:200]}")
+
+
+def _check_event(i, ev):
+    if not isinstance(ev, dict):
+        raise TraceFormatError(f"event[{i}] is not an object: {ev!r}")
+    name = ev.get("name")
+    if not isinstance(name, str) or not name:
+        _fail(i, ev, "missing/empty name")
+    ph = ev.get("ph")
+    if ph not in _PHASES:
+        _fail(i, ev, f"bad phase {ph!r} (allowed: {sorted(_PHASES)})")
+    ts = ev.get("ts")
+    if not isinstance(ts, (int, float)) or isinstance(ts, bool) or ts < 0:
+        _fail(i, ev, f"bad ts {ts!r}")
+    for key in ("pid", "tid"):
+        if key in ev and not isinstance(ev[key], (int, str)):
+            _fail(i, ev, f"bad {key} {ev[key]!r}")
+    if ph == "X":
+        dur = ev.get("dur")
+        if not isinstance(dur, (int, float)) or isinstance(dur, bool) \
+                or dur < 0:
+            _fail(i, ev, f"X event needs numeric dur, got {dur!r}")
+    elif ph == "i":
+        if "dur" in ev:
+            _fail(i, ev, "instant event must not carry dur")
+        if "s" in ev and ev["s"] not in _INSTANT_SCOPES:
+            _fail(i, ev, f"bad instant scope {ev['s']!r}")
+    elif ph == "C":
+        args = ev.get("args")
+        if not isinstance(args, dict) or not args:
+            _fail(i, ev, "counter event needs non-empty args")
+        for k, v in args.items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                _fail(i, ev, f"counter args[{k!r}] not numeric: {v!r}")
+
+
+def validate_trace(trace):
+    """Validate a chrome trace; `trace` is a file path, a JSON string, or
+    an already-parsed dict. Returns the number of events checked."""
+    if isinstance(trace, str):
+        if trace.lstrip().startswith(("{", "[")):
+            trace = json.loads(trace)
+        else:
+            with open(trace) as f:
+                trace = json.load(f)
+    if isinstance(trace, list):      # bare event-array form is also legal
+        events = trace
+    elif isinstance(trace, dict):
+        events = trace.get("traceEvents")
+        if not isinstance(events, list):
+            raise TraceFormatError("top level has no traceEvents list")
+    else:
+        raise TraceFormatError(f"trace is not an object: {type(trace)}")
+    for i, ev in enumerate(events):
+        _check_event(i, ev)
+    return len(events)
+
+
+def main(argv):
+    if not argv:
+        print("usage: validate_trace.py TRACE.json [...]", file=sys.stderr)
+        return 2
+    for path in argv:
+        try:
+            n = validate_trace(path)
+        except (TraceFormatError, OSError, json.JSONDecodeError) as e:
+            print(f"{path}: INVALID: {e}", file=sys.stderr)
+            return 1
+        print(f"{path}: ok ({n} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
