@@ -72,6 +72,11 @@ _state = {
 _events = []
 _counters = []       # (name, ts_us, value) sample series
 _counter_last = {}   # name -> latest value (the Prometheus gauge registry)
+# rolling (continuous_dump) trims fold into these so dumps() still
+# aggregates the whole run while each trace segment stays bounded
+_agg_events = {}     # name -> [count, total_us, min_us, max_us]
+_agg_counts = {}     # counter name -> folded sample count
+_dump_seq = 0        # rolling trace segment number (never reused)
 
 
 def set_config(filename="profile.json", profile_all=False,
@@ -366,9 +371,13 @@ def track_jit(key, fn):
 # enabled by the same `profile_memory` config flag the reference uses)
 # ---------------------------------------------------------------------------
 
-# RLock: registering a buffer can allocate (dict resize) and thereby run a
-# pending finalizer (_note_free) on this same thread mid-critical-section
-_mlock = threading.RLock()
+# The weakref finalizer (_note_free) takes NO locks: GC can run it on a
+# thread that is mid-critical-section under _mlock or _lock (allocations
+# inside those sections can trigger a collection), so any acquisition
+# there would self-deadlock. It only appends to _pending_frees (atomic
+# under the GIL); the books are settled at the next drain point
+# (_note_alloc / memory_stats / render_prometheus).
+_mlock = threading.Lock()
 _mem = {
     "enabled": False,
     "live": defaultdict(int),     # device label -> live bytes
@@ -377,6 +386,7 @@ _mem = {
     "allocs": 0,                  # cumulative allocation events
     "frees": 0,
 }
+_pending_frees = []               # buffer keys enqueued by finalizers
 
 _scope_tls = threading.local()
 
@@ -401,16 +411,35 @@ def _device_of(buf):
 
 
 def _note_free(key):
-    with _mlock:
+    # weakref.finalize callback — must stay lock-free (see _mlock comment)
+    _pending_frees.append(key)
+
+
+def _drain_frees_locked():
+    """Settle queued finalizer frees into the books. Caller holds _mlock.
+    Returns {device: live_bytes_after} for devices that changed."""
+    changed = {}
+    while _pending_frees:
+        try:
+            key = _pending_frees.pop()
+        except IndexError:      # lost a race to a concurrent drain
+            break
         rec = _mem["buffers"].pop(key, None)
         if rec is None:
-            return
+            continue
         nbytes, dev = rec
         _mem["live"][dev] -= nbytes
         _mem["frees"] += 1
-        live = _mem["live"][dev]
-    if is_running():
-        _counter_sample(f"memory:live_bytes:{dev}", live)
+        changed[dev] = _mem["live"][dev]
+    return changed
+
+
+def _drain_frees():
+    with _mlock:
+        changed = _drain_frees_locked()
+    if changed and is_running():
+        for dev, live in changed.items():
+            _counter_sample(f"memory:live_bytes:{dev}", live)
 
 
 def _note_alloc(buf, tag=None):
@@ -426,6 +455,9 @@ def _note_alloc(buf, tag=None):
     except Exception:       # noqa: BLE001 — tracers, abstract values
         return
     key = id(buf)
+    # settle queued frees first: a dead buffer's id() can be recycled by
+    # this very allocation, and its stale entry would mask the new one
+    _drain_frees()
     with _mlock:
         if key in _mem["buffers"]:
             return
@@ -467,6 +499,7 @@ def memory_stats():
     """Pure-python accounting snapshot: per-device live/peak bytes plus
     whatever the backend itself reports (jax.live_arrays byte total,
     device memory_stats) when available."""
+    _drain_frees()
     with _mlock:
         snap = {
             "live_bytes": dict(_mem["live"]),
@@ -502,6 +535,7 @@ def _reset_memory_locked():
     the event counts restart; live accounting keeps tracking the buffers
     that are still alive (dropping them would corrupt the books)."""
     with _mlock:
+        _drain_frees_locked()
         for dev, live in _mem["live"].items():
             _mem["peak"][dev] = live
         _mem["allocs"] = 0
@@ -512,16 +546,53 @@ def _reset_memory_locked():
 # dump / dumps
 # ---------------------------------------------------------------------------
 
+def _fold_aggregates_locked(events, counters):
+    """Fold trimmed buffers into the persistent aggregates (caller holds
+    _lock) so dumps() keeps whole-run stats after rolling dumps discard
+    the raw events."""
+    for ev in events:
+        a = _agg_events.get(ev["name"])
+        if a is None:
+            _agg_events[ev["name"]] = [1, ev["dur"], ev["dur"], ev["dur"]]
+        else:
+            a[0] += 1
+            a[1] += ev["dur"]
+            a[2] = min(a[2], ev["dur"])
+            a[3] = max(a[3], ev["dur"])
+    for name, _ts, _value in counters:
+        _agg_counts[name] = _agg_counts.get(name, 0) + 1
+
+
+def _segment_path(seq):
+    root, ext = os.path.splitext(_state["filename"])
+    return f"{root}.{seq:04d}{ext or '.json'}"
+
+
 def dump(finished=True, profile_process="worker"):
     """Write chrome://tracing JSON (reference MXDumpProfile;
     profiler.h:79 'chrome tracing json'). `finished=False` (the continuous
-    dump path) keeps the buffers for the next rolling snapshot."""
+    dump path) writes a bounded *segment* file (`<name>.NNNN.json`) holding
+    only the events since the previous rolling dump and clears the buffers
+    — a long run produces a sequence of small traces instead of one
+    ever-growing file re-serialized every period. Trimmed events are folded
+    into the aggregate registry so dumps() still covers the whole run."""
+    global _dump_seq
     with _lock:
         events = list(_events)
         counters = list(_counters)
         if finished:
             _events.clear()
             _counters.clear()
+            _agg_events.clear()
+            _agg_counts.clear()
+        else:
+            if not events and not counters:
+                return None     # quiet period: no empty segment spam
+            _events.clear()
+            _counters.clear()
+            _fold_aggregates_locked(events, counters)
+            seq, _dump_seq = _dump_seq, _dump_seq + 1
+    path = _state["filename"] if finished else _segment_path(seq)
     trace = []
     for ev in events:
         e = {"name": ev["name"], "cat": ev["cat"], "ph": ev["ph"],
@@ -536,9 +607,9 @@ def dump(finished=True, profile_process="worker"):
     for name, ts, value in counters:
         trace.append({"name": name, "ph": "C", "ts": ts, "pid": 0,
                       "args": {"value": _finite(value, 0)}})
-    with open(_state["filename"], "w") as f:
+    with open(path, "w") as f:
         json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
-    return _state["filename"]
+    return path
 
 
 def _finite(v, default=None):
@@ -565,17 +636,27 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     with _lock:
         events = list(_events)
         counters = list(_counters)
+        folded = {k: list(v) for k, v in _agg_events.items()}
+        folded_counts = dict(_agg_counts)
+        last = dict(_counter_last)
         if reset:
             _events.clear()
             _counters.clear()
+            _agg_events.clear()
+            _agg_counts.clear()
     agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+    for name, (cnt, tot, mn, mx) in folded.items():
+        agg[name] = [cnt, tot, mn, mx]
     for ev in events:
         a = agg[ev["name"]]
         a[0] += 1
         a[1] += ev["dur"]
         a[2] = min(a[2], ev["dur"])
         a[3] = max(a[3], ev["dur"])
-    cagg = {}
+    # counter series trimmed by rolling dumps contribute their sample
+    # count; the latest value comes from the gauge registry
+    cagg = {name: (cnt, last.get(name, 0))
+            for name, cnt in folded_counts.items()}
     for name, ts, value in counters:
         cnt = cagg[name][0] + 1 if name in cagg else 1
         cagg[name] = (cnt, value)
@@ -677,6 +758,8 @@ def render_prometheus():
     family("mxnet_profiler_buffered_events", "gauge",
            "trace events buffered since the last dump")
     lines.append(f"mxnet_profiler_buffered_events {n_events}")
+    family("mxnet_profiler_buffered_counter_samples", "gauge",
+           "counter samples buffered since the last dump")
     lines.append(f"mxnet_profiler_buffered_counter_samples {n_samples}")
 
     if last:
@@ -712,6 +795,7 @@ def render_prometheus():
                 f'{{key="{_prom_label(name)}"}} '
                 f'{comp[name]["compile_ms"]:.3f}')
 
+    _drain_frees()
     with _mlock:
         live = dict(_mem["live"])
         peak = dict(_mem["peak"])

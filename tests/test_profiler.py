@@ -335,6 +335,44 @@ def test_memory_accounting_live_peak_and_counter_track(tmp_path):
     assert sum(stats["peak_bytes"].values()) == peak
 
 
+def test_free_finalizer_is_lock_free():
+    """GC can fire the buffer finalizer on a thread already inside a
+    profiler critical section (allocations under _lock/_mlock can trigger
+    a collection), so _note_free must acquire neither lock — it enqueues
+    and the books settle at the next drain point."""
+    with profiler._lock, profiler._mlock:
+        profiler._note_free(0xDEAD)      # deadlocks here if it takes a lock
+    assert 0xDEAD in profiler._pending_frees
+    profiler._drain_frees()              # unknown key: drained as a no-op
+    assert not profiler._pending_frees
+
+
+def test_freed_buffer_id_reuse_does_not_mask_new_alloc():
+    profiler.set_config(profile_memory=True)
+    profiler.start()
+    try:
+        a = nd.array(np.zeros((64, 64), np.float32))
+        buf = a._data
+        key = id(buf)
+        with profiler._mlock:
+            assert key in profiler._mem["buffers"]
+        before = profiler.memory_stats()
+        # simulate: GC fired the finalizer, nothing drained yet, and a new
+        # buffer recycled the same id(). _note_alloc must settle the queue
+        # first — a stale entry would otherwise swallow the registration
+        profiler._note_free(key)
+        profiler._note_alloc(buf)
+        with profiler._mlock:
+            assert key in profiler._mem["buffers"]
+        after = profiler.memory_stats()
+        assert after["free_events"] == before["free_events"] + 1
+        assert after["alloc_events"] == before["alloc_events"] + 1
+        # net live bytes unchanged: one free settled, one alloc re-added
+        assert after["live_bytes"] == before["live_bytes"]
+    finally:
+        profiler.stop()
+
+
 def test_memory_hook_uninstalled_after_stop():
     from incubator_mxnet_tpu.ndarray import ndarray as ndmod
     profiler.set_config(profile_memory=True)
@@ -361,15 +399,41 @@ def test_continuous_dump_writes_rolling_traces(tmp_path):
     profiler.start()
     try:
         nd.ones((8, 8)).asnumpy()
+        # rolling dumps write bounded segment files (rolling.NNNN.json),
+        # not the final filename — that stays reserved for dump()
         deadline = time.time() + 5
-        while not out.exists() and time.time() < deadline:
+        segments = []
+        while not segments and time.time() < deadline:
             time.sleep(0.05)
-        assert out.exists(), "dump thread never wrote the rolling trace"
-        validate_trace(str(out))
+            segments = sorted(tmp_path.glob("rolling.*.json"))
+        assert segments, "dump thread never wrote a rolling trace segment"
+        for seg in segments:
+            validate_trace(str(seg))
     finally:
         profiler.stop()
-    # the buffers survived the rolling (finished=False) dumps
+    # the trimmed events were folded into the aggregate registry, so the
+    # whole-run stats survive even though the raw buffers were cleared
     assert "_ones" in profiler.dumps()
+    with profiler._lock:
+        assert not any(e["name"].endswith("_ones") for e in profiler._events)
+
+
+def test_rolling_dump_trims_buffers_and_skips_quiet_periods(tmp_path):
+    out = tmp_path / "seg.json"
+    profiler.set_config(filename=str(out))
+    profiler.start()
+    try:
+        nd.ones((4, 4)).asnumpy()
+        path = profiler.dump(finished=False)
+        assert path is not None and ".json" in path and path != str(out)
+        validate_trace(path)
+        # buffers were cleared: an immediate second rolling dump is a no-op
+        assert profiler.dump(finished=False) is None
+    finally:
+        profiler.stop()
+    assert "_ones" in profiler.dumps()
+    profiler.dumps(reset=True)
+    assert "_ones" not in profiler.dumps()
 
 
 # ---------------------------------------------------------------------------
@@ -382,17 +446,19 @@ _PROM_LINE = re.compile(
 
 def _assert_prometheus_text(text):
     assert text.endswith("\n")
-    seen_types = set()
+    declared = set()
     for line in text.splitlines():
         if not line:
             continue
         if line.startswith("# TYPE"):
-            seen_types.add(line.split()[3])
+            declared.add(line.split()[2])
             continue
         if line.startswith("#"):
             continue
         assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
-    assert seen_types >= {"gauge"}
+        # no stray samples: every metric belongs to a declared family
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        assert name in declared, f"sample without HELP/TYPE family: {name}"
 
 
 def test_render_prometheus_exposition_format():
