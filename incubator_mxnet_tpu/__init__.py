@@ -41,13 +41,13 @@ def _configure_jax():
         except Exception:
             pass
     from .runtime import set_fp32_matmul_mode
-    set_fp32_matmul_mode(os.environ.get("MXTPU_FP32_MATMUL", "strict"))
+    from .util import getenv_str
+    set_fp32_matmul_mode(getenv_str("MXTPU_FP32_MATMUL"))
     # Persistent XLA compilation cache: eager mode compiles one executable per
     # (op, shape) like the reference's cudnn autotune cache persists algo
     # choices (src/operator/nn/cudnn/cudnn_algoreg*) — ours persists whole
     # binaries across processes.
-    cache_dir = os.environ.get("MXTPU_COMPILE_CACHE",
-                               os.path.expanduser("~/.cache/mxtpu_xla"))
+    cache_dir = os.path.expanduser(getenv_str("MXTPU_COMPILE_CACHE"))
     if cache_dir and cache_dir != "0":
         try:
             os.makedirs(cache_dir, exist_ok=True)

@@ -450,6 +450,7 @@ class HybridBlock(Block):
                 for p, o in zip(param_list, old):
                     p._data._data = o
             flat, rebuild = _flatten_outputs(out)
+            # mxlint: disable=TS03(rebuild is the host-side output pytree structure captured at trace time, never a tracer)
             out_struct["rebuild"] = rebuild
             return tuple(o._data for o in flat), dict(sw.writes)
 

@@ -18,6 +18,8 @@ import logging
 import os
 import zipfile
 
+from ...util import getenv_str
+
 from ...base import MXNetError
 from ..utils import check_sha1, download
 
@@ -61,10 +63,6 @@ _SHA1 = {
     "vgg19_bn": "f360b758e856f1074a85abd5fd873ed1d98297c3",
 }
 
-_DEFAULT_REPO = ("https://apache-mxnet.s3-accelerate.dualstack."
-                 "amazonaws.com/")
-
-
 def register_model(name, sha1):
     """Extension hook: register an artifact checksum (e.g. for a private
     mirror of weights this build trained itself)."""
@@ -78,9 +76,7 @@ def short_hash(name):
 
 
 def _default_root():
-    return os.path.join(os.environ.get("MXNET_HOME",
-                                       os.path.join("~", ".mxnet")),
-                        "models")
+    return os.path.join(getenv_str("MXNET_HOME"), "models")
 
 
 def get_model_file(name, root=None):
@@ -100,7 +96,7 @@ def get_model_file(name, root=None):
         logging.warning("Mismatch in the content of model file detected. "
                         "Downloading again.")
     os.makedirs(root, exist_ok=True)
-    repo = os.environ.get("MXNET_GLUON_REPO", _DEFAULT_REPO).rstrip("/")
+    repo = getenv_str("MXNET_GLUON_REPO").rstrip("/")
     zip_path = os.path.join(root, file_name + ".zip")
     download(f"{repo}/gluon/models/{file_name}.zip", path=zip_path,
              overwrite=True)

@@ -11,8 +11,6 @@ XLA inserted the collectives inside the compiled step.
 """
 from __future__ import annotations
 
-import os
-
 from .. import optimizer as opt
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
@@ -27,10 +25,11 @@ def _aggregation_size():
     otherwise MXNET_OPTIMIZER_AGGREGATION_SIZE (reference default 4).
     <= 1 disables aggregation — the per-param oracle path."""
     from .. import engine
+    from ..util import getenv_int
     n = engine.bulk_size()
     if n > 0:
         return n
-    return int(os.environ.get("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4))
+    return getenv_int("MXNET_OPTIMIZER_AGGREGATION_SIZE")
 
 
 class Trainer:

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import pickle
+import threading
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
@@ -189,12 +190,25 @@ class KVStore:
     which are reduced on push — the multi-device gradient case).
     """
 
+    # shared sequence counters (store generation, barrier tag, heartbeat)
+    # live on the class; every bump goes through _next_seq so concurrent
+    # store creation / barriers from io worker threads cannot tear them
+    _class_lock = threading.Lock()
     _async_gen_counter = 0
+
+    @classmethod
+    def _next_seq(cls, name):
+        """Atomically bump the named class counter, returning the new
+        value (KVStore-rooted so subclasses share one sequence space)."""
+        with KVStore._class_lock:
+            value = getattr(KVStore, name) + 1
+            setattr(KVStore, name, value)
+            return value
 
     def __init__(self, kv_type="local", mesh=None):
         import jax
 
-        import os as _os
+        from .util import getenv_int
         self._type = kv_type
         self._store = {}           # key -> NDArray (the authoritative copy)
         self._updater = None
@@ -204,8 +218,7 @@ class KVStore:
         self._mesh = mesh
         # arrays at/above this element count take the ownership-sharded
         # wire (reference env var + default, src/kvstore/kvstore_dist.h:58)
-        self._bigarray_bound = int(_os.environ.get(
-            "MXNET_KVSTORE_BIGARRAY_BOUND", 1000 * 1000))
+        self._bigarray_bound = getenv_int("MXNET_KVSTORE_BIGARRAY_BOUND")
         self._wire_stats = {"whole": 0, "sharded": 0, "packed": 0}
         # cumulative reduction-round observability (Trainer snapshots
         # per-step deltas into the kvstore_collectives_per_step /
@@ -215,8 +228,7 @@ class KVStore:
         # flat-pack bucket byte cap for pushpull_list (a few dozen MB keeps
         # per-bucket latency bounded, same spirit as the reference's
         # bigarray server striping)
-        self._flatpack_bound = int(_os.environ.get(
-            "MXNET_KVSTORE_FLATPACK_BOUND", 32 << 20))
+        self._flatpack_bound = getenv_int("MXNET_KVSTORE_FLATPACK_BOUND")
         self._async_client = None
         self._async_gen = None
         if kv_type == "dist_async" and jax.process_count() > 1:
@@ -228,8 +240,7 @@ class KVStore:
             # stores). It namespaces this store's keys/optimizer on the
             # shared rank-0 server, so a second training run in the same
             # cluster cannot inherit the first's weights.
-            self._async_gen = KVStore._async_gen_counter
-            KVStore._async_gen_counter += 1
+            self._async_gen = KVStore._next_seq("_async_gen_counter") - 1
             # true async mode: host-side parameter server on rank 0, addr
             # exchanged through the coordination service (the reference's
             # scheduler role in ps-lite's rendezvous)
@@ -622,9 +633,8 @@ class KVStore:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             self._heartbeat()
-            KVStore._barrier_seq += 1
-            multihost_utils.sync_global_devices(
-                f"kvstore_barrier_{KVStore._barrier_seq}")
+            seq = KVStore._next_seq("_barrier_seq")
+            multihost_utils.sync_global_devices(f"kvstore_barrier_{seq}")
         else:
             for v in self._store.values():
                 v._data.block_until_ready()
@@ -659,9 +669,8 @@ class KVStore:
         c = self._dist_client()
         if c is None:
             return
-        KVStore._hb_seq += 1
         key = f"mxtpu_hb/{self.rank}"
-        val = str(KVStore._hb_seq)
+        val = str(KVStore._next_seq("_hb_seq"))
         try:
             c.key_value_set(key, val, allow_overwrite=True)
         except TypeError:

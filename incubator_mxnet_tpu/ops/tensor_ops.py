@@ -259,7 +259,7 @@ def norm(data, *, ord=2, axis=None, keepdims=False):
 
 @register(nondiff=True)
 def argmax(data, *, axis=None, keepdims=False):
-    if _argext_needs_split(data, axis):
+    if _argext_needs_split(data.shape, axis):
         return _flat_argext(data, jnp.argmax, jnp.max, keepdims, axis)
     out = jnp.argmax(data, axis=axis, keepdims=keepdims)
     return out.astype(jnp.float32)
@@ -267,18 +267,23 @@ def argmax(data, *, axis=None, keepdims=False):
 
 @register(nondiff=True)
 def argmin(data, *, axis=None, keepdims=False):
-    if _argext_needs_split(data, axis):
+    if _argext_needs_split(data.shape, axis):
         return _flat_argext(data, jnp.argmin, jnp.min, keepdims, axis)
     return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
 
 
-def _argext_needs_split(data, axis):
+def _argext_needs_split(shape, axis):
     """jnp.arg{max,min} positions are int32 under default jax config —
     a reduction spanning >=2^31 elements silently wraps negative
-    (reference large-tensor nightly class of bug)."""
+    (reference large-tensor nightly class of bug). Takes the static
+    shape tuple, not the array, so the branch on it in argmax/argmin is
+    visibly trace-safe (mxlint TS02)."""
     if axis is None:
-        return data.size >= 2**31
-    return data.shape[axis % data.ndim] >= 2**31
+        size = 1
+        for d in shape:
+            size *= d
+        return size >= 2**31
+    return shape[axis % len(shape)] >= 2**31
 
 
 def _flat_argext(data, arg_fn, ext_fn, keepdims, axis=None):

@@ -38,11 +38,12 @@ transposes at the boundary.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..util import getenv_bool, getenv_str
 
 __all__ = ["conv3x3_bwd_fused", "fused_eligible", "conv3x3_custom"]
 
@@ -252,7 +253,7 @@ def conv3x3_bwd_fused(x, w, go, bn=None):
     xt = jnp.transpose(x, (0, 2, 3, 1))
     gt = jnp.transpose(go, (0, 2, 3, 1))
     w_hwio = jnp.transpose(w, (2, 3, 1, 0))
-    if os.environ.get("MXTPU_CONV_BWD_KERNEL", "patch") == "taps":
+    if getenv_str("MXTPU_CONV_BWD_KERNEL") == "taps":
         dx, dw = _bwd_nhwc(xt, gt, w_hwio, bn)
     else:
         dx, dw = _patch_nhwc(xt, gt, w_hwio, bn)
@@ -263,7 +264,7 @@ def conv3x3_bwd_fused(x, w, go, bn=None):
 def fused_eligible(data_shape, w_shape, kernel, stride, dilate, pad,
                    num_group):
     """3x3 stride-1 pad-1 ungrouped 2D conv on TPU with even batch."""
-    if os.environ.get("MXTPU_FUSED_CONV_BWD", "0") != "1":
+    if not getenv_bool("MXTPU_FUSED_CONV_BWD"):
         # default OFF: measured slower than XLA's native conv backward at
         # every ResNet shape on v5e (docs/perf_notes.md round-4 section)
         return False

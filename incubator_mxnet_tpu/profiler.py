@@ -257,10 +257,8 @@ _compile_warned = set()
 
 
 def _warn_threshold():
-    try:
-        return int(os.environ.get("MXNET_COMPILE_WARN_THRESHOLD", "8"))
-    except ValueError:
-        return 8
+    from .util import getenv_int
+    return getenv_int("MXNET_COMPILE_WARN_THRESHOLD")
 
 
 def compile_event(key, cache_hit, compile_ms=0.0):

@@ -27,7 +27,8 @@ _MAGIC = 0xCED7230A
 
 def _native():
     """The C++ codec (native/recordio.cc), None if g++/load unavailable."""
-    if os.environ.get("MXTPU_NO_NATIVE"):
+    from .util import getenv_bool
+    if getenv_bool("MXTPU_NO_NATIVE"):
         return None
     try:
         from . import native

@@ -7,13 +7,15 @@ imports.
 """
 from __future__ import annotations
 
+import collections
 import functools
 import os
 import threading
 
 __all__ = ["makedirs", "set_np_shape", "is_np_shape", "use_np_shape",
            "np_shape", "set_np_array", "is_np_array", "np_array", "use_np",
-           "set_np", "reset_np", "getenv", "setenv", "default_array"]
+           "set_np", "reset_np", "getenv", "setenv", "default_array",
+           "ENV_VARS", "EnvSpec", "getenv_int", "getenv_bool", "getenv_str"]
 
 _tls = threading.local()
 
@@ -120,6 +122,96 @@ def getenv(name):
 
 def setenv(name, value):
     os.environ[name] = value
+
+
+# -- environment-variable registry ------------------------------------------
+#
+# Every MXNET_*/MXTPU_* knob the package reads is declared here once, with
+# its type, default, and doc, and read only through getenv_int/getenv_bool/
+# getenv_str below.  tools/mxlint enforces this (rules EV01/EV02) and
+# tools/diagnose.py prints the table with live values.  The reference
+# framework documented its env vars in docs/faq/env_var.md by hand; keeping
+# the registry in code makes the doc impossible to forget.
+
+EnvSpec = collections.namedtuple("EnvSpec", ["default", "kind", "doc"])
+
+ENV_VARS = collections.OrderedDict([
+    ("MXNET_OPTIMIZER_AGGREGATION_SIZE", EnvSpec(4, "int",
+     "Max parameters fused into one multi-tensor optimizer dispatch by "
+     "gluon.Trainer; <=1 restores per-tensor updates.")),
+    ("MXNET_KVSTORE_BIGARRAY_BOUND", EnvSpec(1000 * 1000, "int",
+     "Element count at/above which a kvstore array takes the "
+     "ownership-sharded wire (reference kvstore_dist.h bigarray bound).")),
+    ("MXNET_KVSTORE_FLATPACK_BOUND", EnvSpec(32 << 20, "int",
+     "Flat-pack bucket byte cap for kvstore.pushpull_list gradient "
+     "aggregation.")),
+    ("MXNET_COMPILE_WARN_THRESHOLD", EnvSpec(8, "int",
+     "Compiles of the same jit key after which the profiler warns about "
+     "a likely recompile loop.")),
+    ("MXNET_HOME", EnvSpec("~/.mxnet", "str",
+     "Data directory for downloaded model-zoo parameter files.")),
+    ("MXNET_GLUON_REPO", EnvSpec(
+     "https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/", "str",
+     "Base URL for gluon model-zoo downloads.")),
+    ("MXTPU_NO_NATIVE", EnvSpec(False, "bool",
+     "Disable the C accelerators for recordio/image packing and fall "
+     "back to pure python.")),
+    ("MXTPU_CONV_BWD_KERNEL", EnvSpec("patch", "str",
+     "Conv backward-data kernel choice: 'patch' (default) or 'taps'.")),
+    ("MXTPU_FUSED_CONV_BWD", EnvSpec(False, "bool",
+     "Enable the experimental fused conv backward pallas kernel.")),
+    ("MXTPU_FP32_MATMUL", EnvSpec("strict", "str",
+     "fp32 matmul precision: 'strict' (MXNet semantics, fp32 "
+     "accumulate), 'fast' (bf16_3x), or 'fastest' (plain bf16).")),
+    ("MXTPU_COMPILE_CACHE", EnvSpec("~/.cache/mxtpu_xla", "str",
+     "XLA persistent compilation-cache directory; '0' disables.")),
+    ("MXTPU_TEST_PLATFORM", EnvSpec("cpu", "str",
+     "Test-suite only: jax platform the suite pins itself to.")),
+    ("MXTPU_TEST_SEED", EnvSpec(0, "int",
+     "Test-suite only: base RNG seed for the randomized operator tests.")),
+])
+
+_FALSY = frozenset(("", "0", "false", "off", "no"))
+
+
+def _spec(name):
+    try:
+        return ENV_VARS[name]
+    except KeyError:
+        from .base import MXNetError
+        raise MXNetError(
+            f"environment variable {name!r} is not declared in "
+            f"util.ENV_VARS; add it there with a default and doc")
+
+
+def getenv_int(name):
+    """Declared-default int read of an ENV_VARS entry; an unparseable
+    value falls back to the default rather than crashing startup."""
+    spec = _spec(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return spec.default
+    try:
+        return int(raw)
+    except ValueError:
+        return spec.default
+
+
+def getenv_bool(name):
+    """Declared-default bool read; '', '0', 'false', 'off', 'no' (any
+    case) are False, everything else set is True."""
+    spec = _spec(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return spec.default
+    return raw.strip().lower() not in _FALSY
+
+
+def getenv_str(name):
+    """Declared-default string read of an ENV_VARS entry."""
+    spec = _spec(name)
+    raw = os.environ.get(name)
+    return spec.default if raw is None else raw
 
 
 def default_array(source_array, ctx=None, dtype=None):

@@ -1,0 +1,15 @@
+"""CC01 corpus: attribute guarded by a lock elsewhere, RMW'd without it."""
+import threading
+
+
+class HitCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits = 0
+
+    def record(self):
+        with self._lock:
+            self._hits += 1
+
+    def undo(self):
+        self._hits -= 1

@@ -1,0 +1,20 @@
+"""CC02 corpus: nested acquisition inverts the declared lock order, and
+an undeclared lock is taken."""
+import threading
+
+MXLINT_LOCK_ORDER = ("_event_lock", "_mem_lock")
+
+_event_lock = threading.Lock()
+_mem_lock = threading.Lock()
+_rogue_lock = threading.Lock()
+
+
+def snapshot():
+    with _mem_lock:
+        with _event_lock:
+            return 1
+
+
+def rogue():
+    with _rogue_lock:
+        return 2

@@ -1,0 +1,17 @@
+"""CC03 corpus: calling, under a lock, a function that takes that lock."""
+import threading
+
+_lock = threading.Lock()
+_events = []
+
+
+def flush():
+    with _lock:
+        drained = list(_events)
+        del _events[:]
+    return drained
+
+
+def shutdown():
+    with _lock:
+        return flush()

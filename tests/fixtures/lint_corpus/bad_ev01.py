@@ -1,0 +1,6 @@
+"""EV01 corpus: raw environment reads of package knobs."""
+import os
+
+KERNEL = os.environ.get("MXTPU_CONV_BWD_KERNEL", "patch")
+DEBUG = os.getenv("MXNET_DEBUG_FLAG")
+HOME = os.environ["MXNET_HOME"]

@@ -1,0 +1,4 @@
+"""EV02 corpus: helper read of a variable missing from util.ENV_VARS."""
+from util import getenv_int
+
+LIMIT = getenv_int("MXNET_TOTALLY_UNDECLARED_LIMIT")

@@ -1,0 +1,12 @@
+"""TS01 corpus: host side effects inside a registered (traced) op body."""
+import time
+
+import numpy as np
+from ops.registry import register
+
+
+@register()
+def noisy_scale(data, *, factor=2.0):
+    time.time()
+    noise = np.random.uniform(size=3)
+    return data * factor + noise[0]

@@ -1,0 +1,9 @@
+"""TS02 corpus: python control flow on a traced value."""
+import jax
+
+
+@jax.jit
+def clamp_positive(x):
+    if x > 0:
+        return x
+    return -x

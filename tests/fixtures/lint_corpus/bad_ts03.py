@@ -1,0 +1,11 @@
+"""TS03 corpus: traced value leaked into module state during tracing."""
+import jax
+
+_last_output = {}
+
+
+@jax.jit
+def remember(x):
+    y = x * 2
+    _last_output["y"] = y
+    return y

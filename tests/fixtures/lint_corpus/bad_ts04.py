@@ -1,0 +1,12 @@
+"""TS04 corpus: closure-captured array baked into the jit executable."""
+import jax
+import jax.numpy as jnp
+
+
+def make_projector():
+    table = jnp.ones((128, 128))
+
+    def project(x):
+        return x @ table
+
+    return jax.jit(project)

@@ -1,0 +1,19 @@
+"""CC01 corpus (clean): every read-modify-write holds the guard."""
+import threading
+
+
+class HitCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits = 0
+
+    def record(self):
+        with self._lock:
+            self._hits += 1
+
+    def undo(self):
+        with self._lock:
+            self._hits -= 1
+
+    def _bump_locked(self, n):
+        self._hits += n

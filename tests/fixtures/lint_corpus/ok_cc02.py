@@ -1,0 +1,13 @@
+"""CC02 corpus (clean): nesting follows the declared order."""
+import threading
+
+MXLINT_LOCK_ORDER = ("_event_lock", "_mem_lock")
+
+_event_lock = threading.Lock()
+_mem_lock = threading.Lock()
+
+
+def snapshot():
+    with _event_lock:
+        with _mem_lock:
+            return 1

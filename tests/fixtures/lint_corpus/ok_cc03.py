@@ -1,0 +1,21 @@
+"""CC03 corpus (clean): the caller-holds-lock contract via *_locked."""
+import threading
+
+_lock = threading.Lock()
+_events = []
+
+
+def _flush_locked():
+    drained = list(_events)
+    del _events[:]
+    return drained
+
+
+def flush():
+    with _lock:
+        return _flush_locked()
+
+
+def shutdown():
+    with _lock:
+        return _flush_locked()

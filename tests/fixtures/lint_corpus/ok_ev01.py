@@ -1,0 +1,8 @@
+"""EV01 corpus (clean): reads go through the declared helpers; non-package
+variables may stay raw."""
+import os
+
+from util import getenv_str
+
+KERNEL = getenv_str("MXTPU_CONV_BWD_KERNEL")
+PLATFORM = os.environ.get("JAX_PLATFORMS")  # not an MXNET_/MXTPU_ knob

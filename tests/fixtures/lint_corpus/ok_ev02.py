@@ -1,0 +1,5 @@
+"""EV02 corpus (clean): helper reads use declared registry names."""
+from util import getenv_int, getenv_str
+
+THRESHOLD = getenv_int("MXNET_COMPILE_WARN_THRESHOLD")
+HOME = getenv_str("MXNET_HOME")

@@ -1,0 +1,11 @@
+"""TS01 corpus (clean): side effects outside the traced body, pure op."""
+import time
+
+from ops.registry import register
+
+_LOADED_AT = time.time()  # host code: fine
+
+
+@register()
+def scale(data, *, factor=2.0):
+    return data * factor

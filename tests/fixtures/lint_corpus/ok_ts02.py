@@ -1,0 +1,12 @@
+"""TS02 corpus (clean): branches on static shape info and is-None only."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp_positive(x, bias=None):
+    if bias is not None:
+        x = x + bias
+    if x.ndim > 1 and len(x.shape) > 1:
+        x = x.reshape(-1)
+    return jnp.where(x > 0, x, -x)

@@ -1,0 +1,9 @@
+"""TS03 corpus (clean): results are returned, local state only."""
+import jax
+
+
+@jax.jit
+def remember(x):
+    acc = {}
+    acc["y"] = x * 2
+    return acc["y"]

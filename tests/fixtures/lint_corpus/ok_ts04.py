@@ -1,0 +1,13 @@
+"""TS04 corpus (clean): the array travels as an argument, not a capture."""
+import jax
+import jax.numpy as jnp
+
+
+def make_projector():
+    table = jnp.ones((128, 128))
+
+    def project(x, weights):
+        return x @ weights
+
+    jitted = jax.jit(project)
+    return lambda x: jitted(x, table)

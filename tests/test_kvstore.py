@@ -254,3 +254,35 @@ def test_async_server_roundtrip_and_auth():
         bad.close()
     finally:
         srv.stop()
+
+
+def test_class_seq_counters_thread_safe():
+    """KVStore._next_seq (store generation / barrier tag / heartbeat
+    sequence) hands out unique monotone values under thread contention.
+    Regression for the unlocked `KVStore._hb_seq += 1` class-counter RMWs
+    mxlint's CC01 flagged: the torn bump could reuse a barrier tag or
+    heartbeat generation across threads."""
+    import threading
+
+    from incubator_mxnet_tpu.kvstore import KVStore
+
+    start = KVStore._test_seq = 0
+    n_threads, per_thread = 8, 200
+    seen = [None] * n_threads
+
+    def worker(i):
+        seen[i] = [KVStore._next_seq("_test_seq")
+                   for _ in range(per_thread)]
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    all_vals = [v for chunk in seen for v in chunk]
+    assert len(set(all_vals)) == n_threads * per_thread  # no duplicates
+    assert KVStore._test_seq == start + n_threads * per_thread
+    for chunk in seen:
+        assert chunk == sorted(chunk)  # per-thread monotone
+    del KVStore._test_seq

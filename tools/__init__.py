@@ -1,0 +1,2 @@
+# tools/ is importable so `python -m tools.mxlint` and the `mxlint`
+# console script resolve; the other entries here stay plain scripts.

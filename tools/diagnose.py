@@ -54,6 +54,38 @@ def main():
     for k in sorted(os.environ):
         if k.startswith(("MXTPU_", "MXNET_", "JAX_", "XLA_", "DMLC_", "TPU_")):
             print(f"{k}={os.environ[k]}")
+
+    print("----------Declared Env Vars (util.ENV_VARS)----------")
+    try:
+        from incubator_mxnet_tpu.util import ENV_VARS
+        width = max(len(n) for n in ENV_VARS)
+        for name, spec in ENV_VARS.items():
+            live = os.environ.get(name)
+            live = "(unset)" if live is None else f"={live}"
+            print(f"{name:<{width}} {spec.kind:<4} "
+                  f"default={spec.default!r} {live}")
+            print(f"{'':<{width}}      {spec.doc}")
+    except Exception as e:
+        print("ENV_VARS table FAILED:", e)
+
+    print("----------Static Analysis (mxlint)----------")
+    try:
+        from tools.mxlint import lint_paths
+        pkg = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "incubator_mxnet_tpu")
+        res = lint_paths([pkg])
+        summary = res.as_dict()
+        print("files scanned:", summary["files_scanned"])
+        print("findings     :", len(summary["findings"]),
+              summary["counts"] if summary["counts"] else "")
+        print("suppressed   :", len(summary["suppressed"]))
+        for s in summary["suppressed"]:
+            print(f"  {s['rule']} {s['path']}:{s['line']} ({s['reason']})")
+        for f in summary["findings"][:20]:
+            print(f"  {f['rule']} {f['path']}:{f['line']} {f['message']}")
+    except Exception as e:
+        print("mxlint probe FAILED:", e)
     return 0
 
 

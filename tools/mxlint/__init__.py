@@ -1,0 +1,136 @@
+"""mxlint — static trace-safety / concurrency / env-hygiene checks for
+incubator_mxnet_tpu.
+
+Run it:
+
+    python -m tools.mxlint [paths...] [--format=text|json] [--changed]
+
+or programmatically:
+
+    from tools.mxlint import lint_paths
+    result = lint_paths(["incubator_mxnet_tpu"])
+
+Pure stdlib (``ast`` + ``os`` + ``json``); never imports the package it
+lints, so it runs in milliseconds with no jax initialization.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import RULES, Finding, ModuleInfo
+from . import rules_trace, rules_concurrency, rules_env
+
+__all__ = ["RULES", "Finding", "LintResult", "lint_paths", "lint_source"]
+
+_SKIP_DIRS = {"__pycache__", "build", "dist", ".git", ".pytest_cache"}
+
+
+class LintResult:
+    """Findings + suppressions for one lint run."""
+
+    def __init__(self):
+        self.findings = []       # active Finding objects
+        self.suppressed = []     # Finding objects silenced by a disable
+        self.errors = []         # (path, message) for unparseable files
+        self.files_scanned = 0
+
+    @property
+    def clean(self):
+        return not self.findings and not self.errors
+
+    def as_dict(self):
+        counts = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "version": 1,
+            "files_scanned": self.files_scanned,
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "reason": f.suppress_reason}
+                for f in self.suppressed],
+            "errors": [{"path": p, "message": m} for p, m in self.errors],
+            "counts": counts,
+        }
+
+
+def _iter_py_files(paths):
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in _SKIP_DIRS and not d.endswith(".egg-info"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _package_root(paths):
+    """Directory containing util.py, for registry extraction: the first
+    path that is (or contains) the incubator_mxnet_tpu package."""
+    for path in paths:
+        path = os.path.abspath(path)
+        cand = path if os.path.isdir(path) else os.path.dirname(path)
+        while cand and cand != os.path.dirname(cand):
+            if os.path.isfile(os.path.join(cand, "util.py")) and \
+                    os.path.isfile(os.path.join(cand, "__init__.py")):
+                return cand
+            nested = os.path.join(cand, "incubator_mxnet_tpu")
+            if os.path.isfile(os.path.join(nested, "util.py")):
+                return nested
+            cand = os.path.dirname(cand)
+    return None
+
+
+def lint_source(src, path="<string>", registry=None):
+    """Lint one source string; returns (findings, suppressed)."""
+    mod = ModuleInfo(path, src, relpath=path)
+    return _apply_rules(mod, registry)
+
+
+def _apply_rules(mod, registry):
+    raw = []
+    raw += rules_trace.check(mod)
+    raw += rules_concurrency.check(mod)
+    raw += rules_env.check(mod, registry=registry)
+    findings, suppressed = [], []
+    for f in raw:
+        reason = mod.suppression_for(f.rule, f.line)
+        if reason is not None:
+            f.suppress_reason = reason
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    return findings, suppressed
+
+
+def lint_paths(paths, registry=None):
+    """Lint files/directories. `registry` overrides the env-var registry
+    normally parsed out of the package's util.py."""
+    result = LintResult()
+    if registry is None:
+        root = _package_root(paths)
+        if root is not None:
+            registry = rules_env.load_registry(root)
+    for path in _iter_py_files(paths):
+        rel = os.path.relpath(path).replace("\\", "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            mod = ModuleInfo(path, src, relpath=rel)
+        except (OSError, SyntaxError) as e:
+            result.errors.append((rel, str(e)))
+            continue
+        result.files_scanned += 1
+        findings, suppressed = _apply_rules(mod, registry)
+        result.findings.extend(findings)
+        result.suppressed.extend(suppressed)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

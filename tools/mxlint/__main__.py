@@ -1,0 +1,85 @@
+"""mxlint CLI.
+
+    python -m tools.mxlint [paths...] [--format=text|json] [--changed]
+
+Exit status: 0 clean, 1 findings (or unparseable files), 2 usage/internal
+error.  ``--changed`` lints only the .py files reported by
+``git diff --name-only HEAD`` plus untracked files — the pre-commit mode.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+from . import lint_paths
+
+
+def _changed_files():
+    files = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 check=True).stdout
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise SystemExit(f"mxlint: --changed needs git: {e}")
+        files.update(line.strip() for line in out.splitlines()
+                     if line.strip())
+    return sorted(f for f in files
+                  if f.endswith(".py") and os.path.exists(f))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint",
+        description="trace-safety / concurrency / env-hygiene linter")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: "
+                         "incubator_mxnet_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs HEAD (plus untracked)")
+    args = ap.parse_args(argv)
+
+    if args.changed:
+        paths = _changed_files()
+        if not paths:
+            if args.format == "json":
+                print(json.dumps({"version": 1, "files_scanned": 0,
+                                  "findings": [], "suppressed": [],
+                                  "errors": [], "counts": {}}))
+            else:
+                print("mxlint: no changed python files")
+            return 0
+    else:
+        paths = args.paths or ["incubator_mxnet_tpu"]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            print(f"mxlint: no such path: {', '.join(missing)}",
+                  file=sys.stderr)
+            return 2
+
+    result = lint_paths(paths)
+
+    if args.format == "json":
+        print(json.dumps(result.as_dict(), indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for path, msg in result.errors:
+            print(f"{path}: parse error: {msg}")
+        n, s = len(result.findings), len(result.suppressed)
+        print(f"mxlint: {result.files_scanned} files, {n} finding"
+              f"{'' if n == 1 else 's'}, {s} suppressed")
+        if s:
+            for f in result.suppressed:
+                print(f"  suppressed {f.rule} at {f.path}:{f.line} "
+                      f"({f.suppress_reason})")
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

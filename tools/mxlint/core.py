@@ -1,0 +1,237 @@
+"""mxlint core: per-module AST model shared by every rule family.
+
+The reference framework's invariants (NNVM op purity for the dependency
+engine's var-version chains, engine-callback lock discipline) were enforced
+only by review. Our JAX port carries the same invariants in Python form;
+this package encodes them as automated passes over stdlib `ast` — the TVM
+move of turning IR invariants into passes instead of review lore.
+
+A ModuleInfo is built once per file and handed to each rule family:
+
+  * parent links (`mx_parent`) so rules can ask "what encloses this node"
+  * import alias tables (``import numpy as _np`` -> _np: numpy) so rules
+    match *modules*, not spellings
+  * a suppression map parsed from ``# mxlint: disable=RULE(reason)``
+    comments — a disable with an EMPTY reason does not suppress, so every
+    in-tree suppression documents itself
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+__all__ = ["RULES", "Finding", "ModuleInfo", "dotted", "root_name",
+           "enclosing_function", "lock_key"]
+
+# rule id -> (one-line title, fix hint)
+RULES = {
+    "TS01": (
+        "host side effect in traced code",
+        "hoist the call out of the traced function, or use the jax "
+        "equivalent (jax.random.*, jax.debug.print, jax.debug.callback)"),
+    "TS02": (
+        "python branch on a traced value",
+        "use jnp.where / lax.cond / lax.while_loop, or make the value a "
+        "static (keyword-only) parameter"),
+    "TS03": (
+        "traced value may leak into host state",
+        "return the value instead of writing it to self/globals/closures; "
+        "tracer leaks poison later calls and block jit caching"),
+    "TS04": (
+        "closure-captured array baked into a jit constant",
+        "pass the array as an argument (or bind it via a default arg); a "
+        "captured array recompiles the executable every time it changes"),
+    "CC01": (
+        "read-modify-write outside the guarding lock",
+        "take the same lock that guards this attribute elsewhere (or move "
+        "the update into a *_locked helper called under it)"),
+    "CC02": (
+        "lock acquisition violates the declared lock order",
+        "acquire locks in the order declared in tools/mxlint/lock_order.py "
+        "(or declare the new lock there)"),
+    "CC03": (
+        "function that takes this lock called while it is held",
+        "call the *_locked variant, or restructure so the lock is "
+        "released first (threading.Lock is not reentrant)"),
+    "EV01": (
+        "raw os.environ read of an MXNET_*/MXTPU_* variable",
+        "route through util.getenv_int/getenv_bool/getenv_str so the "
+        "default and doc live in util.ENV_VARS"),
+    "EV02": (
+        "environment variable not declared in util.ENV_VARS",
+        "add the variable (default + description) to util.ENV_VARS"),
+}
+
+_SUPP_ITEM = re.compile(r"([A-Z]{2}\d{2})\(([^)]*)\)")
+_SUPP_RE = re.compile(r"#\s*mxlint:\s*disable=")
+
+
+class Finding:
+    """One rule violation at file:line, with a fix hint."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "hint",
+                 "suppress_reason")
+
+    def __init__(self, rule, path, line, col, message):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.hint = RULES[rule][1]
+        self.suppress_reason = None
+
+    def as_dict(self):
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "col": self.col, "message": self.message, "hint": self.hint}
+        if self.suppress_reason is not None:
+            d["suppressed"] = self.suppress_reason
+        return d
+
+    def render(self):
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message}\n    hint: {self.hint}")
+
+
+def dotted(node):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def root_name(node):
+    """Base Name of an Attribute/Subscript/Call chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def enclosing_function(node):
+    """Nearest enclosing FunctionDef/Lambda (via mx_parent), else None."""
+    n = getattr(node, "mx_parent", None)
+    while n is not None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return n
+        n = getattr(n, "mx_parent", None)
+    return None
+
+
+def lock_key(expr):
+    """Normalized dotted name for a with-item that looks like a lock
+    ('self._lock', '_mlock', 'cls._lock', 'KVStore._class_lock'), else
+    None. A context manager qualifies when its terminal name segment
+    contains 'lock'."""
+    d = dotted(expr)
+    if d is None:
+        return None
+    if "lock" in d.rsplit(".", 1)[-1].lower():
+        return d
+    return None
+
+
+class ModuleInfo:
+    """Parsed module + the cross-rule symbol/alias/suppression tables."""
+
+    def __init__(self, path, src, relpath=None):
+        self.path = path
+        self.relpath = relpath or path
+        self.src = src
+        self.tree = ast.parse(src, filename=path)
+        self.lines = src.splitlines()
+        self._link_parents()
+        self.import_aliases = {}   # local name -> imported module path
+        self.from_imports = {}     # local name -> (module, original name)
+        self.module_names = set()  # every top-level binding
+        self.class_names = set()
+        self._collect_bindings()
+        self.suppressions = self._parse_suppressions()
+
+    # -- structure ---------------------------------------------------------
+    def _link_parents(self):
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child.mx_parent = parent
+
+    def _collect_bindings(self):
+        # imports are collected from the WHOLE tree: this codebase lazily
+        # imports jax/os inside functions, and an alias means the same
+        # module wherever it appears
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    self.import_aliases[local] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    local = a.asname or a.name
+                    self.from_imports[local] = (node.module or "", a.name)
+        for node in self.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for a in node.names:
+                    self.module_names.add(
+                        a.asname or a.name.split(".")[0])
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                self.module_names.add(node.name)
+                if isinstance(node, ast.ClassDef):
+                    self.class_names.add(node.name)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            self.module_names.add(n.id)
+
+    def aliases_of(self, module):
+        """Local names bound to `module` (exact match on the import path)."""
+        return {local for local, mod in self.import_aliases.items()
+                if mod == module}
+
+    def from_import_names(self, original, module_suffix=None):
+        """Local names for `from X import original` (optionally requiring
+        X to end with module_suffix, dots-insensitive)."""
+        out = set()
+        for local, (mod, orig) in self.from_imports.items():
+            if orig != original:
+                continue
+            if module_suffix is not None:
+                if not mod.lstrip(".").endswith(module_suffix) and \
+                        mod.lstrip(".") != module_suffix:
+                    continue
+            out.add(local)
+        return out
+
+    # -- suppressions ------------------------------------------------------
+    def _parse_suppressions(self):
+        supp = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPP_RE.search(line)
+            if not m:
+                continue
+            for rule, reason in _SUPP_ITEM.findall(line[m.end():]):
+                supp.setdefault(i, {})[rule] = reason.strip()
+        return supp
+
+    def suppression_for(self, rule, line):
+        """Reason string when `rule` is disabled at `line` — the disable
+        comment may sit on the line itself or on a pure-comment line
+        directly above. Empty reasons never suppress."""
+        for cand in (line, line - 1):
+            reasons = self.suppressions.get(cand)
+            if not reasons or rule not in reasons:
+                continue
+            if cand == line - 1:
+                text = self.lines[cand - 1].lstrip()
+                if not text.startswith("#"):
+                    continue
+            reason = reasons[rule]
+            if reason:
+                return reason
+        return None

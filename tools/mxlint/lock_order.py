@@ -1,0 +1,32 @@
+"""Declared lock-acquisition order per module (CC02's ground truth).
+
+The reference engine documented its mutex hierarchy in comments next to the
+engine code; here it is a table the linter enforces.  Keys are paths
+relative to the package root (``incubator_mxnet_tpu``); values are the
+locks a module may hold, in the only order nesting is allowed.  Lock names
+are the normalized dotted spelling at the acquisition site (``with
+self._lock`` -> ``self._lock``).
+
+A lock acquired in a covered module but absent from its entry is an
+*undeclared* lock (CC02): declare it here — stating where a new lock sits
+in the hierarchy is the point of the exercise.
+
+Modules not listed are uncovered: CC02 does not fire there (CC01/CC03
+still do) — unless the module self-declares its hierarchy with a
+top-level ``MXLINT_LOCK_ORDER = ("first", "second")`` tuple, which CC02
+then enforces the same way.
+"""
+
+LOCK_ORDER = {
+    # profiler: event/counter lock, compile-tracker clock, memory book.
+    # PR 3's GC deadlock came precisely from violating this file's order.
+    "profiler.py": ("_lock", "_clock", "_mlock"),
+    "serve/batcher.py": ("self._lock",),
+    "serve/stats.py": ("self._lock",),
+    "serve/predictor.py": ("self._compile_lock",),
+    "kvstore_server.py": ("self._lock",),
+    "kvstore.py": ("KVStore._class_lock",),
+    "gluon/block.py": ("cls._lock",),
+    "symbol/symbol.py": ("cls._lock",),
+    "native/__init__.py": ("_lock",),
+}

@@ -1,0 +1,290 @@
+"""Concurrency rules (CC01-CC03).
+
+CC01 — an attribute that is guarded by a lock *somewhere* in its class
+(read-modify-written inside ``with self._lock``) must be guarded
+*everywhere* it is read-modify-written; a lone unlocked ``self.x += 1``
+next to locked updates is exactly the racy ``Counter.increment`` PR 3
+fixed by hand.  Class attributes get the stricter form: any
+``Cls.attr += 1`` style RMW with no lock held is flagged, because class
+counters are shared across every instance and thread by construction.
+
+CC02 — nested lock acquisition must follow the order declared in
+``lock_order.LOCK_ORDER``; acquiring a lock the module never declared is
+flagged too.  This is the static form of the hierarchy whose violation
+gave PR 3 its GC finalizer deadlock.
+
+CC03 — calling, while a lock is held, a same-module function that
+acquires that same lock: ``threading.Lock`` is not reentrant, so this is
+a guaranteed self-deadlock.  The in-tree convention is that helpers named
+``*_locked`` expect the caller to hold the lock; the rule understands it.
+
+Functions named ``*_locked`` are exempt from CC01 (their contract is
+"caller holds the lock"), as is ``__init__`` (no concurrent access before
+construction completes).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, dotted, lock_key, root_name
+from .lock_order import LOCK_ORDER
+
+
+def _order_for(mod):
+    rel = mod.relpath.replace("\\", "/")
+    for key, order in LOCK_ORDER.items():
+        if rel.endswith("incubator_mxnet_tpu/" + key) or rel == key:
+            return order
+    # a module outside the registry may self-declare its hierarchy with a
+    # top-level `MXLINT_LOCK_ORDER = ("first", "second")` tuple
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "MXLINT_LOCK_ORDER":
+                return tuple(
+                    n.value for n in getattr(node.value, "elts", ())
+                    if isinstance(n, ast.Constant) and
+                    isinstance(n.value, str))
+    return None
+
+
+def _fn_name_chain(node):
+    """Name of the function enclosing `node`, '' at module level."""
+    n = getattr(node, "mx_parent", None)
+    while n is not None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return n.name
+        n = getattr(n, "mx_parent", None)
+    return ""
+
+
+def _with_locks(node):
+    """Lock keys acquired by a With statement (usually one)."""
+    keys = []
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+            k = lock_key(item.context_expr)
+            if k is not None:
+                keys.append(k)
+    return keys
+
+
+def _held_locks(node):
+    """Lock keys held at `node`, outermost first."""
+    held = []
+    n = getattr(node, "mx_parent", None)
+    while n is not None:
+        for k in _with_locks(n):
+            held.append(k)
+        n = getattr(n, "mx_parent", None)
+    held.reverse()
+    return held
+
+
+def _is_rmw(stmt):
+    """True for an AugAssign, or an Assign whose RHS reads the target."""
+    if isinstance(stmt, ast.AugAssign):
+        return True
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        target = dotted(stmt.targets[0])
+        if target is None:
+            return False
+        for n in ast.walk(stmt.value):
+            if isinstance(n, (ast.Attribute, ast.Name)) and \
+                    dotted(n) == target and isinstance(n.ctx, ast.Load):
+                return True
+    return False
+
+
+def _cc01(mod, findings):
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        # pass 1: attributes RMW'd under a self/cls lock anywhere in class
+        guarded = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            if not _is_rmw(node):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            held = _held_locks(node)
+            if not held:
+                continue
+            for t in targets:
+                d = dotted(t if not isinstance(t, ast.Subscript)
+                           else t.value)
+                if d and root_name(t) in ("self", "cls"):
+                    guarded.setdefault(d, held[0])
+        # pass 2: the same attributes RMW'd with no lock held
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            if not _is_rmw(node) or _held_locks(node):
+                continue
+            fn = _fn_name_chain(node)
+            if fn.endswith("_locked"):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                d = dotted(t if not isinstance(t, ast.Subscript)
+                           else t.value)
+                if d is None:
+                    continue
+                if d.split(".")[0] in mod.class_names or \
+                        d.startswith("cls."):
+                    # class attributes are shared across every instance
+                    # and thread by construction — __init__ is not safe
+                    findings.append(Finding(
+                        "CC01", mod.relpath, node.lineno, node.col_offset,
+                        f"class attribute `{d}` read-modify-written "
+                        f"without a lock; shared across all threads"))
+                elif d in guarded and fn != "__init__":
+                    findings.append(Finding(
+                        "CC01", mod.relpath, node.lineno, node.col_offset,
+                        f"`{d}` is updated under `{guarded[d]}` elsewhere "
+                        f"in `{cls.name}` but read-modify-written here "
+                        f"without it"))
+
+
+def _cc01_module_globals(mod, findings):
+    """Module-level analog: globals RMW'd under a module lock somewhere
+    must not be RMW'd lock-free elsewhere."""
+    guarded = {}
+    bare = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.AugAssign):
+            continue
+        if not isinstance(node.target, ast.Name):
+            continue
+        fn = _fn_name_chain(node)
+        if fn.endswith("_locked"):
+            continue
+        held = _held_locks(node)
+        # only globals: name declared `global` in the enclosing fn, or
+        # the statement sits at module level
+        is_global = isinstance(getattr(node, "mx_parent", None), ast.Module)
+        n = getattr(node, "mx_parent", None)
+        while n is not None and not is_global:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for stmt in ast.walk(n):
+                    if isinstance(stmt, ast.Global) and \
+                            node.target.id in stmt.names:
+                        is_global = True
+                break
+            n = getattr(n, "mx_parent", None)
+        if not is_global:
+            continue
+        if held:
+            guarded.setdefault(node.target.id, held[0])
+        else:
+            bare.append(node)
+    for node in bare:
+        if node.target.id in guarded:
+            findings.append(Finding(
+                "CC01", mod.relpath, node.lineno, node.col_offset,
+                f"global `{node.target.id}` is updated under "
+                f"`{guarded[node.target.id]}` elsewhere but "
+                f"read-modify-written here without it"))
+
+
+def _normalize(key, order):
+    """Match an acquisition spelling against a declared name: exact, or
+    same terminal attribute (`self._lock` vs `_lock` never conflated —
+    both sides must agree on the full dotted form)."""
+    return key if key in order else None
+
+
+def _cc02(mod, findings):
+    order = _order_for(mod)
+    if order is None:
+        return
+    rank = {name: i for i, name in enumerate(order)}
+    for node in ast.walk(mod.tree):
+        keys = _with_locks(node)
+        if not keys:
+            continue
+        held = _held_locks(node)
+        for k in keys:
+            if k not in rank:
+                findings.append(Finding(
+                    "CC02", mod.relpath, node.lineno, node.col_offset,
+                    f"lock `{k}` is not declared in the lock-order "
+                    f"registry for this module"))
+                continue
+            for h in held:
+                if h in rank and rank[h] > rank[k]:
+                    findings.append(Finding(
+                        "CC02", mod.relpath, node.lineno, node.col_offset,
+                        f"acquiring `{k}` while holding `{h}` inverts "
+                        f"the declared order {order}"))
+
+
+def _locks_taken_by(fn):
+    """Lock keys a function acquires anywhere in its own body (not in
+    nested defs)."""
+    taken = set()
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        taken.update(_with_locks(node))
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+    return taken
+
+
+def _cc03(mod, findings):
+    # map function name -> locks it takes (module + class methods)
+    takes = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            locks = _locks_taken_by(node)
+            if locks:
+                takes.setdefault(node.name, set()).update(locks)
+    for node in ast.walk(mod.tree):
+        # direct re-entry: with L: ... with L:
+        for k in _with_locks(node):
+            if k in _held_locks(node):
+                findings.append(Finding(
+                    "CC03", mod.relpath, node.lineno, node.col_offset,
+                    f"`{k}` acquired while already held "
+                    f"(threading.Lock self-deadlocks)"))
+        # call under lock to a function that takes the same lock
+        if isinstance(node, ast.Call):
+            held = set(_held_locks(node))
+            if not held:
+                continue
+            fname = dotted(node.func)
+            if fname is None:
+                continue
+            # only bare / self. / cls. calls can hit a same-module def;
+            # `self._thread.start()` is some other object's method
+            if fname.count(".") > 1 or (
+                    "." in fname and
+                    fname.split(".")[0] not in ("self", "cls")):
+                continue
+            callee = fname.split(".")[-1]
+            if callee.endswith("_locked"):
+                continue  # contract: caller holds the lock, callee doesn't
+            overlap = takes.get(callee, set()) & held
+            if overlap:
+                k = sorted(overlap)[0]
+                findings.append(Finding(
+                    "CC03", mod.relpath, node.lineno, node.col_offset,
+                    f"`{callee}()` acquires `{k}`, which is already held "
+                    f"at this call site"))
+
+
+def check(mod):
+    findings = []
+    _cc01(mod, findings)
+    _cc01_module_globals(mod, findings)
+    _cc02(mod, findings)
+    _cc03(mod, findings)
+    return findings

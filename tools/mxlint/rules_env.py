@@ -1,0 +1,110 @@
+"""Env-var hygiene rules (EV01-EV02).
+
+Every ``MXNET_*`` / ``MXTPU_*`` knob must be read through the
+``util.getenv_int/getenv_bool/getenv_str`` helpers, whose defaults and
+descriptions live in the single ``util.ENV_VARS`` registry (EV01), and
+every name passed to those helpers must actually be declared there (EV02).
+The registry is recovered by *parsing* util.py, never importing it, so the
+linter stays independent of jax and runs anywhere.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, dotted
+
+_PREFIXES = ("MXNET_", "MXTPU_")
+_HELPERS = {"getenv_int", "getenv_bool", "getenv_str"}
+
+
+def _defines_registry(mod):
+    """True when the module assigns a top-level ENV_VARS — that module
+    (util.py) is the one place raw reads are allowed."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "ENV_VARS":
+                    return True
+        elif isinstance(node, ast.AnnAssign):
+            if isinstance(node.target, ast.Name) and \
+                    node.target.id == "ENV_VARS":
+                return True
+    return False
+
+
+def load_registry(package_root):
+    """Declared env-var names, by parsing <package_root>/util.py.
+    Returns None when util.py has no ENV_VARS yet (EV02 then skips)."""
+    path = os.path.join(package_root, "util.py")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "ENV_VARS"
+                   for t in node.targets):
+            continue
+        names = set()
+        for n in ast.walk(node.value):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                    and n.value.startswith(_PREFIXES):
+                names.add(n.value)
+        return names
+    return None
+
+
+def _literal_env_name(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) and \
+            node.value.startswith(_PREFIXES):
+        return node.value
+    return None
+
+
+def check(mod, registry=None):
+    findings = []
+    if _defines_registry(mod):
+        return findings  # util.py itself implements the helpers
+    os_aliases = mod.aliases_of("os")
+    environ_chains = {a + ".environ" for a in os_aliases}
+    environ_chains |= set(mod.from_import_names("environ", "os"))
+    getenv_chains = {a + ".getenv" for a in os_aliases}
+    getenv_chains |= {a + ".environ.get" for a in os_aliases}
+    getenv_chains |= set(mod.from_import_names("getenv", "os"))
+
+    for node in ast.walk(mod.tree):
+        # EV01: os.environ["MXNET_X"], os.environ.get("MXNET_X"),
+        # os.getenv("MXNET_X")
+        name = None
+        if isinstance(node, ast.Subscript):
+            if dotted(node.value) in environ_chains:
+                name = _literal_env_name(
+                    node.slice if not isinstance(node.slice, ast.Index)
+                    else node.slice.value)
+        elif isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname in getenv_chains and node.args:
+                name = _literal_env_name(node.args[0])
+            elif fname is not None and \
+                    fname.split(".")[-1] in _HELPERS:
+                # EV02: helper called with an undeclared name
+                if node.args:
+                    ev = _literal_env_name(node.args[0])
+                    if ev is not None and registry is not None and \
+                            ev not in registry:
+                        findings.append(Finding(
+                            "EV02", mod.relpath, node.lineno,
+                            node.col_offset,
+                            f"`{ev}` is read via "
+                            f"{fname.split('.')[-1]} but not declared "
+                            f"in util.ENV_VARS"))
+                continue
+        if name is not None:
+            findings.append(Finding(
+                "EV01", mod.relpath, node.lineno, node.col_offset,
+                f"raw environment read of `{name}` bypasses "
+                f"util.ENV_VARS"))
+    return findings

@@ -1,0 +1,428 @@
+"""Trace-safety rules (TS01-TS04).
+
+A function is *traced* when jax runs it once with abstract tracers to
+build an XLA program: op bodies registered through ``ops.registry.register``,
+anything decorated with / passed to ``jax.jit``, and the callables handed to
+``profiler.track_jit``.  Inside such a function the Python code is a
+metaprogram — host side effects run at trace time only (TS01), ``if``/
+``while`` on traced values raises or silently specializes (TS02), storing a
+tracer into host state leaks it (TS03), and a closure-captured array is
+baked into the executable as a constant, recompiling whenever it changes
+(TS04 — the class of silent recompile PR 3's runtime tracker can only
+detect after the fact).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, dotted, root_name
+
+# calls that are host side effects regardless of module (TS01)
+_HOST_BUILTINS = {"print", "input", "open", "breakpoint", "exec", "eval"}
+# attribute chains rooted at the `os` module that touch host state
+_OS_HOST = {"environ", "getenv", "putenv", "system", "popen", "remove",
+            "unlink", "makedirs", "mkdir", "rename", "urandom"}
+# shape-like attributes that are static at trace time (TS02 allowance)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type"}
+_STATIC_CALLS = {"len", "isinstance", "issubclass", "callable", "hasattr",
+                 "getattr", "type"}
+# numpy/jax constructors whose result is an array value (TS04 evidence)
+_ARRAY_CTORS = {"array", "asarray", "zeros", "ones", "full", "empty",
+                "arange", "linspace", "eye", "device_put", "asnumpy"}
+
+
+class TracedFn:
+    """One function the linter believes jax will trace."""
+
+    __slots__ = ("node", "kind", "traced_params")
+
+    def __init__(self, node, kind, traced_params):
+        self.node = node
+        self.kind = kind          # "op" | "jit" | "track_jit"
+        self.traced_params = traced_params  # names holding tracer values
+
+    @property
+    def name(self):
+        return getattr(self.node, "name", "<lambda>")
+
+
+def _decorator_call(dec):
+    """(dotted name of decorator callable, Call node or None)."""
+    if isinstance(dec, ast.Call):
+        return dotted(dec.func), dec
+    return dotted(dec), None
+
+
+def _kw(call, name):
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _is_true(node):
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _positional_params(fn):
+    """Positional/vararg parameter names (the tracer-carrying ones); a
+    leading self/cls is host state, not a tracer."""
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    return set(names)
+
+
+def _jit_names(mod):
+    """Local spellings that resolve to jax.jit: 'jax' aliases give
+    '<alias>.jit', plus `from jax import jit [as j]`."""
+    chains = set()
+    for alias in mod.aliases_of("jax"):
+        chains.add(alias + ".jit")
+    for local in mod.from_import_names("jit", "jax"):
+        chains.add(local)
+    return chains
+
+
+def _track_jit_names(mod):
+    """Spellings of profiler.track_jit: from-imports of track_jit, plus
+    '<alias>.track_jit' for any imported module named/aliased profiler."""
+    chains = set(mod.from_import_names("track_jit"))
+    for local, modpath in mod.import_aliases.items():
+        if modpath.split(".")[-1] == "profiler":
+            chains.add(local + ".track_jit")
+    for local, (src, orig) in mod.from_imports.items():
+        if orig == "profiler":
+            chains.add(local + ".track_jit")
+    return chains
+
+
+def _register_names(mod):
+    """Spellings of ops.registry.register (from-imports only; every
+    in-tree user does `from .registry import register`)."""
+    return mod.from_import_names("register", "registry")
+
+
+def _local_functions(scope):
+    """name -> FunctionDef for defs directly inside `scope`'s body."""
+    out = {}
+    for stmt in ast.walk(scope):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(stmt.name, stmt)
+    return out
+
+
+def discover_traced(mod):
+    """All TracedFn in a module."""
+    found = {}
+
+    def add(node, kind):
+        if id(node) in found:
+            return
+        if isinstance(node, ast.Lambda):
+            params = {a.arg for a in node.args.args + node.args.posonlyargs}
+            if node.args.vararg:
+                params.add(node.args.vararg.arg)
+            found[id(node)] = TracedFn(node, kind, params)
+        else:
+            found[id(node)] = TracedFn(node, kind, _positional_params(node))
+
+    jit_chains = _jit_names(mod)
+    track_chains = _track_jit_names(mod)
+    reg_names = _register_names(mod)
+    fn_table = _local_functions(mod.tree)
+
+    def resolve(arg):
+        """Turn a jit()/track_jit() argument into a function node."""
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            return fn_table.get(arg.id)
+        return None
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                name, call = _decorator_call(dec)
+                if name in reg_names:
+                    if call is not None and _is_true(_kw(call, "eager_only")):
+                        continue  # never traced
+                    add(node, "op")
+                elif name in jit_chains:
+                    add(node, "jit")
+                elif name is not None and name.endswith("partial") and \
+                        call is not None and call.args and \
+                        dotted(call.args[0]) in jit_chains:
+                    add(node, "jit")
+        elif isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name in jit_chains and node.args:
+                target = resolve(node.args[0])
+                if target is not None:
+                    add(target, "jit")
+            elif name in track_chains and len(node.args) >= 2:
+                target = resolve(node.args[1])
+                if target is not None:
+                    add(target, "track_jit")
+    return list(found.values())
+
+
+# -- TS01 -------------------------------------------------------------------
+
+def _host_call_reason(call, mod):
+    fname = dotted(call.func)
+    if fname in _HOST_BUILTINS:
+        return f"call to `{fname}()`"
+    if fname is None:
+        return None
+    parts = fname.split(".")
+    head = parts[0]
+    imported = mod.import_aliases.get(head)
+    if imported == "numpy" and len(parts) >= 2 and parts[1] == "random":
+        return f"call to `{fname}()` (host RNG; results freeze at trace time)"
+    if imported == "os" and len(parts) >= 2 and parts[1] in _OS_HOST:
+        return f"call to `{fname}()` (host OS access)"
+    return None
+
+
+def _ts01(mod, tf, findings):
+    for node in ast.walk(tf.node):
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            # time.time() / time.monotonic() style, via real module alias
+            if fname is not None:
+                head = fname.split(".")[0]
+                if mod.import_aliases.get(head) == "time":
+                    findings.append(Finding(
+                        "TS01", mod.relpath, node.lineno, node.col_offset,
+                        f"`{fname}()` inside traced `{tf.name}` runs at "
+                        f"trace time, not per step"))
+                    continue
+            reason = _host_call_reason(node, mod)
+            if reason:
+                findings.append(Finding(
+                    "TS01", mod.relpath, node.lineno, node.col_offset,
+                    f"{reason} inside traced `{tf.name}`"))
+        elif isinstance(node, ast.Subscript):
+            d = dotted(node.value)
+            if d is not None:
+                head = d.split(".")[0]
+                if mod.import_aliases.get(head) == "os" and \
+                        d.endswith(".environ"):
+                    findings.append(Finding(
+                        "TS01", mod.relpath, node.lineno, node.col_offset,
+                        f"`{d}[...]` read inside traced `{tf.name}`"))
+
+
+# -- TS02 -------------------------------------------------------------------
+
+def _mentions_traced_value(test, traced):
+    """True when `test` depends on a traced parameter in a way that is
+    dynamic at trace time (not .shape/.ndim/len()/isinstance/is-None)."""
+    def dynamic_names(node):
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return set()
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname in _STATIC_CALLS:
+                return set()
+            out = set()
+            for a in node.args:
+                out |= dynamic_names(a)
+            for k in node.keywords:
+                out |= dynamic_names(k.value)
+            return out
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return set()
+            out = dynamic_names(node.left)
+            for c in node.comparators:
+                out |= dynamic_names(c)
+            return out
+        if isinstance(node, ast.Name):
+            return {node.id}
+        out = set()
+        for child in ast.iter_child_nodes(node):
+            out |= dynamic_names(child)
+        return out
+
+    return bool(dynamic_names(test) & traced)
+
+
+def _ts02(mod, tf, findings):
+    body = tf.node.body if not isinstance(tf.node, ast.Lambda) else []
+    stack = list(body) if isinstance(body, list) else [body]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # nested defs have their own tracer params
+        if isinstance(node, (ast.If, ast.While)):
+            if _mentions_traced_value(node.test, tf.traced_params):
+                kw = "while" if isinstance(node, ast.While) else "if"
+                findings.append(Finding(
+                    "TS02", mod.relpath, node.lineno, node.col_offset,
+                    f"`{kw}` condition in traced `{tf.name}` depends on a "
+                    f"traced value"))
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+# -- TS03 -------------------------------------------------------------------
+
+def _collect_locals(fn):
+    """Names bound inside `fn` itself (params, assignments, loops, withs,
+    comprehensions, nested defs)."""
+    names = set()
+    a = fn.args
+    for arg in a.posonlyargs + a.args + a.kwonlyargs:
+        names.add(arg.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.comprehension,)):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+    return names
+
+
+def _ts03(mod, tf, findings):
+    """Stores whose target roots outside the traced function: self.x = ...,
+    global/nonlocal writes, and subscript/attribute stores on closure
+    names.  Checked for the traced fn and any defs nested in it (they
+    trace together)."""
+    def check_fn(fn, fn_locals):
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_fn(node, fn_locals | _collect_locals(node))
+                continue
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                findings.append(Finding(
+                    "TS03", mod.relpath, node.lineno, node.col_offset,
+                    f"`{type(node).__name__.lower()}` write inside traced "
+                    f"`{tf.name}` leaks trace-time state"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if not isinstance(t, (ast.Attribute, ast.Subscript)):
+                        continue
+                    root = root_name(t)
+                    if root is None or root in fn_locals:
+                        continue
+                    findings.append(Finding(
+                        "TS03", mod.relpath, node.lineno, node.col_offset,
+                        f"store to `{dotted(t) or root}` in traced "
+                        f"`{tf.name}` writes host state during tracing"))
+            for child in ast.iter_child_nodes(node):
+                stack.append(child)
+
+    if isinstance(tf.node, ast.Lambda):
+        return
+    check_fn(tf.node, _collect_locals(tf.node))
+
+
+# -- TS04 -------------------------------------------------------------------
+
+def _array_bindings(scope, mod):
+    """Names in `scope` whose binding makes them look like concrete arrays:
+    assigned from a numpy/jnp/jax constructor call, `.asnumpy()`,
+    `.data()` or `._data` access."""
+    np_like = set()
+    for local, path in mod.import_aliases.items():
+        if path in ("numpy", "jax.numpy", "jax"):
+            np_like.add(local)
+    arrays = set()
+    for stmt in scope.body if isinstance(scope.body, list) else []:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not stmt:
+                continue
+            if not isinstance(node, ast.Assign):
+                continue
+            val = node.value
+            is_array = False
+            if isinstance(val, ast.Call):
+                fname = dotted(val.func)
+                if fname:
+                    parts = fname.split(".")
+                    if parts[0] in np_like and parts[-1] in _ARRAY_CTORS:
+                        is_array = True
+                    elif parts[-1] in ("asnumpy", "data"):
+                        is_array = True
+            elif isinstance(val, ast.Attribute) and val.attr == "_data":
+                is_array = True
+            if not is_array:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    arrays.add(t.id)
+    return arrays
+
+
+def _ts04(mod, tf, findings):
+    """Free names in a nested traced fn whose enclosing-scope binding is
+    array-like: jit will bake the value in as a constant."""
+    fn = tf.node
+    if isinstance(fn, ast.Lambda):
+        return
+    enclosing = getattr(fn, "mx_parent", None)
+    while enclosing is not None and not isinstance(
+            enclosing, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        enclosing = getattr(enclosing, "mx_parent", None)
+    if enclosing is None:
+        return  # module-level fn: captures are module constants
+    fn_locals = _collect_locals(fn)
+    candidates = _array_bindings(enclosing, mod)
+    if not candidates:
+        return
+    reported = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in fn_locals or node.id in mod.module_names:
+                continue
+            if node.id not in candidates or node.id in reported:
+                continue
+            # names only used in call position are functions, not arrays
+            parent = getattr(node, "mx_parent", None)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                continue
+            reported.add(node.id)
+            findings.append(Finding(
+                "TS04", mod.relpath, node.lineno, node.col_offset,
+                f"traced `{tf.name}` closes over array `{node.id}`; it "
+                f"becomes a compile-time constant"))
+
+
+def check(mod):
+    findings = []
+    for tf in discover_traced(mod):
+        _ts01(mod, tf, findings)
+        _ts02(mod, tf, findings)
+        _ts03(mod, tf, findings)
+        _ts04(mod, tf, findings)
+    return findings
