@@ -503,6 +503,40 @@ def bench_fused_step(steps, n_params=64, dim=64):
     return steps / dt_f, steps / dt_u, disp_f, disp_u
 
 
+def bench_input_pipeline(steps, batch=32, image_size=64):
+    """Input-pipeline overlap row: iterate a DataLoader and run a jitted
+    reduction per batch, synchronous (pin_memory=False — batchify and the
+    H2D copy serialize with the consumer) vs the double-buffered device
+    prefetch (pin_memory=True — io/prefetch.py stages batch N+1's async
+    host->HBM copy under batch N's compute, iter_prefetcher.h's double
+    buffer extended past host RAM). Returns (sync_img_s, prefetch_img_s)."""
+    import jax
+    from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    n = batch * max(steps, 4)
+    rs = np.random.RandomState(0)
+    X = rs.rand(n, 3, image_size, image_size).astype(np.float32)
+    Y = rs.randint(0, 10, n).astype(np.float32)
+    ds = ArrayDataset(X, Y)
+
+    @jax.jit
+    def compute(x):
+        v = x.reshape(x.shape[0], -1)
+        return (v @ v.T).sum()
+
+    def consume(pin):
+        out = None
+        for xb, _ in DataLoader(ds, batch_size=batch, shuffle=False,
+                                pin_memory=pin):
+            out = compute(xb._data)
+        _sync(out)
+
+    consume(False)                        # compile + warmup
+    dt_sync = _time_best(lambda: consume(False))
+    dt_pin = _time_best(lambda: consume(True))
+    return n / dt_sync, n / dt_pin
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=None,
@@ -634,6 +668,22 @@ def main():
                   f"{f_sps / u_sps:5.2f}x", file=sys.stderr)
         except Exception as e:
             print(f"[bench] fused_step: FAILED {e!r}", file=sys.stderr)
+        try:
+            s_ips, p_ips = bench_input_pipeline(
+                steps_for("train", "float32"))
+            results.append({"mode": "input_pipeline", "batch": 32,
+                            "dtype": "float32",
+                            "sync_img_per_sec": round(s_ips, 2),
+                            "prefetch_img_per_sec": round(p_ips, 2),
+                            "speedup": round(p_ips / s_ips, 3)
+                            if s_ips else None,
+                            "vs_baseline": None})
+            print(f"[bench] input pipeline (b32)            "
+                  f"{p_ips:9.2f} img/s prefetched vs "
+                  f"{s_ips:9.2f} sync: {p_ips / s_ips:5.2f}x",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] input_pipeline: FAILED {e!r}", file=sys.stderr)
 
     if on_tpu:
         try:

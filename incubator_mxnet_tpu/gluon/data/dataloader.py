@@ -148,9 +148,17 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False):
+                 thread_pool=False, pin_device_id=0):
         self._dataset = dataset
-        self._pin_memory = pin_memory
+        # pin_memory routes batches through io.prefetch.DevicePrefetcher
+        # (the TPU-native reading of the reference's pinned-staging-buffer
+        # flag, dataloader.py:616): batchify/shm copy-out AND the async
+        # host->HBM issue run on a background thread, double-buffered, so
+        # batch N+1's transfer overlaps batch N's compute. An int value is
+        # taken as the buffer depth (True == 2).
+        self._pin_memory = int(pin_memory) if not isinstance(
+            pin_memory, bool) else (2 if pin_memory else 0)
+        self._pin_device_id = pin_device_id
         if batch_sampler is None:
             if batch_size is None:
                 raise MXNetError("batch_size required when no batch_sampler")
@@ -214,6 +222,17 @@ class DataLoader:
         self._pool = ThreadPool(self._num_workers)
 
     def __iter__(self):
+        if self._pin_memory:
+            from ...io.prefetch import DevicePrefetcher
+            device = None
+            if self._pin_device_id:
+                import jax
+                device = jax.devices()[self._pin_device_id]
+            return DevicePrefetcher(self._iter_batches(),
+                                    size=self._pin_memory, device=device)
+        return self._iter_batches()
+
+    def _iter_batches(self):
         if self._num_workers == 0 or self._pool is None:
             for batch_idx in self._batch_sampler:
                 yield self._batchify_fn([self._dataset[i] for i in batch_idx])

@@ -56,6 +56,8 @@ class Trainer:
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore
         self._update_on_kv = False
+        self._async_baked_rescale = None
+        self._async_rescale_warned = set()
         self._states_to_load = None
         # last-step observability (profiler counters publish these when the
         # profiler is running; always readable for tests/tools)
@@ -115,6 +117,11 @@ class Trainer:
                     "update_on_kvstore=False is invalid with dist_async "
                     "(updates happen on the parameter server)")
             self._kvstore.set_optimizer(self._optimizer)
+            # the optimizer (rescale_grad = scale/batch_size included) is
+            # pickled to the server exactly ONCE, here; later local
+            # rescale_grad writes never reach it (reference trainer.py
+            # warns on the same one-shot capture)
+            self._async_baked_rescale = self._optimizer.rescale_grad
         self._kv_initialized = True
 
     @property
@@ -138,6 +145,19 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kv:
+            if self._optimizer.rescale_grad != self._async_baked_rescale \
+                    and self._async_baked_rescale not in \
+                    self._async_rescale_warned:
+                import warnings
+                baked_bs = self._scale / self._async_baked_rescale
+                warnings.warn(
+                    f"Trainer.step(batch_size={batch_size}) differs from "
+                    f"the batch_size ({baked_bs:g}) baked into the "
+                    "optimizer serialized to the dist_async server; the "
+                    "server keeps applying the original rescale_grad, so "
+                    "updates are mis-scaled. Recreate the Trainer (and "
+                    "kvstore) to change batch size mid-run.", UserWarning)
+                self._async_rescale_warned.add(self._async_baked_rescale)
             # server applies the optimizer on push; pull returns the
             # authoritative weights
             for i, p in enumerate(self._params):

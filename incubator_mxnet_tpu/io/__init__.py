@@ -1,7 +1,9 @@
 from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, CSVIter,
                  ResizeIter, PrefetchingIter, MXDataIter, ImageRecordIter,
                  MNISTIter, LibSVMIter)
+from .prefetch import DevicePrefetcher, prefetch_to_device
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "CSVIter",
            "ResizeIter", "PrefetchingIter", "MXDataIter", "ImageRecordIter",
-           "MNISTIter", "LibSVMIter"]
+           "MNISTIter", "LibSVMIter", "DevicePrefetcher",
+           "prefetch_to_device"]

@@ -258,18 +258,32 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (reference io.py PrefetchingIter over
-    dmlc::ThreadedIter — here a plain producer thread + queue)."""
+    dmlc::ThreadedIter — here a plain producer thread + queue).
 
-    def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
+    With ``device=True`` (or an explicit jax device, or a ``mesh``) a
+    SECOND pipeline stage consumes the host queue through
+    :class:`.prefetch.DevicePrefetcher`: the host thread keeps overlapping
+    decode/augment, while the device stage issues the async host->HBM copy
+    of batch N+1 under batch N's compute — the full analog of the
+    reference's iter_prefetcher.h double buffer, extended past host RAM.
+    """
+
+    def __init__(self, iters, rename_data=None, rename_label=None, depth=2,
+                 device=False, mesh=None, axis="dp"):
         if not isinstance(iters, list):
             iters = [iters]
         assert len(iters) == 1, "single backing iter supported"
         self.iter = iters[0]
         super().__init__(self.iter.batch_size)
         import queue
+        self._depth = depth
+        self._device = device
+        self._mesh = mesh
+        self._axis = axis
         self._queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread = None
+        self._dev = None
         self._start()
 
     def _start(self):
@@ -284,18 +298,48 @@ class PrefetchingIter(DataIter):
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
+        if self._device or self._mesh is not None:
+            from .prefetch import DevicePrefetcher
+            dev = self._device if self._device not in (True, False) else None
+            self._dev = DevicePrefetcher(self._host_drain(),
+                                         size=self._depth, mesh=self._mesh,
+                                         axis=self._axis, device=dev)
+
+    def _host_drain(self):
+        """Generator feeding the device stage from the host queue. Polls
+        with a timeout so reset()/close() (which set _stop) can't leave
+        the device-stage worker blocked forever on an idle queue."""
+        import queue
+        while not self._stop.is_set():
+            try:
+                batch = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if batch is None:
+                return
+            yield batch
 
     def reset(self):
         self._stop.set()
+        if self._dev is not None:
+            self._dev.close()
+            self._dev = None
         if self._thread is not None:
             while not self._queue.empty():
                 self._queue.get_nowait()
             self._thread.join(timeout=5)
+            # a worker blocked in put() is unblocked by the drain above and
+            # may land one stale batch before it sees _stop; sweep it out
+            # so the next epoch starts clean
+            while not self._queue.empty():
+                self._queue.get_nowait()
         self.iter.reset()
         self._stop.clear()
         self._start()
 
     def next(self):
+        if self._dev is not None:
+            return next(self._dev)      # StopIteration terminates the epoch
         batch = self._queue.get()
         if batch is None:
             raise StopIteration

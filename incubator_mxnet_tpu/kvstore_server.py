@@ -28,9 +28,23 @@ Wire ops (reference message vocabulary, kvstore_dist_server.h DataHandleEx):
                     sends the serialized optimizer to servers,
                     python/mxnet/kvstore.py:450 _send_command_to_servers)
   stats / stop    — introspection / shutdown
+
+Wire security: the payload is pickle, so authentication must happen before
+a single byte is unpickled. Each side sends a random 16-byte nonce at
+connect time; both derive a per-connection session key
+HMAC(token, client_nonce + server_nonce) and every frame carries a
+HMAC-SHA256 tag over (direction, per-direction sequence number, payload).
+A peer without the cluster token cannot produce a valid tag for even its
+first frame, captured frames fail on any other connection (fresh nonces)
+or at any other position (sequence number), and in-flight tampering is
+detected — unlike the previous one-shot cleartext token handshake, which
+a same-network sniffer could replay verbatim. The listener additionally
+binds only the coordinator-facing interface (MXNET_KVSTORE_BIND_ADDR to
+override), not 0.0.0.0.
 """
 from __future__ import annotations
 
+import hashlib
 import hmac
 import pickle
 import secrets
@@ -44,11 +58,8 @@ __all__ = ["AsyncServer", "AsyncClient", "start_async_server",
            "connect_async_server"]
 
 _HDR = struct.Struct("<Q")
-
-
-def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+_NONCE_LEN = 16
+_MAC_LEN = hashlib.sha256().digest_size
 
 
 def _recv_exact(sock, n):
@@ -61,9 +72,47 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
-def _recv_msg(sock):
-    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n))
+def _session_key(token, client_nonce, server_nonce):
+    return hmac.new(token.encode(), b"mxtpu-kvstore-v1" + client_nonce +
+                    server_nonce, hashlib.sha256).digest()
+
+
+def _frame_mac(key, direction, seq, payload):
+    return hmac.new(key, direction + _HDR.pack(seq) + payload,
+                    hashlib.sha256).digest()
+
+
+class _Channel:
+    """One authenticated end of a connection: frames are
+    ``len || payload || mac`` with the MAC bound to the session key, the
+    frame direction (so a reflected frame never verifies), and a
+    per-direction sequence number (so a replayed or reordered frame never
+    verifies). ``recv`` raises ConnectionError on a bad MAC BEFORE the
+    payload reaches pickle."""
+
+    def __init__(self, sock, key, send_dir, recv_dir):
+        self._sock = sock
+        self._key = key
+        self._send_dir = send_dir
+        self._recv_dir = recv_dir
+        self._send_seq = 0
+        self._recv_seq = 0
+
+    def send(self, obj):
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        mac = _frame_mac(self._key, self._send_dir, self._send_seq, payload)
+        self._send_seq += 1
+        self._sock.sendall(_HDR.pack(len(payload)) + payload + mac)
+
+    def recv(self):
+        (n,) = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
+        payload = _recv_exact(self._sock, n)
+        mac = _recv_exact(self._sock, _MAC_LEN)
+        want = _frame_mac(self._key, self._recv_dir, self._recv_seq, payload)
+        if not hmac.compare_digest(mac, want):
+            raise ConnectionError("frame MAC mismatch")
+        self._recv_seq += 1
+        return pickle.loads(payload)
 
 
 def _host_ip():
@@ -185,18 +234,22 @@ class AsyncServer:
     # -- socket plumbing ---------------------------------------------------
     def _client_loop(self, conn):
         try:
-            # auth handshake first, as RAW BYTES (never unpickle from an
-            # unauthenticated peer): exactly 32 hex chars, constant-time
-            # compare, silent close on mismatch
+            # nonce exchange as RAW BYTES, then per-frame HMAC with the
+            # derived session key; a peer without the token fails the MAC
+            # on its very first frame — nothing is ever unpickled from it
             try:
-                presented = _recv_exact(conn, len(self.token))
+                client_nonce = _recv_exact(conn, _NONCE_LEN)
+                server_nonce = secrets.token_bytes(_NONCE_LEN)
+                conn.sendall(server_nonce)
             except (ConnectionError, OSError):
                 return
-            if not hmac.compare_digest(presented, self.token.encode()):
-                return
+            chan = _Channel(conn,
+                            _session_key(self.token, client_nonce,
+                                         server_nonce),
+                            send_dir=b"S", recv_dir=b"C")
             while not self._stopped.is_set():
                 try:
-                    msg = _recv_msg(conn)
+                    msg = chan.recv()       # silent close on MAC mismatch
                 except (ConnectionError, OSError):
                     return
                 try:
@@ -204,7 +257,7 @@ class AsyncServer:
                 except Exception as e:          # report, don't kill server
                     reply = ("err", repr(e))
                 try:
-                    _send_msg(conn, reply)
+                    chan.send(reply)
                 except (ConnectionError, OSError):
                     return
         finally:
@@ -223,16 +276,31 @@ class AsyncServer:
             self._threads.append(t)
 
     def start(self):
-        """Bind, start the accept thread, return the advertised addr."""
+        """Bind, start the accept thread, return the advertised addr.
+
+        Binds ONLY the coordinator-facing interface by default (the same
+        address the workers are told to dial), so the pickle endpoint is
+        not reachable on every interface of the host; MXNET_KVSTORE_BIND_ADDR
+        overrides (e.g. '127.0.0.1' for single-machine runs, '0.0.0.0' to
+        restore wildcard binding behind a firewall)."""
+        from .util import getenv_str
+        bind = getenv_str("MXNET_KVSTORE_BIND_ADDR") or _host_ip()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("0.0.0.0", 0))
+        try:
+            self._sock.bind((bind, 0))
+        except OSError:
+            # interface probe gave an unbindable address (odd netns /
+            # no default route): loopback still serves single-machine runs
+            bind = "127.0.0.1"
+            self._sock.bind((bind, 0))
         self._sock.listen(64)
         port = self._sock.getsockname()[1]
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
-        return f"{_host_ip()}:{port}"
+        advertise = _host_ip() if bind in ("0.0.0.0", "::") else bind
+        return f"{advertise}:{port}"
 
     def stop(self):
         self._stopped.set()
@@ -258,12 +326,19 @@ class AsyncClient:
         self._lock = threading.Lock()
         self._sock = socket.create_connection((host, int(port)), timeout=120)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.sendall(token.encode())   # auth before first frame
+        # nonce exchange, then every frame is HMAC'd with the session key
+        client_nonce = secrets.token_bytes(_NONCE_LEN)
+        self._sock.sendall(client_nonce)
+        server_nonce = _recv_exact(self._sock, _NONCE_LEN)
+        self._chan = _Channel(self._sock,
+                              _session_key(token, client_nonce,
+                                           server_nonce),
+                              send_dir=b"C", recv_dir=b"S")
 
     def call(self, *msg):
         with self._lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
+            self._chan.send(msg)
+            reply = self._chan.recv()
         if reply[0] != "ok":
             raise MXNetError(f"async kvstore server: {reply[1]}")
         return reply[1] if len(reply) > 1 else None

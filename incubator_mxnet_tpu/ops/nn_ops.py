@@ -250,14 +250,21 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
         n = 1
         for i in red:
             n *= data.shape[i]
-        first = lax.slice_in_dim(data, 0, 1, axis=red[0])
-        c = jnp.mean(first.astype(jnp.float32), axis=red, keepdims=True)
-        shifted = data.astype(jnp.float32) - c
-        s1 = jnp.sum(shifted, axis=red, dtype=jnp.float32)
-        s2 = jnp.sum(jnp.square(shifted), axis=red, dtype=jnp.float32)
-        dmean = s1 / n
-        mean = jnp.reshape(c, (-1,)) + dmean
-        var = jnp.maximum(s2 / n - jnp.square(dmean), 0.0)
+        if n == 0:
+            # 0-size batch: the shifted one-pass path below slices [0:1]
+            # of an empty reduce axis (a TypeError); the plain reductions
+            # keep the old NaN-stats-no-crash contract for this edge
+            mean = jnp.mean(data.astype(jnp.float32), axis=red)
+            var = jnp.var(data.astype(jnp.float32), axis=red)
+        else:
+            first = lax.slice_in_dim(data, 0, 1, axis=red[0])
+            c = jnp.mean(first.astype(jnp.float32), axis=red, keepdims=True)
+            shifted = data.astype(jnp.float32) - c
+            s1 = jnp.sum(shifted, axis=red, dtype=jnp.float32)
+            s2 = jnp.sum(jnp.square(shifted), axis=red, dtype=jnp.float32)
+            dmean = s1 / n
+            mean = jnp.reshape(c, (-1,)) + dmean
+            var = jnp.maximum(s2 / n - jnp.square(dmean), 0.0)
         mean = mean.astype(moving_mean.dtype)
         var = var.astype(moving_var.dtype)
     else:

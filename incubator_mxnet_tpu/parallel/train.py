@@ -195,6 +195,9 @@ class TrainStep:
         self.params = dict(params)
         self.opt_state = opt_state
         self._step_count = 0
+        # inputs that arrived already carrying the step's data sharding
+        # (io.prefetch pre-placed them) and skipped the _to_device copy
+        self.preplaced_hits = 0
         non_diff = {p.name for p in self._param_list if p.grad_req == "null"}
 
         def step_fn(params, opt_state, rng, step_i, *batch):
@@ -247,9 +250,44 @@ class TrainStep:
                     jnp.issubdtype(a.dtype, jnp.floating):
                 a = a.astype(self._dtype)
             if self._data_sharding is not None:
-                a = jax.device_put(a, self._data_sharding)
+                # batches staged through io.prefetch arrive ALREADY carrying
+                # this NamedSharding — re-issuing device_put would serialize
+                # a no-op transfer into the step; skip it
+                if getattr(a, "sharding", None) == self._data_sharding:
+                    self.preplaced_hits += 1
+                else:
+                    a = jax.device_put(a, self._data_sharding)
             arrs.append(a)
         return arrs
+
+    def run_epoch(self, data_iter, prefetch=2):
+        """Drive one pass over ``data_iter`` with the device input pipeline:
+        the iterator is wrapped in io.prefetch (sharded over the mesh's
+        data axis when the step has one) so batch N+1's host->HBM copy
+        overlaps batch N's compiled step, and pre-placed shards skip the
+        step's own device_put. An already-constructed DevicePrefetcher is
+        consumed as-is (its placement target wins). Batches may be
+        (x..., label) tuples/lists or a single array. Returns the per-step
+        losses as an NDArray."""
+        from ..io.prefetch import DevicePrefetcher, prefetch_to_device
+        from ..ndarray.ndarray import NDArray
+        it, owned = data_iter, False
+        if not isinstance(it, DevicePrefetcher):
+            it = prefetch_to_device(iter(it), size=prefetch, mesh=self.mesh,
+                                    axis=self.data_axis)
+            owned = True
+        losses = []
+        try:
+            for batch in it:
+                if not isinstance(batch, (tuple, list)):
+                    batch = (batch,)
+                losses.append(self(*batch))
+        finally:
+            if owned:
+                it.close()
+        if not losses:
+            return NDArray(jnp.zeros((0,), jnp.float32))
+        return NDArray(jnp.stack([getattr(l, "_data", l) for l in losses]))
 
     def __call__(self, *batch):
         from ..ndarray import random as _rnd

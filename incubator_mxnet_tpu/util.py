@@ -145,6 +145,10 @@ ENV_VARS = collections.OrderedDict([
     ("MXNET_KVSTORE_FLATPACK_BOUND", EnvSpec(32 << 20, "int",
      "Flat-pack bucket byte cap for kvstore.pushpull_list gradient "
      "aggregation.")),
+    ("MXNET_KVSTORE_BIND_ADDR", EnvSpec("", "str",
+     "Interface the dist_async parameter server binds to; empty (default) "
+     "binds the coordinator-facing interface only — never 0.0.0.0 unless "
+     "set explicitly.")),
     ("MXNET_COMPILE_WARN_THRESHOLD", EnvSpec(8, "int",
      "Compiles of the same jit key after which the profiler warns about "
      "a likely recompile loop.")),

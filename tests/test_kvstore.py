@@ -239,12 +239,16 @@ def test_async_server_roundtrip_and_auth():
         c1.call("push", 0, "w", np.ones(3, np.float32), 0)
         np.testing.assert_allclose(c2.call("pull", 0, "w"), -0.3, rtol=1e-6)
 
-        # wrong token: server closes without replying (never unpickles)
+        # wrong token: the first frame's HMAC fails, so the server closes
+        # without replying (the payload is never unpickled)
         host, port = addr.rsplit(":", 1)
         bad = _socket.create_connection((host, int(port)), timeout=10)
-        bad.sendall(b"x" * len(srv.token))
-        payload = pickle.dumps(("pull", "w"))
-        bad.sendall(struct.pack("<Q", len(payload)) + payload)
+        bad.sendall(b"\x00" * 16)                    # client nonce
+        server_nonce = bad.recv(16)
+        assert len(server_nonce) == 16
+        payload = pickle.dumps(("pull", 0, "w"))
+        mac = b"m" * 32                              # garbage MAC
+        bad.sendall(struct.pack("<Q", len(payload)) + payload + mac)
         bad.settimeout(5)
         try:
             reply = bad.recv(1)
@@ -252,6 +256,53 @@ def test_async_server_roundtrip_and_auth():
             reply = b""                      # RST: also a refusal
         assert reply == b""                  # closed, never a reply frame
         bad.close()
+
+        # wrong token via the real client: channel dies on its first call
+        import secrets as _secrets
+        with pytest.raises((mx.base.MXNetError, ConnectionError, OSError)):
+            evil = AsyncClient(addr, _secrets.token_hex(16))
+            evil.call("pull", 0, "w")
+    finally:
+        srv.stop()
+
+
+def test_trainer_dist_async_batch_size_warning():
+    """gluon.Trainer.step(batch_size) warns (once per baked value) when the
+    batch size differs from the one baked into the optimizer that was
+    serialized to the dist_async server at _init_kvstore time — the server
+    keeps applying the original rescale_grad, so updates are mis-scaled."""
+    import warnings
+
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.kvstore_server import AsyncClient, AsyncServer
+
+    srv = AsyncServer()
+    addr = srv.start()
+    try:
+        kv = kvstore.create("local")
+        kv._async_client = AsyncClient(addr, srv.token)
+        kv._async_gen = 0
+
+        net = gluon.nn.Dense(2, in_units=3)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1}, kvstore=kv)
+        x = mx.nd.ones((4, 3))
+
+        def _one_step(bs):
+            with autograd.record():
+                loss = net(x).sum()
+            loss.backward()
+            trainer.step(bs)
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")   # matching batch size: silent
+            _one_step(4)
+        with pytest.warns(UserWarning, match="dist_async"):
+            _one_step(8)                     # changed mid-run: warn
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")   # but only ONCE per baked value
+            _one_step(16)
     finally:
         srv.stop()
 

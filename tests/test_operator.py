@@ -137,6 +137,31 @@ def test_batchnorm_inference_and_training():
     np.testing.assert_allclose(m.asnumpy(), x.mean(axis=(0, 2, 3)), rtol=1e-4, atol=1e-5)
 
 
+def test_batchnorm_zero_size_batch_training():
+    """0-size batch under autograd.record: the one-pass shifted-variance
+    path sliced [0:1] of an empty reduce axis (a TypeError). The contract
+    is the reference's np-shape semantics: NaN batch stats, no crash, and
+    an output of the input's (empty) shape."""
+    gamma, beta = np.ones(4, np.float32), np.zeros(4, np.float32)
+    mean, var = np.zeros(4, np.float32), np.ones(4, np.float32)
+    x = nd.array(np.zeros((0, 4, 2, 2), np.float32))
+    with autograd.record():
+        out = nd.BatchNorm(x, nd.array(gamma), nd.array(beta),
+                           nd.array(mean), nd.array(var), eps=1e-5)
+    o, m, v = out
+    assert o.shape == (0, 4, 2, 2)
+    assert m.shape == (4,) and v.shape == (4,)
+    assert np.all(np.isnan(m.asnumpy()))        # empty-reduce stats are NaN
+    # the non-empty path is untouched
+    x1 = _rand(2, 4, 2, 2)
+    with autograd.record():
+        o1, m1, _ = nd.BatchNorm(nd.array(x1), nd.array(gamma),
+                                 nd.array(beta), nd.array(mean),
+                                 nd.array(var), eps=1e-5)
+    np.testing.assert_allclose(m1.asnumpy(), x1.mean(axis=(0, 2, 3)),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_layernorm():
     x = _rand(2, 5)
     g, b = np.ones(5, np.float32), np.zeros(5, np.float32)
