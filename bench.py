@@ -44,11 +44,15 @@ def _wait_for_backend(max_wait=None):
     override). The TPU tunnel can be transiently Unavailable — and a bad
     tunnel makes jax.devices() HANG rather than raise, so each probe runs
     in a subprocess with its own timeout; the parent only initializes its
-    backend after a probe has succeeded. Returns the platform string, or
-    None after the deadline (caller emits the null JSON line and a
-    distinct message rather than dying in jax.devices()). The reference's
-    analog is its benchmark loop's resilience to warm-up noise
-    (example/image-classification/benchmark_score.py)."""
+    backend after a probe has succeeded. When the configured accelerator
+    never comes up within the deadline, retries the probe pinned to
+    JAX_PLATFORMS=cpu and continues there — a CPU round with real
+    numbers beats an empty BENCH json (rounds 4-5 published nulls
+    because a dead tunnel zeroed the whole run). Returns the platform
+    string, or None only when even the CPU backend is unusable (caller
+    emits the null JSON line rather than dying in jax.devices()). The
+    reference's analog is its benchmark loop's resilience to warm-up
+    noise (example/image-classification/benchmark_score.py)."""
     import os
     import subprocess
     if max_wait is None:
@@ -64,6 +68,21 @@ def _wait_for_backend(max_wait=None):
         attempt += 1
         remaining = deadline - time.time()
         if remaining <= 0:
+            if os.environ.get("JAX_PLATFORMS") != "cpu":
+                try:
+                    r = subprocess.run(
+                        probe, capture_output=True, text=True, timeout=120,
+                        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+                    for line in r.stdout.splitlines():
+                        if line.startswith("PLATFORM="):
+                            print("[bench] configured backend never came "
+                                  "up; FALLING BACK to JAX_PLATFORMS=cpu "
+                                  "so this round still publishes numbers",
+                                  file=sys.stderr)
+                            os.environ["JAX_PLATFORMS"] = "cpu"
+                            return line.split("=", 1)[1]
+                except (subprocess.TimeoutExpired, OSError):
+                    pass
             return None
         try:
             r = subprocess.run(
@@ -537,6 +556,78 @@ def bench_input_pipeline(steps, batch=32, image_size=64):
     return n / dt_sync, n / dt_pin
 
 
+_COLD_START_SCRIPT = """
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, profiler
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.serve import Predictor
+
+prefix = os.environ["MXTPU_BENCH_ARTIFACT"]
+if sys.argv[1] == "export":
+    net = nn.HybridSequential()
+    for _ in range(6):
+        net.add(nn.Dense(512, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize()
+    net(nd.array(np.zeros((1, 256), np.float32)))
+    net.export(prefix)
+    print(json.dumps({{"ok": True}}))
+else:
+    t0 = time.perf_counter()
+    pred = Predictor.from_artifact(prefix,
+                                   bucket_sizes=(1, 2, 4, 8, 16, 32),
+                                   input_shapes={{"data": (1, 256)}},
+                                   prewarm=True)
+    out = pred.predict({{"data": np.zeros((4, 256), np.float32)}})
+    np.asarray(out[0])
+    ttfp = (time.perf_counter() - t0) * 1e3
+    wall = sum(v["compile_ms"] for v in profiler.compile_stats().values())
+    from incubator_mxnet_tpu import compile_cache as cc
+    s = cc.stats()
+    print(json.dumps({{"ttfp_ms": ttfp, "compile_wall_ms": wall,
+                       "misses": s["misses"], "disk_hits": s["disk_hits"]}}))
+"""
+
+
+def bench_serve_cold_start():
+    """Fleet cold-start row: time-to-first-prediction of a *fresh
+    process* booting a Predictor (construct + prewarm every ladder
+    bucket + one real predict) against a cold vs warm
+    MXNET_EXEC_CACHE_DIR. The warm boot deserializes AOT executables
+    from the shared dir instead of re-tracing (compile_cache.py) — the
+    ">=3x faster TTFP" acceptance criterion of the cold-start
+    milestone. Runs pinned to CPU: the row measures the cache, not the
+    chip, and must produce numbers even when the TPU tunnel is down.
+    Returns (cold, warm) dicts of {ttfp_ms, compile_wall_ms, misses,
+    disk_hits} reported from inside the booting process (interpreter +
+    jax import excluded: those are paid identically either way)."""
+    import os
+    import subprocess
+    import tempfile
+    d = tempfile.mkdtemp(prefix="mxec_bench_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_EXEC_CACHE_DIR=os.path.join(d, "cache"),
+               MXTPU_BENCH_ARTIFACT=os.path.join(d, "model"))
+    script = _COLD_START_SCRIPT.format(
+        repo=os.path.dirname(os.path.abspath(__file__)))
+
+    def run(mode):
+        r = subprocess.run([sys.executable, "-c", script, mode], env=env,
+                           capture_output=True, text=True, timeout=600)
+        if r.returncode != 0:
+            raise RuntimeError(f"cold-start {mode} subprocess failed: "
+                               f"{(r.stderr or '').strip()[-500:]}")
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    run("export")
+    cold = run("boot")
+    warm = run("boot")
+    return cold, warm
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=None,
@@ -684,6 +775,33 @@ def main():
                   file=sys.stderr)
         except Exception as e:
             print(f"[bench] input_pipeline: FAILED {e!r}", file=sys.stderr)
+
+    # cold-start row runs in EVERY mode: it is CPU-pinned (measures the
+    # executable cache, not the chip) and cheap, and it must publish even
+    # on rounds where the accelerator is unreachable
+    try:
+        cold, warm = bench_serve_cold_start()
+        speedup = (cold["ttfp_ms"] / warm["ttfp_ms"]
+                   if warm["ttfp_ms"] else None)
+        results.append({"mode": "serve_cold_start", "batch": 4,
+                        "dtype": "float32",
+                        "cold_ttfp_ms": round(cold["ttfp_ms"], 1),
+                        "warm_ttfp_ms": round(warm["ttfp_ms"], 1),
+                        "cold_compile_wall_ms":
+                            round(cold["compile_wall_ms"], 1),
+                        "warm_compile_wall_ms":
+                            round(warm["compile_wall_ms"], 1),
+                        "warm_misses": warm["misses"],
+                        "warm_disk_hits": warm["disk_hits"],
+                        "speedup": round(speedup, 2) if speedup else None,
+                        "vs_baseline": None})
+        print(f"[bench] serve cold-start (cpu, 4 buckets) TTFP "
+              f"{cold['ttfp_ms']:7.0f} ms cold-dir vs "
+              f"{warm['ttfp_ms']:7.0f} ms warm-dir: {speedup:5.2f}x "
+              f"({warm['disk_hits']} deserialized, "
+              f"{warm['misses']} recompiled)", file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] serve_cold_start: FAILED {e!r}", file=sys.stderr)
 
     if on_tpu:
         try:

@@ -109,6 +109,7 @@ def __getattr__(name):
         "monitor": ".monitor",
         "mon": ".monitor",
         "profiler": ".profiler",
+        "compile_cache": ".compile_cache",
         "runtime": ".runtime",
         "parallel": ".parallel",
         "models": ".models",

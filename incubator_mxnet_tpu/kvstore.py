@@ -147,23 +147,19 @@ def _flat_pack_fn(shapes):
     (mesh broadcast or cross-process all-reduce) — one fabric transfer for
     the whole bucket, the reference's many-tensors-per-server-request
     packing."""
-    import jax
     import jax.numpy as jnp
 
     def pack(*xs):
         return jnp.concatenate([x.reshape(-1) for x in xs])
 
-    from . import profiler as _prof
-    return _prof.track_jit(f"kvstore:flat_pack[{len(shapes)}]",
-                           jax.jit(pack))
+    from . import compile_cache as _cc
+    return _cc.cached_jit(f"kvstore:flat_pack[{len(shapes)}]", pack)
 
 
 @functools.lru_cache(maxsize=64)
 def _flat_unpack_fn(shapes):
     """Jitted inverse of _flat_pack_fn: static slice offsets derived from
     the bucket's shape tuple (part of the cache key)."""
-    import jax
-
     sizes = []
     for s in shapes:
         n = 1
@@ -178,9 +174,8 @@ def _flat_unpack_fn(shapes):
             off += n
         return tuple(outs)
 
-    from . import profiler as _prof
-    return _prof.track_jit(f"kvstore:flat_unpack[{len(shapes)}]",
-                           jax.jit(unpack))
+    from . import compile_cache as _cc
+    return _cc.cached_jit(f"kvstore:flat_unpack[{len(shapes)}]", unpack)
 
 
 class KVStore:

@@ -416,8 +416,6 @@ def _fused_fn(op_name, n, arity, static_items, dyn_keys):
     returns (weight, *states) in exactly that order. Weight/state buffers
     are donated on backends that support donation (grads are NOT donated:
     the autograd buffers are reused by the next backward)."""
-    import jax
-
     donate = _donation_supported()
     key = (op_name, n, arity, static_items, dyn_keys, donate)
     f = _fused_cache.get(key)
@@ -435,15 +433,19 @@ def _fused_fn(op_name, n, arity, static_items, dyn_keys):
             outs.extend(res if isinstance(res, tuple) else (res,))
         return tuple(outs)
 
+    # two-tier executable cache (donation is part of the jit options the
+    # fingerprint covers): reports hit/disk-hit/retrace telemetry and lets
+    # a fresh trainer process deserialize the fused step instead of
+    # recompiling it
+    from .. import compile_cache as _cc
     if donate:
         # flat starts at position 2; within each weight's arity-slot,
         # position 1 is the gradient — everything else is donatable
         argnums = tuple(2 + j for j in range(arity * n) if j % arity != 1)
-        f = jax.jit(fused, donate_argnums=argnums)
+        f = _cc.cached_jit(f"fused:{op_name}[n={n}]", fused,
+                           donate_argnums=argnums)
     else:
-        f = jax.jit(fused)
-    from .. import profiler as _prof
-    f = _prof.track_jit(f"fused:{op_name}[n={n}]", f)
+        f = _cc.cached_jit(f"fused:{op_name}[n={n}]", fused)
     if len(_fused_cache) >= _FUSED_CACHE_MAX:
         _fused_cache.pop(next(iter(_fused_cache)))
     _fused_cache[key] = f

@@ -115,8 +115,10 @@ class OpDef:
                 out, vjp_fn = jax.vjp(fwd, *primals)
                 return vjp_fn(_match_ct_dtypes(cts, out))
 
-            from .. import profiler as _prof
-            f = _prof.track_jit(f"op:{self.name}:vjp", jax.jit(bwd))
+            # two-tier executable cache: reports hit/disk-hit/retrace to
+            # the profiler's jit tracker and AOT-persists the executable
+            from .. import compile_cache as _cc
+            f = _cc.cached_jit(f"op:{self.name}:vjp", bwd)
             self._cache_put(key, f)
         return f
 
@@ -131,23 +133,24 @@ class OpDef:
         Stateful ops receive the PRNG key as a traced leading argument so the
         jit cache is keyed on params only, never on key values.
         """
-        import jax
         key = _hashable(params)
         f = self._jit_cache.get(key)
         if f is None:
+            # two-tier executable cache: every call through it reports
+            # hit/disk-hit/recompile to the profiler's jit tracker, and the
+            # compiled executable persists across processes when
+            # MXNET_EXEC_CACHE_DIR is set
+            from .. import compile_cache as _cc
             if self.stateful:
                 base = self.fn
 
                 def f_rng(rng, *arrs, _base=base, _params=params):
                     return _base(*arrs, rng=rng, **_params)
 
-                f = jax.jit(f_rng)
+                f = _cc.cached_jit(f"op:{self.name}", f_rng)
             else:
-                f = jax.jit(functools.partial(self.fn, **params))
-            # compile telemetry: every call through the cached executable
-            # reports hit/recompile to the profiler's jit tracker
-            from .. import profiler as _prof
-            f = _prof.track_jit(f"op:{self.name}", f)
+                f = _cc.cached_jit(f"op:{self.name}",
+                                   functools.partial(self.fn, **params))
             self._cache_put(key, f)
         return f
 

@@ -50,6 +50,18 @@ __all__ = ["conv3x3_bwd_fused", "fused_eligible", "conv3x3_custom"]
 _ACC = jnp.float32
 
 
+def _compiler_params(pltpu):
+    # the params class has been renamed across jax releases
+    # (CompilerParams <-> TPUCompilerParams); accept either and degrade
+    # to backend defaults when neither fits
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams", None)
+    try:
+        return cls(dimension_semantics=("arbitrary",))
+    except TypeError:
+        return None
+
+
 def _interpret():
     return jax.default_backend() != "tpu"
 
@@ -169,10 +181,7 @@ def _patch_nhwc(x, go, w_hwio, bn):
             else lax.Precision.HIGHEST)
     kern = functools.partial(_patch_kernel, bn=bn, h=h, w_sp=w_sp,
                              ci=ci, co=co, prec=prec)
-    try:
-        params = pltpu.CompilerParams(dimension_semantics=("arbitrary",))
-    except TypeError:
-        params = None
+    params = _compiler_params(pltpu)
     dx, dw = pl.pallas_call(
         kern,
         grid=grid,
@@ -215,10 +224,7 @@ def _bwd_nhwc(x, go, w_hwio, bn):
             else lax.Precision.HIGHEST)
     kern = functools.partial(_bwd_kernel, bn=bn, h=h, w_sp=w_sp,
                              ci=ci, co=co, prec=prec)
-    try:
-        params = pltpu.CompilerParams(dimension_semantics=("arbitrary",))
-    except TypeError:
-        params = None
+    params = _compiler_params(pltpu)
     dx, dw = pl.pallas_call(
         kern,
         grid=grid,

@@ -135,9 +135,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
 
 def _compiler_params():
     from jax.experimental.pallas import tpu as pltpu
+    # the params class has been renamed across jax releases
+    # (CompilerParams <-> TPUCompilerParams); accept either and degrade
+    # to backend defaults when neither fits
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams", None)
     try:
-        return pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        return cls(dimension_semantics=("parallel", "parallel", "arbitrary"))
     except TypeError:
         return None
 

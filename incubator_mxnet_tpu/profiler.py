@@ -252,7 +252,10 @@ def _counter_sample_locked(name, value):
 # ---------------------------------------------------------------------------
 
 _clock = threading.Lock()
-_compile = {}            # key -> [hits, misses, compile_ms_total, last_ms]
+# key -> [hits, misses, compile_ms_total, last_ms, disk_hits]; disk_hits
+# counts the subset of hits served by deserializing a persistent-cache
+# entry (compile_cache disk tier) rather than reusing an in-process one
+_compile = {}
 _compile_warned = set()
 
 
@@ -261,13 +264,16 @@ def _warn_threshold():
     return getenv_int("MXNET_COMPILE_WARN_THRESHOLD")
 
 
-def compile_event(key, cache_hit, compile_ms=0.0):
+def compile_event(key, cache_hit, compile_ms=0.0, disk=False):
     """Record one lookup against a compiled-executable cache.
 
     key:       stable cache identity ("op:dot", "fused:adam_update[n=4]",
                "kvstore:flat_pack[13]", "serve:exec[8x6]", ...)
     cache_hit: True when an already-compiled executable served the call
     compile_ms: trace+compile wall time charged to a miss
+    disk:      the hit deserialized a persistent compile_cache entry (a
+               fresh process avoiding an XLA retrace) rather than reusing
+               an executable already loaded in this process
 
     Always-on (independent of start/stop): recompile pathologies are
     exactly the thing you need visibility into *before* deciding to
@@ -283,9 +289,11 @@ def compile_event(key, cache_hit, compile_ms=0.0):
     with _clock:
         rec = _compile.get(key)
         if rec is None:
-            rec = _compile[key] = [0, 0, 0.0, 0.0]
+            rec = _compile[key] = [0, 0, 0.0, 0.0, 0]
         if cache_hit:
             rec[0] += 1
+            if disk:
+                rec[4] += 1
         else:
             rec[1] += 1
             rec[2] += float(compile_ms)
@@ -302,10 +310,12 @@ def compile_event(key, cache_hit, compile_ms=0.0):
 
 
 def compile_stats():
-    """Snapshot {key: {hits, misses, compile_ms, last_compile_ms}}."""
+    """Snapshot {key: {hits, misses, compile_ms, last_compile_ms,
+    disk_hits}} (disk_hits <= hits: persistent-cache deserializes)."""
     with _clock:
         return {k: {"hits": v[0], "misses": v[1],
-                    "compile_ms": v[2], "last_compile_ms": v[3]}
+                    "compile_ms": v[2], "last_compile_ms": v[3],
+                    "disk_hits": v[4]}
                 for k, v in _compile.items()}
 
 
@@ -330,7 +340,11 @@ def track_jit(key, fn):
     don't expose a cache size (older jax, non-jit callables).
     """
     probe = getattr(fn, "_cache_size", None)
+    # first-call detection must be atomic: concurrent first calls would
+    # otherwise both read called=False and both record a miss (the CC01
+    # unlocked read-modify-write pattern mxlint polices)
     state = {"called": False}
+    state_lock = threading.Lock()
 
     def wrapped(*args, **kwargs):
         before = None
@@ -349,8 +363,9 @@ def track_jit(key, fn):
             except Exception:       # noqa: BLE001
                 after = None
         if before is None or after is None:
-            first = not state["called"]
-            state["called"] = True
+            with state_lock:
+                first = not state["called"]
+                state["called"] = True
             compile_event(key, cache_hit=not first,
                           compile_ms=dt_ms if first else 0.0)
         elif after > before:
@@ -540,6 +555,20 @@ def _reset_memory_locked():
         _mem["frees"] = 0
 
 
+def _exec_cache_stats(always=False):
+    """Aggregate counters of the two-tier executable cache
+    (compile_cache.stats()), or None when it has seen no traffic (unless
+    `always`) — keeps dumps() noise-free for sessions that never jit."""
+    try:
+        from . import compile_cache as _cc
+        snap = _cc.stats()
+    except Exception:       # noqa: BLE001 — torn-down interpreter, no jax
+        return None
+    if not always and not any(snap.values()):
+        return None
+    return snap
+
+
 # ---------------------------------------------------------------------------
 # dump / dumps
 # ---------------------------------------------------------------------------
@@ -666,6 +695,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             _compile.clear()
             _compile_warned.clear()
         _reset_memory_locked()
+    exec_cache = _exec_cache_stats()
     if format == "json":
         out = {
             "stats": {k: {"count": v[0], "total_us": _finite(v[1], 0.0),
@@ -675,6 +705,8 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
                          for k, (c, v) in cagg.items()},
             "compile": comp,
         }
+        if exec_cache is not None:
+            out["exec_cache"] = exec_cache
         if mem is not None:
             out["memory"] = {"live_bytes": mem["live_bytes"],
                              "peak_bytes": mem["peak_bytes"],
@@ -698,12 +730,19 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             sval = f"{val:.3f}" if isinstance(val, float) else f"{val}"
             lines.append(f"{name:<48}{cnt:>10}{sval:>16}")
     if comp:
-        lines += ["", f"{'Compile cache':<48}{'Hits':>8}{'Misses':>8}"
-                      f"{'Compile(ms)':>14}",
-                  "-" * 78]
+        lines += ["", f"{'Compile cache':<48}{'Hits':>8}{'Disk':>8}"
+                      f"{'Misses':>8}{'Compile(ms)':>14}",
+                  "-" * 86]
         for name, rec in sorted(comp.items()):
-            lines.append(f"{name:<48}{rec['hits']:>8}{rec['misses']:>8}"
+            lines.append(f"{name:<48}{rec['hits']:>8}"
+                         f"{rec.get('disk_hits', 0):>8}{rec['misses']:>8}"
                          f"{rec['compile_ms']:>14.1f}")
+    if exec_cache is not None:
+        lines += ["", f"{'Executable cache (two-tier)':<34}{'Value':>12}",
+                  "-" * 46]
+        for k in ("hits", "misses", "disk_hits", "evictions", "bytes",
+                  "disk_errors", "fallbacks", "mem_entries"):
+            lines.append(f"{'exec_cache_' + k:<34}{exec_cache[k]:>12}")
     if mem is not None and (mem["live_bytes"] or mem["peak_bytes"]):
         lines += ["", f"{'Memory (device)':<48}{'Live(bytes)':>14}"
                       f"{'Peak(bytes)':>14}",
@@ -785,6 +824,15 @@ def render_prometheus():
             lines.append(
                 f'mxnet_compile_cache_misses_total'
                 f'{{key="{_prom_label(name)}"}} {comp[name]["misses"]}')
+        family("mxnet_compile_cache_disk_hits_total", "counter",
+               "persistent-cache deserialize hits per jit cache key "
+               "(hits that a cold process would otherwise pay as "
+               "recompiles)")
+        for name in sorted(comp):
+            lines.append(
+                f'mxnet_compile_cache_disk_hits_total'
+                f'{{key="{_prom_label(name)}"}} '
+                f'{comp[name].get("disk_hits", 0)}')
         family("mxnet_compile_time_ms_total", "counter",
                "wall-clock ms spent tracing+compiling per jit cache key")
         for name in sorted(comp):
@@ -792,6 +840,24 @@ def render_prometheus():
                 f'mxnet_compile_time_ms_total'
                 f'{{key="{_prom_label(name)}"}} '
                 f'{comp[name]["compile_ms"]:.3f}')
+
+    ec = _exec_cache_stats(always=True)
+    if ec is not None:
+        _EC_FAMILIES = (
+            ("hits", "counter", "exec-cache memory-tier hits"),
+            ("misses", "counter", "exec-cache XLA trace+compiles"),
+            ("disk_hits", "counter",
+             "exec-cache persistent-tier deserialize hits"),
+            ("evictions", "counter",
+             "exec-cache LRU + disk-budget evictions"),
+            ("bytes", "gauge", "exec-cache disk occupancy in bytes"),
+            ("entries", "gauge", "exec-cache in-memory executables"),
+        )
+        for stat, mtype, help_text in _EC_FAMILIES:
+            value = ec["mem_entries"] if stat == "entries" else ec[stat]
+            suffix = "_total" if mtype == "counter" else ""
+            family(f"mxnet_exec_cache_{stat}{suffix}", mtype, help_text)
+            lines.append(f"mxnet_exec_cache_{stat}{suffix} {value}")
 
     _drain_frees()
     with _mlock:

@@ -13,8 +13,13 @@ parameters held on device and passed as traced arguments. Shape discipline
 is the serving-critical part (Ragged Paged Attention, arXiv:2604.15464:
 TPU serving wins come from a SMALL FIXED set of compiled bucket shapes):
 a `bucket_sizes` ladder pads every batch up to the next bucket, so the
-executable count is bounded by the ladder length — never by traffic — and
-`max_executables` hard-fails instead of silently compiling per shape.
+executable count is bounded by the ladder length — never by traffic.
+Executables live in the process-wide two-tier cache (`compile_cache`):
+`max_executables` is advisory — crossing it warns about unbucketed
+traffic, and eviction is owned by the unified LRU
+(`MXNET_EXEC_CACHE_SIZE`). With `MXNET_EXEC_CACHE_DIR` set, `warmup()`
+(or `prewarm=True`) deserializes every bucket's executable ahead of first
+traffic, so a fleet replica cold-starts without a single XLA retrace.
 """
 from __future__ import annotations
 
@@ -79,7 +84,7 @@ class Predictor:
 
     def __init__(self, symbol, params=None, input_shapes=None, ctx=None,
                  bucket_sizes=(1, 2, 4, 8, 16, 32), max_executables=None,
-                 batch_axis=0):
+                 batch_axis=0, prewarm=False):
         from .. import symbol as _sym
         from .. import nd
 
@@ -138,16 +143,21 @@ class Predictor:
 
         self.ladder = (BucketLadder(bucket_sizes)
                        if bucket_sizes is not None else None)
-        # default cap: one executable per bucket, or 16 for free-shape use
+        # advisory bound: one executable per bucket, or 16 for free-shape
+        # use — crossing it warns (unbucketed-traffic bug) but no longer
+        # hard-fails; the unified exec-cache LRU owns eviction
         self._max_executables = (max_executables if max_executables
                                  else (len(self.ladder) if self.ladder
                                        else 16))
         self._batch_axis = batch_axis
         self._executables = {}
+        self._cap_warned = False
         self._compile_lock = threading.Lock()
         self._run = self._sym._build_eval(training=False)
         self._inputs = {}
         self._outputs = None
+        if prewarm:
+            self.warmup()
 
     # ------------------------------------------------------------------
     # compiled-executable management
@@ -168,13 +178,21 @@ class Predictor:
             fn = self._executables.get(sig)
             if fn is not None:
                 return fn
-            if len(self._executables) >= self._max_executables:
-                raise MXNetError(
-                    f"predictor executable cache full "
-                    f"({self._max_executables}): refusing to compile for "
-                    f"signature {sig} — serving must stay within the "
-                    f"bucket ladder {self.ladder}")
-            import jax
+            if len(self._executables) >= self._max_executables and \
+                    not self._cap_warned:
+                # pre-unification this was a hard MXNetError; the unified
+                # LRU makes an over-ladder signature cost one compile +
+                # one eviction instead of an outage, but it is still the
+                # unbucketed-traffic bug — say so once
+                self._cap_warned = True
+                import logging
+                logging.warning(
+                    "predictor: %d executable signatures exceed the "
+                    "advisory cap %d (ladder %s) — traffic is compiling "
+                    "outside the bucket ladder; the shared exec-cache "
+                    "LRU (MXNET_EXEC_CACHE_SIZE) now owns eviction",
+                    len(self._executables) + 1, self._max_executables,
+                    self.ladder)
 
             run = self._run
 
@@ -182,12 +200,64 @@ class Predictor:
                 outs, _ = run({**param_vals, **input_vals})
                 return tuple(outs)
 
-            from .. import profiler as _prof
+            from .. import compile_cache as _cc
             shapes = ",".join("x".join(map(str, shape))
                               for _, shape, _ in sig)
-            fn = _prof.track_jit(f"serve:exec[{shapes}]", jax.jit(call))
+            fn = _cc.cached_jit(f"serve:exec[{shapes}]", call)
             self._executables[sig] = fn
             return fn
+
+    def warmup(self, input_shapes=None, dtypes=None):
+        """AOT pre-warm: materialize one executable per ladder bucket
+        BEFORE first traffic, from abstract `jax.ShapeDtypeStruct` avals
+        (no example batch needed). With a warm `MXNET_EXEC_CACHE_DIR`
+        every bucket deserializes instead of compiling, so a fleet
+        replica reaches first-prediction in milliseconds.
+
+        input_shapes: per-input full shapes (batch axis value is ignored
+            and swept over the ladder); defaults to the shapes declared
+            at construction. dtypes: per-input dtype map or one dtype
+            string for all inputs (default float32).
+
+        Returns {bucket_size: "hit" | "disk" | "miss"} — a warm fleet
+        sees "disk" everywhere."""
+        import jax
+        import jax.numpy as jnp
+
+        shapes = dict(self._input_shapes)
+        if input_shapes:
+            shapes.update({k: tuple(v)
+                           for k, v in dict(input_shapes).items()})
+        missing = [k for k in self._input_names if not shapes.get(k)]
+        if missing:
+            raise MXNetError(
+                f"warmup needs full input shapes for {missing}; declare "
+                f"input_shapes at construction or pass them here")
+        if dtypes is None:
+            dtypes = {}
+        elif isinstance(dtypes, str):
+            dtypes = {k: dtypes for k in self._input_names}
+        buckets = self.ladder.sizes if self.ladder else \
+            tuple(sorted({shapes[k][self._batch_axis]
+                          for k in self._input_names}))
+        out = {}
+        for b in buckets:
+            avals = {}
+            for name in self._input_names:
+                shp = list(shapes[name])
+                if len(shp) <= self._batch_axis:
+                    raise MXNetError(
+                        f"input {name!r} shape {tuple(shp)} has no batch "
+                        f"axis {self._batch_axis}")
+                if self.ladder is not None:
+                    shp[self._batch_axis] = b
+                dt = jnp.dtype(dtypes.get(name, "float32"))
+                avals[name] = jax.ShapeDtypeStruct(tuple(shp), dt)
+            sig = tuple((name, tuple(a.shape), str(a.dtype))
+                        for name, a in sorted(avals.items()))
+            fn = self._executable_for(sig)
+            out[b] = fn.warmup(self._param_vals, avals)
+        return out
 
     def _pad_batch(self, arrays):
         """Pad dict of batched host/device arrays up the bucket ladder.

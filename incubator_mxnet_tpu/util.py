@@ -152,6 +152,24 @@ ENV_VARS = collections.OrderedDict([
     ("MXNET_COMPILE_WARN_THRESHOLD", EnvSpec(8, "int",
      "Compiles of the same jit key after which the profiler warns about "
      "a likely recompile loop.")),
+    ("MXNET_EXEC_CACHE_DIR", EnvSpec("", "str",
+     "Persistent executable-cache directory (compile_cache.py): AOT-"
+     "compiled XLA executables from the four tracked jit choke points "
+     "(op registry, fused optimizer, kvstore flat-pack, serving) are "
+     "serialized here and deserialized by later processes, so a fleet "
+     "replica cold-starts without recompiling. Empty (default) disables "
+     "the disk tier; the in-memory LRU is always on. Distinct from "
+     "MXTPU_COMPILE_CACHE (jax's own compilation cache, which still "
+     "pays tracing+lowering per process).")),
+    ("MXNET_EXEC_CACHE_SIZE", EnvSpec(1024, "int",
+     "Entry capacity of the process-wide in-memory executable LRU shared "
+     "by all compile_cache.cached_jit call sites; replaces serve's "
+     "per-predictor hard executable cap and the per-op FIFO memos as THE "
+     "eviction policy.")),
+    ("MXNET_EXEC_CACHE_DISK_BYTES", EnvSpec(2 << 30, "int",
+     "Byte budget for MXNET_EXEC_CACHE_DIR; after a write pushes "
+     "occupancy past it, oldest entries (mtime order) are evicted. "
+     "<=0 disables the bound.")),
     ("MXNET_HOME", EnvSpec("~/.mxnet", "str",
      "Data directory for downloaded model-zoo parameter files.")),
     ("MXNET_GLUON_REPO", EnvSpec(

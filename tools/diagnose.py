@@ -68,6 +68,27 @@ def main():
     except Exception as e:
         print("ENV_VARS table FAILED:", e)
 
+    print("----------Executable Cache (compile_cache)----------")
+    try:
+        from incubator_mxnet_tpu import compile_cache
+        ds = compile_cache.disk_stats()
+        if ds["dir"] is None:
+            print("disk tier    : disabled (MXNET_EXEC_CACHE_DIR unset)")
+        else:
+            budget = ds["budget"]
+            pct = (f" ({100.0 * ds['bytes'] / budget:.1f}% of "
+                   f"{budget} budget)") if budget > 0 else " (unbounded)"
+            print("dir          :", ds["dir"])
+            print("entries      :", ds["entries"])
+            print(f"occupancy    : {ds['bytes']} bytes{pct}")
+        s = compile_cache.stats()
+        print("mem entries  :", s["mem_entries"])
+        print("counters     :",
+              {k: s[k] for k in ("hits", "misses", "disk_hits",
+                                 "evictions", "disk_errors", "fallbacks")})
+    except Exception as e:
+        print("compile_cache probe FAILED:", e)
+
     print("----------Static Analysis (mxlint)----------")
     try:
         from tools.mxlint import lint_paths

@@ -18,9 +18,14 @@ then enforces the same way.
 """
 
 LOCK_ORDER = {
-    # profiler: event/counter lock, compile-tracker clock, memory book.
+    # profiler: event/counter lock, compile-tracker clock, memory book,
+    # and track_jit's per-wrapper first-call latch.
     # PR 3's GC deadlock came precisely from violating this file's order.
-    "profiler.py": ("_lock", "_clock", "_mlock"),
+    "profiler.py": ("_lock", "_clock", "_mlock", "state_lock"),
+    # compile_cache: per-wrapper single-flight compile lock outermost
+    # (disk/LRU/counter updates nest under it), per-wrapper sig memo and
+    # the module LRU+counter lock are leaves.
+    "compile_cache.py": ("self._compile_lock", "self._lock", "_lock"),
     "serve/batcher.py": ("self._lock",),
     "serve/stats.py": ("self._lock",),
     "serve/predictor.py": ("self._compile_lock",),

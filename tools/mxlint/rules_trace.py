@@ -100,6 +100,20 @@ def _track_jit_names(mod):
     return chains
 
 
+def _cached_jit_names(mod):
+    """Spellings of compile_cache.cached_jit: the two-tier executable
+    cache wraps a traced callable exactly like track_jit(key, fn) does
+    (arg index 1), so its call sites keep full trace-safety coverage."""
+    chains = set(mod.from_import_names("cached_jit"))
+    for local, modpath in mod.import_aliases.items():
+        if modpath.split(".")[-1] == "compile_cache":
+            chains.add(local + ".cached_jit")
+    for local, (src, orig) in mod.from_imports.items():
+        if orig == "compile_cache":
+            chains.add(local + ".cached_jit")
+    return chains
+
+
 def _register_names(mod):
     """Spellings of ops.registry.register (from-imports only; every
     in-tree user does `from .registry import register`)."""
@@ -131,7 +145,7 @@ def discover_traced(mod):
             found[id(node)] = TracedFn(node, kind, _positional_params(node))
 
     jit_chains = _jit_names(mod)
-    track_chains = _track_jit_names(mod)
+    track_chains = _track_jit_names(mod) | _cached_jit_names(mod)
     reg_names = _register_names(mod)
     fn_table = _local_functions(mod.tree)
 
