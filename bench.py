@@ -556,6 +556,53 @@ def bench_input_pipeline(steps, batch=32, image_size=64):
     return n / dt_sync, n / dt_pin
 
 
+def bench_fused_block(steps, batch=16, image_size=64):
+    """Fused residual-block row: the same ResNet-18 train loop with the
+    gluon fused path on (MXTPU_FUSED_BLOCK=1 — blocks lower through the
+    autotuned FusedConvBNReLU / FusedBNAddReLU ops) vs off (the
+    layer-by-layer Conv/BatchNorm/relu oracle). Off-TPU the tuner's
+    candidate sets are empty and both sides run the identical XLA
+    composition, so this row only separates on a real accelerator.
+    Returns (fused_img_s, unfused_img_s)."""
+    import os
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.parallel import TrainStep
+
+    def loss_fn(out, label):
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, label[:, None], axis=1))
+
+    rs = np.random.RandomState(0)
+    xh = rs.randn(batch, 3, image_size, image_size).astype(np.float32)
+    x = jnp.asarray(xh)
+    y = jnp.asarray(rs.randint(0, 100, batch).astype(np.int32))
+    _sync(x), _sync(y)
+
+    def run_one(fused):
+        prev = os.environ.get("MXTPU_FUSED_BLOCK")
+        os.environ["MXTPU_FUSED_BLOCK"] = "1" if fused else "0"
+        try:
+            net = vision.resnet18_v1(classes=100)
+            net.initialize(mx.init.Xavier())
+            step = TrainStep(net, loss_fn, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.01,
+                                               "momentum": 0.9},
+                             example_inputs=[mx.nd.array(xh)])
+            _sync(step.run_steps(steps, x, y))      # compile + warmup
+            dt = _time_best(lambda: _sync(step.run_steps(steps, x, y)))
+        finally:
+            if prev is None:
+                os.environ.pop("MXTPU_FUSED_BLOCK", None)
+            else:
+                os.environ["MXTPU_FUSED_BLOCK"] = prev
+        return batch * steps / dt
+
+    return run_one(True), run_one(False)
+
+
 _COLD_START_SCRIPT = """
 import json, os, sys, time
 import numpy as np
@@ -775,6 +822,20 @@ def main():
                   file=sys.stderr)
         except Exception as e:
             print(f"[bench] input_pipeline: FAILED {e!r}", file=sys.stderr)
+        try:
+            fb_f, fb_u = bench_fused_block(steps_for("train", "float32"))
+            results.append({"mode": "fused_block_train", "batch": 16,
+                            "dtype": "float32",
+                            "fused_img_per_sec": round(fb_f, 2),
+                            "unfused_img_per_sec": round(fb_u, 2),
+                            "speedup": round(fb_f / fb_u, 3)
+                            if fb_u else None,
+                            "vs_baseline": None})
+            print(f"[bench] fused block train (resnet18, b16) "
+                  f"{fb_f:9.2f} img/s fused vs {fb_u:9.2f} unfused: "
+                  f"{fb_f / fb_u:5.2f}x", file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] fused_block: FAILED {e!r}", file=sys.stderr)
 
     # cold-start row runs in EVERY mode: it is CPU-pinned (measures the
     # executable cache, not the chip) and cheap, and it must publish even
@@ -829,6 +890,17 @@ def main():
         except Exception as e:
             print(f"[bench] transformer longctx: FAILED {e!r}",
                   file=sys.stderr)
+
+    try:
+        from incubator_mxnet_tpu import tune as _tune
+        ts = _tune.stats()
+        if any(ts.values()):
+            results.append(dict({"mode": "tune_stats"}, **ts))
+            print("[bench] tune: " +
+                  " ".join(f"{k}={v}" for k, v in sorted(ts.items())),
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] tune stats: FAILED {e!r}", file=sys.stderr)
 
     print(f"[bench] device: {kind} ({platform}), timed steps: "
           f"{args.steps or 'per-config'}", file=sys.stderr)

@@ -13,7 +13,10 @@ the API and XLA:TPU re-lays out internally, so no NHWC shim is needed.
 from __future__ import annotations
 
 from ....base import MXNetError
+from ....util import getenv_bool
+from .... import autograd, nd
 from ...block import HybridBlock
+from ...parameter import DeferredInitializationError
 from ... import nn
 
 __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
@@ -21,6 +24,36 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
            "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
            "get_resnet"]
+
+_BN_PARAMS = ("gamma", "beta", "running_mean", "running_var")
+
+
+def _fused_blocks(F):
+    """Route residual units through the fused conv+BN(+add)+ReLU ops?
+    Only on the nd path (the symbolic executor owns its own BatchNorm aux
+    wiring) and behind MXTPU_FUSED_BLOCK — off restores the
+    layer-by-layer oracle composition."""
+    return F is nd and getenv_bool("MXTPU_FUSED_BLOCK")
+
+
+def _layer_args(layer, probe, names):
+    """Parameter NDArrays of a child layer, finishing deferred init from
+    `probe` (the layer's input, or a shape-only stand-in) when needed —
+    the same recovery _eager_forward performs for a normal child call."""
+    try:
+        return [getattr(layer, n).data() for n in names]
+    except DeferredInitializationError:
+        layer._finish_deferred(probe)
+        return [getattr(layer, n).data() for n in names]
+
+
+class _Shape:
+    """Shape-only stand-in for infer_shape() when the fused inference
+    path never materializes the intermediate activation (BatchNorm's
+    infer_shape reads only x.shape[axis])."""
+
+    def __init__(self, shape):
+        self.shape = shape
 
 # depth -> (unit kind, per-stage unit counts); stage base widths are fixed
 _SPECS = {
@@ -68,7 +101,51 @@ class _ResUnit(HybridBlock):
         self.shortcut_norm = (nn.BatchNorm()
                               if project and not preact else None)
 
+    def _fused_bn_act(self, F, norm, z, residual):
+        """FusedBNAddReLU through a BatchNorm child, plus the running-stat
+        update the layer would have done (mirrors nn.BatchNorm
+        hybrid_forward exactly, including the autograd.pause)."""
+        training = autograd.is_training() and not norm._use_global_stats
+        gamma, beta, rm, rv = _layer_args(norm, z, _BN_PARAMS)
+        args = ((z, gamma, beta, rm, rv) if residual is None
+                else (z, gamma, beta, rm, rv, residual))
+        out, mean, var = F.FusedBNAddReLU(
+            *args, eps=norm._epsilon, momentum=norm._momentum,
+            fix_gamma=not norm._scale,
+            use_global_stats=norm._use_global_stats, axis=norm._axis,
+            training=training)
+        if training:
+            with autograd.pause():
+                m = norm._momentum
+                norm.running_mean.set_data(rm * m + mean * (1 - m))
+                norm.running_var.set_data(rv * m + var * (1 - m))
+        return out
+
+    def _fused_unit(self, F, conv, norm, x, residual):
+        """One conv->bn(->add)->relu leg through the fused ops. Training
+        materializes the conv output (the batch statistics need it; the
+        op fuses the epilogue); inference folds the whole chain into one
+        autotuned fused-forward call."""
+        training = autograd.is_training() and not norm._use_global_stats
+        if training:
+            return self._fused_bn_act(F, norm, conv(x), residual)
+        (weight,) = _layer_args(conv, x, ("weight",))
+        gamma, beta, rm, rv = _layer_args(
+            norm, _Shape((0, conv._channels, 0, 0)), _BN_PARAMS)
+        args = ((x, weight, gamma, beta, rm, rv) if residual is None
+                else (x, weight, gamma, beta, rm, rv, residual))
+        out, _mean, _var = F.FusedConvBNReLU(
+            *args, kernel=conv._kernel, stride=conv._strides,
+            dilate=conv._dilation, pad=conv._padding,
+            num_filter=conv._channels, num_group=conv._groups,
+            eps=norm._epsilon, momentum=norm._momentum,
+            fix_gamma=not norm._scale,
+            use_global_stats=norm._use_global_stats, training=False)
+        return out
+
     def _forward_v1(self, F, x):
+        if _fused_blocks(F):
+            return self._forward_v1_fused(F, x)
         y = x
         n = len(self.convs)
         for i, (conv, norm) in enumerate(zip(self.convs, self.norms)):
@@ -80,14 +157,30 @@ class _ResUnit(HybridBlock):
             s = self.shortcut_norm(self.shortcut(s))
         return F.relu(y + s)
 
+    def _forward_v1_fused(self, F, x):
+        # the projection shortcut stays a child-layer call: its BatchNorm
+        # apply already dispatches through the tuned epilogue table
+        s = x
+        if self.shortcut is not None:
+            s = self.shortcut_norm(self.shortcut(s))
+        y = x
+        n = len(self.convs)
+        for i, (conv, norm) in enumerate(zip(self.convs, self.norms)):
+            y = self._fused_unit(F, conv, norm, y,
+                                 s if i == n - 1 else None)
+        return y
+
     def _forward_v2(self, F, x):
         convs = list(self.convs)
         norms = list(self.norms)
-        y = F.relu(norms[0](x))
+        fused = _fused_blocks(F)
+        y = (self._fused_bn_act(F, norms[0], x, None) if fused
+             else F.relu(norms[0](x)))
         s = self.shortcut(y) if self.shortcut is not None else x
         y = convs[0](y)
         for conv, norm in zip(convs[1:], norms[1:]):
-            y = conv(F.relu(norm(y)))
+            y = conv(self._fused_bn_act(F, norm, y, None) if fused
+                     else F.relu(norm(y)))
         return y + s
 
     def hybrid_forward(self, F, x):

@@ -14,6 +14,7 @@ from __future__ import annotations
 import numpy as _np
 
 from ..base import MXNetError, dtype_np
+from .. import tune
 from .registry import register
 
 import jax
@@ -62,6 +63,23 @@ def _tup(v, n, default):
     return tuple(int(x) for x in v)
 
 
+def _stem_s2d_parts(data, weight, k):
+    """The space-to-depth input/weight transforms plus the equivalent
+    stride-1 conv geometry (m, pad lo/hi), shared by _stem_s2d_conv and
+    the fused conv+BN+ReLU inference path."""
+    n, c, h, w = data.shape
+    x = data.reshape(n, c, h // 2, 2, w // 2, 2)
+    x = x.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * 4, h // 2, w // 2)
+    o = weight.shape[0]
+    m = (k + 1) // 2
+    wp = jnp.pad(weight, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    wp = wp.reshape(o, c, m, 2, m, 2)
+    wp = wp.transpose(0, 1, 3, 5, 2, 4).reshape(o, c * 4, m, m)
+    lo = (k // 2 + 1) // 2
+    hi = (k - k // 2 - 2) // 2
+    return x, wp, m, lo, hi
+
+
 def _stem_s2d_conv(data, weight, k):
     """Space-to-depth rewrite of a k x k stride-2 'same' conv on a skinny
     channel input (the ResNet/Inception stem shape): 2x2 space-to-depth on
@@ -73,22 +91,23 @@ def _stem_s2d_conv(data, weight, k):
     — with a C_in=12 stride-1 conv XLA tiles far better. Exact only for
     k % 4 == 3 (pad k//2 odd), stride 2, dilation 1, groups 1, even H/W.
     """
-    n, c, h, w = data.shape
-    x = data.reshape(n, c, h // 2, 2, w // 2, 2)
-    x = x.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * 4, h // 2, w // 2)
-    o = weight.shape[0]
-    m = (k + 1) // 2
-    wp = jnp.pad(weight, ((0, 0), (0, 0), (1, 0), (1, 0)))
-    wp = wp.reshape(o, c, m, 2, m, 2)
-    wp = wp.transpose(0, 1, 3, 5, 2, 4).reshape(o, c * 4, m, m)
-    lo = (k // 2 + 1) // 2
-    hi = (k - k // 2 - 2) // 2
+    x, wp, _, lo, hi = _stem_s2d_parts(data, weight, k)
     dn = _conv_dnums(2)
     return lax.conv_general_dilated(
         x, wp, window_strides=(1, 1), padding=[(lo, hi), (lo, hi)],
         dimension_numbers=dn,
         preferred_element_type=jnp.float32 if data.dtype == jnp.float32
         else None)
+
+
+def _stem_eligible(data, kernel, stride, dilate, pad, num_group):
+    """The _stem_s2d_conv exactness conditions (see its docstring)."""
+    return (len(kernel) == 2 and num_group == 1 and stride == (2, 2)
+            and dilate == (1, 1) and kernel[0] == kernel[1]
+            and kernel[0] % 4 == 3 and pad == (kernel[0] // 2,) * 2
+            and data.ndim == 4 and data.shape[1] <= 8
+            and data.shape[2] % 2 == 0 and data.shape[3] % 2 == 0
+            and jax.default_backend() == "tpu")
 
 
 def _conv_xla(data, weight, kernel, stride, dilate, pad, num_group):
@@ -106,6 +125,27 @@ def _conv_xla(data, weight, kernel, stride, dilate, pad, num_group):
         else None)
 
 
+def _conv3x3_xla(data, weight):
+    """The plain-XLA candidate the tuned 3x3 table races against."""
+    return _conv_xla(data, weight, (3, 3), (1, 1), (1, 1), (1, 1), 1)
+
+
+def _conv_core(data, weight, kernel, stride, dilate, pad, num_group):
+    """Convolution dispatch shared by the Convolution op and the fused
+    conv+BN+ReLU paths: stem space-to-depth rewrite, then the tuned 3x3
+    table (parallel/conv_backward's fused-backward kernel raced against
+    XLA's native vjp — selection by measurement, never by heuristic),
+    then plain XLA."""
+    kernel = tuple(int(x) for x in kernel)
+    if _stem_eligible(data, kernel, stride, dilate, pad, num_group):
+        return _stem_s2d_conv(data, weight, kernel[0])
+    if (kernel == (3, 3) and stride == (1, 1) and dilate == (1, 1)
+            and pad == (1, 1) and num_group == 1 and data.ndim == 4):
+        from ..parallel import conv_backward  # noqa: F401 — registers conv3x3
+        return tune.tuned_call("conv3x3", _conv3x3_xla, data, weight)
+    return _conv_xla(data, weight, kernel, stride, dilate, pad, num_group)
+
+
 @register(name="Convolution", aliases=("convolution", "Convolution_v1"))
 def convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(), pad=(),
                 num_filter=0, num_group=1, workspace=1024, no_bias=False,
@@ -114,22 +154,7 @@ def convolution(data, weight, bias=None, *, kernel, stride=(), dilate=(), pad=()
     stride = _tup(stride, nd_, 1)
     dilate = _tup(dilate, nd_, 1)
     pad = _tup(pad, nd_, 0)
-    if (nd_ == 2 and num_group == 1 and stride == (2, 2)
-            and dilate == (1, 1) and kernel[0] == kernel[1]
-            and kernel[0] % 4 == 3 and pad == (kernel[0] // 2,) * 2
-            and data.shape[1] <= 8 and data.shape[2] % 2 == 0
-            and data.shape[3] % 2 == 0
-            and jax.default_backend() == "tpu"):
-        out = _stem_s2d_conv(data, weight, kernel[0])
-    else:
-        from ..parallel.conv_backward import conv3x3_custom, fused_eligible
-        if fused_eligible(data.shape, weight.shape, kernel, stride, dilate,
-                          pad, num_group):
-            # opt-in fused Pallas backward (interpret mode off-TPU)
-            out = conv3x3_custom(data, weight)
-        else:
-            out = _conv_xla(data, weight, kernel, stride, dilate, pad,
-                            num_group)
+    out = _conv_core(data, weight, kernel, stride, dilate, pad, num_group)
     if bias is not None and not no_bias:
         out = out + jnp.reshape(bias, (1, -1) + (1,) * nd_)
     return out.astype(data.dtype)
@@ -226,6 +251,87 @@ def pooling(data, *, kernel=(), pool_type="max", global_pool=False, stride=(),
 # group_norm.cc, instance_norm.cc, lrn.cc)
 # --------------------------------------------------------------------------
 
+def _bn_batch_stats(data, red):
+    """Batch (mean, var) in f32 over reduce axes ``red``.
+
+    ONE pass over the full activation for both statistics: sibling
+    sum/sum-of-squares reductions multi-output-fuse in XLA, where
+    mean-then-var reads the (large) activation from HBM twice. f32
+    accumulation regardless of input dtype (bf16 sums would lose
+    mass at ResNet-scale reduction counts). The reductions run on
+    data SHIFTED by a per-channel estimate taken from ONE slice of
+    the reduce dims (a 1/N-cost pre-read): var is shift-invariant,
+    and a shift within O(std) of the true mean kills the
+    E[x^2]-E[x]^2 catastrophic cancellation for badly-centered
+    activations (|mean| >> std) — unconditionally, unlike a
+    moving_mean shift, which is garbage at cold start.
+    """
+    n = 1
+    for i in red:
+        n *= data.shape[i]
+    if n == 0:
+        # 0-size batch: the shifted one-pass path below slices [0:1]
+        # of an empty reduce axis (a TypeError); the plain reductions
+        # keep the old NaN-stats-no-crash contract for this edge
+        return (jnp.mean(data.astype(jnp.float32), axis=red),
+                jnp.var(data.astype(jnp.float32), axis=red))
+    first = lax.slice_in_dim(data, 0, 1, axis=red[0])
+    c = jnp.mean(first.astype(jnp.float32), axis=red, keepdims=True)
+    shifted = data.astype(jnp.float32) - c
+    s1 = jnp.sum(shifted, axis=red, dtype=jnp.float32)
+    s2 = jnp.sum(jnp.square(shifted), axis=red, dtype=jnp.float32)
+    dmean = s1 / n
+    mean = jnp.reshape(c, (-1,)) + dmean
+    var = jnp.maximum(s2 / n - jnp.square(dmean), 0.0)
+    return mean, var
+
+
+def _bn_scale_bias(gamma, beta, mean, var, eps, fix_gamma):
+    """BN recomposed as one multiply-add epilogue (scale/bias are C-sized
+    — the per-channel math costs nothing; the activation is touched
+    once)."""
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    scale = g * jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    bias = beta - mean * scale
+    return scale, bias
+
+
+def _bn_apply_xla(data, scale, bias):
+    """Plain-XLA candidate for the tuned BN apply epilogue."""
+    from ..parallel.fused_conv import bn_act_reference
+    return bn_act_reference(data, scale, bias, relu=False)
+
+
+def _bn_act_xla(data, scale, bias):
+    """Plain-XLA candidate for the tuned BN+ReLU epilogue."""
+    from ..parallel.fused_conv import bn_act_reference
+    return bn_act_reference(data, scale, bias, relu=True)
+
+
+def _bn_add_act_xla(data, scale, bias, residual):
+    """Plain-XLA candidate for the tuned BN+residual-add+ReLU epilogue."""
+    from ..parallel.fused_conv import bn_act_reference
+    return bn_act_reference(data, scale, bias, residual, relu=True)
+
+
+def _conv_bn_relu_xla(data, weight, scale, bias, *, k, pad_lo, pad_hi):
+    """Plain-XLA candidate for the tuned fused conv+BN+ReLU forward."""
+    from ..parallel.fused_conv import conv_bn_relu_reference
+    return conv_bn_relu_reference(data, weight, scale, bias, k, pad_lo,
+                                  pad_hi)
+
+
+def _bn_apply(data, scale, bias, ax):
+    """The BN scale/bias apply, autotuned on the NCHW fast path."""
+    if ax == 1 and data.ndim == 4:
+        from ..parallel import fused_conv  # noqa: F401 — registers epilogues
+        return tune.tuned_call("bn_apply", _bn_apply_xla, data, scale, bias)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    return (data * jnp.reshape(scale, shape)
+            + jnp.reshape(bias, shape)).astype(data.dtype)
+
+
 @register(name="BatchNorm", aliases=("batch_norm", "BatchNorm_v1"), train_aware=True)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
@@ -236,48 +342,118 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     ax = axis % data.ndim
     red = tuple(i for i in range(data.ndim) if i != ax)
     if training and not use_global_stats:
-        # ONE pass over the full activation for both statistics: sibling
-        # sum/sum-of-squares reductions multi-output-fuse in XLA, where
-        # mean-then-var reads the (large) activation from HBM twice. f32
-        # accumulation regardless of input dtype (bf16 sums would lose
-        # mass at ResNet-scale reduction counts). The reductions run on
-        # data SHIFTED by a per-channel estimate taken from ONE slice of
-        # the reduce dims (a 1/N-cost pre-read): var is shift-invariant,
-        # and a shift within O(std) of the true mean kills the
-        # E[x^2]-E[x]^2 catastrophic cancellation for badly-centered
-        # activations (|mean| >> std) — unconditionally, unlike a
-        # moving_mean shift, which is garbage at cold start.
-        n = 1
-        for i in red:
-            n *= data.shape[i]
-        if n == 0:
-            # 0-size batch: the shifted one-pass path below slices [0:1]
-            # of an empty reduce axis (a TypeError); the plain reductions
-            # keep the old NaN-stats-no-crash contract for this edge
-            mean = jnp.mean(data.astype(jnp.float32), axis=red)
-            var = jnp.var(data.astype(jnp.float32), axis=red)
-        else:
-            first = lax.slice_in_dim(data, 0, 1, axis=red[0])
-            c = jnp.mean(first.astype(jnp.float32), axis=red, keepdims=True)
-            shifted = data.astype(jnp.float32) - c
-            s1 = jnp.sum(shifted, axis=red, dtype=jnp.float32)
-            s2 = jnp.sum(jnp.square(shifted), axis=red, dtype=jnp.float32)
-            dmean = s1 / n
-            mean = jnp.reshape(c, (-1,)) + dmean
-            var = jnp.maximum(s2 / n - jnp.square(dmean), 0.0)
+        mean, var = _bn_batch_stats(data, red)
         mean = mean.astype(moving_mean.dtype)
         var = var.astype(moving_var.dtype)
     else:
         mean, var = moving_mean, moving_var
-    shape = [1] * data.ndim
-    shape[ax] = data.shape[ax]
-    g = jnp.ones_like(gamma) if fix_gamma else gamma
-    # recomposed as one multiply-add epilogue (scale/bias are C-sized —
-    # the per-channel math costs nothing; the activation is touched once)
-    scale = g * jax.lax.rsqrt(var.astype(jnp.float32) + eps)
-    bias = beta - mean * scale
-    out = data * jnp.reshape(scale, shape) + jnp.reshape(bias, shape)
-    return (out.astype(data.dtype), mean, var)
+    scale, bias = _bn_scale_bias(gamma, beta, mean, var, eps, fix_gamma)
+    return (_bn_apply(data, scale, bias, ax), mean, var)
+
+
+@register(name="FusedBNAddReLU", aliases=("fused_bn_add_relu",),
+          train_aware=True)
+def fused_bn_add_relu(data, gamma, beta, moving_mean, moving_var,
+                      residual=None, *, eps=1e-3, momentum=0.9,
+                      fix_gamma=True, use_global_stats=False, axis=1,
+                      training=False):
+    """BatchNorm + optional residual add + ReLU as ONE op, with the apply
+    chain dispatched through the autotuned epilogue table (reference: the
+    fused NHWC bn-add-relu kernels under src/operator/nn/batch_norm.cu).
+    Same contract as BatchNorm — returns (out, batch_mean, batch_var) and
+    the Gluon block owns the running-stat update. Numerics match the
+    layer-by-layer composition exactly: the BN output is rounded to the
+    data dtype BEFORE the residual add and ReLU."""
+    ax = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    if training and not use_global_stats:
+        mean, var = _bn_batch_stats(data, red)
+        mean = mean.astype(moving_mean.dtype)
+        var = var.astype(moving_var.dtype)
+    else:
+        mean, var = moving_mean, moving_var
+    scale, bias = _bn_scale_bias(gamma, beta, mean, var, eps, fix_gamma)
+    if ax == 1 and data.ndim == 4:
+        from ..parallel import fused_conv  # noqa: F401 — registers epilogues
+        if residual is None:
+            out = tune.tuned_call("bn_act", _bn_act_xla, data, scale, bias)
+        else:
+            out = tune.tuned_call("bn_add_act", _bn_add_act_xla, data,
+                                  scale, bias, residual)
+    else:
+        out = _bn_apply(data, scale, bias, ax)
+        if residual is not None:
+            out = out + residual
+        out = jnp.maximum(out, 0)
+    return (out, mean, var)
+
+
+def _conv_bn_relu_infer(data, weight, scale, bias, kernel, stride, dilate,
+                        pad, num_group, residual):
+    """Inference fused-forward dispatch: the moving stats are already
+    folded into scale/bias, so the whole chain is ONE tuned kernel when
+    the conv is stride-1 same-size (directly, or via the stem
+    space-to-depth rewrite); anything else is conv + tuned epilogue."""
+    k = kernel[0] if kernel else 0
+    if residual is None and _stem_eligible(data, kernel, stride, dilate,
+                                           pad, num_group):
+        x2, w2, m, lo, hi = _stem_s2d_parts(data, weight, k)
+        return tune.tuned_call("conv_bn_relu", _conv_bn_relu_xla, x2, w2,
+                               scale, bias, k=m, pad_lo=(lo, lo),
+                               pad_hi=(hi, hi))
+    if (residual is None and len(kernel) == 2 and kernel == (k, k)
+            and k % 2 == 1 and stride == (1, 1) and dilate == (1, 1)
+            and pad == (k // 2,) * 2 and num_group == 1 and data.ndim == 4):
+        return tune.tuned_call("conv_bn_relu", _conv_bn_relu_xla, data,
+                               weight, scale, bias, k=k,
+                               pad_lo=(k // 2,) * 2, pad_hi=(k // 2,) * 2)
+    z = _conv_core(data, weight, kernel, stride, dilate, pad,
+                   num_group).astype(data.dtype)
+    if residual is None:
+        return tune.tuned_call("bn_act", _bn_act_xla, z, scale, bias)
+    return tune.tuned_call("bn_add_act", _bn_add_act_xla, z, scale, bias,
+                           residual)
+
+
+@register(name="FusedConvBNReLU", aliases=("fused_conv_bn_relu",),
+          train_aware=True)
+def fused_conv_bn_relu(data, weight, gamma, beta, moving_mean, moving_var,
+                       residual=None, *, kernel, stride=(), dilate=(),
+                       pad=(), num_filter=0, num_group=1, eps=1e-3,
+                       momentum=0.9, fix_gamma=True, use_global_stats=False,
+                       training=False):
+    """Convolution + BatchNorm + (optional residual add) + ReLU as one op
+    (reference: cudnnConvolutionBiasActivationForward in
+    src/operator/nn/cudnn/). Inference folds the moving stats into a
+    per-channel scale/bias and dispatches the autotuned fused forward
+    kernel; training must materialize the conv output for the batch
+    statistics, so it fuses the epilogue only. Returns (out, mean, var)
+    with BatchNorm's contract."""
+    nd_ = len(kernel)
+    kernel = tuple(int(x) for x in kernel)
+    stride = _tup(stride, nd_, 1)
+    dilate = _tup(dilate, nd_, 1)
+    pad = _tup(pad, nd_, 0)
+    from ..parallel import fused_conv  # noqa: F401 — registers the kernels
+    if not training or use_global_stats:
+        scale, bias = _bn_scale_bias(gamma, beta, moving_mean, moving_var,
+                                     eps, fix_gamma)
+        out = _conv_bn_relu_infer(data, weight, scale, bias, kernel, stride,
+                                  dilate, pad, num_group, residual)
+        return (out, moving_mean, moving_var)
+    z = _conv_core(data, weight, kernel, stride, dilate, pad,
+                   num_group).astype(data.dtype)
+    red = (0,) + tuple(range(2, z.ndim))
+    mean, var = _bn_batch_stats(z, red)
+    mean = mean.astype(moving_mean.dtype)
+    var = var.astype(moving_var.dtype)
+    scale, bias = _bn_scale_bias(gamma, beta, mean, var, eps, fix_gamma)
+    if residual is None:
+        out = tune.tuned_call("bn_act", _bn_act_xla, z, scale, bias)
+    else:
+        out = tune.tuned_call("bn_add_act", _bn_add_act_xla, z, scale, bias,
+                              residual)
+    return (out, mean, var)
 
 
 @register(name="LayerNorm", aliases=("layer_norm",))

@@ -569,6 +569,19 @@ def _exec_cache_stats(always=False):
     return snap
 
 
+def _tune_stats(always=False):
+    """Aggregate counters of the kernel autotuner (tune.stats()), or None
+    when no tuned_call site ran (unless `always`)."""
+    try:
+        from . import tune as _tn
+        snap = _tn.stats()
+    except Exception:       # noqa: BLE001 — torn-down interpreter, no jax
+        return None
+    if not always and not any(snap.values()):
+        return None
+    return snap
+
+
 # ---------------------------------------------------------------------------
 # dump / dumps
 # ---------------------------------------------------------------------------
@@ -696,6 +709,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             _compile_warned.clear()
         _reset_memory_locked()
     exec_cache = _exec_cache_stats()
+    tune_snap = _tune_stats()
     if format == "json":
         out = {
             "stats": {k: {"count": v[0], "total_us": _finite(v[1], 0.0),
@@ -707,6 +721,8 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         }
         if exec_cache is not None:
             out["exec_cache"] = exec_cache
+        if tune_snap is not None:
+            out["tune"] = tune_snap
         if mem is not None:
             out["memory"] = {"live_bytes": mem["live_bytes"],
                              "peak_bytes": mem["peak_bytes"],
@@ -743,6 +759,12 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         for k in ("hits", "misses", "disk_hits", "evictions", "bytes",
                   "disk_errors", "fallbacks", "mem_entries"):
             lines.append(f"{'exec_cache_' + k:<34}{exec_cache[k]:>12}")
+    if tune_snap is not None:
+        lines += ["", f"{'Kernel autotuner':<34}{'Value':>12}",
+                  "-" * 46]
+        for k in ("searches", "hits", "disk_hits", "disk_errors",
+                  "fallbacks", "winners"):
+            lines.append(f"{'tune_' + k:<34}{tune_snap[k]:>12}")
     if mem is not None and (mem["live_bytes"] or mem["peak_bytes"]):
         lines += ["", f"{'Memory (device)':<48}{'Live(bytes)':>14}"
                       f"{'Peak(bytes)':>14}",
@@ -858,6 +880,25 @@ def render_prometheus():
             suffix = "_total" if mtype == "counter" else ""
             family(f"mxnet_exec_cache_{stat}{suffix}", mtype, help_text)
             lines.append(f"mxnet_exec_cache_{stat}{suffix} {value}")
+
+    tn = _tune_stats(always=True)
+    if tn is not None:
+        _TUNE_FAMILIES = (
+            ("searches", "counter",
+             "autotuner candidate sweeps timed (or trivially decided)"),
+            ("hits", "counter", "autotuner memory-table winner lookups"),
+            ("disk_hits", "counter",
+             "autotuner winners re-loaded from the persistent store"),
+            ("disk_errors", "counter",
+             "corrupt/stale/unwritable autotuner winner files"),
+            ("fallbacks", "counter",
+             "tuned_call dispatches that fell back to the XLA path"),
+            ("winners", "gauge", "tuned winners resident in memory"),
+        )
+        for stat, mtype, help_text in _TUNE_FAMILIES:
+            suffix = "_total" if mtype == "counter" else ""
+            family(f"mxnet_tune_{stat}{suffix}", mtype, help_text)
+            lines.append(f"mxnet_tune_{stat}{suffix} {tn[stat]}")
 
     _drain_frees()
     with _mlock:

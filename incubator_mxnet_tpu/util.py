@@ -182,6 +182,22 @@ ENV_VARS = collections.OrderedDict([
      "Conv backward-data kernel choice: 'patch' (default) or 'taps'.")),
     ("MXTPU_FUSED_CONV_BWD", EnvSpec(False, "bool",
      "Enable the experimental fused conv backward pallas kernel.")),
+    ("MXNET_TUNE", EnvSpec(True, "bool",
+     "Enable the kernel autotuner (tune.py): per-(kernel, shape, dtype, "
+     "device) timed selection between hand Pallas kernels and the plain "
+     "XLA composition. Off, every tuned_call site runs its XLA "
+     "fallback.")),
+    ("MXNET_TUNE_SAMPLES", EnvSpec(3, "int",
+     "Timed repetitions per autotuner candidate (best-of); the first, "
+     "untimed call absorbs compilation.")),
+    ("MXTPU_TUNE_INTERPRET", EnvSpec(False, "bool",
+     "Offer interpret-mode Pallas candidates to the autotuner off-TPU. "
+     "Test-suite only: interpret mode always loses a fair timing race, "
+     "so off-TPU candidate sets are empty unless this is set.")),
+    ("MXTPU_FUSED_BLOCK", EnvSpec(True, "bool",
+     "Route gluon ResNet residual units through the fused "
+     "conv+BN(+add)+ReLU ops (autotuned; the XLA candidate keeps the "
+     "unfused numerics). Off restores the layer-by-layer oracle path.")),
     ("MXTPU_FP32_MATMUL", EnvSpec("strict", "str",
      "fp32 matmul precision: 'strict' (MXNet semantics, fp32 "
      "accumulate), 'fast' (bf16_3x), or 'fastest' (plain bf16).")),

@@ -1,0 +1,116 @@
+"""Donation audit for the fused optimizer step on the cached_jit AOT path.
+
+`optimizer_ops._fused_fn` compiles the bucketed update through
+`compile_cache.cached_jit` with `donate_argnums` covering every weight and
+optimizer-state slot (gradients are never donated: autograd reuses those
+buffers on the next backward). Donation must survive the executable cache:
+
+  * the donate option is part of the jit-kwargs fingerprint component, so a
+    donating and a non-donating build of the same function can never serve
+    each other's disk entries,
+  * the gradient slots are excluded from `donate_argnums` for every bucket
+    arity,
+  * on backends that actually implement aliasing (TPU/GPU), an executable
+    deserialized from the disk tier still consumes its donated inputs.
+
+CPU ignores donation (XLA drops it with a warning), so the end-to-end
+aliasing assertion is accelerator-gated; everything else runs everywhere.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import compile_cache as cc
+from incubator_mxnet_tpu.ops import optimizer_ops as oo
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    d = tmp_path / "exec_cache"
+    monkeypatch.setenv("MXNET_EXEC_CACHE_DIR", str(d))
+    cc.clear(memory=True, stats=True)
+    yield str(d)
+    cc.clear(memory=True, stats=True)
+
+
+def _donated(wrapper):
+    """The donate_argnums tuple a cached_jit wrapper was built with."""
+    opts = dict(eval(wrapper._opts))
+    return tuple(opts.get("donate_argnums", ()))
+
+
+def test_fused_fn_never_donates_gradient_slots(monkeypatch):
+    """Weight + state slots are donated, gradient slots never are
+    (position 1 of every arity-group; flat args start at index 2)."""
+    monkeypatch.setattr(oo, "_donation_supported", lambda: True)
+    oo._fused_cache.clear()
+    try:
+        f = oo._fused_fn("sgd_mom_update", 2, 3,
+                         (("momentum", 0.9),), ("lr", "wd"))
+        argnums = _donated(f)
+        assert argnums == (2, 4, 5, 7)
+        grad_positions = {2 + j for j in range(3 * 2) if j % 3 == 1}
+        assert not set(argnums) & grad_positions
+        # plain sgd (arity 2: weight, grad) — only the weights donate
+        g = oo._fused_fn("sgd_update", 3, 2, (), ("lr", "wd"))
+        assert _donated(g) == (2, 4, 6)
+    finally:
+        oo._fused_cache.clear()
+
+
+def test_no_donation_requested_on_unsupported_backend(monkeypatch):
+    """Where the backend cannot alias (CPU), the fused step must not ask
+    for donation at all — a donated-then-ignored buffer would still be
+    poisoned for the caller on a backend that honors deletion."""
+    monkeypatch.setattr(oo, "_donation_supported", lambda: False)
+    oo._fused_cache.clear()
+    try:
+        f = oo._fused_fn("sgd_update", 2, 2, (), ("lr", "wd"))
+        assert _donated(f) == ()
+    finally:
+        oo._fused_cache.clear()
+
+
+def test_donation_is_part_of_the_fingerprint(cache_dir):
+    """Same fn, same key, different donate_argnums -> different
+    fingerprints: a deserialized executable can never be served to a call
+    site that disagrees about which buffers it invalidates."""
+
+    def axpy(w, g):
+        return w - 0.1 * g
+
+    plain = cc.cached_jit("donation:fp", axpy)
+    donating = cc.cached_jit("donation:fp", axpy, donate_argnums=(0,))
+    args = (jnp.zeros((4, 4)), jnp.ones((4, 4)))
+    fp_plain, _ = plain._fingerprint_for(args, {})
+    fp_donate, _ = donating._fingerprint_for(args, {})
+    assert fp_plain != fp_donate
+
+
+@pytest.mark.skipif(jax.default_backend() not in ("tpu", "gpu"),
+                    reason="buffer donation is a no-op on CPU")
+def test_deserialized_executable_still_aliases(cache_dir):
+    """Cold process compiles + persists; simulated warm process
+    deserializes from disk — the donated input must still be consumed
+    (the regression this guards: an AOT payload that silently dropped
+    input_output_aliases would double peak memory of every train step)."""
+
+    def upd(w, g):
+        return w - 0.1 * g
+
+    f = cc.cached_jit("donation:alias", upd, donate_argnums=(0,))
+    w1 = jnp.asarray(np.ones((8, 8), np.float32))
+    g = jnp.asarray(np.full((8, 8), 2.0, np.float32))
+    out1 = f(w1, g)
+    out1.block_until_ready()
+    assert w1.is_deleted()
+
+    cc.clear(memory=True)          # simulated fresh process: disk tier only
+    before = cc.stats()["disk_hits"]
+    w2 = jnp.asarray(np.ones((8, 8), np.float32))
+    out2 = f(w2, g)
+    out2.block_until_ready()
+    assert cc.stats()["disk_hits"] == before + 1
+    assert w2.is_deleted()
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out1))

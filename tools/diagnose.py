@@ -89,6 +89,37 @@ def main():
     except Exception as e:
         print("compile_cache probe FAILED:", e)
 
+    print("----------Kernel Autotuner (tune)----------")
+    try:
+        from incubator_mxnet_tpu import tune
+        # importing the kernel providers registers their search spaces so
+        # winners() can decode what the persistent store holds
+        from incubator_mxnet_tpu.parallel import conv_backward  # noqa: F401
+        from incubator_mxnet_tpu.parallel import fused_conv  # noqa: F401
+        s = tune.stats()
+        print("counters     :",
+              {k: s[k] for k in ("searches", "hits", "disk_hits",
+                                 "disk_errors", "fallbacks")})
+        recs = tune.winners()
+        if not recs:
+            print("winners      : (none recorded)")
+        else:
+            by_dev = {}
+            for rec in recs.values():
+                by_dev.setdefault(rec.get("device_kind", "?"), []).append(rec)
+            for dev in sorted(by_dev):
+                group = by_dev[dev]
+                print(f"device kind  : {dev} ({len(group)} tuned shapes)")
+                for rec in sorted(group, key=lambda r: (r["kernel"],
+                                                        r["key"])):
+                    t = rec.get("timings_us", {})
+                    best = t.get(rec["winner"])
+                    best = "" if best is None else f" {best}us"
+                    print(f"  {rec['kernel']:<16} -> {rec['winner']}{best}"
+                          f"  [{rec['key']}]")
+    except Exception as e:
+        print("tune probe FAILED:", e)
+
     print("----------Static Analysis (mxlint)----------")
     try:
         from tools.mxlint import lint_paths

@@ -26,6 +26,9 @@ LOCK_ORDER = {
     # (disk/LRU/counter updates nest under it), per-wrapper sig memo and
     # the module LRU+counter lock are leaves.
     "compile_cache.py": ("self._compile_lock", "self._lock", "_lock"),
+    # tune: one module lock guards the winner table and counters; the
+    # disk tier is written outside it (atomic tmp+rename, last wins).
+    "tune.py": ("_lock",),
     "serve/batcher.py": ("self._lock",),
     "serve/stats.py": ("self._lock",),
     "serve/predictor.py": ("self._compile_lock",),

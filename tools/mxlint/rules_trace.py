@@ -114,6 +114,20 @@ def _cached_jit_names(mod):
     return chains
 
 
+def _tuned_call_names(mod):
+    """Spellings of tune.tuned_call: the autotuner dispatches its XLA
+    fallback (arg index 1, after the kernel name) under jit exactly like
+    cached_jit(key, fn), so the fallback keeps trace-safety coverage."""
+    chains = set(mod.from_import_names("tuned_call"))
+    for local, modpath in mod.import_aliases.items():
+        if modpath.split(".")[-1] == "tune":
+            chains.add(local + ".tuned_call")
+    for local, (src, orig) in mod.from_imports.items():
+        if orig == "tune":
+            chains.add(local + ".tuned_call")
+    return chains
+
+
 def _register_names(mod):
     """Spellings of ops.registry.register (from-imports only; every
     in-tree user does `from .registry import register`)."""
@@ -145,7 +159,8 @@ def discover_traced(mod):
             found[id(node)] = TracedFn(node, kind, _positional_params(node))
 
     jit_chains = _jit_names(mod)
-    track_chains = _track_jit_names(mod) | _cached_jit_names(mod)
+    track_chains = (_track_jit_names(mod) | _cached_jit_names(mod)
+                    | _tuned_call_names(mod))
     reg_names = _register_names(mod)
     fn_table = _local_functions(mod.tree)
 
