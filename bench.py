@@ -603,6 +603,67 @@ def bench_fused_block(steps, batch=16, image_size=64):
     return run_one(True), run_one(False)
 
 
+def bench_checkpoint(steps, batch=32, dim=512, every=100):
+    """Checkpoint-overhead row (robustness cost tracking): the same
+    compiled MLP train loop uncheckpointed, with a SYNCHRONOUS
+    fault.CheckpointManager.save every `every` steps (fsync'd write on
+    the step path — what PR 8 replaces), and with
+    fault.AsyncCheckpointManager.save_async (write-behind: the step only
+    pays the device->host snapshot; the writer thread owns the disk).
+    Fixed model size: a 4x Dense(dim) MLP. Returns (base_sps, sync_sps,
+    async_sps) steps/s; overhead %% derived by the caller."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import fault
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.parallel import TrainStep
+
+    net = nn.HybridSequential()
+    for _ in range(3):
+        net.add(nn.Dense(dim, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+
+    def loss_fn(out, label):
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, label[:, None], axis=1))
+
+    rs = np.random.RandomState(0)
+    xh = rs.randn(batch, dim).astype(np.float32)
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.01,
+                                       "momentum": 0.9},
+                     example_inputs=[mx.nd.array(xh)])
+    x = jnp.asarray(xh)
+    y = jnp.asarray(rs.randint(0, 10, batch).astype(np.int32))
+    _sync(step(x, y))                     # compile + warmup
+
+    def loop(manager):
+        for i in range(steps):
+            # fetch the loss every step (the usual logging pattern) so all
+            # three variants pay the same dispatch barrier and the delta is
+            # checkpoint cost, not lost pipeline overlap
+            _sync(step(x, y))
+            if manager is not None and (i + 1) % every == 0:
+                step.save_checkpoint(manager, data_state={"batch": i + 1})
+
+    dt_base = _time_best(lambda: loop(None))
+    with tempfile.TemporaryDirectory() as d:
+        sync_mgr = fault.CheckpointManager(d, prefix="s", max_keep=2)
+        dt_sync = _time_best(lambda: loop(sync_mgr))
+        async_mgr = fault.AsyncCheckpointManager(d, prefix="a", max_keep=2)
+        try:
+            dt_async = _time_best(lambda: loop(async_mgr))
+            async_mgr.flush(timeout=60)   # writes land AFTER the timed
+            #                               window — that is the point
+        finally:
+            async_mgr.close()
+    return steps / dt_base, steps / dt_sync, steps / dt_async
+
+
 _COLD_START_SCRIPT = """
 import json, os, sys, time
 import numpy as np
@@ -863,6 +924,30 @@ def main():
               f"{warm['misses']} recompiled)", file=sys.stderr)
     except Exception as e:
         print(f"[bench] serve_cold_start: FAILED {e!r}", file=sys.stderr)
+
+    # checkpoint-overhead row also runs in EVERY mode: it measures the
+    # step-path cost of fault tolerance (host snapshot + write-behind),
+    # which matters on CPU rounds exactly as much as on TPU rounds
+    try:
+        ck_steps = max(200, steps_for("train", "float32"))
+        b_sps, s_sps, a_sps = bench_checkpoint(ck_steps)
+        sync_pct = (100.0 * (b_sps / s_sps - 1.0)) if s_sps else None
+        async_pct = (100.0 * (b_sps / a_sps - 1.0)) if a_sps else None
+        results.append({"mode": "checkpoint", "batch": 32,
+                        "dtype": "float32",
+                        "base_steps_per_sec": round(b_sps, 2),
+                        "sync_steps_per_sec": round(s_sps, 2),
+                        "async_steps_per_sec": round(a_sps, 2),
+                        "sync_overhead_pct": round(sync_pct, 2)
+                        if sync_pct is not None else None,
+                        "async_overhead_pct": round(async_pct, 2)
+                        if async_pct is not None else None,
+                        "vs_baseline": None})
+        print(f"[bench] checkpoint overhead (mlp 4x512, every 100 steps) "
+              f"async {async_pct:+6.2f}% vs sync {sync_pct:+6.2f}% "
+              f"of step time", file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] checkpoint: FAILED {e!r}", file=sys.stderr)
 
     if on_tpu:
         try:

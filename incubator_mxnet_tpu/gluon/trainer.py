@@ -296,16 +296,22 @@ class Trainer:
         self._counters[2].set_value(self._last_step_collective_bytes)
         self._counters[3].set_value(self._last_step_recompiles)
 
-    def save_states(self, fname):
+    def states_bytes(self):
+        """Serialized optimizer state as bytes — what save_states writes.
+        fault.AsyncCheckpointManager snapshots this synchronously and
+        defers the disk write to its background thread."""
         if not self._kv_initialized:
             self._init_kvstore()   # decide update-on-kvstore BEFORE
             #                        choosing where states live (reference
             #                        trainer does the same)
         if getattr(self, "_update_on_kv", False):
-            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
-            return
+            return self._kvstore.optimizer_state_bytes(dump_optimizer=True)
+        return self._updaters[0].get_states(dump_optimizer=True)
+
+    def save_states(self, fname):
+        states = self.states_bytes()
         with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=True))
+            f.write(states)
 
     def load_states(self, fname):
         if not self._kv_initialized:

@@ -35,7 +35,8 @@ from ..base import MXNetError
 __all__ = ["DevicePrefetcher", "prefetch_to_device"]
 
 
-def prefetch_to_device(iterator, size=2, mesh=None, axis="dp", device=None):
+def prefetch_to_device(iterator, size=2, mesh=None, axis="dp", device=None,
+                       skip_batches=0):
     """Wrap a host batch iterator in a background device-placement stage.
 
     iterator: anything iterable yielding batches — NDArrays, (data, label)
@@ -43,6 +44,11 @@ def prefetch_to_device(iterator, size=2, mesh=None, axis="dp", device=None):
         leaves are placed on device asynchronously; non-array leaves pass
         through untouched.
     size:     queue depth (2 = classic double buffering).
+    skip_batches: discard this many source batches on the worker thread
+        WITHOUT device placement — the mid-epoch-exact resume fast-forward
+        (fault.AsyncCheckpointManager stores the consumed-batch cursor;
+        passing it here replays an epoch from the exact next batch). The
+        skipped batches still advance :attr:`cursor`.
     mesh/axis: place leaves with NamedSharding(mesh, P(axis)) — pre-sharded
         input for SPMD consumers (TrainStep skips its own device_put on
         shards that already carry this sharding).
@@ -58,7 +64,7 @@ def prefetch_to_device(iterator, size=2, mesh=None, axis="dp", device=None):
     still executes), and publishes data-stall counters to the profiler.
     """
     return DevicePrefetcher(iterator, size=size, mesh=mesh, axis=axis,
-                            device=device)
+                            device=device, skip_batches=skip_batches)
 
 
 class DevicePrefetcher:
@@ -66,12 +72,19 @@ class DevicePrefetcher:
     the source iterator (decode, batchify, shm copy-out) AND the H2D issue
     run off the consumer thread; order is preserved by construction."""
 
-    def __init__(self, iterator, size=2, mesh=None, axis="dp", device=None):
+    def __init__(self, iterator, size=2, mesh=None, axis="dp", device=None,
+                 skip_batches=0):
         if size < 1:
             raise MXNetError("prefetch size must be >= 1")
         if mesh is not None and device is not None:
             raise MXNetError("mesh and device are mutually exclusive")
+        if skip_batches < 0:
+            raise MXNetError("skip_batches must be >= 0")
         self._src = iter(iterator)
+        self._skip = int(skip_batches)
+        # source batches consumed, INCLUDING skipped ones: the data-iterator
+        # position a checkpoint records for mid-epoch-exact resume
+        self.cursor = int(skip_batches)
         self._sharding = None
         self._device = device
         if mesh is not None:
@@ -97,6 +110,16 @@ class DevicePrefetcher:
     def _worker(self):
         src = self._src
         try:
+            # resume fast-forward: burn the already-consumed prefix off the
+            # worker thread, no placement cost, before the first real batch
+            for _ in range(self._skip):
+                if self._stop.is_set():
+                    return
+                try:
+                    next(src)
+                except StopIteration:
+                    self._offer(("done", None, 0))
+                    return
             while not self._stop.is_set():
                 try:
                     batch = next(src)
@@ -199,6 +222,7 @@ class DevicePrefetcher:
                 raise payload
             raise StopIteration
         self.batches += 1
+        self.cursor += 1
         self.bytes_total += nbytes
         self.last_wait_ms = wait_ms
         self.wait_ms_total += wait_ms
@@ -224,7 +248,14 @@ class DevicePrefetcher:
         return {"batches": self.batches, "h2d_bytes": self.bytes_total,
                 "last_wait_ms": self.last_wait_ms,
                 "wait_ms_total": self.wait_ms_total,
-                "depth": self._queue.qsize(), "size": self.size}
+                "depth": self._queue.qsize(), "size": self.size,
+                "cursor": self.cursor}
+
+    def state(self):
+        """Checkpointable position: pass ``state()['cursor']`` back as
+        ``skip_batches`` over the same source to resume mid-epoch exactly
+        (no skipped, no repeated batches)."""
+        return {"cursor": self.cursor}
 
     # -- lifecycle ---------------------------------------------------------
     def close(self):

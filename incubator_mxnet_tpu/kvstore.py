@@ -200,10 +200,10 @@ class KVStore:
             setattr(KVStore, name, value)
             return value
 
-    def __init__(self, kv_type="local", mesh=None):
+    def __init__(self, kv_type="local", mesh=None, rank_hint=None):
         import jax
 
-        from .util import getenv_int
+        from .util import getenv_int, getenv_str
         self._type = kv_type
         self._store = {}           # key -> NDArray (the authoritative copy)
         self._updater = None
@@ -226,7 +226,36 @@ class KVStore:
         self._flatpack_bound = getenv_int("MXNET_KVSTORE_FLATPACK_BOUND")
         self._async_client = None
         self._async_gen = None
-        if kv_type == "dist_async" and jax.process_count() > 1:
+        self._async_addr = None     # "host:port token" of the PS endpoint
+        # elastic membership state (server-assigned in elastic mode; the
+        # heartbeat thread writes _membership_epoch/_membership_dirty and
+        # the consumer thread reads them — plain attribute stores, no
+        # read-modify-write races across threads)
+        self._rank_override = None
+        self._num_workers_override = None
+        self._membership_epoch = 0
+        self._membership_dirty = False
+        self._local_steps = 0       # pushes observed; the heartbeat's
+        #                             step payload for straggler detection
+        self._hb_stop = None
+        self._hb_thread = None
+        elastic_addr = getenv_str("MXNET_KVSTORE_ASYNC_ADDR")
+        if kv_type == "dist_async" and elastic_addr \
+                and jax.process_count() <= 1:
+            # ELASTIC direct-connect mode: no jax.distributed rendezvous —
+            # the worker dials the published server endpoint and is
+            # ASSIGNED a rank by the membership registry. This is the
+            # replacement-worker path: a respawned process (after a
+            # kill -9) reclaims its dead predecessor's rank via rank_hint
+            # and rejoins a running job without a full-job restart.
+            # Elastic workers share server generation 0 (each elastic job
+            # runs its own server process).
+            from . import kvstore_server as _ksrv
+            self._async_gen = 0
+            self._async_addr = elastic_addr
+            self._async_client = _ksrv.connect_async_server(elastic_addr)
+            self._register(rank_hint)
+        elif kv_type == "dist_async" and jax.process_count() > 1:
             # store GENERATION: creation index counted over multi-process
             # dist_async stores ONLY (they are created collectively — same
             # count/order on every process, the reference's dist protocol
@@ -257,7 +286,16 @@ class KVStore:
                     c.key_value_set(addr_key, addr)
             else:
                 addr = c.blocking_key_value_get(addr_key, 120_000)
+            self._async_addr = addr
             self._async_client = _ksrv.connect_async_server(addr)
+        if self._async_client is not None:
+            # periodic liveness beats over a DEDICATED connection (a push
+            # blocked on the shared client must not read as death) feed
+            # the server registry behind get_dead_nodes/stragglers
+            self._start_heartbeat_sender()
+        if self._async_client is not None or self.num_workers > 1:
+            from . import fault as _fault
+            _fault._register_kvstore(self)
         if kv_type in _TPU_TYPES and mesh is None:
             # one flat axis over every visible device; callers doing real
             # tp/sp pass their own mesh
@@ -273,12 +311,17 @@ class KVStore:
 
     @property
     def rank(self):
-        """Worker id (reference kvstore.py `rank`); process index on a pod."""
+        """Worker id (reference kvstore.py `rank`): process index on a
+        pod, or the server-assigned rank in elastic dist_async mode."""
+        if self._rank_override is not None:
+            return self._rank_override
         import jax
         return jax.process_index() if self._type in _TPU_TYPES else 0
 
     @property
     def num_workers(self):
+        if self._num_workers_override is not None:
+            return self._num_workers_override
         import jax
         return jax.process_count() if self._type in _TPU_TYPES else 1
 
@@ -424,6 +467,14 @@ class KVStore:
         """Sum the pushed value list; run the updater against the stored
         weight if one is set, else replace the stored value
         (reference kvstore.py:160; kvstore_local.cc PushImpl)."""
+        from . import fault as _fault
+        _fault.inject("push")       # MXNET_FAULT_INJECT test hook
+        self._local_steps += 1
+        if self._membership_dirty:
+            # the heartbeat thread observed a membership epoch change:
+            # refresh on the CONSUMER thread, at a push boundary, so the
+            # collective plan never changes mid-operation
+            self._elastic_refresh()
         for k, v in zip(self._key_list(key),
                         self._val_list(key, value) if isinstance(key, (list, tuple))
                         else [value]):
@@ -682,14 +733,30 @@ class KVStore:
         except Exception:
             pass
 
-    def get_dead_nodes(self, timeout=60):
-        """Ranks whose heartbeat generation has not CHANGED for `timeout`
-        seconds of this process's monotonic clock (or that never checked
-        in). Reference: ps-lite node timeouts surfaced as
-        kv.get_dead_nodes (src/kvstore/kvstore_dist.h:121). Note the
-        cadence contract: workers heartbeat at pushes and barriers, so
-        `timeout` must exceed the longest push-free phase (checkpointing,
-        eval) or live workers will be misreported."""
+    def get_dead_nodes(self, timeout=None):
+        """Ranks considered dead after `timeout` seconds without a
+        liveness signal (default MXNET_DEAD_NODE_TIMEOUT). Reference:
+        ps-lite node timeouts surfaced as kv.get_dead_nodes
+        (src/kvstore/kvstore_dist.h:121).
+
+        dist_async: answered by the SERVER's registry, fed by the
+        periodic heartbeat threads (every MXNET_HEARTBEAT_INTERVAL s) —
+        detection latency is timeout + one beat. dist_sync: ranks whose
+        coordination-service heartbeat generation has not CHANGED for
+        `timeout` seconds of this process's monotonic clock; workers beat
+        at pushes and barriers, so `timeout` must exceed the longest
+        push-free phase (checkpointing, eval) or live workers will be
+        misreported."""
+        if timeout is None:
+            from .util import getenv_int
+            timeout = getenv_int("MXNET_DEAD_NODE_TIMEOUT")
+        if self._async_client is not None:
+            dead = self._async_client.call("dead_nodes", self._async_gen,
+                                           float(timeout))
+            if dead:
+                from . import fault as _fault
+                _fault._bump("dead_nodes_seen", len(dead))
+            return dead
         if self.num_workers <= 1:
             return []
         c = self._dist_client()
@@ -713,6 +780,134 @@ class KVStore:
             if now - self._hb_seen[r][1] > float(timeout):
                 dead.append(r)
         return dead
+
+    # -- elastic membership (dist_async server registry) -------------------
+    def _register(self, rank_hint=None):
+        """Join the server's membership registry; the server assigns (or
+        lets a replacement worker reclaim) a rank and bumps the
+        membership epoch every other worker observes via heartbeats."""
+        info = self._async_client.call("register", self._async_gen,
+                                       None if rank_hint is None
+                                       else int(rank_hint))
+        self._rank_override = int(info["rank"])
+        self._num_workers_override = max(1, int(info["num_workers"]))
+        self._membership_epoch = int(info["epoch"])
+        self._membership_dirty = False
+        if info.get("rejoined"):
+            from . import fault as _fault
+            _fault._bump("rejoins")
+        return info
+
+    def _start_heartbeat_sender(self):
+        from .util import getenv_int
+        period = max(1, getenv_int("MXNET_HEARTBEAT_INTERVAL"))
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, args=(self._async_addr, period),
+            name="mxtpu-kvstore-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def _hb_loop(self, addr, period):
+        from . import fault as _fault
+        from . import kvstore_server as _ksrv
+        client = None
+        while not self._hb_stop.wait(period):
+            try:
+                if client is None:
+                    client = _ksrv.connect_async_server(addr)
+                epoch = client.call("heartbeat", self._async_gen,
+                                    self.rank, self._local_steps)
+                _fault._bump("heartbeats_sent")
+                if epoch != self._membership_epoch:
+                    if self._membership_epoch:      # the first epoch seen
+                        #                             is not a CHANGE
+                        self._membership_dirty = True
+                        _fault._bump("membership_changes")
+                    self._membership_epoch = epoch
+            except (MXNetError, OSError, ConnectionError):
+                # server unreachable this beat: drop the connection and
+                # redial next period — missed beats ARE the death signal,
+                # the sender must never crash or hang on them
+                if client is not None:
+                    client.close()
+                    client = None
+        if client is not None:
+            client.close()
+
+    def _elastic_refresh(self):
+        """Consumer-thread reaction to a membership epoch change: refresh
+        the live worker count and re-bucket the collective plan."""
+        self._membership_dirty = False
+        try:
+            info = self.membership()
+        except MXNetError:
+            self._membership_dirty = True   # retry at the next push
+            return
+        live = [r for r in info["workers"] if r not in info["dead"]]
+        if self._rank_override is not None:
+            self._num_workers_override = max(1, len(live))
+        self.rebucket()
+
+    def rebucket(self):
+        """Drop the cached flat-pack bucket plans (and their jitted
+        pack/unpack executables) so the next pushpull_list re-buckets
+        for the CURRENT membership."""
+        _flat_pack_fn.cache_clear()
+        _flat_unpack_fn.cache_clear()
+
+    def membership(self, timeout=None, lag=None):
+        """Membership snapshot from the async server registry: {'epoch',
+        'workers', 'dead', 'stragglers', 'steps'}. A static single-worker
+        view outside dist_async."""
+        from .util import getenv_int
+        if timeout is None:
+            timeout = getenv_int("MXNET_DEAD_NODE_TIMEOUT")
+        if lag is None:
+            lag = getenv_int("MXNET_STRAGGLER_LAG")
+        if self._async_client is None:
+            return {"epoch": 0, "workers": list(range(self.num_workers)),
+                    "dead": [], "stragglers": [], "steps": {}}
+        return self._async_client.call("membership", self._async_gen,
+                                       float(timeout), int(lag))
+
+    def stragglers(self, lag=None, timeout=None):
+        """Live ranks whose reported step trails the leader by >= `lag`
+        (default MXNET_STRAGGLER_LAG) — the slow-worker counterpart of
+        get_dead_nodes. [] outside dist_async."""
+        if self._async_client is None:
+            return []
+        out = self.membership(timeout=timeout, lag=lag)["stragglers"]
+        if out:
+            from . import fault as _fault
+            _fault._bump("stragglers_seen", len(out))
+        return out
+
+    def rejoin(self, manager=None, net=None, trainer=None, ctx=None):
+        """Elastic rejoin after a loss: re-register with the server
+        (reclaiming this worker's rank if the registry saw it die),
+        refresh membership, re-bucket the collective plan, and — given a
+        fault.CheckpointManager — restore net/trainer from the newest
+        intact checkpoint generation. Returns the step to resume from
+        (0 when no checkpoint exists)."""
+        if self._async_client is None:
+            raise MXNetError("rejoin() requires a dist_async store")
+        self._register(self._rank_override)
+        self.rebucket()
+        if manager is not None and net is not None:
+            from . import fault as _fault
+            return _fault.resume_or_start(manager, net, trainer, ctx=ctx)
+        return 0
+
+    def close(self):
+        """Stop the heartbeat sender and drop server connections (elastic
+        workers and tests; daemon threads make this optional at exit)."""
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            if self._hb_thread is not None:
+                self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+        if self._async_client is not None:
+            self._async_client.close()
 
     # -- optimizer-on-store ------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -775,18 +970,21 @@ class KVStore:
             raise MXNetError("compression threshold must be positive")
         self._compression = params
 
-    def save_optimizer_states(self, fname, dump_optimizer=False):
+    def optimizer_state_bytes(self, dump_optimizer=False):
+        """Serialized optimizer state as bytes (the write-behind
+        checkpointer snapshots this without touching disk)."""
         if self._async_client is not None:
             # the optimizer state lives ON THE SERVER in async mode
-            states = self._async_client.call("get_states", self._async_gen,
-                                             dump_optimizer)
-            with open(fname, "wb") as f:
-                f.write(states)
-            return
+            return self._async_client.call("get_states", self._async_gen,
+                                           dump_optimizer)
         if self._updater is None:
             raise MXNetError("no optimizer set")
+        return self._updater.get_states(dump_optimizer=dump_optimizer)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        states = self.optimizer_state_bytes(dump_optimizer=dump_optimizer)
         with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
+            f.write(states)
 
     def load_optimizer_states(self, fname):
         if self._async_client is not None:
@@ -804,13 +1002,18 @@ class KVStore:
             self._updater.set_states(f.read())
 
 
-def create(name="local", mesh=None):
+def create(name="local", mesh=None, rank_hint=None):
     """Create a KVStore (reference src/kvstore/kvstore.cc:40-76). Accepted
     types: local, device, tpu, dist, dist_sync, dist_async,
-    dist_device_sync, nccl (nccl/dist map onto the mesh-collective backend)."""
+    dist_device_sync, nccl (nccl/dist map onto the mesh-collective backend).
+
+    `rank_hint` only matters in elastic dist_async mode
+    (MXNET_KVSTORE_ASYNC_ADDR set): a replacement worker passes its dead
+    predecessor's rank to reclaim that identity from the membership
+    registry."""
     if not isinstance(name, str):
         raise MXNetError("kvstore type must be a string")
     name = name.lower()
     if name not in ("local", "device") + _TPU_TYPES:
         raise MXNetError(f"unknown kvstore type {name!r}")
-    return KVStore(name, mesh=mesh)
+    return KVStore(name, mesh=mesh, rank_hint=rank_hint)

@@ -51,7 +51,9 @@ import secrets
 import socket
 import struct
 import threading
+import time
 
+from . import fault as _fault
 from .base import MXNetError
 
 __all__ = ["AsyncServer", "AsyncClient", "start_async_server",
@@ -99,12 +101,14 @@ class _Channel:
         self._recv_seq = 0
 
     def send(self, obj):
+        _fault.inject("frame_send")     # MXNET_FAULT_INJECT test hook
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         mac = _frame_mac(self._key, self._send_dir, self._send_seq, payload)
         self._send_seq += 1
         self._sock.sendall(_HDR.pack(len(payload)) + payload + mac)
 
     def recv(self):
+        _fault.inject("frame_recv")     # MXNET_FAULT_INJECT test hook
         (n,) = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
         payload = _recv_exact(self._sock, n)
         mac = _recv_exact(self._sock, _MAC_LEN)
@@ -142,6 +146,15 @@ class AsyncServer:
         self._lock = threading.Lock()   # serializes updates, like the
         #                                 reference's executor queue
         self._push_counts = {}      # (gen, rank) -> pushes handled
+        # liveness registry (reference kvstore_dist.h:121 get_dead_nodes):
+        # fed by register/heartbeat/push, read by dead_nodes/membership.
+        # _hb_lock is a LEAF lock — never held together with self._lock
+        # (push refreshes liveness after releasing the update lock)
+        self._hb_lock = threading.Lock()
+        self._liveness = {}         # (gen, rank) -> (last_monotonic, step)
+        self._members = {}          # gen -> set of registered ranks
+        self._epoch = {}            # gen -> membership epoch (bumps on
+        #                             register, i.e. join/rejoin)
         self._stopped = threading.Event()
         self._sock = None
         self._threads = []
@@ -186,6 +199,11 @@ class AsyncServer:
                 self._push_counts[ck] = self._push_counts.get(ck, 0) + 1
                 total = sum(n for (g, _), n in self._push_counts.items()
                             if g == gen)
+            with self._hb_lock:     # a push proves liveness too (taken
+                #                     AFTER _lock is released, never nested)
+                if (gen, rank) in self._liveness:
+                    step = self._liveness[(gen, rank)][1]
+                    self._liveness[(gen, rank)] = (time.monotonic(), step)
             return ("ok", total)
         if op == "pull":
             _, gen, key = msg
@@ -226,10 +244,74 @@ class AsyncServer:
                     return ("err", "no optimizer set")
                 updater.set_states(states)
             return ("ok",)
+        if op == "register":
+            # elastic membership: assign (or reclaim) a rank. A rank_hint
+            # naming a DEAD rank reclaims that identity — the respawned
+            # replacement for a kill -9'd worker; a hint naming a LIVE
+            # rank gets a fresh one instead (never steal an identity)
+            _, gen, rank_hint = msg
+            from .util import getenv_int
+            timeout = getenv_int("MXNET_DEAD_NODE_TIMEOUT")
+            with self._hb_lock:
+                members = self._members.setdefault(gen, set())
+                now = time.monotonic()
+                rejoined = False
+                rank = rank_hint
+                if rank is not None and rank in members:
+                    last = self._liveness.get((gen, rank), (0.0, 0))[0]
+                    if now - last > timeout:
+                        rejoined = True
+                    else:
+                        rank = None
+                if rank is None:
+                    rank = 0
+                    while rank in members:
+                        rank += 1
+                members.add(rank)
+                self._liveness[(gen, rank)] = (now, 0)
+                self._epoch[gen] = self._epoch.get(gen, 0) + 1
+                return ("ok", {"rank": rank, "epoch": self._epoch[gen],
+                               "num_workers": len(members),
+                               "rejoined": rejoined})
+        if op == "heartbeat":
+            # liveness beat; the reply carries the membership epoch so
+            # every worker learns of joins/rejoins within one beat period
+            _, gen, rank, step = msg
+            with self._hb_lock:
+                self._members.setdefault(gen, set()).add(rank)
+                self._liveness[(gen, rank)] = (time.monotonic(), int(step))
+                return ("ok", self._epoch.setdefault(gen, 1))
+        if op == "dead_nodes":
+            _, gen, timeout = msg
+            with self._hb_lock:
+                return ("ok", self._dead_locked(gen, timeout))
+        if op == "membership":
+            _, gen, timeout, lag = msg
+            with self._hb_lock:
+                members = sorted(self._members.get(gen, ()))
+                dead = self._dead_locked(gen, timeout)
+                steps = {r: self._liveness.get((gen, r), (0.0, 0))[1]
+                         for r in members}
+                top = max(steps.values(), default=0)
+                stragglers = sorted(
+                    r for r in members
+                    if r not in dead and top - steps[r] >= lag
+                ) if lag > 0 else []
+                return ("ok", {"epoch": self._epoch.setdefault(gen, 1),
+                               "workers": members, "dead": dead,
+                               "stragglers": stragglers, "steps": steps})
         if op == "stop":
             self._stopped.set()
             return ("ok",)
         return ("err", f"unknown op {op!r}")
+
+    def _dead_locked(self, gen, timeout):
+        """Registered ranks with no beat/push within `timeout` seconds,
+        judged by THIS host's monotonic clock (caller holds _hb_lock)."""
+        now = time.monotonic()
+        return sorted(
+            r for r in self._members.get(gen, ())
+            if now - self._liveness.get((gen, r), (0.0, 0))[0] > timeout)
 
     # -- socket plumbing ---------------------------------------------------
     def _client_loop(self, conn):
@@ -319,35 +401,114 @@ def _updater_key(key):
 
 
 class AsyncClient:
-    """Worker-side connection to the async server (reference KVWorker)."""
+    """Worker-side connection to the async server (reference KVWorker).
+
+    A dead or wedged server can no longer hang a worker forever: dialing
+    uses MXNET_KVSTORE_CONNECT_TIMEOUT, every call is bounded by
+    MXNET_KVSTORE_CALL_TIMEOUT on the socket, and both paths retry up to
+    MXNET_KVSTORE_RETRIES times over a FRESH connection with exponential
+    backoff (MXNET_KVSTORE_RETRY_BACKOFF_MS initial, doubling, capped at
+    10s) before raising a clear MXNetError naming the budget spent.
+
+    At-least-once caveat: a call that timed out may still have been
+    applied by the server before the retry lands (e.g. a push counted
+    twice). The async semantics already tolerate duplicate gradients —
+    they are indistinguishable from one more unbarriered push — but tests
+    must not assert exact per-rank push counts under fault injection.
+    """
 
     def __init__(self, addr, token):
-        host, port = addr.rsplit(":", 1)
+        from .util import getenv_int
+        self._addr = addr
+        self._token = token
         self._lock = threading.Lock()
-        self._sock = socket.create_connection((host, int(port)), timeout=120)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # nonce exchange, then every frame is HMAC'd with the session key
-        client_nonce = secrets.token_bytes(_NONCE_LEN)
-        self._sock.sendall(client_nonce)
-        server_nonce = _recv_exact(self._sock, _NONCE_LEN)
-        self._chan = _Channel(self._sock,
-                              _session_key(token, client_nonce,
+        self._sock = None
+        self._chan = None
+        self._connect_timeout = getenv_int("MXNET_KVSTORE_CONNECT_TIMEOUT")
+        self._call_timeout = getenv_int("MXNET_KVSTORE_CALL_TIMEOUT")
+        self._retries = max(0, getenv_int("MXNET_KVSTORE_RETRIES"))
+        self._backoff_ms = max(
+            1, getenv_int("MXNET_KVSTORE_RETRY_BACKOFF_MS"))
+        with self._lock:
+            last = None
+            for attempt in range(self._retries + 1):
+                if attempt:
+                    time.sleep(self._backoff_s(attempt))
+                try:
+                    self._dial_locked()
+                    return
+                except (ConnectionError, OSError) as e:
+                    last = e
+                    self._close_locked()
+            raise MXNetError(
+                f"async kvstore server at {self._addr} unreachable after "
+                f"{self._retries + 1} connect attempts "
+                f"(MXNET_KVSTORE_CONNECT_TIMEOUT={self._connect_timeout}s, "
+                f"MXNET_KVSTORE_RETRIES={self._retries}): {last!r}")
+
+    def _backoff_s(self, attempt):
+        return min(10.0, self._backoff_ms / 1e3 * (2 ** (attempt - 1)))
+
+    def _close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._chan = None
+
+    def _dial_locked(self):
+        host, port = self._addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=self._connect_timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # nonce exchange, then every frame is HMAC'd with the session
+            # key (the connect timeout also bounds the exchange)
+            client_nonce = secrets.token_bytes(_NONCE_LEN)
+            sock.sendall(client_nonce)
+            server_nonce = _recv_exact(sock, _NONCE_LEN)
+        except BaseException:
+            sock.close()
+            raise
+        sock.settimeout(self._call_timeout)
+        self._sock = sock
+        self._chan = _Channel(sock,
+                              _session_key(self._token, client_nonce,
                                            server_nonce),
                               send_dir=b"C", recv_dir=b"S")
 
     def call(self, *msg):
+        last = None
+        reply = None
         with self._lock:
-            self._chan.send(msg)
-            reply = self._chan.recv()
+            for attempt in range(self._retries + 1):
+                if attempt:
+                    time.sleep(self._backoff_s(attempt))
+                try:
+                    if self._chan is None:
+                        self._dial_locked()
+                    self._chan.send(msg)
+                    reply = self._chan.recv()
+                    break
+                except (ConnectionError, OSError) as e:     # timeout /
+                    last = e        # reset / MAC mismatch / injected drop:
+                    self._close_locked()    # retry over a fresh connection
+            else:
+                raise MXNetError(
+                    f"async kvstore call {msg[0]!r} to {self._addr} failed "
+                    f"after {self._retries + 1} attempts "
+                    f"(MXNET_KVSTORE_CALL_TIMEOUT={self._call_timeout}s, "
+                    f"MXNET_KVSTORE_RETRIES={self._retries}): {last!r}")
         if reply[0] != "ok":
+            # the server ANSWERED with an application error: never retried
             raise MXNetError(f"async kvstore server: {reply[1]}")
         return reply[1] if len(reply) > 1 else None
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._close_locked()
 
 
 _SERVER_SINGLETON = {}

@@ -39,7 +39,7 @@ from ..base import MXNetError
 from .ndarray import NDArray
 from .sparse import CSRNDArray, RowSparseNDArray
 
-__all__ = ["save", "load", "load_frombuffer", "from_dlpack",
+__all__ = ["save", "save_bytes", "load", "load_frombuffer", "from_dlpack",
            "to_dlpack_for_read", "to_dlpack_for_write"]
 
 # legacy npz container keys (pre-wire format; load-only)
@@ -128,9 +128,11 @@ def _save_one(out, arr):
         out.append(_raw_bytes(_np.asarray(a.asnumpy(), _np.int64)))
 
 
-def save(fname: str, data):
-    """Save a list or dict of NDArrays on the reference dmlc binary wire
-    (reference ndarray/utils.py save -> MXNDArraySave)."""
+def save_bytes(data):
+    """Serialize a list or dict of NDArrays to the reference dmlc binary
+    wire and return the bytes (what :func:`save` writes). Callers that
+    need the payload in memory anyway (checksummed checkpoints) avoid a
+    write-then-read-back round trip."""
     if isinstance(data, (NDArray, RowSparseNDArray, CSRNDArray)):
         data = [data]
     if isinstance(data, (list, tuple)):
@@ -150,7 +152,13 @@ def save(fname: str, data):
         raw = n.encode("utf-8")
         out.append(struct.pack("<Q", len(raw)))
         out.append(raw)
-    payload = b"".join(out)
+    return b"".join(out)
+
+
+def save(fname: str, data):
+    """Save a list or dict of NDArrays on the reference dmlc binary wire
+    (reference ndarray/utils.py save -> MXNDArraySave)."""
+    payload = save_bytes(data)
     with open(fname, "wb") as f:
         f.write(payload)
 

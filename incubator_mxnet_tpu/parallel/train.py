@@ -260,7 +260,8 @@ class TrainStep:
             arrs.append(a)
         return arrs
 
-    def run_epoch(self, data_iter, prefetch=2):
+    def run_epoch(self, data_iter, prefetch=2, checkpoint=None,
+                  checkpoint_every=0, start_batch=0):
         """Drive one pass over ``data_iter`` with the device input pipeline:
         the iterator is wrapped in io.prefetch (sharded over the mesh's
         data axis when the step has one) so batch N+1's host->HBM copy
@@ -268,20 +269,37 @@ class TrainStep:
         step's own device_put. An already-constructed DevicePrefetcher is
         consumed as-is (its placement target wins). Batches may be
         (x..., label) tuples/lists or a single array. Returns the per-step
-        losses as an NDArray."""
+        losses as an NDArray.
+
+        Fault tolerance: with ``checkpoint`` (a fault.CheckpointManager /
+        AsyncCheckpointManager) and ``checkpoint_every=N``, every N-th
+        batch snapshots params + optimizer state + the batch cursor
+        (write-behind when the manager is async, so the step never waits
+        on disk). ``start_batch`` fast-forwards the source iterator — pass
+        the ``data_state['batch']`` of the restored checkpoint to resume
+        mid-epoch with no skipped or repeated batches."""
         from ..io.prefetch import DevicePrefetcher, prefetch_to_device
         from ..ndarray.ndarray import NDArray
         it, owned = data_iter, False
         if not isinstance(it, DevicePrefetcher):
             it = prefetch_to_device(iter(it), size=prefetch, mesh=self.mesh,
-                                    axis=self.data_axis)
+                                    axis=self.data_axis,
+                                    skip_batches=start_batch)
             owned = True
+        elif start_batch:
+            raise MXNetError("start_batch needs an unwrapped source "
+                             "iterator (pass skip_batches to io.prefetch "
+                             "when constructing the DevicePrefetcher)")
         losses = []
         try:
             for batch in it:
                 if not isinstance(batch, (tuple, list)):
                     batch = (batch,)
                 losses.append(self(*batch))
+                if checkpoint is not None and checkpoint_every and \
+                        it.cursor % checkpoint_every == 0:
+                    self.save_checkpoint(checkpoint,
+                                         data_state={"batch": it.cursor})
         finally:
             if owned:
                 it.close()
@@ -289,8 +307,51 @@ class TrainStep:
             return NDArray(jnp.zeros((0,), jnp.float32))
         return NDArray(jnp.stack([getattr(l, "_data", l) for l in losses]))
 
+    def save_checkpoint(self, manager, data_state=None, extra=None):
+        """Snapshot the compiled step's params + optimizer state (+ an
+        opaque ``data_state`` cursor) through a fault.CheckpointManager.
+        An AsyncCheckpointManager makes this write-behind: the only
+        step-blocking cost is the device->host copy."""
+        flat = {}
+        for k, v in self.params.items():
+            flat[f"p/{k}"] = jax.device_get(v)
+        for k, st in self.opt_state.items():
+            for i, s in enumerate(st):
+                flat[f"o{i}/{k}"] = jax.device_get(s)
+        save = getattr(manager, "save_async", manager.save)
+        save(self._step_count, params=flat, extra=extra,
+             data_state=data_state)
+
+    def load_checkpoint(self, manager, step=None):
+        """Restore params/opt-state saved by :meth:`save_checkpoint` onto
+        this step's current shardings; rewinds ``_step_count``. Returns
+        ``(step, data_state)`` — feed ``data_state['batch']`` back into
+        ``run_epoch(start_batch=...)`` for a mid-epoch-exact resume."""
+        step, arrays, data_state = manager.restore_arrays(step)
+        host = {k: getattr(v, "_data", v) for k, v in arrays.items()}
+
+        def _placed(tag, like):
+            a = jnp.asarray(host[tag]).astype(like.dtype)
+            sh = getattr(like, "sharding", None)
+            return jax.device_put(a, sh) if sh is not None else a
+
+        missing = [k for k in self.params if f"p/{k}" not in host]
+        if missing:
+            raise MXNetError(f"checkpoint step {step} lacks params "
+                             f"{missing[:3]}... — saved by a different "
+                             "model?")
+        self.params = {k: _placed(f"p/{k}", v)
+                       for k, v in self.params.items()}
+        self.opt_state = {
+            k: tuple(_placed(f"o{i}/{k}", s) for i, s in enumerate(st))
+            for k, st in self.opt_state.items()}
+        self._step_count = step
+        return step, data_state
+
     def __call__(self, *batch):
         from ..ndarray import random as _rnd
+        from .. import fault as _fault
+        _fault.inject("step")       # MXNET_FAULT_INJECT test hook
         arrs = self._to_device(batch)
         rng = _rnd.next_key()
         self.params, self.opt_state, loss = self._jit_step(

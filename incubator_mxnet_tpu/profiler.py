@@ -582,6 +582,20 @@ def _tune_stats(always=False):
     return snap
 
 
+def _fault_stats(always=False):
+    """Fault-tolerance counters (fault.stats(): checkpoints, heartbeats,
+    dead/straggler sightings, rejoins), or None when the process did no
+    fault-tolerance work (unless `always`)."""
+    try:
+        from . import fault as _ft
+        snap = _ft.stats()
+    except Exception:       # noqa: BLE001 — torn-down interpreter
+        return None
+    if not always and not any(snap.values()):
+        return None
+    return snap
+
+
 # ---------------------------------------------------------------------------
 # dump / dumps
 # ---------------------------------------------------------------------------
@@ -710,6 +724,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         _reset_memory_locked()
     exec_cache = _exec_cache_stats()
     tune_snap = _tune_stats()
+    fault_snap = _fault_stats()
     if format == "json":
         out = {
             "stats": {k: {"count": v[0], "total_us": _finite(v[1], 0.0),
@@ -723,6 +738,8 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             out["exec_cache"] = exec_cache
         if tune_snap is not None:
             out["tune"] = tune_snap
+        if fault_snap is not None:
+            out["fault"] = fault_snap
         if mem is not None:
             out["memory"] = {"live_bytes": mem["live_bytes"],
                              "peak_bytes": mem["peak_bytes"],
@@ -765,6 +782,13 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         for k in ("searches", "hits", "disk_hits", "disk_errors",
                   "fallbacks", "winners"):
             lines.append(f"{'tune_' + k:<34}{tune_snap[k]:>12}")
+    if fault_snap is not None:
+        lines += ["", f"{'Fault tolerance':<34}{'Value':>12}",
+                  "-" * 46]
+        for k in sorted(fault_snap):
+            v = fault_snap[k]
+            sval = f"{v:.1f}" if isinstance(v, float) else f"{v}"
+            lines.append(f"{'fault_' + k:<34}{sval:>12}")
     if mem is not None and (mem["live_bytes"] or mem["peak_bytes"]):
         lines += ["", f"{'Memory (device)':<48}{'Live(bytes)':>14}"
                       f"{'Peak(bytes)':>14}",
@@ -899,6 +923,43 @@ def render_prometheus():
             suffix = "_total" if mtype == "counter" else ""
             family(f"mxnet_tune_{stat}{suffix}", mtype, help_text)
             lines.append(f"mxnet_tune_{stat}{suffix} {tn[stat]}")
+
+    ft = _fault_stats(always=True)
+    if ft is not None:
+        # mxnet_worker_*: the fleet-health scrape surface — liveness,
+        # stragglers, elastic rejoins, and write-behind checkpoint health
+        _WORKER_FAMILIES = (
+            ("heartbeats_sent", "heartbeats_total", "counter",
+             "liveness beats sent to the dist_async server registry"),
+            ("dead_nodes_seen", "dead_nodes_total", "counter",
+             "cumulative dead ranks reported by get_dead_nodes"),
+            ("stragglers_seen", "stragglers_total", "counter",
+             "cumulative straggler ranks reported (step lag >= "
+             "MXNET_STRAGGLER_LAG)"),
+            ("rejoins", "rejoins_total", "counter",
+             "elastic re-registrations reclaiming a dead rank"),
+            ("membership_changes", "membership_changes_total", "counter",
+             "server membership epoch changes observed via heartbeats"),
+            ("ckpt_saves", "checkpoint_saves_total", "counter",
+             "checkpoint generations committed to disk"),
+            ("ckpt_dropped", "checkpoint_dropped_total", "counter",
+             "pending snapshots dropped by the bounded write-behind queue"),
+            ("ckpt_errors", "checkpoint_errors_total", "counter",
+             "background checkpoint write failures"),
+            ("ckpt_fallbacks", "checkpoint_fallbacks_total", "counter",
+             "corrupt checkpoint generations skipped at restore"),
+            ("ckpt_write_ms", "checkpoint_write_ms_total", "counter",
+             "wall-clock ms spent writing checkpoints off the step path"),
+            ("ckpt_last_step", "checkpoint_last_step", "gauge",
+             "newest step durably checkpointed"),
+            ("faults_injected", "faults_injected_total", "counter",
+             "MXNET_FAULT_INJECT actions fired (tests only)"),
+        )
+        for stat, prom, mtype, help_text in _WORKER_FAMILIES:
+            family(f"mxnet_worker_{prom}", mtype, help_text)
+            v = ft[stat]
+            v = f"{v:.3f}" if isinstance(v, float) else f"{v}"
+            lines.append(f"mxnet_worker_{prom} {v}")
 
     _drain_frees()
     with _mlock:

@@ -149,6 +149,43 @@ ENV_VARS = collections.OrderedDict([
      "Interface the dist_async parameter server binds to; empty (default) "
      "binds the coordinator-facing interface only — never 0.0.0.0 unless "
      "set explicitly.")),
+    ("MXNET_KVSTORE_ASYNC_ADDR", EnvSpec("", "str",
+     "Elastic-join endpoint for the dist_async parameter server as "
+     "'host:port token'. When set, a single-process worker connects "
+     "directly (no jax.distributed rendezvous) and is assigned a rank by "
+     "the server — the replacement-worker path after a kill -9.")),
+    ("MXNET_KVSTORE_CONNECT_TIMEOUT", EnvSpec(10, "int",
+     "Seconds an AsyncClient waits for one TCP connect + nonce exchange "
+     "to the dist_async server before retrying.")),
+    ("MXNET_KVSTORE_CALL_TIMEOUT", EnvSpec(60, "int",
+     "Seconds an AsyncClient waits for the reply to one RPC frame before "
+     "treating the server as wedged and retrying over a fresh "
+     "connection.")),
+    ("MXNET_KVSTORE_RETRIES", EnvSpec(4, "int",
+     "Reconnect/retry attempts (per call and per connect) against a dead "
+     "or wedged dist_async server before raising MXNetError.")),
+    ("MXNET_KVSTORE_RETRY_BACKOFF_MS", EnvSpec(100, "int",
+     "Initial retry backoff in milliseconds; doubles per attempt "
+     "(exponential, capped at 10s).")),
+    ("MXNET_HEARTBEAT_INTERVAL", EnvSpec(2, "int",
+     "Seconds between background worker heartbeats to the dist_async "
+     "server's liveness registry.")),
+    ("MXNET_DEAD_NODE_TIMEOUT", EnvSpec(30, "int",
+     "Seconds without a heartbeat after which the dist_async server "
+     "reports a worker dead (get_dead_nodes default; reference "
+     "kvstore_dist.h:121 node timeout).")),
+    ("MXNET_STRAGGLER_LAG", EnvSpec(100, "int",
+     "Heartbeat-reported step lag behind the fastest worker at/above "
+     "which a worker is counted a straggler.")),
+    ("MXNET_CKPT_QUEUE", EnvSpec(2, "int",
+     "Bounded write-behind queue depth of fault.AsyncCheckpointManager; "
+     "when full the OLDEST pending snapshot is dropped (newest state "
+     "wins) so a slow disk never stalls the train loop.")),
+    ("MXNET_FAULT_INJECT", EnvSpec("", "str",
+     "Test-suite only: fault-injection spec 'site@n:action[,...]' where "
+     "action is kill, drop, or delay=SECONDS — e.g. 'push@5:kill' kills "
+     "the process at the 5th kvstore push, 'frame@3:drop' drops the 3rd "
+     "wire frame. Empty disables injection.")),
     ("MXNET_COMPILE_WARN_THRESHOLD", EnvSpec(8, "int",
      "Compiles of the same jit key after which the profiler warns about "
      "a likely recompile loop.")),

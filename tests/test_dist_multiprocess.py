@@ -20,6 +20,10 @@ WORKER = textwrap.dedent("""
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
+    try:        # multiprocess CPU collectives need the gloo backend
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass    # older jax: gloo was the default
     jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
                                num_processes=nproc, process_id=pid)
     sys.path.insert(0, {repo!r})
@@ -125,6 +129,10 @@ WORKER_KILL = textwrap.dedent("""
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
+    try:        # multiprocess CPU collectives need the gloo backend
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass    # older jax: gloo was the default
     jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
                                num_processes=nproc, process_id=pid)
     sys.path.insert(0, {repo!r})
@@ -211,6 +219,10 @@ WORKER_ASYNC = textwrap.dedent("""
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
+    try:        # multiprocess CPU collectives need the gloo backend
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass    # older jax: gloo was the default
     jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
                                num_processes=nproc, process_id=pid)
     sys.path.insert(0, {repo!r})
@@ -295,6 +307,10 @@ WORKER_PREEMPT = textwrap.dedent("""
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
+    try:        # multiprocess CPU collectives need the gloo backend
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass    # older jax: gloo was the default
     jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
                                num_processes=nproc, process_id=pid)
     sys.path.insert(0, {repo!r})
@@ -430,3 +446,234 @@ def test_dist_preemption_resume_roundtrip(tmp_path):
     w_resumed = np.load(ck / "final_int.npy")
     w_full = np.load(ck2 / "final_full.npy")
     np.testing.assert_allclose(w_resumed, w_full, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# PR 8: elastic fault tolerance — kill -9 + respawn convergence oracle, and
+# mid-epoch exact-cursor resume. The elastic path needs NO jax.distributed
+# rendezvous (each worker is a single-process jax; the parameter server is a
+# host-side socket endpoint), so a kill -9'd worker CAN be replaced — and
+# these tests also run where multi-process XLA collectives are unavailable.
+# ---------------------------------------------------------------------------
+
+SERVER_ELASTIC = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {repo!r})
+    from incubator_mxnet_tpu.kvstore_server import start_async_server
+    addr_token = start_async_server()
+    with open(sys.argv[1] + ".tmp", "w") as f:
+        f.write(addr_token)
+    os.replace(sys.argv[1] + ".tmp", sys.argv[1])   # atomic publish
+    time.sleep(600)                                 # killed by the test
+""")
+
+WORKER_ELASTIC = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    addrfile, ckdir, total = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    hint = int(sys.argv[4]) if len(sys.argv) > 4 else None
+    with open(addrfile) as f:
+        os.environ["MXNET_KVSTORE_ASYNC_ADDR"] = f.read()
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import fault
+
+    kv = mx.kv.create("dist_async", rank_hint=hint)
+    if hint is not None:
+        # the respawn must have RECLAIMED its dead predecessor's rank,
+        # not been handed a fresh one
+        assert fault.stats()["rejoins"] == 1, "respawn got a fresh rank"
+        assert kv.rank == hint, kv.rank
+    target = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    kv.init("w", mx.nd.zeros((4,)))                  # first writer wins
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+
+    mgr = fault.CheckpointManager(ckdir)
+    start = mgr.latest_step() or 0                   # exact step cursor
+    sys.stdout.write("ELASTIC_RESUMED_AT_%d\\n" % start)
+    out = mx.nd.zeros((4,))
+    for step in range(start, total):
+        kv.pull("w", out=out)                        # server's latest w
+        grad = 2.0 * (out.asnumpy() - target)
+        kv.push("w", mx.nd.array(grad))              # MXNET_FAULT_INJECT
+        #                                              may SIGKILL here
+        mgr.save(step + 1, params={{"w": out}},
+                 data_state={{"step": step + 1}})
+    kv.pull("w", out=out)
+    np.save(os.path.join(ckdir, "final.npy"), out.asnumpy())
+    err = float(np.abs(out.asnumpy() - target).max())
+    sys.stdout.write("ELASTIC_DONE %d %.6f\\n" % (kv.rank, err))
+    sys.stdout.flush()
+    kv.close()
+    os._exit(0)
+""")
+
+
+@pytest.mark.timeout(600)
+def test_elastic_kill9_respawn_converges(tmp_path):
+    """THE acceptance oracle: kill -9 a worker mid-run, respawn it, and
+    the final weights match an uninterrupted run exactly. Two independent
+    server processes (elastic jobs pin server generation 0, so each run
+    owns a server); the interrupted worker is killed by fault injection
+    at its 5th push; its replacement reclaims rank 0 after the dead-node
+    timeout and resumes from the checkpointed step cursor."""
+    import time
+    TOTAL = 12
+    srv_script = tmp_path / "server.py"
+    srv_script.write_text(SERVER_ELASTIC.format(repo=REPO))
+    wrk_script = tmp_path / "worker.py"
+    wrk_script.write_text(WORKER_ELASTIC.format(repo=REPO))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_FAULT_INJECT", None)
+    env["MXNET_HEARTBEAT_INTERVAL"] = "1"
+    env["MXNET_DEAD_NODE_TIMEOUT"] = "2"
+
+    servers = []
+    try:
+        addr_files = [tmp_path / "addr_a", tmp_path / "addr_b"]
+        for af in addr_files:
+            servers.append(subprocess.Popen(
+                [sys.executable, str(srv_script), str(af)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                text=True, env=env))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not all(
+                af.exists() for af in addr_files):
+            time.sleep(0.5)
+        assert all(af.exists() for af in addr_files), "servers never up"
+
+        ck_oracle = tmp_path / "ck_oracle"
+        ck_int = tmp_path / "ck_int"
+        ck_oracle.mkdir()
+        ck_int.mkdir()
+
+        # uninterrupted oracle on server A / doomed worker on server B:
+        # fault injection SIGKILLs it at its 5th push (4 applied)
+        oracle = subprocess.Popen(
+            [sys.executable, str(wrk_script), str(addr_files[0]),
+             str(ck_oracle), str(TOTAL)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        env_kill = dict(env)
+        env_kill["MXNET_FAULT_INJECT"] = "push@5:kill"
+        doomed = subprocess.Popen(
+            [sys.executable, str(wrk_script), str(addr_files[1]),
+             str(ck_int), str(TOTAL)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env_kill)
+
+        out_d, err_d = doomed.communicate(timeout=240)
+        assert doomed.returncode == -9, (          # ACTUALLY kill -9'd
+            doomed.returncode, out_d, err_d[-1500:])
+        assert "ELASTIC_DONE" not in out_d
+
+        out_o, err_o = oracle.communicate(timeout=240)
+        assert oracle.returncode == 0, err_o[-2000:]
+        assert "ELASTIC_RESUMED_AT_0" in out_o
+        assert "ELASTIC_DONE" in out_o
+
+        time.sleep(4)       # > MXNET_DEAD_NODE_TIMEOUT: the registry must
+        #                     now judge rank 0 dead so the hint reclaims it
+        respawn = subprocess.Popen(
+            [sys.executable, str(wrk_script), str(addr_files[1]),
+             str(ck_int), str(TOTAL), "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        out_r, err_r = respawn.communicate(timeout=240)
+        assert respawn.returncode == 0, err_r[-2000:]
+        resumed = int([l for l in out_r.splitlines()
+                       if l.startswith("ELASTIC_RESUMED_AT_")][0]
+                      .rsplit("_", 1)[1])
+        assert resumed == 4, f"expected resume at step 4, got {resumed}"
+        assert "ELASTIC_DONE" in out_r
+
+        import numpy as np
+        w_oracle = np.load(ck_oracle / "final.npy")
+        w_respawn = np.load(ck_int / "final.npy")
+        np.testing.assert_allclose(w_respawn, w_oracle, rtol=1e-6,
+                                   atol=1e-7)
+        err = float(np.abs(w_oracle - np.array(
+            [1.0, -2.0, 3.0, 0.5], np.float32)).max())
+        assert err < 0.5, f"SGD did not move toward the target: {err}"
+    finally:
+        for s in servers:
+            s.kill()
+
+
+def test_midepoch_exact_cursor_resume(tmp_path):
+    """Mid-epoch resume restarts from the EXACT iterator cursor: the
+    combined interrupted+resumed consumption log equals the uninterrupted
+    run's — every batch exactly once, no skips, no repeats — and the
+    final weights match bit-for-bit-close."""
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import fault, gluon
+    from incubator_mxnet_tpu.parallel import TrainStep
+
+    BATCHES, DIM = 10, 6
+    rs = np.random.RandomState(7)
+    xs = [rs.randn(4, DIM).astype(np.float32) for _ in range(BATCHES)]
+    ys = [rs.randn(4, 2).astype(np.float32) for _ in range(BATCHES)]
+
+    def data_iter(log):
+        for i in range(BATCHES):
+            log.append(i)
+            yield (xs[i], ys[i])
+
+    def loss_fn(out, label):
+        return jnp.mean((out.astype(jnp.float32) - label) ** 2)
+
+    def make_step():
+        # fixed prefix: every instance names its params identically, the
+        # way a respawned process re-creating the model would see them
+        net = gluon.nn.Dense(2, in_units=DIM, prefix="net_")
+        net.initialize(mx.init.Constant(0.05))
+        return TrainStep(net, loss_fn, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.05,
+                                           "momentum": 0.9},
+                         example_inputs=[mx.nd.array(xs[0])])
+
+    # oracle: one uninterrupted epoch
+    log_full = []
+    step_a = make_step()
+    step_a.run_epoch(data_iter(log_full))
+    assert log_full == list(range(BATCHES))
+    w_full = {k: np.asarray(jax.device_get(v))
+              for k, v in step_a.params.items()}
+
+    # interrupted run: the process dies right after the checkpoint at
+    # cursor 6 (checkpoint_every=3 -> generations at cursors 3 and 6)
+    mgr = fault.CheckpointManager(str(tmp_path / "ck"))
+    log_int = []
+    step_b = make_step()
+    step_b.run_epoch(itertools.islice(data_iter(log_int), 6),
+                     checkpoint=mgr, checkpoint_every=3)
+    assert mgr.latest_step() == 6
+    assert mgr.data_state() == {"batch": 6}
+
+    # resume in a FRESH TrainStep (a new process would look like this):
+    # restore params/opt-state/step-count, fast-forward the source by the
+    # checkpointed cursor, finish the epoch
+    log_res = []
+    step_c = make_step()
+    step, data_state = step_c.load_checkpoint(mgr)
+    assert step == 6 and data_state == {"batch": 6}
+    step_c.run_epoch(data_iter(log_res), start_batch=data_state["batch"])
+
+    consumed = log_int[:6] + [i for i in log_res if i >= 6]
+    assert consumed == list(range(BATCHES)), consumed
+    # the resumed pipeline consumed the skipped prefix on the host but
+    # never stepped on it: cursor math, not batch replay
+    w_res = {k: np.asarray(jax.device_get(v))
+             for k, v in step_c.params.items()}
+    assert set(w_res) == set(w_full)
+    for k in w_full:
+        np.testing.assert_allclose(w_res[k], w_full[k], rtol=1e-6,
+                                   atol=1e-7)

@@ -138,3 +138,220 @@ def test_preemption_checkpoint_loop(tmp_path):
 def test_get_dead_nodes():
     assert fault.get_dead_nodes() == []
     assert mx.fault.get_dead_nodes(timeout_sec=1) == []
+
+
+# ---------------------------------------------------------------------------
+# PR 8: corruption degradation, write-behind checkpointing, fault injection
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def clean_fault_state():
+    """Isolate injector spec + counters; tests below mutate both."""
+    fault.set_fault_spec("")
+    fault._reset_stats()
+    yield
+    fault.set_fault_spec("")
+    fault._reset_stats()
+
+
+def _two_generations(tmp_path, with_trainer=True):
+    net = _net()
+    trainer = None
+    if with_trainer:
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        _train_steps(net, trainer, 1)
+    mgr = fault.CheckpointManager(str(tmp_path), max_keep=4)
+    mgr.save(1, net, trainer)
+    if with_trainer:
+        _train_steps(net, trainer, 1)
+    mgr.save(2, net, trainer)
+    return mgr, net, trainer
+
+
+def _flip_byte(path, offset=-1):
+    with open(path, "r+b") as f:
+        f.seek(offset, os.SEEK_END)
+        b = f.read(1)
+        f.seek(offset, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_truncated_params_falls_back(tmp_path, clean_fault_state):
+    mgr, net, trainer = _two_generations(tmp_path)
+    p2 = os.path.join(tmp_path, "ckpt-00000002.params")
+    with open(p2, "r+b") as f:
+        f.truncate(os.path.getsize(p2) // 2)
+    assert mgr.latest_step() == 1           # size mismatch vs manifest
+    net2 = _net()
+    assert mgr.restore(net2) == 1
+    assert fault.stats()["ckpt_fallbacks"] >= 1
+
+
+def test_bitflipped_params_falls_back(tmp_path, clean_fault_state):
+    mgr, net, trainer = _two_generations(tmp_path)
+    # same byte count, different content: only the sha256 can see it
+    _flip_byte(os.path.join(tmp_path, "ckpt-00000002.params"))
+    assert mgr.latest_step() == 1
+    net2 = _net()
+    trainer2 = gluon.Trainer(net2.collect_params(), "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9})
+    assert mgr.restore(net2, trainer2) == 1
+
+
+def test_bitflipped_states_falls_back(tmp_path, clean_fault_state):
+    mgr, net, trainer = _two_generations(tmp_path)
+    _flip_byte(os.path.join(tmp_path, "ckpt-00000002.states"))
+    assert mgr.latest_step() == 1           # optimizer state is an artifact
+    net2 = _net()
+    trainer2 = gluon.Trainer(net2.collect_params(), "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9})
+    assert mgr.restore(net2, trainer2) == 1
+
+
+def test_explicit_corrupt_step_raises(tmp_path, clean_fault_state):
+    """step=None degrades; an explicitly requested step must not silently
+    answer with a different generation."""
+    mgr, net, trainer = _two_generations(tmp_path)
+    _flip_byte(os.path.join(tmp_path, "ckpt-00000002.params"))
+    net2 = _net()
+    with pytest.raises(mx.MXNetError, match="unusable"):
+        mgr.restore(net2, step=2)
+
+
+def test_all_generations_corrupt_raises(tmp_path, clean_fault_state):
+    mgr, net, trainer = _two_generations(tmp_path)
+    for s in (1, 2):
+        _flip_byte(os.path.join(tmp_path, "ckpt-%08d.params" % s))
+    assert mgr.latest_step() is None
+    net2 = _net()
+    with pytest.raises(mx.MXNetError):
+        mgr.restore(net2)
+
+
+def test_async_manager_roundtrip_and_data_state(tmp_path, clean_fault_state):
+    net = _net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    _train_steps(net, trainer, 2)
+    with fault.AsyncCheckpointManager(str(tmp_path), max_keep=3) as mgr:
+        mgr.save_async(2, net, trainer, extra={"epoch": 0},
+                       data_state={"batch": 17})
+        mgr.flush(timeout=60)
+        assert mgr.pending() == 0
+        assert mgr.latest_step() == 2
+        assert mgr.data_state() == {"batch": 17}
+        assert mgr.extra() == {"epoch": 0}
+        net2 = _net()
+        trainer2 = gluon.Trainer(net2.collect_params(), "sgd",
+                                 {"learning_rate": 0.1, "momentum": 0.9})
+        assert fault.resume_or_start(mgr, net2, trainer2) == 2
+        vals1 = [v.data().asnumpy()
+                 for _, v in sorted(net.collect_params().items())]
+        vals2 = [v.data().asnumpy()
+                 for _, v in sorted(net2.collect_params().items())]
+        for a, b in zip(vals1, vals2):
+            np.testing.assert_allclose(b, a, rtol=1e-6)
+
+
+def test_async_queue_drops_oldest(tmp_path, clean_fault_state):
+    """A slow disk (injected delay on the background write) must drop the
+    OLDEST pending snapshot, never block the producer."""
+    fault.set_fault_spec("ckpt_write@1:delay=0.5")
+    net = _net()
+    mgr = fault.AsyncCheckpointManager(str(tmp_path), queue_size=1)
+    try:
+        for s in (1, 2, 3):
+            mgr.save_async(s, net)      # returns immediately every time
+        mgr.flush(timeout=60)
+        st = fault.stats()
+        assert st["ckpt_dropped"] >= 1
+        assert mgr.latest_step() == 3   # the newest state always lands
+    finally:
+        mgr.close()
+
+
+def test_async_write_error_surfaces_at_flush(tmp_path, clean_fault_state):
+    net = _net()
+    mgr = fault.AsyncCheckpointManager(str(tmp_path))
+    boom = OSError("disk full")
+
+    def _bad_commit(*a, **k):
+        raise boom
+    mgr._commit = _bad_commit
+    mgr.save_async(1, net)
+    with pytest.raises(mx.MXNetError, match="disk full"):
+        mgr.flush(timeout=60)
+    assert fault.stats()["ckpt_errors"] == 1
+    mgr.flush(timeout=60)               # error cleared once raised
+    del mgr._commit                     # close() drains through the real one
+    mgr.close()
+
+
+def test_async_closed_rejects_saves(tmp_path, clean_fault_state):
+    net = _net()
+    mgr = fault.AsyncCheckpointManager(str(tmp_path))
+    mgr.close()
+    with pytest.raises(mx.MXNetError, match="closed"):
+        mgr.save_async(1, net)
+    mgr.close()                         # idempotent
+
+
+def test_preemption_callback_failure_is_logged(caplog, clean_fault_state):
+    """S2: a crashing on_preempt must stop the loop anyway AND leave a
+    warning with the traceback — never a silent `except: pass`."""
+    def bad_callback():
+        raise RuntimeError("emergency save exploded")
+
+    pre = fault.PreemptionHandler(signals=(signal.SIGUSR1,),
+                                  on_preempt=bad_callback)
+    with pre:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        with caplog.at_level("WARNING", logger="incubator_mxnet_tpu.fault"):
+            assert pre.should_stop()    # still stops
+            assert pre.should_stop()    # callback fired exactly once
+    text = caplog.text
+    assert "on_preempt callback failed" in text
+    assert "emergency save exploded" in text    # full traceback logged
+    assert text.count("on_preempt callback failed") == 1
+
+
+def test_fault_injector_parse_and_actions(clean_fault_state):
+    for bad in ("push", "push@x:drop", "push@1:explode", "push@1"):
+        with pytest.raises(mx.MXNetError, match="MXNET_FAULT_INJECT"):
+            fault.FaultInjector(bad)
+    assert not fault.FaultInjector("").active
+
+    fault.set_fault_spec("push@2:drop,step@1:delay=0.05")
+    fault.inject("push")                        # hit 1: no-op
+    with pytest.raises(ConnectionError, match="injected frame drop"):
+        fault.inject("push")                    # hit 2: fires
+    fault.inject("push")                        # hit 3: spent
+    t0 = __import__("time").monotonic()
+    fault.inject("step")
+    assert __import__("time").monotonic() - t0 >= 0.05
+    assert fault.stats()["faults_injected"] == 2
+
+
+def test_get_dead_nodes_delegates_to_registered_store(clean_fault_state):
+    class _StubKV:
+        def get_dead_nodes(self, timeout=None):
+            return [3, timeout]
+
+    saved = list(fault._live_kvstores)
+    try:
+        stub = _StubKV()
+        fault._register_kvstore(stub)
+        assert fault.get_dead_nodes(timeout_sec=7) == [3, 7]
+    finally:
+        fault._live_kvstores[:] = saved
+
+
+def test_fault_counters_in_profiler(clean_fault_state):
+    from incubator_mxnet_tpu import profiler
+    out = profiler.render_prometheus()
+    assert "mxnet_worker_heartbeats_total" in out
+    assert "mxnet_worker_checkpoint_saves_total" in out
+    fault._bump("heartbeats_sent", 5)
+    js = json.loads(profiler.dumps(format="json"))
+    assert js["fault"]["heartbeats_sent"] == 5

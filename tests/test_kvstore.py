@@ -337,3 +337,165 @@ def test_class_seq_counters_thread_safe():
     for chunk in seen:
         assert chunk == sorted(chunk)  # per-thread monotone
     del KVStore._test_seq
+
+
+# ---------------------------------------------------------------------------
+# PR 8: membership registry, client retry budget, elastic direct-connect
+# ---------------------------------------------------------------------------
+
+def test_async_server_membership_registry(monkeypatch):
+    """register/heartbeat/dead_nodes/membership against an in-process
+    server: rank assignment, epoch bumps, dead detection after silence,
+    straggler classification, and rank reclamation by a replacement."""
+    import time
+
+    from incubator_mxnet_tpu.kvstore_server import AsyncClient, AsyncServer
+
+    monkeypatch.setenv("MXNET_DEAD_NODE_TIMEOUT", "1")
+    srv = AsyncServer()
+    addr = srv.start()
+    try:
+        c0 = AsyncClient(addr, srv.token)
+        c1 = AsyncClient(addr, srv.token)
+        r0 = c0.call("register", 0, None)
+        assert r0["rank"] == 0 and not r0["rejoined"]
+        r1 = c1.call("register", 0, None)
+        assert r1["rank"] == 1 and r1["epoch"] > r0["epoch"]
+        assert r1["num_workers"] == 2
+
+        # rank 0 keeps beating (advancing to step 10); rank 1 goes silent
+        for _ in range(4):
+            c0.call("heartbeat", 0, 0, 10)
+            time.sleep(0.35)
+        assert c0.call("dead_nodes", 0, 1.0) == [1]
+        m = c0.call("membership", 0, 1.0, 5)
+        assert m["workers"] == [0, 1] and m["dead"] == [1]
+        assert m["stragglers"] == []    # dead ranks are not stragglers
+        assert m["steps"][0] == 10
+
+        # a replacement worker RECLAIMS the dead rank via its hint
+        c2 = AsyncClient(addr, srv.token)
+        r2 = c2.call("register", 0, 1)
+        assert r2["rank"] == 1 and r2["rejoined"]
+        assert r2["epoch"] > r1["epoch"]
+        # ...but a hint naming a LIVE rank never steals the identity
+        c3 = AsyncClient(addr, srv.token)
+        r3 = c3.call("register", 0, 0)
+        assert r3["rank"] == 2 and not r3["rejoined"]
+        # rank 2 is alive at step 0 while the leader is at 10: straggler
+        m2 = c0.call("membership", 0, 60.0, 5)
+        assert 2 in m2["stragglers"]
+        for c in (c0, c1, c2, c3):
+            c.close()
+    finally:
+        srv.stop()
+
+
+def test_async_client_connect_retry_budget(monkeypatch):
+    """A dead endpoint fails FAST with a clear error naming the budget —
+    never a hang (S1)."""
+    import time
+
+    from incubator_mxnet_tpu.kvstore_server import AsyncClient
+
+    monkeypatch.setenv("MXNET_KVSTORE_CONNECT_TIMEOUT", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRIES", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_BACKOFF_MS", "10")
+    t0 = time.monotonic()
+    with pytest.raises(mx.base.MXNetError,
+                       match="unreachable after 2 connect attempts"):
+        AsyncClient("127.0.0.1:1", "deadbeef")   # nothing listens on :1
+    assert time.monotonic() - t0 < 10
+
+
+def test_async_client_call_retries_over_fresh_connection(monkeypatch):
+    """A connection reset mid-session is survived transparently: the call
+    redials and retries. An application-level 'err' reply, by contrast,
+    is raised immediately — the server ANSWERED."""
+    import socket as _socket
+
+    from incubator_mxnet_tpu.kvstore_server import AsyncClient, AsyncServer
+
+    monkeypatch.setenv("MXNET_KVSTORE_RETRIES", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_BACKOFF_MS", "10")
+    srv = AsyncServer()
+    addr = srv.start()
+    try:
+        c = AsyncClient(addr, srv.token)
+        c.call("init", 0, "w", np.zeros(3, np.float32))
+        c._sock.shutdown(_socket.SHUT_RDWR)      # simulated reset
+        np.testing.assert_allclose(c.call("pull", 0, "w"), 0.0)
+        with pytest.raises(mx.base.MXNetError, match="not initialized"):
+            c.call("pull", 0, "nope")
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_elastic_kvstore_registry_end_to_end(monkeypatch):
+    """Elastic direct-connect mode (MXNET_KVSTORE_ASYNC_ADDR): server
+    assigns ranks, a join flips the membership-dirty flag via heartbeat,
+    the next push refreshes num_workers, a silent worker turns up in
+    get_dead_nodes, and a respawn with rank_hint reclaims the rank."""
+    import time
+
+    from incubator_mxnet_tpu import fault
+    from incubator_mxnet_tpu.kvstore_server import AsyncServer
+
+    monkeypatch.setenv("MXNET_HEARTBEAT_INTERVAL", "1")
+    monkeypatch.setenv("MXNET_DEAD_NODE_TIMEOUT", "2")
+    srv = AsyncServer()
+    addr = srv.start()
+    monkeypatch.setenv("MXNET_KVSTORE_ASYNC_ADDR", f"{addr} {srv.token}")
+    stores = []
+    try:
+        kv = kvstore.create("dist_async")
+        stores.append(kv)
+        assert kv.rank == 0 and kv.num_workers == 1
+        kv.init("w", mx.nd.zeros((4,)))
+        kv.push("w", mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+        kv2 = kvstore.create("dist_async")       # second worker joins
+        stores.append(kv2)
+        assert kv2.rank == 1
+        deadline = time.monotonic() + 15         # one beat carries the
+        while time.monotonic() < deadline:       # epoch bump back
+            if kv._membership_dirty:
+                break
+            time.sleep(0.2)
+        assert kv._membership_dirty, "join never observed via heartbeat"
+        kv.push("w", mx.nd.ones((4,)))           # consumer-side refresh
+        assert not kv._membership_dirty
+        assert kv.num_workers == 2
+        assert kv.membership()["workers"] == [0, 1]
+        assert kv.get_dead_nodes(timeout=60) == []
+
+        kv2.close()                              # rank 1 stops beating
+        deadline = time.monotonic() + 20
+        dead = []
+        while time.monotonic() < deadline:
+            dead = kv.get_dead_nodes(timeout=2)
+            if dead:
+                break
+            time.sleep(0.5)
+        assert dead == [1], f"silent rank never reported dead: {dead}"
+
+        before = fault.stats()["rejoins"]
+        kv3 = kvstore.create("dist_async", rank_hint=1)  # the respawn
+        stores.append(kv3)
+        assert kv3.rank == 1
+        assert fault.stats()["rejoins"] == before + 1
+        # module-level liveness API answers through the newest store
+        assert fault.get_dead_nodes(timeout_sec=60) == []
+    finally:
+        for s in stores:
+            s.close()
+        srv.stop()
+
+
+def test_rejoin_requires_dist_async():
+    with pytest.raises(mx.base.MXNetError, match="dist_async"):
+        kvstore.create("local").rejoin()

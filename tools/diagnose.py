@@ -120,6 +120,24 @@ def main():
     except Exception as e:
         print("tune probe FAILED:", e)
 
+    print("----------Fault Tolerance (fault)----------")
+    try:
+        from incubator_mxnet_tpu import fault
+        s = fault.stats()
+        print("checkpoint   :",
+              {k.replace("ckpt_", ""): s[k] for k in
+               ("ckpt_saves", "ckpt_async_snapshots", "ckpt_dropped",
+                "ckpt_errors", "ckpt_fallbacks", "ckpt_last_step")})
+        print("write ms     :", round(s["ckpt_write_ms"], 1))
+        print("liveness     :",
+              {k: s[k] for k in ("heartbeats_sent", "dead_nodes_seen",
+                                 "stragglers_seen", "rejoins",
+                                 "membership_changes")})
+        print("injected     :", s["faults_injected"])
+        print("dead nodes   :", fault.get_dead_nodes())
+    except Exception as e:
+        print("fault probe FAILED:", e)
+
     print("----------Static Analysis (mxlint)----------")
     try:
         from tools.mxlint import lint_paths

@@ -32,8 +32,18 @@ LOCK_ORDER = {
     "serve/batcher.py": ("self._lock",),
     "serve/stats.py": ("self._lock",),
     "serve/predictor.py": ("self._compile_lock",),
-    "kvstore_server.py": ("self._lock",),
+    # kvstore_server: update lock outermost (it serializes pushes, like
+    # the reference's executor queue); the heartbeat/liveness registry
+    # lock is a LEAF — push refreshes liveness only AFTER releasing the
+    # update lock, so the two never nest in either direction. The
+    # AsyncClient's connection lock is also spelled self._lock.
+    "kvstore_server.py": ("self._lock", "self._hb_lock"),
     "kvstore.py": ("KVStore._class_lock",),
+    # fault: AsyncCheckpointManager's queue lock and FaultInjector's hit
+    # counter (both spelled self._lock at their sites) stay outermost of
+    # the module-level stats-counter leaf lock (_bump runs under _wlock
+    # holders' call chains via _commit).
+    "fault.py": ("self._wlock", "self._lock", "_stats_lock"),
     "gluon/block.py": ("cls._lock",),
     "symbol/symbol.py": ("cls._lock",),
     "native/__init__.py": ("_lock",),
