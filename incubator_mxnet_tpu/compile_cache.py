@@ -353,6 +353,7 @@ class _CachedJit:
         self.__wrapped__ = fn
         self._jfn = jax.jit(fn, **jit_kwargs)
         self._opts = repr(sorted(jit_kwargs.items()))
+        self._donate = tuple(jit_kwargs.get("donate_argnums", ()) or ())
         # plain-jit escape hatch: anything the AOT path cannot serve
         # (tracer args, exotic leaves, executable/aval skew) runs here,
         # keeping track_jit's probe-based accounting for those calls
@@ -370,6 +371,13 @@ class _CachedJit:
         if fp is not None:
             return fp, None
         traced = self._jfn.trace(*args, **kwargs)
+        # shardlint graph capture: this branch runs once per call
+        # signature per process, so the observation is free when off and
+        # a single snapshot when on
+        from . import shardlint as _sl
+        if _sl.enabled():
+            _sl.record_jit(self._key, traced=traced,
+                           donate_argnums=self._donate)
         fp = _fingerprint(self._key, self._opts, traced, sig)
         with self._lock:
             while len(self._fps) >= _SIG_MEMO_MAX:
@@ -436,6 +444,15 @@ class _CachedJit:
         except Exception:       # noqa: BLE001 — aval/layout skew at call
             self._note_fallback()
             return self._fallback(*args, **kwargs)
+
+    def trace_signature(self, *args, **kwargs):
+        """Trace (but do NOT compile) this call signature, returning its
+        fingerprint. Cheap way to materialize the jaxpr for one signature
+        — the shardlint offline corpus uses it to feed the capture hook
+        without paying an XLA compile. Args may be concrete arrays or
+        `jax.ShapeDtypeStruct` avals."""
+        fp, _traced = self._fingerprint_for(args, kwargs)
+        return fp
 
     def warmup(self, *args, **kwargs):
         """Materialize the executable for this signature WITHOUT running

@@ -438,6 +438,13 @@ def _fused_fn(op_name, n, arity, static_items, dyn_keys):
     # a fresh trainer process deserialize the fused step instead of
     # recompiling it
     from .. import compile_cache as _cc
+    from .. import shardlint as _sl
+    # role map for shardlint's donation audit (SL03): args 0/1 are the
+    # dyn-vector tuple and rescale scalar; within each weight's
+    # arity-slot, position 1 is the gradient, the rest are weight/state
+    _sl.annotate(f"fused:{op_name}[n={n}]",
+                 arg_roles={2 + j: ("grads" if j % arity == 1 else "params")
+                            for j in range(arity * n)})
     if donate:
         # flat starts at position 2; within each weight's arity-slot,
         # position 1 is the gradient — everything else is donatable

@@ -20,17 +20,21 @@ from .ring_attention import ring_attention, ring_attention_sharded
 from .flash_attention import flash_attention, flash_attention_bh
 from .pipeline import pipeline_apply, pipeline_sharded
 from .moe import moe_apply, moe_sharded, init_moe_params
+from .partition import match_partition_rules
 from .tensor_parallel import (column_parallel_spec, row_parallel_spec,
-                              transformer_param_specs)
+                              transformer_param_specs,
+                              transformer_partition_rules)
 from .compression import (quantized_allreduce, quantized_psum,
                           quantize_pack, quantize_pack_pallas,
                           two_bit_pack, two_bit_unpack)
 
 __all__ = ["make_mesh", "local_mesh_axis_sizes", "functionalize", "TrainStep",
+           "match_partition_rules",
            "shard_batch", "ring_attention", "ring_attention_sharded",
            "flash_attention", "flash_attention_bh", "pipeline_apply", "pipeline_sharded",
            "moe_apply", "moe_sharded", "init_moe_params",
            "column_parallel_spec", "row_parallel_spec",
-           "transformer_param_specs", "quantized_allreduce",
+           "transformer_param_specs", "transformer_partition_rules",
+           "quantized_allreduce",
            "quantized_psum", "quantize_pack", "quantize_pack_pallas",
            "two_bit_pack", "two_bit_unpack"]

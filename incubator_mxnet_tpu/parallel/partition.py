@@ -1,0 +1,84 @@
+"""Partition-rule matching for param/optimizer pytrees (ROADMAP item 2).
+
+`match_partition_rules(rules, params)` maps every named leaf of a flat
+param dict to a PartitionSpec by first-match regex search — the
+EasyLM/t5x idiom the tensor-parallel models hand-roll today. The
+framework-level contract this adds on top of the idiom:
+
+  * a scalar leaf is replicated by policy (a P() spec) and counted as
+    *declared* replicated, never as a rule match;
+  * an UNMATCHED leaf is an error by default (`on_unmatched="error"`):
+    silent fall-to-replication is exactly the accidental-full-replication
+    bug SL04 exists to catch. `on_unmatched="replicate"` keeps the
+    permissive behavior but records the unmatched names in a shardlint
+    partition capture, so the analyzer still reports them;
+  * when MXNET_SHARDLINT capture is on, every call records a coverage
+    report (leaves / matched / unmatched / replicated) keyed by `key`.
+"""
+from __future__ import annotations
+
+import re
+
+from ..base import MXNetError
+
+__all__ = ["match_partition_rules"]
+
+
+def match_partition_rules(rules, params, on_unmatched="error",
+                          key="partition"):
+    """Resolve a PartitionSpec per named leaf of `params`.
+
+    rules: iterable of (pattern, PartitionSpec) tried in order; the first
+        pattern whose `re.search` hits the leaf name wins. A pattern of
+        the exact string "replicated" in spec position None is not
+        special — declare replication with an explicit PartitionSpec().
+    params: mapping leaf name -> array-like (anything with ndim/shape).
+    on_unmatched: "error" raises MXNetError naming the unmatched leaves;
+        "replicate" gives them PartitionSpec() and reports them through
+        the shardlint partition capture (SL04 flags each one).
+    key: capture key for the coverage report.
+
+    Returns {leaf name: PartitionSpec}.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if on_unmatched not in ("error", "replicate"):
+        raise MXNetError(f"match_partition_rules: on_unmatched must be "
+                         f"'error' or 'replicate', got {on_unmatched!r}")
+    compiled = [(pat, re.compile(pat), spec) for pat, spec in rules]
+    specs = {}
+    matched, unmatched, replicated = {}, [], []
+    for name, value in params.items():
+        ndim = getattr(value, "ndim", None)
+        if ndim is None:
+            ndim = len(getattr(value, "shape", ()) or ())
+        if ndim == 0:
+            # scalars cannot be sharded; replicated by policy
+            specs[name] = P()
+            replicated.append(name)
+            continue
+        for pat, rx, spec in compiled:
+            if rx.search(name):
+                if spec is None:
+                    raise MXNetError(
+                        f"match_partition_rules: rule {pat!r} maps "
+                        f"{name!r} to None; use PartitionSpec() to "
+                        f"replicate explicitly")
+                specs[name] = spec
+                matched[name] = pat
+                break
+        else:
+            unmatched.append(name)
+            specs[name] = P()
+    from .. import shardlint as _sl
+    if _sl.enabled():
+        _sl.record_partition(key, leaves=list(params), matched=matched,
+                             unmatched=unmatched, replicated=replicated,
+                             rules=[pat for pat, _rx, _s in compiled])
+    if unmatched and on_unmatched == "error":
+        raise MXNetError(
+            f"Partition rule not found for params: {unmatched[:5]}"
+            f"{'...' if len(unmatched) > 5 else ''} — every non-scalar "
+            f"leaf must match a rule or be explicitly replicated "
+            f"(add a ('.*', PartitionSpec()) catch-all to opt in)")
+    return specs

@@ -12,7 +12,7 @@ from __future__ import annotations
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["column_parallel_spec", "row_parallel_spec",
-           "transformer_param_specs"]
+           "transformer_param_specs", "transformer_partition_rules"]
 
 
 def column_parallel_spec(axis="tp"):
@@ -41,3 +41,18 @@ def transformer_param_specs(name, value, tp_axis="tp"):
     if "embed" in name:
         return P(None, tp_axis)
     return P()
+
+
+def transformer_partition_rules(tp_axis="tp"):
+    """The same Megatron layout as a `match_partition_rules` table
+    (first-match-wins regexes over models/transformer.py parameter
+    names). Unlike the per-leaf spec fn, a table is *auditable*: the
+    shardlint SL04 pass (and `on_unmatched="error"`) can prove total
+    coverage, and the trailing explicit catch-all is the declared
+    replicate-everything-else decision, not a silent fallback."""
+    return [
+        (r"(wq|wk|wv|w_in|wi)$", P(None, tp_axis)),   # column parallel
+        (r"(wo|w_out)$", P(tp_axis, None)),           # row parallel
+        (r"embed$", P(None, tp_axis)),                # embed + pos_embed
+        (r".*", P()),   # layernorm scales/biases etc.: replicated
+    ]

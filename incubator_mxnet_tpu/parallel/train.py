@@ -146,8 +146,9 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, example_inputs=None, param_spec_fn=None,
-                 data_axis="dp", dtype=None, donate=True):
+                 param_rules=None, data_axis="dp", dtype=None, donate=True):
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from .. import shardlint as _sl
 
         if example_inputs is None:
             raise MXNetError("TrainStep needs example_inputs")
@@ -178,11 +179,41 @@ class TrainStep:
             optimizer, self._lr, self._momentum, self._wd, opt_kwargs)
         opt_state = {k: state_init(v) for k, v in params.items()}
 
-        # shardings: params replicated (or per param_spec_fn), optimizer
-        # state sharded exactly like its weight, batch on dp
+        # shardings: params replicated (or per param_rules/param_spec_fn),
+        # optimizer state sharded exactly like its weight, batch on dp
         if mesh is not None:
-            pspec = {k: (param_spec_fn(k, v) if param_spec_fn else P())
-                     for k, v in params.items()}
+            if param_rules is not None and param_spec_fn is not None:
+                raise MXNetError("TrainStep takes param_rules OR "
+                                 "param_spec_fn, not both")
+            if param_rules is not None:
+                # regex table; an unmatched non-scalar leaf is an ERROR —
+                # silent fall-to-replication is the SL04 bug class
+                from .partition import match_partition_rules
+                pspec = match_partition_rules(
+                    param_rules, params, on_unmatched="error",
+                    key=f"trainstep:{optimizer}")
+            else:
+                pspec = {}
+                for k, v in params.items():
+                    s = param_spec_fn(k, v) if param_spec_fn else P()
+                    if s is None:
+                        # a None spec used to flow into NamedSharding and
+                        # die with an opaque TypeError — name the leaf and
+                        # demand an explicit decision instead
+                        raise MXNetError(
+                            f"param_spec_fn returned None for {k!r}; "
+                            f"return PartitionSpec() to replicate this "
+                            f"leaf explicitly (or use param_rules=)")
+                    pspec[k] = s
+                if _sl.enabled():
+                    # explicit fn (or the documented replicate-all
+                    # default) counts as declared — SL04 stays quiet
+                    _sl.record_partition(
+                        f"trainstep:{optimizer}", leaves=list(params),
+                        matched={k: "param_spec_fn" for k in params}
+                        if param_spec_fn else {},
+                        unmatched=[],
+                        replicated=[] if param_spec_fn else list(params))
             param_sh = {k: NamedSharding(mesh, s) for k, s in pspec.items()}
             params = {k: jax.device_put(v, param_sh[k])
                       for k, v in params.items()}
@@ -227,11 +258,28 @@ class TrainStep:
             return new_params, new_opt, loss
 
         self._step_fn = step_fn
-        self._donate = donate
+        # donation is requested only where the backend actually aliases
+        # buffers (same gate as the fused optimizer path): on CPU a
+        # donated-then-ignored buffer would still be poisoned for the
+        # caller on any backend that honors deletion
+        self._donate = bool(donate) and _oo._donation_supported()
         self._copts = default_compiler_options()
-        self._jit_step = jax.jit(step_fn,
-                                 donate_argnums=(0, 1) if donate else (),
-                                 compiler_options=self._copts)
+        self._jit_key = f"trainstep:{optimizer}"
+        # declare what the step's args mean so the shardlint donation
+        # audit (SL03) and bf16 rule (SL02) can judge this program
+        _sl.annotate(self._jit_key,
+                     arg_roles={0: "params", 1: "opt_state", 2: "rng",
+                                3: "step"},
+                     declared_bf16=(dtype is not None and
+                                    jnp.dtype(dtype) == jnp.bfloat16))
+        # the whole step routes through the two-tier executable cache —
+        # it was the one hot jit in the package that escaped both
+        # track_jit telemetry and the AOT/disk tier
+        from .. import compile_cache as _cc
+        self._jit_step = _cc.cached_jit(
+            self._jit_key, step_fn,
+            donate_argnums=(0, 1) if self._donate else (),
+            compiler_options=self._copts)
         self._jit_multi = {}
 
     def _to_device(self, batch):
@@ -347,6 +395,20 @@ class TrainStep:
             for k, st in self.opt_state.items()}
         self._step_count = step
         return step, data_state
+
+    def trace_for_analysis(self, *batch):
+        """Trace (but do not compile or run) the step for this batch
+        signature. With MXNET_SHARDLINT capture on, this feeds the full
+        step jaxpr to the analyzer — the tools/shardlint offline corpus
+        drives TrainStep entries through here so `python -m
+        tools.shardlint` never pays an XLA compile for them."""
+        from ..ndarray import random as _rnd
+        arrs = self._to_device(batch)
+        rng = _rnd.next_key()
+        tracer = getattr(self._jit_step, "trace_signature", None)
+        if tracer is not None:
+            tracer(self.params, self.opt_state, rng, self._step_count,
+                   *arrs)
 
     def __call__(self, *batch):
         from ..ndarray import random as _rnd

@@ -343,10 +343,31 @@ def track_jit(key, fn):
     # first-call detection must be atomic: concurrent first calls would
     # otherwise both read called=False and both record a miss (the CC01
     # unlocked read-modify-write pattern mxlint polices)
-    state = {"called": False}
+    state = {"called": False, "captured": False}
     state_lock = threading.Lock()
 
+    def _maybe_capture(args, kwargs):
+        # shardlint graph capture for track_jit sites that did not route
+        # through cached_jit: re-trace the jitted callable once (analysis
+        # mode only — enabled() is off in production)
+        from . import shardlint as _sl
+        if not _sl.enabled():
+            return
+        tracer = getattr(fn, "trace", None)
+        if tracer is None:
+            return
+        try:
+            _sl.record_jit(key, traced=tracer(*args, **kwargs))
+        except Exception:       # noqa: BLE001 — capture must never break a call
+            pass
+
     def wrapped(*args, **kwargs):
+        if not state["captured"]:
+            with state_lock:
+                first_capture = not state["captured"]
+                state["captured"] = True
+            if first_capture:
+                _maybe_capture(args, kwargs)
         before = None
         if probe is not None:
             try:
@@ -582,6 +603,20 @@ def _tune_stats(always=False):
     return snap
 
 
+def _shardlint_stats(always=False):
+    """Graph-capture counters (shardlint.stats(): enabled flag, buffered
+    captures by kind, drops), or None when capture is off and nothing was
+    ever recorded (unless `always`)."""
+    try:
+        from . import shardlint as _sl
+        snap = _sl.stats()
+    except Exception:       # noqa: BLE001 — torn-down interpreter
+        return None
+    if not always and not any(snap.values()):
+        return None
+    return snap
+
+
 def _fault_stats(always=False):
     """Fault-tolerance counters (fault.stats(): checkpoints, heartbeats,
     dead/straggler sightings, rejoins), or None when the process did no
@@ -725,6 +760,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     exec_cache = _exec_cache_stats()
     tune_snap = _tune_stats()
     fault_snap = _fault_stats()
+    sl_snap = _shardlint_stats()
     if format == "json":
         out = {
             "stats": {k: {"count": v[0], "total_us": _finite(v[1], 0.0),
@@ -740,6 +776,8 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             out["tune"] = tune_snap
         if fault_snap is not None:
             out["fault"] = fault_snap
+        if sl_snap is not None:
+            out["shardlint"] = sl_snap
         if mem is not None:
             out["memory"] = {"live_bytes": mem["live_bytes"],
                              "peak_bytes": mem["peak_bytes"],
@@ -789,6 +827,12 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             v = fault_snap[k]
             sval = f"{v:.1f}" if isinstance(v, float) else f"{v}"
             lines.append(f"{'fault_' + k:<34}{sval:>12}")
+    if sl_snap is not None:
+        lines += ["", f"{'Graph capture (shardlint)':<34}{'Value':>12}",
+                  "-" * 46]
+        for k in ("enabled", "captures", "jit", "tuned", "partition",
+                  "dropped"):
+            lines.append(f"{'shardlint_' + k:<34}{sl_snap[k]:>12}")
     if mem is not None and (mem["live_bytes"] or mem["peak_bytes"]):
         lines += ["", f"{'Memory (device)':<48}{'Live(bytes)':>14}"
                       f"{'Peak(bytes)':>14}",
@@ -923,6 +967,27 @@ def render_prometheus():
             suffix = "_total" if mtype == "counter" else ""
             family(f"mxnet_tune_{stat}{suffix}", mtype, help_text)
             lines.append(f"mxnet_tune_{stat}{suffix} {tn[stat]}")
+
+    sl = _shardlint_stats(always=True)
+    if sl is not None:
+        _SL_FAMILIES = (
+            ("enabled", "gauge",
+             "1 while MXNET_SHARDLINT graph capture is on"),
+            ("captures", "gauge",
+             "shardlint captures currently buffered"),
+            ("jit", "counter",
+             "jaxpr captures recorded at the jit choke points"),
+            ("tuned", "counter",
+             "tuned_call dispatch records captured"),
+            ("partition", "counter",
+             "partition-rule coverage reports captured"),
+            ("dropped", "counter",
+             "captures evicted by the bounded buffer"),
+        )
+        for stat, mtype, help_text in _SL_FAMILIES:
+            suffix = "_total" if mtype == "counter" else ""
+            family(f"mxnet_shardlint_{stat}{suffix}", mtype, help_text)
+            lines.append(f"mxnet_shardlint_{stat}{suffix} {sl[stat]}")
 
     ft = _fault_stats(always=True)
     if ft is not None:

@@ -1,0 +1,291 @@
+"""Graph-capture registry for the shardlint analyzer (tools/shardlint).
+
+mxlint (PR 4) sees Python AST; the bugs that cost MFU at scale — silent
+full replication, implicit cross-device transfers, f64 promotion, missed
+donation, host callbacks inside a hot step — only appear in the *lowered*
+program. This module is the package-side half of the analyzer: a bounded
+registry of `Capture` records snapshotted at the jit choke points the
+framework already owns (`compile_cache.cached_jit`, `profiler.track_jit`,
+`tune.tuned_call`) plus the partition-rule matcher
+(`parallel.partition.match_partition_rules`).
+
+Capture is OFF by default (`MXNET_SHARDLINT`); when off every hook is a
+cached boolean check on a path that already runs at most once per call
+signature, so steady-state training and serving pay nothing (asserted by
+tests/test_shardlint.py). The rule passes themselves (SL01-SL05) live in
+tools/shardlint and never import from here at package import time.
+
+Call sites that know what their arguments *mean* declare it with
+`annotate(key, arg_roles=..., declared_bf16=...)`; the donation audit
+(SL03) and mixed-precision rule (SL02) only judge what a call site has
+explicitly declared, so un-annotated user jits are never false positives.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Capture", "enabled", "enable", "reset", "annotate",
+           "annotation_for", "record_jit", "record_tuned",
+           "record_partition", "trace_capture", "partition_capture",
+           "captures", "clear", "stats"]
+
+# Guards the capture buffer, counters, and annotation table
+# (declared in tools/mxlint/lock_order.py).
+_lock = threading.Lock()
+_captures = []
+_annotations = {}            # jit key -> metadata dict
+_stats = {
+    "jit": 0,                # jaxpr captures at cached_jit/track_jit
+    "tuned": 0,              # tuned_call dispatch records
+    "partition": 0,          # partition-rule coverage records
+    "dropped": 0,            # captures evicted by the bounded buffer
+}
+_enabled = None              # cached MXNET_SHARDLINT read; None = unread
+
+
+class Capture:
+    """One graph-level observation for the rule passes.
+
+    kind is "jit" (a traced program: `jaxpr` is the ClosedJaxpr),
+    "tuned" (a tuned_call dispatch: metadata only, args may be tracers),
+    or "partition" (a partition-rule coverage report: `meta` holds
+    leaves/matched/unmatched/replicated name lists).
+    """
+
+    __slots__ = ("key", "kind", "jaxpr", "donate_argnums", "arg_roles",
+                 "declared_bf16", "donation_supported", "backend",
+                 "lowered_text", "allgather_budget", "meta")
+
+    def __init__(self, key, kind="jit", jaxpr=None, donate_argnums=(),
+                 arg_roles=None, declared_bf16=False,
+                 donation_supported=False, backend="unknown",
+                 lowered_text=None, allgather_budget=None, meta=None):
+        self.key = key
+        self.kind = kind
+        self.jaxpr = jaxpr
+        self.donate_argnums = tuple(donate_argnums or ())
+        self.arg_roles = dict(arg_roles) if arg_roles else None
+        self.declared_bf16 = bool(declared_bf16)
+        self.donation_supported = bool(donation_supported)
+        self.backend = backend
+        self.lowered_text = lowered_text
+        self.allgather_budget = allgather_budget
+        self.meta = dict(meta) if meta else {}
+
+    def __repr__(self):
+        return f"Capture({self.key!r}, kind={self.kind!r})"
+
+
+# ---------------------------------------------------------------------------
+# the on/off gate
+# ---------------------------------------------------------------------------
+
+def enabled():
+    """True when graph capture is on. The env var is read once and the
+    answer cached — the hooks sit on trace paths but must stay free."""
+    global _enabled
+    if _enabled is None:
+        from .util import getenv_bool
+        _enabled = getenv_bool("MXNET_SHARDLINT")
+    return _enabled
+
+
+def enable(on=True):
+    """Force capture on/off for this process (tests, the offline CLI);
+    returns the previous effective state."""
+    global _enabled
+    prev = enabled()
+    _enabled = bool(on)
+    return prev
+
+
+def reset():
+    """Forget the cached MXNET_SHARDLINT read and drop all state — the
+    next `enabled()` consults the environment again."""
+    global _enabled
+    _enabled = None
+    clear(stats=True)
+
+
+def _cap_max():
+    from .util import getenv_int
+    return max(getenv_int("MXNET_SHARDLINT_CAPTURES"), 1)
+
+
+# ---------------------------------------------------------------------------
+# call-site metadata
+# ---------------------------------------------------------------------------
+
+def annotate(key, arg_roles=None, declared_bf16=None, allgather_budget=None):
+    """Declare what a jit key's arguments mean. `arg_roles` maps positional
+    argnum -> one of "params" / "opt_state" / "weights" (donation-eligible),
+    "grads" (must NOT be donated), "weights_shared" (reused across calls,
+    never donated), "rng" / "step" / "data" (neutral). `declared_bf16`
+    marks the program as an intentional-bf16 region for SL02;
+    `allgather_budget` caps all-gathers counted on lowered modules (SL05).
+    Annotation is unconditional (construction-time, not per-call) so a
+    capture recorded after a later enable() still finds it."""
+    with _lock:
+        entry = _annotations.setdefault(key, {})
+        if arg_roles is not None:
+            entry["arg_roles"] = dict(arg_roles)
+        if declared_bf16 is not None:
+            entry["declared_bf16"] = bool(declared_bf16)
+        if allgather_budget is not None:
+            entry["allgather_budget"] = int(allgather_budget)
+
+
+def annotation_for(key):
+    with _lock:
+        entry = _annotations.get(key)
+        return dict(entry) if entry else {}
+
+
+def _donation_supported():
+    # single source of truth for "does this backend alias buffers"
+    from .ops.optimizer_ops import _donation_supported as ds
+    try:
+        return ds()
+    except Exception:       # noqa: BLE001 — no jax backend yet
+        return False
+
+
+def _backend():
+    from .compile_cache import _backend as bk
+    try:
+        return bk()
+    except Exception:       # noqa: BLE001
+        return "unknown"
+
+
+def _push(cap, counter):
+    cap_max = _cap_max()
+    with _lock:
+        _captures.append(cap)
+        _stats[counter] += 1
+        while len(_captures) > cap_max:
+            _captures.pop(0)
+            _stats["dropped"] += 1
+
+
+# ---------------------------------------------------------------------------
+# recorders (the choke-point hooks call these; all gated on enabled())
+# ---------------------------------------------------------------------------
+
+def record_jit(key, traced=None, jaxpr=None, donate_argnums=(),
+               lowered_text=None):
+    """Record one traced program. `traced` is what `jax.jit(fn).trace(...)`
+    returns; its `.jaxpr` is snapshotted. Never raises — a capture failure
+    must not break the compile path it observes."""
+    if not enabled():
+        return None
+    try:
+        if jaxpr is None and traced is not None:
+            jaxpr = traced.jaxpr
+        ann = annotation_for(key)
+        cap = Capture(
+            key, kind="jit", jaxpr=jaxpr,
+            donate_argnums=donate_argnums,
+            arg_roles=ann.get("arg_roles"),
+            declared_bf16=ann.get("declared_bf16", False),
+            donation_supported=_donation_supported(),
+            backend=_backend(),
+            lowered_text=lowered_text,
+            allgather_budget=ann.get("allgather_budget"))
+        _push(cap, "jit")
+        return cap
+    except Exception:       # noqa: BLE001 — observation must be free of risk
+        return None
+
+
+def record_tuned(kernel, call_key):
+    """Record one tuned_call dispatch. Metadata only: tuned_call runs
+    inside traces where the args are tracers, so nothing value-dependent
+    is touched here."""
+    if not enabled():
+        return None
+    cap = Capture(f"tuned:{kernel}", kind="tuned",
+                  meta={"call_key": call_key})
+    _push(cap, "tuned")
+    return cap
+
+
+def record_partition(key, leaves, matched, unmatched, replicated,
+                     rules=None):
+    """Record one partition-rule coverage report: every leaf name with how
+    it resolved (matched rule pattern, explicitly replicated, or UNMATCHED
+    — SL04's error case)."""
+    if not enabled():
+        return None
+    cap = partition_capture(key, leaves, matched, unmatched, replicated,
+                            rules=rules)
+    _push(cap, "partition")
+    return cap
+
+
+# ---------------------------------------------------------------------------
+# direct builders (fixtures / tests / offline corpus)
+# ---------------------------------------------------------------------------
+
+def trace_capture(fn, *args, key="fixture", donate_argnums=(),
+                  arg_roles=None, declared_bf16=False,
+                  donation_supported=None, lowered_text=None,
+                  allgather_budget=None, **kwargs):
+    """Trace `fn(*args, **kwargs)` with jax.jit and build a Capture
+    directly, bypassing the enable gate — the fixture-corpus helper.
+    `donate_argnums`/`arg_roles` here are *claims* for the rule passes,
+    so SL03 scenarios are testable on CPU."""
+    import jax
+    traced = jax.jit(fn).trace(*args, **kwargs)
+    if donation_supported is None:
+        donation_supported = _donation_supported()
+    return Capture(key, kind="jit", jaxpr=traced.jaxpr,
+                   donate_argnums=donate_argnums, arg_roles=arg_roles,
+                   declared_bf16=declared_bf16,
+                   donation_supported=donation_supported,
+                   backend=_backend(), lowered_text=lowered_text,
+                   allgather_budget=allgather_budget)
+
+
+def partition_capture(key, leaves, matched, unmatched, replicated,
+                      rules=None):
+    """Build a partition-coverage Capture directly (no enable gate)."""
+    return Capture(key, kind="partition", meta={
+        "leaves": list(leaves),
+        "matched": dict(matched),
+        "unmatched": list(unmatched),
+        "replicated": list(replicated),
+        "rules": [str(r) for r in (rules or ())],
+    })
+
+
+# ---------------------------------------------------------------------------
+# introspection
+# ---------------------------------------------------------------------------
+
+def captures():
+    """Snapshot of the capture buffer (oldest first)."""
+    with _lock:
+        return list(_captures)
+
+
+def clear(stats=False):
+    """Drop buffered captures (and optionally zero the counters). The
+    annotation table survives: it is construction-time declaration, not
+    per-run observation."""
+    with _lock:
+        _captures.clear()
+        if stats:
+            for k in _stats:
+                _stats[k] = 0
+
+
+def stats():
+    """Counter snapshot (the `shardlint_*` telemetry surface in
+    profiler.dumps() and /metrics): enabled flag, buffered captures,
+    per-kind record counts, drops."""
+    with _lock:
+        snap = dict(_stats)
+        snap["captures"] = len(_captures)
+    snap["enabled"] = 1 if (_enabled is True) else 0
+    return snap

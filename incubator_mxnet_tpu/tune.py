@@ -380,6 +380,11 @@ def tuned_call(kernel, fallback, *args, **kwargs):
             _stats["fallbacks"] += 1
         return fallback(*args, **kwargs)
     call_key = _call_key(args, kwargs)
+    # shardlint graph capture: metadata only — args may be tracers here,
+    # so nothing value-dependent is recorded
+    from . import shardlint as _sl
+    if _sl.enabled():
+        _sl.record_tuned(kernel, call_key)
     fp = _fingerprint(kernel, spec.version, call_key)
     rec = _lookup(fp, spec)
     if rec is None:

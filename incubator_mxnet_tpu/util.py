@@ -207,6 +207,20 @@ ENV_VARS = collections.OrderedDict([
      "Byte budget for MXNET_EXEC_CACHE_DIR; after a write pushes "
      "occupancy past it, oldest entries (mtime order) are evicted. "
      "<=0 disables the bound.")),
+    ("MXNET_SHARDLINT", EnvSpec(False, "bool",
+     "Enable shardlint graph capture: the jit choke points "
+     "(compile_cache.cached_jit, profiler.track_jit, tune.tuned_call) and "
+     "the partition-rule matcher snapshot jaxprs/coverage reports into "
+     "shardlint.captures() for the tools/shardlint rule passes "
+     "(SL01-SL05). Off (default), every hook is a cached boolean check on "
+     "a once-per-signature path — zero steady-state overhead.")),
+    ("MXNET_SHARDLINT_CAPTURES", EnvSpec(256, "int",
+     "Bound on the shardlint capture buffer; once full the OLDEST capture "
+     "is dropped (counted in shardlint.stats()['dropped']).")),
+    ("MXNET_SHARDLINT_CORPUS", EnvSpec("", "str",
+     "Comma-separated subset of the tools/shardlint offline model corpus "
+     "to trace (see tools.shardlint.corpus.entries()); empty (default) "
+     "traces every registered entry.")),
     ("MXNET_HOME", EnvSpec("~/.mxnet", "str",
      "Data directory for downloaded model-zoo parameter files.")),
     ("MXNET_GLUON_REPO", EnvSpec(

@@ -1,0 +1,14 @@
+"""SL01 bad twin: a host callback staged into a jitted program."""
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    def step(x):
+        jax.debug.print("loss={l}", l=x.sum())
+        return x * 2.0
+
+    return [sl.trace_capture(step, jnp.ones((4,), jnp.float32),
+                             key="fixture:sl01")]

@@ -1,0 +1,22 @@
+"""SL02 bad twin: an f64 promotion, and a bf16 value silently widened to
+f32 inside a declared-bf16 program."""
+import jax.numpy as jnp
+from jax.experimental import enable_x64
+
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    def promote(x):
+        return x.astype(jnp.float64) * 2.0
+
+    def upcast(x):
+        return x.astype(jnp.float32) + 1.0
+
+    with enable_x64():
+        f64_cap = sl.trace_capture(promote, jnp.ones((4,), jnp.float32),
+                                   key="fixture:sl02_f64")
+    bf16_cap = sl.trace_capture(upcast, jnp.ones((4,), jnp.bfloat16),
+                                key="fixture:sl02_bf16",
+                                declared_bf16=True)
+    return [f64_cap, bf16_cap]

@@ -1,0 +1,13 @@
+"""SL03 bad twin: gradients donated, donation-eligible params not.
+
+Metadata-only captures: SL03 judges donate_argnums against declared
+roles, so the scenario is testable on CPU by *claiming* an aliasing
+backend."""
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    return [sl.Capture("fixture:sl03", kind="jit",
+                       arg_roles={0: "params", 1: "grads"},
+                       donate_argnums=(1,),
+                       donation_supported=True, backend="tpu")]

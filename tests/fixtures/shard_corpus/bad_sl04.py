@@ -1,0 +1,13 @@
+"""SL04 bad twin: one param matched no partition rule and silently fell
+back to full replication."""
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    return [sl.partition_capture(
+        "fixture:sl04",
+        leaves=["body/dense/weight", "head/bias"],
+        matched={"body/dense/weight": r"dense/weight$"},
+        unmatched=["head/bias"],
+        replicated=[],
+        rules=[r"dense/weight$"])]

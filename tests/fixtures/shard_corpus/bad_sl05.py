@@ -1,0 +1,32 @@
+"""SL05 bad twin: a device_put staged inside jit, a back-to-back
+resharding chain, and a lowered module over its all-gather budget."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.lax import with_sharding_constraint
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+
+    def put(x):
+        return jax.device_put(x) + 1.0
+
+    def churn(x):
+        y = with_sharding_constraint(x, NamedSharding(mesh, P()))
+        z = with_sharding_constraint(y, NamedSharding(mesh, P("dp")))
+        return z * 2.0
+
+    put_cap = sl.trace_capture(put, jnp.ones((4,), jnp.float32),
+                               key="fixture:sl05_put")
+    churn_cap = sl.trace_capture(churn, jnp.ones((8,), jnp.float32),
+                                 key="fixture:sl05_churn")
+    hlo_cap = sl.Capture(
+        "fixture:sl05_hlo", kind="jit",
+        lowered_text=("%ag0 = all-gather(...)\n%mm = dot(...)\n"
+                      "%ag1 = all-gather(...)\n%ag2 = all-gather(...)"),
+        allgather_budget=1)
+    return [put_cap, churn_cap, hlo_cap]

@@ -1,0 +1,12 @@
+"""SL01 ok twin: the same step with the host round-trip removed."""
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    def step(x):
+        return x * 2.0
+
+    return [sl.trace_capture(step, jnp.ones((4,), jnp.float32),
+                             key="fixture:sl01_ok")]

@@ -1,0 +1,14 @@
+"""SL02 ok twin: bf16 math that stays bf16 (downcasts are fine), no f64
+anywhere."""
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    def step(x):
+        return (x * 2.0 + x).astype(jnp.bfloat16)
+
+    return [sl.trace_capture(step, jnp.ones((4,), jnp.bfloat16),
+                             key="fixture:sl02_ok",
+                             declared_bf16=True)]

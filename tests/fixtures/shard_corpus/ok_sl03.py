@@ -1,0 +1,9 @@
+"""SL03 ok twin: params donated, gradients left alone."""
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    return [sl.Capture("fixture:sl03_ok", kind="jit",
+                       arg_roles={0: "params", 1: "grads", 2: "rng"},
+                       donate_argnums=(0,),
+                       donation_supported=True, backend="tpu")]

@@ -1,0 +1,14 @@
+"""SL04 ok twin: every leaf either matched a rule or was declared
+replicated (a scalar counts as declared)."""
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    return [sl.partition_capture(
+        "fixture:sl04_ok",
+        leaves=["body/dense/weight", "head/bias", "global_step"],
+        matched={"body/dense/weight": r"dense/weight$",
+                 "head/bias": r"bias$"},
+        unmatched=[],
+        replicated=["global_step"],
+        rules=[r"dense/weight$", r"bias$"])]

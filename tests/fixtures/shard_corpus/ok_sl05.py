@@ -1,0 +1,26 @@
+"""SL05 ok twin: one consistent sharding constraint, transfers outside
+jit, lowered module inside its all-gather budget."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.lax import with_sharding_constraint
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+
+    def step(x):
+        y = with_sharding_constraint(x, sharding)
+        return y * 2.0
+
+    step_cap = sl.trace_capture(step, jnp.ones((8,), jnp.float32),
+                                key="fixture:sl05_ok")
+    hlo_cap = sl.Capture(
+        "fixture:sl05_ok_hlo", kind="jit",
+        lowered_text="%ag0 = all-gather(...)\n%mm = dot(...)",
+        allgather_budget=1)
+    return [step_cap, hlo_cap]

@@ -1,0 +1,16 @@
+"""Suppression twin: the SL01 hit carries a source-anchored disable
+comment with a reason, so it is counted-suppressed, not a finding."""
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu import shardlint as sl
+
+
+def build():
+    def step(x):
+        # shardlint: disable=SL01(loss print kept for the convergence demo)
+        jax.debug.print("loss={l}", l=x.sum())
+        return x * 2.0
+
+    return [sl.trace_capture(step, jnp.ones((4,), jnp.float32),
+                             key="fixture:sl01_suppressed")]

@@ -164,6 +164,47 @@ def test_cli_changed_mode(tmp_path):
     assert "EV01" in p.stdout and "mod.py" in p.stdout
 
 
+def test_cli_changed_mode_follows_renames(tmp_path):
+    """--changed lints a renamed-then-edited file at its NEW path even
+    when the repo config disables rename detection: the -M
+    --name-status parse keys off the last tab field, and D rows (the
+    old name) are skipped instead of relying on path existence."""
+    env = dict(os.environ, PYTHONPATH=REPO,
+               GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    repo = str(tmp_path)
+
+    def git(*args):
+        subprocess.run(["git"] + list(args), cwd=repo, check=True,
+                       capture_output=True, env=env)
+
+    git("init", "-q")
+    # rename detection off in config: -M in the lint command must still
+    # force it, so the R row carries old AND new names
+    git("config", "diff.renames", "false")
+    body = "VALUE = 1\n" + "# filler\n" * 12
+    with open(os.path.join(repo, "old_name.py"), "w") as f:
+        f.write(body)
+    git("add", "."); git("commit", "-qm", "seed")
+    git("mv", "old_name.py", "new_name.py")
+    with open(os.path.join(repo, "new_name.py"), "w") as f:
+        f.write('import os\nV = os.environ.get("MXNET_BAD_KNOB")\n'
+                + "# filler\n" * 12)
+
+    p = _run_cli(["--changed"], cwd=repo, env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "EV01" in p.stdout and "new_name.py" in p.stdout
+    assert "old_name.py" not in p.stdout
+
+    # a pure rename (no edit) of a clean file stays clean — the R row
+    # parse must not crash on the three-field form
+    git("add", "."); git("commit", "-qm", "renamed")
+    git("mv", "new_name.py", "third_name.py")
+    p = _run_cli(["--changed"], cwd=repo, env=env)
+    assert p.returncode == 1, "the violation rides along at third_name.py"
+    assert "third_name.py" in p.stdout
+
+
 # -- regression tests for the first-run true positives ---------------------
 
 def test_argext_split_predicate_is_shape_based():

@@ -1,0 +1,566 @@
+"""shardlint self-tests: the tier-1 graph-analysis gate.
+
+Four layers, mirroring tests/test_lint.py's structure for mxlint:
+(1) every SL rule fires on its known-bad fixture capture and stays quiet
+on the ok twin, (2) the package's own train/serve/parallel entry points
+(the registered corpus) analyze CLEAN with the waiver registry asserted
+exactly, (3) the CLI contract (--fixture, --format=json, exit codes),
+(4) capture-hook mechanics: zero overhead with MXNET_SHARDLINT off
+(counter-asserted), bounded buffer, suppression semantics — plus
+regression tests for the true positives the first self-run surfaced in
+parallel/train.py (unconditional donation; silent/opaque partition
+fallback).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.shardlint import RULES, analyze, load_fixture  # noqa: E402
+
+CORPUS = os.path.join(REPO, "tests", "fixtures", "shard_corpus")
+
+# findings each bad fixture must produce, asserted EXACTLY: the fixtures
+# are precise, nothing else may fire on them
+EXPECTED_BAD_COUNTS = {
+    "SL01": 1,   # one staged debug_callback
+    "SL02": 2,   # f64 promotion + bf16 upcast
+    "SL03": 2,   # grads donated + params not donated
+    "SL04": 1,   # one unmatched leaf
+    "SL05": 3,   # device_put in jit + reshard chain + all-gather budget
+}
+
+# the corpus self-run's waived findings, asserted EXACTLY as
+# (rule, capture key) pairs: a new waived finding means a deliberate
+# waivers.py change, defended in review. Budget: at most 10 entries.
+EXPECTED_WAIVED = [("SL02", "trainstep:sgd")]
+
+
+def _sl():
+    from incubator_mxnet_tpu import shardlint
+    return shardlint
+
+
+def _run_cli(args, env=None):
+    env = dict(env or os.environ)
+    env.setdefault("PYTHONPATH", REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "tools.shardlint"] + args,
+        capture_output=True, text=True, cwd=REPO, env=env)
+
+
+def _fixture(name):
+    return os.path.join(CORPUS, f"{name}.py")
+
+
+# -- fixture corpus --------------------------------------------------------
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_rule_fires_on_bad_fixture(rule):
+    caps, waivers = load_fixture(_fixture(f"bad_{rule.lower()}"))
+    res = analyze(caps, waivers=waivers)
+    fired = [f.rule for f in res.findings]
+    assert set(fired) == {rule}, \
+        f"expected only {rule}, got {sorted(set(fired))}"
+    assert len(fired) == EXPECTED_BAD_COUNTS[rule], \
+        [f.render() for f in res.findings]
+    assert not res.errors and not res.suppressed and not res.waived
+
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_rule_quiet_on_ok_fixture(rule):
+    caps, waivers = load_fixture(_fixture(f"ok_{rule.lower()}"))
+    res = analyze(caps, waivers=waivers)
+    assert [f.render() for f in res.findings] == []
+    assert not res.errors
+
+
+def test_findings_carry_anchor_and_hint():
+    caps, _ = load_fixture(_fixture("bad_sl01"))
+    res = analyze(caps, waivers=())
+    f = res.findings[0]
+    assert f.path and f.path.endswith("bad_sl01.py") and f.line > 0
+    assert f.hint and f.rule in RULES and f.key == "fixture:sl01"
+    d = f.as_dict()
+    assert d["rule"] == "SL01" and d["path"] == f.path and d["line"] == f.line
+
+
+def test_jaxpr_walker_recurses_into_subjaxprs():
+    """A callback hidden inside a nested jit (pjit sub-jaxpr) still
+    surfaces — SL01 walks the whole program, not just the top level."""
+    import jax
+    import jax.numpy as jnp
+    sl = _sl()
+
+    @jax.jit
+    def inner(x):
+        jax.debug.print("x={x}", x=x)
+        return x + 1.0
+
+    def outer(x):
+        return inner(x) * 2.0
+
+    cap = sl.trace_capture(outer, jnp.ones((3,), jnp.float32),
+                           key="nested")
+    res = analyze([cap], waivers=())
+    assert [f.rule for f in res.findings] == ["SL01"]
+
+
+# -- suppression / waiver semantics ----------------------------------------
+
+def test_source_suppression_counted():
+    caps, waivers = load_fixture(_fixture("suppressed_sl01"))
+    res = analyze(caps, waivers=waivers)
+    assert res.findings == []
+    assert [(f.rule, f.suppress_reason) for f in res.suppressed] == \
+        [("SL01", "loss print kept for the convergence demo")]
+
+
+def test_suppression_needs_reason(tmp_path):
+    src = (
+        "import jax\nimport jax.numpy as jnp\n"
+        "from incubator_mxnet_tpu import shardlint as sl\n\n"
+        "def build():\n"
+        "    def step(x):\n"
+        "        # shardlint: disable=SL01()\n"
+        "        jax.debug.print('x={x}', x=x)\n"
+        "        return x\n"
+        "    return [sl.trace_capture(step, jnp.ones((2,)))]\n")
+    path = tmp_path / "empty_reason.py"
+    path.write_text(src)
+    caps, _ = load_fixture(str(path))
+    res = analyze(caps, waivers=())
+    assert [f.rule for f in res.findings] == ["SL01"]
+    assert res.suppressed == []
+
+
+def test_wrong_rule_disable_does_not_silence(tmp_path):
+    src = (
+        "import jax\nimport jax.numpy as jnp\n"
+        "from incubator_mxnet_tpu import shardlint as sl\n\n"
+        "def build():\n"
+        "    def step(x):\n"
+        "        # shardlint: disable=SL05(not the right rule)\n"
+        "        jax.debug.print('x={x}', x=x)\n"
+        "        return x\n"
+        "    return [sl.trace_capture(step, jnp.ones((2,)))]\n")
+    path = tmp_path / "wrong_rule.py"
+    path.write_text(src)
+    caps, _ = load_fixture(str(path))
+    res = analyze(caps, waivers=())
+    assert [f.rule for f in res.findings] == ["SL01"]
+
+
+def test_waiver_glob_matches_key_and_is_counted():
+    caps, _ = load_fixture(_fixture("bad_sl03"))
+    res = analyze(caps, waivers=[("SL03", "fixture:*", "audit demo")])
+    assert res.findings == []
+    assert sorted({(f.rule, f.waive_reason) for f in res.waived}) == \
+        [("SL03", "audit demo")]
+    # a waiver for another rule or key leaves the findings active
+    res = analyze(caps, waivers=[("SL03", "other:*", "no match"),
+                                 ("SL01", "fixture:*", "wrong rule")])
+    assert len(res.findings) == EXPECTED_BAD_COUNTS["SL03"]
+
+
+# -- the package corpus self-clean gate ------------------------------------
+
+def test_corpus_self_run_clean_with_exact_waivers():
+    """The tentpole gate: every registered train/serve/parallel entry
+    point traces and analyzes CLEAN, modulo the exact waiver list."""
+    from tools.shardlint import corpus
+    caps, errors = corpus.run()
+    assert errors == [], errors
+    assert len(caps) >= 5, "corpus should capture every entry"
+    kinds = {c.kind for c in caps}
+    assert {"jit", "partition"} <= kinds
+    res = analyze(caps)
+    assert [f.render() for f in res.findings] == []
+    assert not res.errors
+    assert sorted({(f.rule, f.key) for f in res.waived}) == EXPECTED_WAIVED
+    for f in res.waived:
+        assert f.waive_reason and f.waive_reason.strip()
+
+
+def test_corpus_entry_selection():
+    from tools.shardlint import corpus
+    caps, errors = corpus.run(["partition_rules"])
+    assert errors == []
+    assert caps and all(c.kind == "partition" for c in caps)
+    with pytest.raises(KeyError):
+        corpus.run(["no_such_entry"])
+
+
+def test_waiver_registry_budget():
+    from tools.shardlint.waivers import WAIVERS
+    assert len(WAIVERS) <= 10, "waiver budget: at most 10 entries"
+    for rule, glob, reason in WAIVERS:
+        assert rule in RULES and glob and reason.strip()
+
+
+# -- CLI contract ----------------------------------------------------------
+
+def test_cli_fixture_json_schema():
+    p = _run_cli(["--fixture", _fixture("bad_sl01"), "--format=json"])
+    assert p.returncode == 1, p.stdout + p.stderr
+    data = json.loads(p.stdout)
+    assert data["version"] == 1 and data["captures"] == 1
+    assert data["counts"] == {"SL01": 1}
+    assert data["suppressed"] == [] and data["waived"] == []
+    assert data["errors"] == []
+    (f,) = data["findings"]
+    assert f["rule"] == "SL01" and f["key"] == "fixture:sl01"
+    assert f["path"].endswith("bad_sl01.py") and f["line"] > 0 and f["hint"]
+
+
+def test_cli_fixture_clean_exit_0():
+    p = _run_cli(["--fixture", _fixture("ok_sl01")])
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "0 findings" in p.stdout
+
+
+def test_cli_fixture_suppression_rendered():
+    p = _run_cli(["--fixture", _fixture("suppressed_sl01")])
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "1 suppressed" in p.stdout
+    assert "loss print kept for the convergence demo" in p.stdout
+    # --no-waivers does not touch source suppressions
+    p = _run_cli(["--fixture", _fixture("suppressed_sl01"),
+                  "--no-waivers"])
+    assert p.returncode == 0
+
+
+def test_cli_exit_2_on_missing_fixture_and_bad_entry():
+    assert _run_cli(["--fixture", "no/such/file.py"]).returncode == 2
+    assert _run_cli(["--corpus", "no_such_entry"]).returncode == 2
+
+
+def test_cli_list():
+    p = _run_cli(["--list"])
+    assert p.returncode == 0, p.stdout + p.stderr
+    for name in ("train_step", "train_bf16", "serve_predict",
+                 "fused_optimizer", "partition_rules"):
+        assert name in p.stdout
+    for rule in RULES:
+        assert rule in p.stdout
+
+
+# -- capture mechanics -----------------------------------------------------
+
+def test_capture_off_is_counter_asserted_zero_overhead():
+    """With MXNET_SHARDLINT off, the hooks at cached_jit / track_jit /
+    tuned_call / match_partition_rules record NOTHING — asserted on the
+    registry counters around real traffic through all four choke
+    points."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from incubator_mxnet_tpu import compile_cache, profiler
+    from incubator_mxnet_tpu.parallel import match_partition_rules
+    sl = _sl()
+
+    prev = sl.enable(False)
+    try:
+        before = sl.stats()
+        ncaps = len(sl.captures())
+
+        w = compile_cache.cached_jit("test:sl_off", lambda x: x * 2.0)
+        w(jnp.ones((2,), jnp.float32))
+        w.trace_signature(jnp.ones((2,), jnp.float32))
+
+        import jax
+        tracked = profiler.track_jit("test:sl_off_tracked",
+                                     jax.jit(lambda x: x + 1.0))
+        tracked(jnp.ones((2,), jnp.float32))
+
+        match_partition_rules([(r".*", P())],
+                              {"w": np.ones((2, 2), np.float32)})
+
+        assert sl.record_jit("test:sl_off") is None
+        assert sl.record_tuned("k", "ck") is None
+        after = sl.stats()
+        assert after == before, "capture-off hooks must record nothing"
+        assert len(sl.captures()) == ncaps
+    finally:
+        sl.enable(prev)
+
+
+def test_capture_on_records_at_choke_points():
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import compile_cache
+    sl = _sl()
+
+    prev = sl.enable(True)
+    saved = sl.captures()
+    sl.clear()
+    try:
+        w = compile_cache.cached_jit("test:sl_on", lambda x: x * 3.0)
+        w.trace_signature(jnp.ones((2,), jnp.float32))
+        caps = sl.captures()
+        assert [c.key for c in caps] == ["test:sl_on"]
+        assert caps[0].kind == "jit" and caps[0].jaxpr is not None
+        assert sl.stats()["jit"] >= 1
+    finally:
+        sl.clear()
+        sl.enable(prev)
+        with sl._lock:
+            sl._captures.extend(saved)
+
+
+def test_capture_buffer_bounded(monkeypatch):
+    monkeypatch.setenv("MXNET_SHARDLINT_CAPTURES", "3")
+    sl = _sl()
+    prev = sl.enable(True)
+    saved = sl.captures()
+    sl.clear()
+    dropped0 = sl.stats()["dropped"]
+    try:
+        for i in range(7):
+            sl.record_tuned(f"k{i}", "ck")
+        caps = sl.captures()
+        assert len(caps) == 3
+        assert [c.key for c in caps] == ["tuned:k4", "tuned:k5", "tuned:k6"]
+        assert sl.stats()["dropped"] == dropped0 + 4
+    finally:
+        sl.clear()
+        sl.enable(prev)
+        with sl._lock:
+            sl._captures.extend(saved)
+
+
+def test_annotation_round_trip():
+    sl = _sl()
+    sl.annotate("test:ann", arg_roles={0: "params"}, declared_bf16=True,
+                allgather_budget=2)
+    ann = sl.annotation_for("test:ann")
+    assert ann == {"arg_roles": {0: "params"}, "declared_bf16": True,
+                   "allgather_budget": 2}
+    assert sl.annotation_for("test:never_annotated") == {}
+
+
+def test_profiler_exports_shardlint_counters():
+    sl = _sl()
+    prev = sl.enable(True)
+    saved = sl.captures()
+    sl.clear()
+    try:
+        sl.record_tuned("prof_k", "ck")
+        from incubator_mxnet_tpu import profiler
+        data = json.loads(profiler.dumps(format="json"))
+        assert "shardlint" in data
+        assert data["shardlint"]["captures"] >= 1
+        assert "Graph capture (shardlint)" in profiler.dumps()
+        prom = profiler.render_prometheus()
+        assert "mxnet_shardlint_captures" in prom
+        assert "mxnet_shardlint_jit_total" in prom
+    finally:
+        sl.clear()
+        sl.enable(prev)
+        with sl._lock:
+            sl._captures.extend(saved)
+
+
+# -- match_partition_rules -------------------------------------------------
+
+def test_match_partition_rules_first_match_and_scalars():
+    from jax.sharding import PartitionSpec as P
+    from incubator_mxnet_tpu.parallel import match_partition_rules
+    params = {"layer/weight": np.zeros((4, 4), np.float32),
+              "layer/bias": np.zeros((4,), np.float32),
+              "step": np.zeros((), np.float32)}
+    specs = match_partition_rules(
+        [(r"weight$", P("dp", None)), (r".*", P())], params)
+    assert specs["layer/weight"] == P("dp", None)
+    assert specs["layer/bias"] == P()
+    assert specs["step"] == P()    # scalar: replicated by policy
+
+
+def test_match_partition_rules_unmatched_is_error():
+    from jax.sharding import PartitionSpec as P
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.parallel import match_partition_rules
+    params = {"layer/weight": np.zeros((4, 4), np.float32),
+              "head/out": np.zeros((4, 2), np.float32)}
+    with pytest.raises(MXNetError, match="Partition rule not found"):
+        match_partition_rules([(r"weight$", P("dp", None))], params)
+
+
+def test_match_partition_rules_none_spec_and_bad_mode():
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.parallel import match_partition_rules
+    params = {"w": np.zeros((2, 2), np.float32)}
+    with pytest.raises(MXNetError, match="PartitionSpec\\(\\) to replicate"):
+        match_partition_rules([(r"w", None)], params)
+    with pytest.raises(MXNetError, match="on_unmatched"):
+        match_partition_rules([], params, on_unmatched="ignore")
+
+
+def test_match_partition_rules_replicate_mode_feeds_sl04():
+    """on_unmatched='replicate' keeps permissive behavior but the
+    recorded coverage capture still trips SL04 in the analyzer."""
+    from jax.sharding import PartitionSpec as P
+    from incubator_mxnet_tpu.parallel import match_partition_rules
+    sl = _sl()
+    prev = sl.enable(True)
+    saved = sl.captures()
+    sl.clear()
+    try:
+        specs = match_partition_rules(
+            [(r"weight$", P())],
+            {"layer/weight": np.zeros((2, 2), np.float32),
+             "head/out": np.zeros((2, 2), np.float32)},
+            on_unmatched="replicate", key="test:sl04_feed")
+        assert specs["head/out"] == P()
+        caps = [c for c in sl.captures() if c.key == "test:sl04_feed"]
+        assert len(caps) == 1
+        assert caps[0].meta["unmatched"] == ["head/out"]
+        res = analyze(caps, waivers=())
+        assert [f.rule for f in res.findings] == ["SL04"]
+        assert "head/out" in res.findings[0].message
+    finally:
+        sl.clear()
+        sl.enable(prev)
+        with sl._lock:
+            sl._captures.extend(saved)
+
+
+def test_transformer_partition_rules_match_spec_fn():
+    """The auditable rules table agrees leaf-for-leaf with the per-leaf
+    transformer_param_specs fn over the real transformer param names."""
+    from incubator_mxnet_tpu.parallel import (match_partition_rules,
+                                              transformer_param_specs,
+                                              transformer_partition_rules)
+    params = {}
+    for name in ("embed", "pos_embed", "lnf_g", "lnf_b"):
+        params[name] = np.zeros((8, 4) if "embed" in name else (4,),
+                                np.float32)
+    for name in ("ln1_g", "ln1_b", "wq", "wk", "wv", "wo",
+                 "ln2_g", "ln2_b", "w_in", "w_out"):
+        params["l0." + name] = np.zeros(
+            (4, 4) if name.startswith("w") else (4,), np.float32)
+    specs = match_partition_rules(transformer_partition_rules(), params)
+    for name, value in params.items():
+        assert specs[name] == transformer_param_specs(name, value), name
+
+
+# -- regressions: first self-run true positives in parallel/train.py -------
+
+def _tiny_trainstep(**kw):
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.parallel import TrainStep
+
+    net = nn.Dense(3, in_units=5)
+    net.initialize()
+
+    def loss_fn(out, label):
+        return jnp.mean((out - label) ** 2)
+
+    return TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.05},
+                     example_inputs=[nd.array(np.ones((2, 5), np.float32))],
+                     **kw)
+
+
+def _donated(wrapper):
+    opts = dict(eval(wrapper._opts))
+    return tuple(opts.get("donate_argnums", ()))
+
+
+def test_trainstep_donation_gated_on_backend():
+    """True positive #1: TrainStep requested donate_argnums=(0, 1)
+    unconditionally — on CPU (no buffer aliasing) that is exactly the
+    SL03 'donation requested but unsupported' finding. The request is
+    now gated on _donation_supported(), like the fused optimizer path."""
+    import jax
+    from incubator_mxnet_tpu.ops.optimizer_ops import _donation_supported
+    step = _tiny_trainstep()
+    assert step._donate == _donation_supported()
+    if jax.default_backend() == "cpu":
+        assert step._donate is False
+        assert _donated(step._jit_step) == ()
+    else:
+        assert _donated(step._jit_step) == (0, 1)
+    # the step is annotated for the SL03/SL02 passes either way
+    sl = _sl()
+    ann = sl.annotation_for("trainstep:sgd")
+    assert ann["arg_roles"][0] == "params"
+    assert ann["arg_roles"][1] == "opt_state"
+    # donate=False always wins regardless of backend
+    assert _donated(_tiny_trainstep(donate=False)._jit_step) == ()
+
+
+def test_trainstep_step_still_trains_after_donation_gate():
+    step = _tiny_trainstep()
+    x = np.random.RandomState(0).rand(2, 5).astype(np.float32)
+    y = np.ones((2, 3), np.float32)
+    l0 = float(step(x, y))
+    for _ in range(5):
+        l1 = float(step(x, y))
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_trainstep_param_spec_fn_none_is_named_error():
+    """True positive #2 (partition coverage): a param_spec_fn returning
+    None used to flow into NamedSharding and die with an opaque
+    TypeError — silent-replication's nastier sibling. It now raises an
+    MXNetError naming the leaf."""
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.parallel import make_mesh
+    with pytest.raises(MXNetError, match="param_spec_fn returned None"):
+        _tiny_trainstep(mesh=make_mesh(),
+                        param_spec_fn=lambda k, v: None)
+
+
+def test_trainstep_param_rules_path():
+    """param_rules= routes through match_partition_rules: full coverage
+    constructs and trains; a partial table is an error, not silent
+    replication."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.parallel import make_mesh
+    step = _tiny_trainstep(mesh=make_mesh(),
+                           param_rules=[(r".*", P())])
+    b = 2 * len(jax.devices())
+    x = np.ones((b, 5), np.float32)
+    y = np.ones((b, 3), np.float32)
+    assert np.isfinite(float(step(x, y)))
+    with pytest.raises(MXNetError, match="Partition rule not found"):
+        _tiny_trainstep(mesh=make_mesh(),
+                        param_rules=[(r"weight$", P())])
+    with pytest.raises(MXNetError, match="param_rules OR param_spec_fn"):
+        _tiny_trainstep(mesh=make_mesh(),
+                        param_rules=[(r".*", P())],
+                        param_spec_fn=lambda k, v: P())
+
+
+def test_trainstep_trace_for_analysis_captures_without_running():
+    import jax.numpy as jnp
+    sl = _sl()
+    step = _tiny_trainstep(dtype=jnp.bfloat16)
+    prev = sl.enable(True)
+    saved = sl.captures()
+    sl.clear()
+    try:
+        x = np.ones((2, 5), np.float32)
+        y = np.ones((2, 3), np.float32)
+        step.trace_for_analysis(x, y)
+        assert step._step_count == 0, "trace must not advance the step"
+        caps = [c for c in sl.captures() if c.key == "trainstep:sgd"]
+        assert len(caps) == 1
+        cap = caps[0]
+        assert cap.jaxpr is not None and cap.declared_bf16
+        assert cap.arg_roles[0] == "params"
+    finally:
+        sl.clear()
+        sl.enable(prev)
+        with sl._lock:
+            sl._captures.extend(saved)

@@ -96,6 +96,20 @@ def test_diagnose_runs():
     assert r.returncode == 0, r.stderr
     assert "Python Info" in r.stdout
     assert "incubator_mxnet_tpu" in r.stdout
+    # every diagnostic section renders (a probe that blows up prints
+    # "<name> probe FAILED" instead of its section body)
+    for section in ("JAX / Device Info", "Declared Env Vars (util.ENV_VARS)",
+                    "Executable Cache (compile_cache)",
+                    "Kernel Autotuner (tune)", "Fault Tolerance (fault)",
+                    "Static Analysis (mxlint)",
+                    "Graph Analysis (shardlint)"):
+        assert section in r.stdout, f"missing section {section!r}"
+    assert "probe FAILED" not in r.stdout, r.stdout
+    # the shardlint section names the rule set, the corpus, and the
+    # waiver registry without tracing anything
+    assert "SL01" in r.stdout and "SL05" in r.stdout
+    assert "train_step" in r.stdout and "serve_predict" in r.stdout
+    assert "python -m tools.shardlint" in r.stdout
 
 
 def test_measure_bandwidth_harness():

@@ -156,6 +156,29 @@ def main():
             print(f"  {f['rule']} {f['path']}:{f['line']} {f['message']}")
     except Exception as e:
         print("mxlint probe FAILED:", e)
+
+    print("----------Graph Analysis (shardlint)----------")
+    try:
+        from incubator_mxnet_tpu import shardlint
+        from tools.shardlint import RULES
+        from tools.shardlint.corpus import entries
+        from tools.shardlint.waivers import WAIVERS
+        s = shardlint.stats()
+        print("capture      :", "on" if s["enabled"] else
+              "off (MXNET_SHARDLINT unset)")
+        print("counters     :",
+              {k: s[k] for k in ("captures", "jit", "tuned",
+                                 "partition", "dropped")})
+        print("rules        :")
+        for rule, (title, _hint) in sorted(RULES.items()):
+            print(f"  {rule}: {title}")
+        print("corpus       :", ", ".join(entries()))
+        print("waivers      :", len(WAIVERS))
+        for rule, glob, reason in WAIVERS:
+            print(f"  {rule} on {glob}: {reason}")
+        print("run it       : python -m tools.shardlint [--format=json]")
+    except Exception as e:
+        print("shardlint probe FAILED:", e)
     return 0
 
 

@@ -17,17 +17,32 @@ import sys
 from . import lint_paths
 
 
+def _git_lines(cmd):
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             check=True).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        raise SystemExit(f"mxlint: --changed needs git: {e}")
+    return [line for line in out.splitlines() if line.strip()]
+
+
 def _changed_files():
     files = set()
-    for cmd in (["git", "diff", "--name-only", "HEAD"],
-                ["git", "ls-files", "--others", "--exclude-standard"]):
-        try:
-            out = subprocess.run(cmd, capture_output=True, text=True,
-                                 check=True).stdout
-        except (OSError, subprocess.CalledProcessError) as e:
-            raise SystemExit(f"mxlint: --changed needs git: {e}")
-        files.update(line.strip() for line in out.splitlines()
-                     if line.strip())
+    # -M forces rename detection even when the repo config disables it:
+    # a renamed-then-edited file must be linted at its NEW path, which
+    # plain --name-only reports as a delete+add of the old name only
+    # when similarity detection is off. --name-status lines look like
+    # "M\tpath", "R100\told\tnew", "C75\tsrc\tdst" — the LAST field is
+    # always the path that exists now; D rows have no current path.
+    for line in _git_lines(["git", "diff", "-M", "--name-status", "HEAD"]):
+        parts = line.split("\t")
+        status = parts[0].strip()
+        if not status or status.startswith("D") or len(parts) < 2:
+            continue
+        files.add(parts[-1].strip())
+    for line in _git_lines(["git", "ls-files", "--others",
+                            "--exclude-standard"]):
+        files.add(line.strip())
     return sorted(f for f in files
                   if f.endswith(".py") and os.path.exists(f))
 

@@ -29,6 +29,10 @@ LOCK_ORDER = {
     # tune: one module lock guards the winner table and counters; the
     # disk tier is written outside it (atomic tmp+rename, last wins).
     "tune.py": ("_lock",),
+    # shardlint: one module lock guards the capture buffer, annotation
+    # table, and counters; recorders never call out while holding it, so
+    # it nests under nothing and nothing nests under it.
+    "shardlint.py": ("_lock",),
     "serve/batcher.py": ("self._lock",),
     "serve/stats.py": ("self._lock",),
     "serve/predictor.py": ("self._compile_lock",),

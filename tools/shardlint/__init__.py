@@ -1,0 +1,239 @@
+"""shardlint — jaxpr/HLO-level sharding & performance analyzer for
+incubator_mxnet_tpu.
+
+Run it offline over the registered model corpus (traces on CPU, never
+compiles):
+
+    python -m tools.shardlint [--corpus NAMES] [--format=text|json]
+
+or programmatically over captures the package recorded while
+MXNET_SHARDLINT was on:
+
+    from incubator_mxnet_tpu import shardlint as sl
+    from tools import shardlint as tsl
+    result = tsl.analyze(sl.captures())
+
+mxlint (tools/mxlint) lints the Python the author wrote; shardlint lints
+the *lowered program* — the graph XLA will run — so it catches the bug
+families AST analysis cannot see: host callbacks staged into a hot step
+(SL01), silent f64/bf16 precision drift (SL02), missed or wrong buffer
+donation (SL03), params silently falling back to full replication
+(SL04), and implicit transfers/resharding churn (SL05).
+
+Two silencing mechanisms, both counted and both requiring a reason:
+
+  * source-anchored findings (a specific eqn with a user frame) honor
+    ``# shardlint: disable=RULE(reason)`` on or directly above the line;
+  * graph-anchored findings (whole-capture judgements like SL03/SL04)
+    have no line to comment — they are waived by (rule, key-glob,
+    reason) entries in tools/shardlint/waivers.py.
+"""
+from __future__ import annotations
+
+import fnmatch
+import re
+
+__all__ = ["RULES", "ShardFinding", "ShardlintResult", "analyze",
+           "load_fixture"]
+
+# rule id -> (one-line title, fix hint)
+RULES = {
+    "SL01": (
+        "host callback staged in jitted program",
+        "drop the callback from the hot path, or keep it behind a debug "
+        "flag that stays False in production steps"),
+    "SL02": (
+        "float64 promotion or bf16 upcast in traced program",
+        "pin the dtype (jnp.float32/bfloat16) at the point of creation; "
+        "a python float or np.float64 scalar silently widens the chain"),
+    "SL03": (
+        "buffer donation wrong or missing",
+        "donate params/opt-state on aliasing backends "
+        "(donate_argnums=...), never donate gradients, and gate the "
+        "request on _donation_supported()"),
+    "SL04": (
+        "param fell back to full replication",
+        "add a matching partition rule, or declare replication "
+        "explicitly with a ('.*', PartitionSpec()) catch-all"),
+    "SL05": (
+        "implicit transfer or resharding churn",
+        "move device_put outside jit; collapse conflicting "
+        "with_sharding_constraint chains; raise the all-gather budget "
+        "only with a comment saying why"),
+}
+
+_SUPP_ITEM = re.compile(r"([A-Z]{2}\d{2})\(([^)]*)\)")
+_SUPP_RE = re.compile(r"#\s*shardlint:\s*disable=")
+
+
+class ShardFinding:
+    """One rule violation against a capture, optionally anchored to the
+    user source line that staged the offending eqn."""
+
+    __slots__ = ("rule", "key", "message", "hint", "path", "line",
+                 "suppress_reason", "waive_reason")
+
+    def __init__(self, rule, key, message, path=None, line=None):
+        self.rule = rule
+        self.key = key
+        self.message = message
+        self.hint = RULES[rule][1]
+        self.path = path
+        self.line = line
+        self.suppress_reason = None
+        self.waive_reason = None
+
+    def as_dict(self):
+        d = {"rule": self.rule, "key": self.key, "message": self.message,
+             "hint": self.hint}
+        if self.path is not None:
+            d["path"] = self.path
+            d["line"] = self.line
+        if self.suppress_reason is not None:
+            d["suppressed"] = self.suppress_reason
+        if self.waive_reason is not None:
+            d["waived"] = self.waive_reason
+        return d
+
+    def render(self):
+        where = (f"{self.path}:{self.line}" if self.path
+                 else f"[{self.key}]")
+        return (f"{where}: {self.rule} {self.message} (key={self.key})"
+                f"\n    hint: {self.hint}")
+
+
+class ShardlintResult:
+    """Findings + silences for one analyze() run."""
+
+    def __init__(self):
+        self.findings = []       # active ShardFinding objects
+        self.suppressed = []     # silenced by a source disable comment
+        self.waived = []         # silenced by a registry waiver
+        self.errors = []         # (key, message) pass/corpus failures
+        self.captures_analyzed = 0
+
+    @property
+    def clean(self):
+        return not self.findings and not self.errors
+
+    def as_dict(self):
+        counts = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "version": 1,
+            "captures": self.captures_analyzed,
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": [
+                {"rule": f.rule, "key": f.key, "path": f.path,
+                 "line": f.line, "reason": f.suppress_reason}
+                for f in self.suppressed],
+            "waived": [
+                {"rule": f.rule, "key": f.key, "reason": f.waive_reason}
+                for f in self.waived],
+            "errors": [{"key": k, "message": m} for k, m in self.errors],
+            "counts": counts,
+        }
+
+
+class _SourceSuppressions:
+    """Lazy per-file ``# shardlint: disable=RULE(reason)`` lookup.
+
+    shardlint findings anchor to arbitrary user files via jaxpr source
+    info, so suppression comments are read from the anchored file on
+    demand (cached), not from a pre-parsed module table like mxlint's.
+    A disable with an empty reason never suppresses."""
+
+    def __init__(self):
+        self._cache = {}         # path -> {line: {rule: reason}}
+
+    def _table(self, path):
+        table = self._cache.get(path)
+        if table is None:
+            table = {}
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                lines = []
+            for i, line in enumerate(lines, start=1):
+                m = _SUPP_RE.search(line)
+                if not m:
+                    continue
+                for rule, reason in _SUPP_ITEM.findall(line[m.end():]):
+                    table.setdefault(i, {})[rule] = (
+                        reason.strip(), line.lstrip().startswith("#"))
+            self._cache[path] = table
+        return table
+
+    def lookup(self, rule, path, line):
+        if path is None or line is None:
+            return None
+        table = self._table(path)
+        for cand in (line, line - 1):
+            entry = table.get(cand, {}).get(rule)
+            if entry is None:
+                continue
+            reason, pure_comment = entry
+            if cand == line - 1 and not pure_comment:
+                continue
+            if reason:
+                return reason
+        return None
+
+
+def _waiver_for(finding, waivers):
+    for rule, key_glob, reason in waivers:
+        if rule == finding.rule and fnmatch.fnmatch(finding.key,
+                                                    key_glob):
+            return reason
+    return None
+
+
+def analyze(captures, waivers=None):
+    """Run SL01-SL05 over `captures` (Capture objects from
+    incubator_mxnet_tpu.shardlint). `waivers` is an iterable of
+    (rule, key-glob, reason) triples; None means the in-tree registry
+    (tools/shardlint/waivers.py). Pass `waivers=()` to judge with no
+    silences at all."""
+    from .rules import check_capture
+    if waivers is None:
+        from .waivers import WAIVERS as waivers
+    result = ShardlintResult()
+    supp = _SourceSuppressions()
+    for cap in captures:
+        result.captures_analyzed += 1
+        findings, errors = check_capture(cap)
+        result.errors.extend(errors)
+        for f in findings:
+            reason = supp.lookup(f.rule, f.path, f.line)
+            if reason is not None:
+                f.suppress_reason = reason
+                result.suppressed.append(f)
+                continue
+            reason = _waiver_for(f, waivers)
+            if reason is not None:
+                f.waive_reason = reason
+                result.waived.append(f)
+                continue
+            result.findings.append(f)
+    result.findings.sort(key=lambda f: (f.key, f.rule,
+                                        f.line or 0))
+    return result
+
+
+def load_fixture(path):
+    """Import a fixture module by file path and return
+    (captures, waivers): the module's ``build()`` output and its
+    optional ``WAIVERS`` attribute (default: no waivers — fixtures are
+    judged bare unless they opt in)."""
+    import importlib.util
+    import os
+    name = "shardlint_fixture_" + \
+        os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load fixture {path!r}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return list(mod.build()), tuple(getattr(mod, "WAIVERS", ()))

@@ -1,0 +1,108 @@
+"""shardlint CLI.
+
+    python -m tools.shardlint [--corpus NAMES] [--fixture FILE]
+                              [--format=text|json] [--list] [--no-waivers]
+
+Default mode traces the registered model corpus (tools/shardlint/
+corpus.py) on CPU and analyzes the captures against the in-tree waiver
+registry. ``--fixture FILE`` analyzes a fixture module's ``build()``
+captures instead (its own ``WAIVERS`` attribute applies, if any).
+
+Exit status: 0 clean, 1 findings or corpus/analyzer errors, 2 usage
+error.  ``MXNET_SHARDLINT_CORPUS`` (comma-separated names) preselects
+corpus entries when --corpus is not given.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# the corpus must trace, never touch a real accelerator: an operator
+# running the linter on a TPU host must not grab the chips
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from . import analyze, load_fixture     # noqa: E402
+from . import corpus as _corpus         # noqa: E402
+
+
+def _render_text(result):
+    for f in result.findings:
+        print(f.render())
+    for key, msg in result.errors:
+        print(f"[{key}]: error: {msg}")
+    n, s, w = (len(result.findings), len(result.suppressed),
+               len(result.waived))
+    print(f"shardlint: {result.captures_analyzed} captures, {n} finding"
+          f"{'' if n == 1 else 's'}, {s} suppressed, {w} waived")
+    for f in result.suppressed:
+        print(f"  suppressed {f.rule} at {f.path}:{f.line} "
+              f"({f.suppress_reason})")
+    for f in result.waived:
+        print(f"  waived {f.rule} on {f.key} ({f.waive_reason})")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="shardlint",
+        description="jaxpr/HLO-level sharding & performance analyzer")
+    ap.add_argument("--corpus", default=None,
+                    help="comma-separated corpus entries (default: all; "
+                         "env MXNET_SHARDLINT_CORPUS also selects)")
+    ap.add_argument("--fixture", default=None,
+                    help="analyze a fixture module's build() captures "
+                         "instead of the corpus")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list", action="store_true",
+                    help="list corpus entries and rules, then exit")
+    ap.add_argument("--no-waivers", action="store_true",
+                    help="judge with the waiver registry disabled")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        from . import RULES
+        from .waivers import WAIVERS
+        print("corpus entries:")
+        for name, fn in _corpus.entries().items():
+            doc = (fn.__doc__ or "").strip().splitlines()[0]
+            print(f"  {name}: {doc}")
+        print("rules:")
+        for rule, (title, _hint) in sorted(RULES.items()):
+            print(f"  {rule}: {title}")
+        print(f"waivers: {len(WAIVERS)}")
+        for rule, glob, reason in WAIVERS:
+            print(f"  {rule} on {glob}: {reason}")
+        return 0
+
+    if args.fixture is not None:
+        if not os.path.exists(args.fixture):
+            print(f"shardlint: no such fixture: {args.fixture}",
+                  file=sys.stderr)
+            return 2
+        captures, fixture_waivers = load_fixture(args.fixture)
+        waivers = () if args.no_waivers else fixture_waivers
+        result = analyze(captures, waivers=waivers)
+    else:
+        names = args.corpus if args.corpus is not None else \
+            os.environ.get("MXNET_SHARDLINT_CORPUS", "")
+        names = [n.strip() for n in names.split(",") if n.strip()] or None
+        try:
+            captures, errors = _corpus.run(names)
+        except KeyError as e:
+            print(f"shardlint: {e.args[0]}", file=sys.stderr)
+            return 2
+        result = analyze(captures,
+                         waivers=() if args.no_waivers else None)
+        result.errors.extend(("corpus:" + name, msg)
+                             for name, msg in errors)
+
+    if args.format == "json":
+        print(json.dumps(result.as_dict(), indent=2, sort_keys=True))
+    else:
+        _render_text(result)
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

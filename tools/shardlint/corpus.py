@@ -1,0 +1,173 @@
+"""The offline model corpus: the package's own train/serve/parallel
+entry points, registered so `python -m tools.shardlint` can judge them
+without a TPU and without a training run.
+
+Each entry is a builder that drives a real framework path with capture
+forced on; the captures land in the package-side registry
+(incubator_mxnet_tpu.shardlint) and `run()` hands them back for
+analysis. Entries trace on CPU and avoid XLA compiles where the
+framework offers a trace-only path (TrainStep.trace_for_analysis,
+_CachedJit.trace_signature) — the serve entry pays one tiny MLP
+compile because the predictor's graph only exists per bucket.
+
+This corpus is the tier-1 gate's ground truth: tests/test_shardlint.py
+asserts the whole thing analyzes clean against the exact waiver list in
+waivers.py.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+__all__ = ["entries", "run"]
+
+
+def _corpus_train_step():
+    """Plain f32 TrainStep over a 1+-device mesh: donation gating,
+    partition declaration, and the full fused step jaxpr."""
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.parallel import TrainStep, make_mesh
+    import jax.numpy as jnp
+
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+
+    def loss_fn(out, label):
+        return jnp.mean((out - label) ** 2)
+
+    # the batch's leading dim must divide the data axis whatever the
+    # device count is (1 standalone, 8 under the test harness's forced
+    # host-platform device count)
+    import jax
+    b = 8 * max(len(jax.devices()), 1)
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.05},
+                     mesh=make_mesh(),
+                     example_inputs=[nd.array(np.ones((b, 8), np.float32))])
+    step.trace_for_analysis(nd.array(np.ones((b, 8), np.float32)),
+                            nd.array(np.ones((b, 4), np.float32)))
+
+
+def _corpus_train_bf16():
+    """bf16 TrainStep whose loss deliberately upcasts to an f32 master
+    accumulation — the intentional SL02 hit the waiver registry carries
+    (the waiver demo must stay deterministic, so do not 'fix' this)."""
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.parallel import TrainStep
+    import jax.numpy as jnp
+
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+
+    def loss_fn(out, label):
+        return jnp.mean((out.astype(jnp.float32) - label) ** 2)
+
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.05},
+                     dtype=jnp.bfloat16,
+                     example_inputs=[nd.array(np.ones((4, 8), np.float32))])
+    step.trace_for_analysis(nd.array(np.ones((4, 8), np.float32)),
+                            nd.array(np.ones((4, 4), np.float32)))
+
+
+def _corpus_serve_predict():
+    """Export a tiny MLP, reload through Predictor.from_artifact, run one
+    predict — the serving execute path's capture."""
+    import os
+    import tempfile
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.serve import Predictor
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, activation="relu"), nn.Dense(3))
+    net.initialize()
+    net(nd.array(np.zeros((1, 6), np.float32)))
+    d = tempfile.mkdtemp(prefix="shardlint_corpus_")
+    path = os.path.join(d, "model")
+    net.export(path)
+    pred = Predictor.from_artifact(path, bucket_sizes=(2,))
+    pred.predict({"data": np.ones((2, 6), np.float32)})
+
+
+def _corpus_fused_optimizer():
+    """The fused multi-tensor optimizer executable (role-annotated in
+    _fused_fn), traced without compiling."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops import optimizer_ops as _oo
+
+    f = _oo._fused_fn("sgd_mom_update", 2, 3, (("momentum", 0.9),),
+                      ("lr", "wd"))
+    dyn = (jnp.full((2,), 0.1, jnp.float32),
+           jnp.zeros((2,), jnp.float32))
+    flat = [jnp.ones((4,), jnp.float32) for _ in range(6)]
+    f.trace_signature(dyn, jnp.float32(1.0), *flat)
+
+
+def _corpus_partition_rules():
+    """The in-tree Megatron rules table (tensor_parallel.
+    transformer_partition_rules) over transformer-style param names —
+    the SL04 coverage capture proving the table is total."""
+    import numpy as np
+    from incubator_mxnet_tpu.parallel import (match_partition_rules,
+                                              transformer_partition_rules)
+
+    params = {
+        "embed": np.zeros((32, 16), np.float32),
+        "pos_embed": np.zeros((8, 16), np.float32),
+        "l0.wq": np.zeros((16, 16), np.float32),
+        "l0.wo": np.zeros((16, 16), np.float32),
+        "l0.w_in": np.zeros((16, 64), np.float32),
+        "l0.w_out": np.zeros((64, 16), np.float32),
+        "l0.ln1_g": np.zeros((16,), np.float32),
+        "global_step": np.zeros((), np.float32),
+    }
+    match_partition_rules(transformer_partition_rules(), params,
+                          on_unmatched="error",
+                          key="corpus:partition_rules")
+
+
+def entries():
+    """name -> builder, in run order."""
+    return OrderedDict([
+        ("train_step", _corpus_train_step),
+        ("train_bf16", _corpus_train_bf16),
+        ("serve_predict", _corpus_serve_predict),
+        ("fused_optimizer", _corpus_fused_optimizer),
+        ("partition_rules", _corpus_partition_rules),
+    ])
+
+
+def run(names=None):
+    """Drive the corpus with capture forced on. Returns
+    (captures, errors): the Capture list recorded across the selected
+    entries, and (entry, message) pairs for builders that raised. The
+    process's prior capture state (enabled flag, buffer) is restored on
+    exit so running the corpus inside a test session leaks nothing."""
+    from incubator_mxnet_tpu import shardlint as sl
+    table = entries()
+    unknown = [n for n in (names or ()) if n not in table]
+    if unknown:
+        raise KeyError(f"unknown corpus entries {unknown}; "
+                       f"have {list(table)}")
+    selected = [(n, table[n]) for n in (names or table)]
+    errors = []
+    prev_enabled = sl.enable(True)
+    prev_captures = sl.captures()
+    sl.clear()
+    try:
+        for name, builder in selected:
+            try:
+                builder()
+            except Exception as e:    # noqa: BLE001 — report, keep going
+                errors.append((name, f"{type(e).__name__}: {e}"))
+        return sl.captures(), errors
+    finally:
+        sl.clear()
+        with sl._lock:
+            sl._captures.extend(prev_captures)
+        sl.enable(prev_enabled)

@@ -1,0 +1,239 @@
+"""shardlint rule passes SL01-SL05 over Capture records.
+
+Each pass is a function `check_slNN(cap) -> [ShardFinding]` walking the
+captured jaxpr (or partition metadata) — never re-tracing, never
+compiling.  The jaxpr walker recurses into sub-jaxprs (pjit, cond
+branches, scan bodies) by duck typing on eqn params, so a callback
+buried three jit levels down still surfaces with its user source line.
+
+mxlint's AST rules see what the *author wrote*; these see what XLA will
+actually *run* — the two catch disjoint bug families (a
+`jnp.float64` cast is trace-safe Python and invisible to TS01-TS04,
+but it doubles every downstream buffer on a backend that honors x64).
+"""
+from __future__ import annotations
+
+__all__ = ["check_capture", "walk_eqns", "source_anchor"]
+
+# non-donatable argument roles: gradients are re-used by the next
+# backward pass, shared weights outlive the call
+_NEVER_DONATE = ("grads", "weights_shared")
+# roles the donation audit expects to see donated when the backend
+# supports buffer aliasing
+_DONATE_ELIGIBLE = ("params", "opt_state", "weights")
+# host-callback primitives: each one stalls the TPU step on a host
+# round-trip (debug_callback backs jax.debug.print)
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+
+
+# ---------------------------------------------------------------------------
+# jaxpr plumbing
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(params):
+    """Yield inner jaxprs hiding in eqn params (pjit: ClosedJaxpr under
+    'jaxpr'; cond: tuple of branches; scan/while: body jaxprs)."""
+    for v in params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vs:
+            inner = getattr(item, "jaxpr", None)   # ClosedJaxpr
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner
+            elif hasattr(item, "eqns"):            # raw Jaxpr
+                yield item
+
+
+def walk_eqns(jaxpr):
+    """Depth-first over every eqn including sub-jaxprs. Accepts a
+    ClosedJaxpr or Jaxpr."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in getattr(jaxpr, "eqns", ()):
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from walk_eqns(sub)
+
+
+def source_anchor(eqn):
+    """(path, line) of the user frame that staged this eqn, or
+    (None, None). Uses jax's private source_info_util behind a broad
+    guard — anchors are a nicety, findings survive without them."""
+    try:
+        si = getattr(eqn, "source_info", None)
+        if si is None:
+            return None, None
+        from jax._src import source_info_util as siu
+        frame = siu.user_frame(si)
+        if frame is None:
+            return None, None
+        return (getattr(frame, "file_name", None),
+                getattr(frame, "start_line", None) or None)
+    except Exception:       # noqa: BLE001 — private API, version drift
+        return None, None
+
+
+def _dtype_of(var):
+    aval = getattr(var, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    return str(dt) if dt is not None else None
+
+
+def _finding(cap, rule, message, eqn=None):
+    from . import ShardFinding
+    path, line = source_anchor(eqn) if eqn is not None else (None, None)
+    return ShardFinding(rule, cap.key, message, path=path, line=line)
+
+
+# ---------------------------------------------------------------------------
+# SL01 — host callback in a jitted program
+# ---------------------------------------------------------------------------
+
+def check_sl01(cap):
+    if cap.jaxpr is None:
+        return []
+    out = []
+    for eqn in walk_eqns(cap.jaxpr):
+        name = eqn.primitive.name
+        if name in _CALLBACK_PRIMS:
+            what = ("jax.debug.print/debug_callback"
+                    if name == "debug_callback" else name)
+            out.append(_finding(
+                cap, "SL01",
+                f"{what} staged inside jitted program — every step "
+                f"round-trips to the host", eqn=eqn))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SL02 — f64 promotion / silent bf16 upcast
+# ---------------------------------------------------------------------------
+
+def check_sl02(cap):
+    if cap.jaxpr is None:
+        return []
+    out = []
+    for eqn in walk_eqns(cap.jaxpr):
+        in_dts = [_dtype_of(v) for v in eqn.invars]
+        out_dts = [_dtype_of(v) for v in eqn.outvars]
+        if "float64" in out_dts and "float64" not in in_dts:
+            out.append(_finding(
+                cap, "SL02",
+                f"{eqn.primitive.name} introduces float64 from "
+                f"{[d for d in in_dts if d]} inputs", eqn=eqn))
+        elif (cap.declared_bf16
+              and eqn.primitive.name == "convert_element_type"
+              and "bfloat16" in in_dts
+              and str(eqn.params.get("new_dtype")) == "float32"):
+            out.append(_finding(
+                cap, "SL02",
+                "bfloat16 value upcast to float32 inside a "
+                "declared-bf16 program", eqn=eqn))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SL03 — donation audit
+# ---------------------------------------------------------------------------
+
+def check_sl03(cap):
+    """Judge donate_argnums against the call site's declared arg roles.
+    Captures without arg_roles are skipped outright — SL03 never
+    speculates about what an un-annotated argument means."""
+    roles = cap.arg_roles
+    if roles is None:
+        return []
+    donated = set(cap.donate_argnums)
+    out = []
+    bad = sorted(i for i in donated
+                 if roles.get(i) in _NEVER_DONATE)
+    if bad:
+        out.append(_finding(
+            cap, "SL03",
+            f"non-donatable args donated: "
+            f"{[(i, roles[i]) for i in bad]} — the caller reuses these "
+            f"buffers after the call"))
+    if donated and not cap.donation_supported:
+        out.append(_finding(
+            cap, "SL03",
+            f"donation requested ({sorted(donated)}) but backend "
+            f"{cap.backend!r} does not alias buffers — gate on "
+            f"_donation_supported()"))
+    if cap.donation_supported:
+        missed = sorted(i for i, r in roles.items()
+                        if r in _DONATE_ELIGIBLE and i not in donated)
+        if missed:
+            out.append(_finding(
+                cap, "SL03",
+                f"donation-eligible args not donated: "
+                f"{[(i, roles[i]) for i in missed]} — each one doubles "
+                f"its buffer's HBM footprint across the update"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SL04 — partition-rule coverage
+# ---------------------------------------------------------------------------
+
+def check_sl04(cap):
+    out = []
+    for leaf in cap.meta.get("unmatched", ()):
+        out.append(_finding(
+            cap, "SL04",
+            f"param {leaf!r} matched no partition rule and fell back "
+            f"to full replication"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SL05 — implicit transfer / resharding
+# ---------------------------------------------------------------------------
+
+def check_sl05(cap):
+    out = []
+    if cap.jaxpr is not None:
+        last_constraint = {}     # outvar id -> (eqn, sharding repr)
+        for eqn in walk_eqns(cap.jaxpr):
+            name = eqn.primitive.name
+            if name == "device_put":
+                out.append(_finding(
+                    cap, "SL05",
+                    "device_put staged inside jitted program — an "
+                    "implicit transfer XLA cannot schedule around",
+                    eqn=eqn))
+            elif name == "sharding_constraint":
+                sh = repr(eqn.params.get("sharding"))
+                for v in eqn.invars:
+                    prev = last_constraint.get(id(v))
+                    if prev is not None and prev[1] != sh:
+                        out.append(_finding(
+                            cap, "SL05",
+                            f"value resharded back-to-back: "
+                            f"{prev[1]} then {sh} — the first "
+                            f"constraint only buys a transfer",
+                            eqn=eqn))
+                for v in eqn.outvars:
+                    last_constraint[id(v)] = (eqn, sh)
+    if cap.lowered_text and cap.allgather_budget is not None:
+        n = cap.lowered_text.count("all-gather")
+        if n > cap.allgather_budget:
+            out.append(_finding(
+                cap, "SL05",
+                f"lowered module contains {n} all-gathers, over the "
+                f"declared budget of {cap.allgather_budget}"))
+    return out
+
+
+_PASSES = (check_sl01, check_sl02, check_sl03, check_sl04, check_sl05)
+
+
+def check_capture(cap):
+    """All findings for one Capture. A pass that crashes on an exotic
+    jaxpr records an analyzer error finding rather than killing the
+    run — raising here would make the linter flakier than the code it
+    lints."""
+    findings, errors = [], []
+    for p in _PASSES:
+        try:
+            findings.extend(p(cap))
+        except Exception as e:  # noqa: BLE001 — survive exotic jaxprs
+            errors.append((cap.key, f"{p.__name__}: {e!r}"))
+    return findings, errors

@@ -1,0 +1,21 @@
+"""Registry waivers for graph-anchored shardlint findings.
+
+A finding that anchors to a source line is silenced in place with
+``# shardlint: disable=RULE(reason)``; a finding that judges a whole
+capture (or anchors into generated/corpus code) has no natural line to
+comment, so it is waived here: (rule, capture-key glob, reason).
+
+Rules of the registry:
+  * every entry carries a reason — an empty reason is a test failure;
+  * the list is BUDGETED: tests/test_shardlint.py pins the exact
+    entries and caps the count at 10, so a waiver is a reviewed,
+    deliberate exception, not a pressure valve.
+"""
+
+WAIVERS = [
+    # bf16 training intentionally upcasts the loss to an f32 master
+    # accumulation (mixed-precision policy, docs/architecture/
+    # note_static_analysis.md); the upcast is the point, not a leak.
+    ("SL02", "trainstep:*",
+     "bf16 training keeps the loss in f32 master precision by design"),
+]
