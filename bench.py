@@ -126,6 +126,53 @@ def _device_peak():
     return kind, None
 
 
+def _aot_cost(key, jitted, *args):
+    """Compiler cost summary {flops, bytes_accessed, ...} for a jitted
+    callable at these args, recorded into the profiler cost table. Uses
+    the AOT Lowered (XLA's HLO cost analysis — no second backend compile);
+    returns {} when the backend can't report."""
+    from incubator_mxnet_tpu import profiler
+    try:
+        return profiler.cost_from_executable(key, jitted.lower(*args))
+    except Exception as e:  # noqa: BLE001 — cost is telemetry, not a result
+        print(f"[bench] {key}: compiler cost unavailable ({e!r})",
+              file=sys.stderr)
+        return {}
+
+
+def _check_flops_agreement(name, analytic, compiler, strict):
+    """Cross-check the compiler's reported FLOPs against the analytic
+    formula; >10% disagreement means one of the two models is wrong.
+    Strict (raises) on TPU where cost_analysis is authoritative; on CPU
+    it warns — XLA:CPU analyzes a differently-optimized module. Returns
+    the relative error (None when either side is missing)."""
+    if not analytic or not compiler:
+        return None
+    rel = abs(compiler - analytic) / analytic
+    if rel > 0.10:
+        msg = (f"[bench] {name}: compiler FLOPs {compiler:.4g} vs analytic "
+               f"{analytic:.4g} disagree by {rel * 100:.1f}% (>10%)")
+        if strict:
+            raise AssertionError(msg)
+        print(msg + " -- tolerated off-TPU", file=sys.stderr)
+    return rel
+
+
+def _phase_probe(run_one_step):
+    """Run one step with step-time attribution forced on and return its
+    {phase: ms} breakdown (rounded). The caller must have warmed up
+    already so compile time doesn't masquerade as compute."""
+    from incubator_mxnet_tpu import profiler
+    prev = profiler.attribution_enable(True)
+    try:
+        run_one_step()
+        profiler.phase_step_end()
+        phases = profiler.last_step_phases()
+    finally:
+        profiler.attribution_enable(prev)
+    return {k: round(v, 3) for k, v in phases.items()}
+
+
 def bench_train(batch, dtype, steps, image_size=224):
     """Fully-compiled train loop: `steps` optimizer steps run inside ONE
     XLA program (TrainStep.run_steps scans the fused fwd+bwd+SGD step with
@@ -164,7 +211,31 @@ def bench_train(batch, dtype, steps, image_size=224):
     _sync(x), _sync(y)
     _sync(step.run_steps(steps, x, y))    # compile + warmup
     dt = _time_best(lambda: _sync(step.run_steps(steps, x, y)))
-    return batch * steps / dt
+
+    # observability row extras: per-phase breakdown of one attributed
+    # single step through TrainStep.__call__ (h2d/compute spans with a
+    # device sync), plus the compiler's own cost model for that step —
+    # the cached_jit trainstep executable records cost_analysis() into
+    # the profiler compile table as a side effect of compiling
+    extras = {}
+    try:
+        from incubator_mxnet_tpu import profiler
+        prev = profiler.attribution_enable(True)   # cost hook is gated
+        try:
+            _sync(step(x, y))             # compile the 1-step executable
+            extras["phase_ms"] = _phase_probe(lambda: step(x, y))
+            cost = profiler.cost_stats()
+        finally:
+            profiler.attribution_enable(prev)
+        for key, row in cost.items():
+            if key.startswith("trainstep:") and row.get("flops"):
+                extras["compiler_flops_per_step"] = row["flops"]
+                if row.get("bytes_accessed"):
+                    extras["compiler_bytes_per_step"] = row["bytes_accessed"]
+    except Exception as e:  # noqa: BLE001 — extras must not fail the row
+        print(f"[bench] train b{batch} {dtype}: attribution probe failed "
+              f"({e!r})", file=sys.stderr)
+    return batch * steps / dt, extras
 
 
 def _time_best(run, n=2):
@@ -213,7 +284,26 @@ def bench_inference(batch, dtype, steps, image_size=224):
     fwd = jax.jit(loop, compiler_options=default_compiler_options())
     _sync(fwd(params, rng, xa))
     dt = _time_best(lambda: _sync(fwd(params, rng, xa)))
-    return batch * steps / dt
+
+    extras = {}
+    try:
+        from incubator_mxnet_tpu import profiler
+
+        def one():
+            with profiler.span("compute"):
+                _sync(fwd(params, rng, xa))
+        extras["phase_ms"] = {
+            k: round(v / steps, 3)
+            for k, v in _phase_probe(one).items()}    # per forward pass
+        cost = _aot_cost(f"bench:inference[b{batch},{dtype}]", fwd,
+                         params, rng, xa)
+        if cost.get("flops"):
+            # the lowered program scans `steps` forwards: report per step
+            extras["compiler_flops_per_step"] = cost["flops"] / steps
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] inference b{batch} {dtype}: attribution probe "
+              f"failed ({e!r})", file=sys.stderr)
+    return batch * steps / dt, extras
 
 
 def bench_transformer(steps=20):
@@ -267,7 +357,20 @@ def bench_transformer(steps=20):
     # attention quadratic: fwd 4*B*T^2*D per layer, x3 for train
     flops_step = 6.0 * (n_matmul + n_embed) * B * T + 12.0 * L * B * T * T * D
     _, peak = _device_peak()
-    mfu = flops_step * steps / dt / peak if peak else None
+    # MFU from the compiler's cost model when the step function exposes
+    # AOT lowering; the analytic formula stays as the strict cross-check
+    # (bench_transformer only runs on TPU, where cost_analysis is
+    # authoritative)
+    compiler_step = None
+    if hasattr(step, "lower"):
+        cost = _aot_cost("bench:transformer", step,
+                         params, opt, tokens, targets, 0)
+        if cost.get("flops"):
+            compiler_step = cost["flops"] / steps
+            _check_flops_agreement("transformer train", flops_step,
+                                   compiler_step, strict=True)
+    used = compiler_step if compiler_step else flops_step
+    mfu = used * steps / dt / peak if peak else None
     return tok_s, mfu
 
 
@@ -793,29 +896,52 @@ def main():
     results = []
     head_printed = False
     for mode, batch, dtype in configs:
+        extras = {}
         try:
             if dtype == "int8":
                 ips = bench_int8_inference(batch, steps_for(mode, dtype))
             else:
                 fn = bench_train if mode == "train" else bench_inference
-                ips = fn(batch, dtype, steps_for(mode, dtype))
+                ips, extras = fn(batch, dtype, steps_for(mode, dtype))
         except Exception as e:  # OOM on small chips must not kill the run
             print(f"[bench] {mode} b{batch} {dtype}: FAILED {e!r}",
                   file=sys.stderr)
             continue
         flops = RESNET50_FWD_GFLOP * 1e9 * (3.0 if mode == "train" else 1.0)
         cfg_peak = peak * 2 if (peak and dtype == "int8") else peak
-        mfu = (ips * flops / cfg_peak) if cfg_peak else None
+        # MFU from the compiler's cost model when it reported; the analytic
+        # constant stays as the cross-check row
+        cf_step = extras.get("compiler_flops_per_step")
+        cf_img = cf_step / batch if cf_step else None
+        mfu_analytic = (ips * flops / cfg_peak) if cfg_peak else None
+        mfu = (ips * cf_img / cfg_peak) if (cfg_peak and cf_img) \
+            else mfu_analytic
         base = BASELINES.get((mode, batch, dtype))
         results.append({"mode": mode, "batch": batch, "dtype": dtype,
                         "img_per_sec": round(ips, 2),
                         "mfu": round(mfu, 4) if mfu is not None else None,
+                        "mfu_analytic": round(mfu_analytic, 4)
+                        if mfu_analytic is not None else None,
+                        "compiler_gflop_per_img": round(cf_img / 1e9, 3)
+                        if cf_img else None,
+                        "phase_ms": extras.get("phase_ms") or None,
                         "vs_baseline": round(ips / base, 3) if base else None})
         print(f"[bench] {mode:9s} b{batch:<4d} {dtype:8s} "
               f"{ips:9.2f} img/s"
               + (f"  MFU {mfu*100:5.1f}%" if mfu is not None else "")
-              + (f"  {ips/base:5.2f}x baseline" if base else ""),
+              + (f"  {ips/base:5.2f}x baseline" if base else "")
+              + ("  phases " + " ".join(
+                  f"{k}={v:.1f}ms" for k, v in
+                  sorted(extras["phase_ms"].items(), key=lambda kv: -kv[1]))
+                 if extras.get("phase_ms") else ""),
               file=sys.stderr)
+        # the 10% compiler-vs-analytic cross-check on the ResNet rows:
+        # strict where cost_analysis is authoritative (TPU), warn on CPU.
+        # int8 is excluded — the quantized graph is not the 4.09-GFLOP conv
+        # stack the analytic constant models.
+        if dtype != "int8":
+            _check_flops_agreement(f"resnet {mode} b{batch} {dtype}",
+                                   flops, cf_img, strict=on_tpu)
         # the headline config runs FIRST; emit its JSON line immediately so
         # an outer timeout on the remaining configs can't swallow the result
         if not head_printed and (mode, batch, dtype) == ("train", 32, "float32"):

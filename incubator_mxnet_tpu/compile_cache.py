@@ -408,6 +408,7 @@ class _CachedJit:
                 _mem_put(fp, exe)
                 with _lock:
                     _stats["disk_hits"] += 1
+                self._note_cost(exe)
                 return exe, "disk", (time.perf_counter() - t0) * 1e3
             if traced is None:
                 traced = self._jfn.trace(*args, **kwargs)
@@ -417,7 +418,25 @@ class _CachedJit:
                 _stats["misses"] += 1
             _mem_put(fp, exe)
             _disk_store(fp, exe)
+            self._note_cost(exe)
             return exe, "miss", ms
+
+    def _note_cost(self, exe):
+        """Compiler cost accounting: record cost_analysis() /
+        memory_analysis() for every executable this cache acquires (fresh
+        compile or disk deserialize) into the profiler's per-key cost
+        table. Gated on the attribution flag like every automatic
+        observability hook — otherwise every op a process ever compiles
+        leaks into dumps() (callers who want cost unconditionally use
+        profiler.cost_from_executable directly, the bench.py path).
+        Never raises — cost extraction is advisory."""
+        try:
+            from . import profiler as _prof
+            if not _prof.attribution_enabled():
+                return
+            _prof.cost_from_executable(self._key, exe)
+        except Exception:       # noqa: BLE001 — torn-down interpreter
+            pass
 
     def _note_fallback(self):
         with _lock:

@@ -47,7 +47,8 @@ from .base import MXNetError
 
 __all__ = ["CheckpointManager", "AsyncCheckpointManager", "PreemptionHandler",
            "get_dead_nodes", "resume_or_start", "FaultInjector", "inject",
-           "set_fault_spec", "stats"]
+           "set_fault_spec", "stats", "flight_enabled", "flight_record",
+           "flight_dump", "flight_reset"]
 
 _log = logging.getLogger("incubator_mxnet_tpu.fault")
 
@@ -151,6 +152,10 @@ class FaultInjector:
         with self._lock:
             hits = self._hits[site] = self._hits.get(site, 0) + 1
             actions = [r for r in self._rules.get(site, ()) if r[0] == hits]
+        if actions:
+            # SIGKILL is uncatchable: the flight dump must land on disk
+            # before the action loop runs, not in an atexit/finally.
+            flight_dump(f"fault:{site}#{hits}")
         for _, action, arg in actions:
             _bump("faults_injected")
             _log.warning("fault injected: %s #%d -> %s", site, hits, action)
@@ -186,6 +191,127 @@ def inject(site):
     inj = _get_injector()
     if inj.active:
         inj.fire(site)
+
+
+# ---------------------------------------------------------------------------
+# crash flight recorder: a bounded ring of the last N step records/events,
+# dumped atomically on SIGUSR1, on a FaultInjector trip (BEFORE the action
+# runs — SIGKILL is uncatchable, so the dying worker's postmortem is written
+# pre-mortem), and on unhandled exception in TrainStep.run_epoch. Gated on
+# MXNET_FLIGHT_RECORDER (a directory path) with the cached-boolean pattern.
+# ---------------------------------------------------------------------------
+
+_flight_lock = threading.Lock()     # guards the ring; LEAF, nests under none
+_flight_dir = None                  # cached MXNET_FLIGHT_RECORDER read
+_flight_ring = None                 # deque of recent records
+_flight_sig_installed = False
+
+
+def flight_enabled():
+    """True when the flight recorder is on (MXNET_FLIGHT_RECORDER names a
+    dump directory). Read once and cached — the gate sits on the per-step
+    hot path."""
+    global _flight_dir
+    if _flight_dir is None:
+        from .util import getenv_str
+        _flight_dir = getenv_str("MXNET_FLIGHT_RECORDER") or ""
+    return bool(_flight_dir)
+
+
+def flight_reset():
+    """Forget the cached MXNET_FLIGHT_RECORDER read and drop the ring —
+    the next flight_enabled() consults the environment again (tests)."""
+    global _flight_dir, _flight_ring, _flight_sig_installed
+    with _flight_lock:
+        _flight_dir = None
+        _flight_ring = None
+    _flight_sig_installed = False
+
+
+def _flight_ring_locked():
+    global _flight_ring
+    if _flight_ring is None:
+        from .util import getenv_int
+        _flight_ring = deque(maxlen=max(
+            getenv_int("MXNET_FLIGHT_RECORDER_SIZE"), 8))
+    return _flight_ring
+
+
+def _flight_install_signal():
+    """Lazy SIGUSR1 hook (kill -USR1 <pid> -> postmortem dump of a live
+    but wedged worker). Main-thread only — signal.signal raises from
+    worker threads, and a recorder must never break its host."""
+    global _flight_sig_installed
+    if _flight_sig_installed:
+        return
+    _flight_sig_installed = True
+    if threading.current_thread() is not threading.main_thread():
+        return
+    try:
+        signal.signal(signal.SIGUSR1,
+                      lambda signum, frame: flight_dump("SIGUSR1"))
+    except (ValueError, OSError, AttributeError):
+        pass
+
+
+def flight_record(kind, **data):
+    """Append one record to the flight ring (drop-oldest past
+    MXNET_FLIGHT_RECORDER_SIZE). No-op when the recorder is off; never
+    raises — recording must not take down the step loop."""
+    if not flight_enabled():
+        return
+    try:
+        rec = {"t": time.time(), "kind": str(kind)}
+        rec.update({k: v for k, v in data.items() if v is not None})
+        with _flight_lock:
+            _flight_ring_locked().append(rec)
+        _flight_install_signal()
+    except Exception:       # noqa: BLE001
+        pass
+
+
+def flight_dump(reason):
+    """Write the postmortem JSON atomically (private tmp + fsync +
+    os.replace, the CheckpointManager idiom) to
+    ``$MXNET_FLIGHT_RECORDER/flight-<pid>.json``: the ring, the step
+    attribution registry, and the fault counters. Returns the path, or
+    None when the recorder is off or the write failed (logged, never
+    raised — this runs on dying processes and in signal handlers)."""
+    if not flight_enabled():
+        return None
+    try:
+        from . import profiler as _prof
+        with _flight_lock:
+            ring = list(_flight_ring_locked())
+        payload = {
+            "reason": str(reason),
+            "time": time.time(),
+            "pid": os.getpid(),
+            "records": ring,
+            "fault_stats": stats(),
+        }
+        try:
+            payload["phase_stats"] = _prof.phase_stats()
+            payload["last_step_phases"] = _prof.last_step_phases()
+            payload["trace_id"] = _prof.trace_id()
+        except Exception:       # noqa: BLE001
+            pass
+        os.makedirs(_flight_dir, exist_ok=True)
+        path = os.path.join(_flight_dir, f"flight-{os.getpid()}.json")
+        fd, tmp = tempfile.mkstemp(dir=_flight_dir, prefix=".flight.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        return path
+    except Exception:       # noqa: BLE001
+        _log.warning("flight recorder dump failed", exc_info=True)
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -160,13 +160,20 @@ class Trainer:
                 self._async_rescale_warned.add(self._async_baked_rescale)
             # server applies the optimizer on push; pull returns the
             # authoritative weights
-            for i, p in enumerate(self._params):
-                if p.grad_req != "null":
-                    self._kvstore.pushpull(i, p.grad(), out=p.data())
+            from .. import profiler as _prof
+            with _prof.span("pushpull"):
+                for i, p in enumerate(self._params):
+                    if p.grad_req != "null":
+                        self._kvstore.pushpull(i, p.grad(), out=p.data())
+            _prof.phase_step_end()
             return
-        self.allreduce_grads()
-        self._update(ignore_stale_grad)
+        from .. import profiler as _prof
+        with _prof.span("collective"):
+            self.allreduce_grads()
+        with _prof.span("optimizer"):
+            self._update(ignore_stale_grad)
         self._publish_counters()
+        _prof.phase_step_end()
 
     def allreduce_grads(self):
         if not self._kv_initialized:

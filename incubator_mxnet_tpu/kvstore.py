@@ -493,10 +493,12 @@ class KVStore:
                 # kvstore_dist_server.h:346). The reply is the server's
                 # global push count — free staleness telemetry.
                 import numpy as _onp
+                from . import profiler as _prof
                 self._heartbeat()
-                self._async_client.call(
-                    "push", self._async_gen, k,
-                    _onp.asarray(jax.device_get(merged)), self.rank)
+                with _prof.span("pushpull", args={"op": "push", "key": k}):
+                    self._async_client.call(
+                        "push", self._async_gen, k,
+                        _onp.asarray(jax.device_get(merged)), self.rank)
                 continue
             if self._mesh is not None and jax.process_count() > 1:
                 self._heartbeat()
@@ -532,8 +534,10 @@ class KVStore:
             if self._async_client is not None:
                 # async pull: whatever the server's weights are RIGHT NOW
                 # (other workers' pushes may land between two pulls)
-                latest = jax.numpy.asarray(
-                    self._async_client.call("pull", self._async_gen, k))
+                from . import profiler as _prof
+                with _prof.span("pushpull", args={"op": "pull", "key": k}):
+                    latest = jax.numpy.asarray(
+                        self._async_client.call("pull", self._async_gen, k))
                 self._store[k]._data = latest
             for t in tgts:
                 val = self._store[k]._data
@@ -808,15 +812,35 @@ class KVStore:
         self._hb_thread.start()
 
     def _hb_loop(self, addr, period):
+        import time
         from . import fault as _fault
         from . import kvstore_server as _ksrv
+        from . import profiler as _prof
         client = None
         while not self._hb_stop.wait(period):
             try:
                 if client is None:
                     client = _ksrv.connect_async_server(addr)
-                epoch = client.call("heartbeat", self._async_gen,
-                                    self.rank, self._local_steps)
+                beat = ["heartbeat", self._async_gen,
+                        self.rank, self._local_steps]
+                if _prof.attribution_enabled():
+                    # v2 beat: append the last closed step's {phase: ms}
+                    # vector (feeds the server's straggler report) and
+                    # NTP-style clock-offset estimation off the reply
+                    beat.append(_prof.last_step_phases())
+                t0 = time.time()
+                reply = client.call(*beat)
+                t1 = time.time()
+                if isinstance(reply, dict):     # v2 server reply
+                    epoch = int(reply["epoch"])
+                    server_time = reply.get("server_time")
+                    if server_time is not None:
+                        _prof.clock_sync_event(
+                            "server",
+                            offset_us=(server_time - (t0 + t1) / 2.0) * 1e6,
+                            rtt_us=(t1 - t0) * 1e6)
+                else:
+                    epoch = reply
                 _fault._bump("heartbeats_sent")
                 if epoch != self._membership_epoch:
                     if self._membership_epoch:      # the first epoch seen

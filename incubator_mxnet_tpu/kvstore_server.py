@@ -152,6 +152,7 @@ class AsyncServer:
         # (push refreshes liveness after releasing the update lock)
         self._hb_lock = threading.Lock()
         self._liveness = {}         # (gen, rank) -> (last_monotonic, step)
+        self._phase_reports = {}    # (gen, rank) -> {phase: ms} last step
         self._members = {}          # gen -> set of registered ranks
         self._epoch = {}            # gen -> membership epoch (bumps on
         #                             register, i.e. join/rejoin)
@@ -275,12 +276,25 @@ class AsyncServer:
                                "rejoined": rejoined})
         if op == "heartbeat":
             # liveness beat; the reply carries the membership epoch so
-            # every worker learns of joins/rejoins within one beat period
-            _, gen, rank, step = msg
+            # every worker learns of joins/rejoins within one beat period.
+            # v2 senders append the last step's {phase: ms} vector (the
+            # straggler report names WHICH phase is slow on which rank) and
+            # get a dict reply that also carries the server wall clock for
+            # client-side clock-offset estimation (tools/trace_merge.py);
+            # v1 senders keep the original 4-tuple / int-epoch shape.
+            phases = None
+            if len(msg) == 5:
+                _, gen, rank, step, phases = msg
+            else:
+                _, gen, rank, step = msg
             with self._hb_lock:
                 self._members.setdefault(gen, set()).add(rank)
                 self._liveness[(gen, rank)] = (time.monotonic(), int(step))
-                return ("ok", self._epoch.setdefault(gen, 1))
+                epoch = self._epoch.setdefault(gen, 1)
+                if phases is None:
+                    return ("ok", epoch)
+                self._phase_reports[(gen, rank)] = dict(phases)
+            return ("ok", {"epoch": epoch, "server_time": time.time()})
         if op == "dead_nodes":
             _, gen, timeout = msg
             with self._hb_lock:
@@ -297,9 +311,16 @@ class AsyncServer:
                     r for r in members
                     if r not in dead and top - steps[r] >= lag
                 ) if lag > 0 else []
+                # per-rank phase vectors from v2 heartbeats: the straggler
+                # report can name WHICH phase dominates on a slow rank
+                phases = {r: self._phase_reports[(gen, r)] for r in members
+                          if self._phase_reports.get((gen, r))}
+                slow_phase = {r: max(v, key=v.get)
+                              for r, v in phases.items()}
                 return ("ok", {"epoch": self._epoch.setdefault(gen, 1),
                                "workers": members, "dead": dead,
-                               "stragglers": stragglers, "steps": steps})
+                               "stragglers": stragglers, "steps": steps,
+                               "phases": phases, "slow_phase": slow_phase})
         if op == "stop":
             self._stopped.set()
             return ("ok",)
@@ -315,6 +336,7 @@ class AsyncServer:
 
     # -- socket plumbing ---------------------------------------------------
     def _client_loop(self, conn):
+        from . import profiler as _prof
         try:
             # nonce exchange as RAW BYTES, then per-frame HMAC with the
             # derived session key; a peer without the token fails the MAC
@@ -334,8 +356,25 @@ class AsyncServer:
                     msg = chan.recv()       # silent close on MAC mismatch
                 except (ConnectionError, OSError):
                     return
+                # trace-header unwrap: v2 clients wrap the op tuple as
+                # ("__v2__", {"trace", "span"}, msg) INSIDE the pickled
+                # payload, so the existing frame MAC covers the header —
+                # a tampered header fails authentication before unpickle.
+                # v1 clients send the plain tuple and dispatch unchanged.
+                hdr = None
+                if (isinstance(msg, tuple) and len(msg) == 3
+                        and msg[0] == "__v2__" and isinstance(msg[1], dict)):
+                    hdr, msg = msg[1], msg[2]
                 try:
-                    reply = self._handle(msg)
+                    if hdr is not None and _prof.attribution_enabled():
+                        # handler span linked to the worker-side span id
+                        # carried on the wire (merged-timeline join key)
+                        with _prof.span(f"server:{msg[0]}", args={
+                                "link_trace": hdr.get("trace"),
+                                "link_span": hdr.get("span")}):
+                            reply = self._handle(msg)
+                    else:
+                        reply = self._handle(msg)
                 except Exception as e:          # report, don't kill server
                     reply = ("err", repr(e))
                 try:
@@ -480,6 +519,19 @@ class AsyncClient:
                               send_dir=b"C", recv_dir=b"S")
 
     def call(self, *msg):
+        from . import profiler as _prof
+        wire = msg
+        if _prof.attribution_enabled():
+            # protocol v2: trace/span header travels INSIDE the pickled
+            # payload so the frame MAC authenticates it; the span id is the
+            # caller's innermost active span (the worker-side pushpull
+            # span), letting the server's handler span link back to it
+            span = _prof.current_span_id()
+            wire = ("__v2__",
+                    {"trace": _prof.trace_id(),
+                     "span": span if span is not None
+                     else _prof.next_span_id()},
+                    msg)
         last = None
         reply = None
         with self._lock:
@@ -489,7 +541,7 @@ class AsyncClient:
                 try:
                     if self._chan is None:
                         self._dial_locked()
-                    self._chan.send(msg)
+                    self._chan.send(wire)
                     reply = self._chan.recv()
                     break
                 except (ConnectionError, OSError) as e:     # timeout /

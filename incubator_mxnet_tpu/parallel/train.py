@@ -338,16 +338,40 @@ class TrainStep:
             raise MXNetError("start_batch needs an unwrapped source "
                              "iterator (pass skip_batches to io.prefetch "
                              "when constructing the DevicePrefetcher)")
+        from .. import fault as _fault
+        from .. import profiler as _prof
         losses = []
+        flight = _fault.flight_enabled()
+        src = iter(it)
+        _end = object()
         try:
-            for batch in it:
+            while True:
+                # manual next() so the host-side wait on the input
+                # pipeline is attributable (span is a shared no-op with
+                # MXNET_STEP_ATTRIBUTION off — zero bookkeeping)
+                with _prof.span("input_wait"):
+                    batch = next(src, _end)
+                if batch is _end:
+                    break
                 if not isinstance(batch, (tuple, list)):
                     batch = (batch,)
                 losses.append(self(*batch))
                 if checkpoint is not None and checkpoint_every and \
                         it.cursor % checkpoint_every == 0:
-                    self.save_checkpoint(checkpoint,
-                                         data_state={"batch": it.cursor})
+                    with _prof.span("ckpt_snapshot"):
+                        self.save_checkpoint(
+                            checkpoint, data_state={"batch": it.cursor})
+                _prof.phase_step_end()
+                if flight:
+                    _fault.flight_record(
+                        "step", step=self._step_count, cursor=it.cursor,
+                        phases=_prof.last_step_phases() or None)
+        except Exception as e:
+            # the postmortem hook the kill/fault tests rely on: dump the
+            # flight ring before the exception unwinds the train loop
+            # (no-op when MXNET_FLIGHT_RECORDER is unset)
+            _fault.flight_dump(f"exception:{type(e).__name__}")
+            raise
         finally:
             if owned:
                 it.close()
@@ -413,11 +437,22 @@ class TrainStep:
     def __call__(self, *batch):
         from ..ndarray import random as _rnd
         from .. import fault as _fault
+        from .. import profiler as _prof
         _fault.inject("step")       # MXNET_FAULT_INJECT test hook
-        arrs = self._to_device(batch)
+        attr = _prof.attribution_enabled()
+        with _prof.span("h2d"):
+            arrs = self._to_device(batch)
         rng = _rnd.next_key()
-        self.params, self.opt_state, loss = self._jit_step(
-            self.params, self.opt_state, rng, self._step_count, *arrs)
+        with _prof.span("compute"):
+            self.params, self.opt_state, loss = self._jit_step(
+                self.params, self.opt_state, rng, self._step_count, *arrs)
+            if attr:
+                # dispatch is async: the compute span is only real wall
+                # time if we sync on the result. Gated on attribution so
+                # the un-attributed hot path keeps XLA's pipelining.
+                _block = getattr(loss, "block_until_ready", None)
+                if _block is not None:
+                    _block()
         self._step_count += 1
         return loss
 

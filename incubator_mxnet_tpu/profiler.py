@@ -53,7 +53,13 @@ __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
            "pause", "resume", "is_running", "Scope", "Task", "Event",
            "Counter", "Marker", "Domain", "compile_event", "compile_stats",
            "compile_totals", "track_jit", "memory_event", "memory_stats",
-           "memory_enabled", "render_prometheus"]
+           "memory_enabled", "render_prometheus",
+           "span", "observe_phase", "attribution_enabled",
+           "attribution_enable",
+           "attribution_reset", "phase_stats", "phase_step_end",
+           "last_step_phases", "span_records", "next_span_id", "trace_id",
+           "clock_sync_event", "cost_event", "cost_stats",
+           "cost_from_executable", "device_peak_flops", "mfu_stats"]
 
 _lock = threading.Lock()
 _state = {
@@ -401,6 +407,399 @@ def track_jit(key, fn):
 
 
 # ---------------------------------------------------------------------------
+# step-time attribution (StepTimeline): profiler.span(phase) attributes every
+# train step / serve request into named phases — input_wait, h2d, compute,
+# collective, optimizer, ckpt_snapshot, queue_wait. Gated on
+# MXNET_STEP_ATTRIBUTION with the shardlint cached-boolean pattern: off (the
+# default) the hot paths take the gate branch and nothing else — span()
+# is never even called, and _span_records stays 0 (counter-asserted).
+# ---------------------------------------------------------------------------
+
+_attr_enabled = None        # cached MXNET_STEP_ATTRIBUTION read
+# log-spaced ms histogram bounds shared by every phase (floor 10us, x1.6):
+# rendered as mxnet_step_phase_ms Prometheus histograms
+_PHASE_BOUNDS = tuple(0.01 * (1.6 ** i) for i in range(30))
+# phase -> [count, total_ms, max_ms, last_ms, bucket_counts[len+1]]
+_phases = {}
+_span_records = 0           # spans actually booked (zero-overhead assert)
+_span_seq = 0               # process-wide span-id counter (wire-propagated)
+_span_tls = threading.local()   # per-thread active-span stack (nesting)
+_trace_id = None            # lazy per-process trace identity
+_step_phases_cur = {}       # phase -> ms accumulated in the step in flight
+_step_phases_last = {}      # previous step's phase vector (heartbeats)
+_step_seq = 0               # steps closed by phase_step_end()
+
+
+def attribution_enabled():
+    """True when step-time attribution is on. The env var is read once
+    and cached — the gate sits on the per-batch hot path."""
+    global _attr_enabled
+    if _attr_enabled is None:
+        from .util import getenv_bool
+        _attr_enabled = getenv_bool("MXNET_STEP_ATTRIBUTION")
+    return _attr_enabled
+
+
+def attribution_enable(on=True):
+    """Force attribution on/off for this process (tests, bench); returns
+    the previous effective state."""
+    global _attr_enabled
+    prev = attribution_enabled()
+    _attr_enabled = bool(on)
+    return prev
+
+
+def attribution_reset():
+    """Forget the cached MXNET_STEP_ATTRIBUTION read and drop all phase
+    state — the next attribution_enabled() consults the environment."""
+    global _attr_enabled
+    _attr_enabled = None
+    with _lock:
+        _reset_phases_locked()
+
+
+def _reset_phases_locked():
+    global _span_records, _step_phases_cur, _step_phases_last, _step_seq
+    _phases.clear()
+    _span_records = 0
+    _step_phases_cur = {}
+    _step_phases_last = {}
+    _step_seq = 0
+
+
+def span_records():
+    """Spans booked since the last reset. The zero-overhead contract:
+    with MXNET_STEP_ATTRIBUTION unset this stays exactly 0 through any
+    amount of run_epoch / batcher traffic."""
+    with _lock:
+        return _span_records
+
+
+def next_span_id():
+    """Process-unique monotonically increasing span id (propagated on the
+    kvstore wire so worker push/pull spans link to server handler spans).
+    Thread-safe: the increment happens under the module lock."""
+    global _span_seq
+    with _lock:
+        _span_seq += 1
+        return _span_seq
+
+
+def trace_id():
+    """Lazy per-process trace identity carried in span args and wire
+    headers, so a merged multi-process timeline can attribute every span
+    to its origin process."""
+    global _trace_id
+    if _trace_id is None:
+        _trace_id = f"{os.getpid():x}.{int(time.time() * 1e3) & 0xffffffff:x}"
+    return _trace_id
+
+
+def current_span_id():
+    """Id of this thread's innermost active span (None outside any span):
+    what the kvstore client stamps on outgoing wire frames."""
+    stack = getattr(_span_tls, "stack", None)
+    return stack[-1][1] if stack else None
+
+
+class _NullSpan:
+    """Shared no-op returned while attribution is off: no allocation, no
+    lock, no counter — the off path must cost one boolean check."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_phase", "_args", "_t0", "span_id", "parent_id")
+
+    def __init__(self, phase, args):
+        self._phase = phase
+        self._args = args
+        self._t0 = None
+        self.span_id = None
+        self.parent_id = None
+
+    def __enter__(self):
+        stack = getattr(_span_tls, "stack", None)
+        if stack is None:
+            stack = _span_tls.stack = []
+        self.parent_id = stack[-1][1] if stack else None
+        self.span_id = next_span_id()
+        stack.append((self._phase, self.span_id))
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        dur_ms = (t1 - self._t0) * 1e3
+        stack = getattr(_span_tls, "stack", None)
+        if stack and stack[-1][1] == self.span_id:
+            stack.pop()
+        _book_phase(self._phase, self._t0, dur_ms,
+                    self.span_id, self.parent_id, self._args)
+        return False
+
+
+def span(phase, args=None):
+    """Context manager attributing the enclosed wall time to `phase`.
+    While MXNET_STEP_ATTRIBUTION is off this returns a shared no-op; on,
+    it books per-phase aggregates + histogram and (while the profiler is
+    running) a nested chrome-trace X span carrying span_id/parent/trace
+    linkage args."""
+    if not attribution_enabled():
+        return _NULL_SPAN
+    return _Span(str(phase), args)
+
+
+def observe_phase(phase, dur_ms, t0=None, args=None):
+    """Book an externally MEASURED duration into `phase` — for waits that
+    cannot be enclosed in a ``with span(...)`` block, like the serve
+    batcher's queue_wait (enqueue happened on another thread). `t0` is a
+    time.perf_counter()-base start in seconds (defaults to now − dur)."""
+    if not attribution_enabled():
+        return
+    if t0 is None:
+        t0 = time.perf_counter() - dur_ms / 1e3
+    _book_phase(str(phase), t0, float(dur_ms), next_span_id(), None, args)
+
+
+def _phase_bucket(dur_ms):
+    for i, b in enumerate(_PHASE_BOUNDS):
+        if dur_ms <= b:
+            return i
+    return len(_PHASE_BOUNDS)
+
+
+def _book_phase(phase, t0, dur_ms, span_id, parent_id, extra):
+    global _span_records
+    running = _state["running"] and not _state["paused"]
+    ev = None
+    if running:
+        args = {"span_id": span_id, "trace": trace_id()}
+        if parent_id is not None:
+            args["parent"] = parent_id
+        if extra:
+            args.update(extra)
+        ev = {"name": f"phase:{phase}", "cat": "step", "ts": t0 * 1e6,
+              "dur": dur_ms * 1e3, "tid": threading.get_ident(), "ph": "X",
+              "args": args}
+    with _lock:
+        rec = _phases.get(phase)
+        if rec is None:
+            rec = _phases[phase] = [0, 0.0, 0.0, 0.0,
+                                    [0] * (len(_PHASE_BOUNDS) + 1)]
+        rec[0] += 1
+        rec[1] += dur_ms
+        rec[2] = max(rec[2], dur_ms)
+        rec[3] = dur_ms
+        rec[4][_phase_bucket(dur_ms)] += 1
+        _span_records += 1
+        # only top-level spans accumulate into the step vector: a nested
+        # sub-span's time is already inside its parent's
+        if parent_id is None:
+            _step_phases_cur[phase] = _step_phases_cur.get(phase, 0.0) \
+                + dur_ms
+        if ev is not None:
+            _events.append(ev)
+
+
+def phase_step_end():
+    """Close the step in flight: the accumulated top-level phase vector
+    becomes last_step_phases() (what heartbeats carry to the server's
+    straggler report) and the next step starts clean."""
+    if not attribution_enabled():
+        return
+    global _step_phases_cur, _step_phases_last, _step_seq
+    with _lock:
+        if _step_phases_cur:
+            _step_phases_last = _step_phases_cur
+            _step_phases_cur = {}
+            _step_seq += 1
+
+
+def last_step_phases():
+    """{phase: ms} vector of the most recently closed step (empty until
+    attribution records one)."""
+    with _lock:
+        return dict(_step_phases_last)
+
+
+def phase_stats():
+    """Snapshot of the attribution registry: {"steps", "spans",
+    "phases": {phase: {count, total_ms, avg_ms, max_ms, last_ms}}}."""
+    with _lock:
+        return {
+            "steps": _step_seq,
+            "spans": _span_records,
+            "phases": {p: {"count": v[0], "total_ms": v[1],
+                           "avg_ms": v[1] / max(v[0], 1),
+                           "max_ms": v[2], "last_ms": v[3]}
+                       for p, v in _phases.items()},
+        }
+
+
+def clock_sync_event(peer, offset_us, rtt_us):
+    """Record one clock-correlation sample against a remote peer as a
+    ph:"M" metadata event. Args anchor this process's perf_counter trace
+    timebase to its wall clock at the same instant, plus the estimated
+    wall offset to the peer — tools/trace_merge.py picks the smallest-RTT
+    sample per process to shift its timeline onto the server clock."""
+    if not _state["running"] or _state["paused"]:
+        return
+    now = time.perf_counter() * 1e6
+    _record("clock_sync", "__metadata", now, 0, ph="M",
+            args={"peer": str(peer), "offset_us": float(offset_us),
+                  "rtt_us": float(rtt_us), "perf_anchor_us": now,
+                  "wall_anchor_us": time.time() * 1e6,
+                  "trace": trace_id()})
+
+
+# ---------------------------------------------------------------------------
+# compiler cost accounting: flops / bytes-accessed / peak memory per cached
+# executable, recorded at the cached_jit choke points from XLA's own
+# cost_analysis()/memory_analysis() — the compiler, not an analytic formula,
+# is the source of truth for model FLOPs and MFU
+# ---------------------------------------------------------------------------
+
+# key -> {"flops", "bytes_accessed", "peak_bytes"} (present keys only);
+# guarded by _clock next to the compile table it annotates
+_costs = {}
+
+_PEAK_TFLOPS = {
+    "TPU v4": 275, "TPU v5 lite": 197, "TPU v5e": 197, "TPU v5": 459,
+    "TPU v5p": 459, "TPU v6e": 918, "TPU v6": 918, "TPU v7": 4614,
+}
+
+
+def cost_event(key, flops=None, bytes_accessed=None, peak_bytes=None):
+    """Record compiler-reported cost for one executable (last write wins:
+    a re-compile of the same key refreshes its cost)."""
+    if _state["paused"]:
+        return
+    rec = {}
+    for name, v in (("flops", flops), ("bytes_accessed", bytes_accessed),
+                    ("peak_bytes", peak_bytes)):
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            continue
+        if v > 0 and v == v and v != float("inf"):
+            rec[name] = v
+    if not rec:
+        return
+    with _clock:
+        _costs[key] = rec
+
+
+def cost_from_executable(key, exe):
+    """Best-effort extraction of cost_analysis()/memory_analysis() from a
+    compiled executable, recorded via cost_event. Every probe is
+    defensive: backends may return None, a list, or raise — cost
+    accounting must never break a compile. Returns the extracted dict
+    (possibly empty) so callers (bench) can reuse the numbers."""
+    flops = bytes_accessed = peak = None
+    try:
+        ca = exe.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if isinstance(ca, dict):
+            flops = ca.get("flops")
+            bytes_accessed = ca.get("bytes accessed")
+    except Exception:       # noqa: BLE001
+        pass
+    try:
+        ma = exe.memory_analysis()
+        total = 0.0
+        for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                     "temp_size_in_bytes"):
+            v = getattr(ma, attr, None)
+            if v:
+                total += float(v)
+        if total > 0:
+            peak = total
+    except Exception:       # noqa: BLE001
+        pass
+    cost_event(key, flops=flops, bytes_accessed=bytes_accessed,
+               peak_bytes=peak)
+    out = {}
+    with _clock:
+        rec = _costs.get(key)
+        if rec:
+            out = dict(rec)
+    return out
+
+
+def cost_stats():
+    """Snapshot {key: {flops, bytes_accessed, peak_bytes, intensity}}
+    (intensity = flops / bytes accessed: the executable's roofline
+    position; only derivable when the compiler reported both)."""
+    with _clock:
+        snap = {k: dict(v) for k, v in _costs.items()}
+    for rec in snap.values():
+        f, b = rec.get("flops"), rec.get("bytes_accessed")
+        if f and b:
+            rec["intensity"] = f / b
+    return snap
+
+
+def device_peak_flops():
+    """Best-effort peak FLOP/s of device 0 (bf16 matmul peak for known
+    TPU generations). None on CPU/unknown kinds — MFU is then null
+    rather than a made-up number."""
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind
+    except Exception:       # noqa: BLE001
+        return None
+    for k, v in sorted(_PEAK_TFLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.lower().startswith(k.lower()):
+            return v * 1e12
+    return None
+
+
+def mfu_stats():
+    """MFU derived from compiler cost accounting instead of analytic FLOP
+    formulas: model FLOPs/step come from the most-called trainstep
+    executable's cost_analysis() and seconds/step from the attributed
+    'compute' phase. Returns None until both ingredients exist; "mfu" is
+    null off-TPU (no trustworthy peak), the flops rate is still real."""
+    with _clock:
+        calls = {k: v[0] + v[1] for k, v in _compile.items()}
+        costs = {k: dict(v) for k, v in _costs.items()}
+    best = None
+    for key, rec in costs.items():
+        if not key.startswith("trainstep:") or not rec.get("flops"):
+            continue
+        c = calls.get(key, 0)
+        if best is None or c > best[1]:
+            best = (key, c, rec)
+    if best is None:
+        return None
+    key, _, rec = best
+    with _lock:
+        comp = _phases.get("compute")
+        compute_ms = comp[1] / max(comp[0], 1) if comp else None
+    out = {"key": key, "flops_per_step": rec["flops"],
+           "bytes_per_step": rec.get("bytes_accessed"),
+           "compute_ms_per_step": compute_ms,
+           "peak_flops": device_peak_flops(),
+           "flops_per_sec": None, "mfu": None}
+    if compute_ms:
+        out["flops_per_sec"] = rec["flops"] / (compute_ms / 1e3)
+        if out["peak_flops"]:
+            out["mfu"] = out["flops_per_sec"] / out["peak_flops"]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # memory profiler (reference storage_profiler.h GpuDeviceStorageProfiler,
 # enabled by the same `profile_memory` config flag the reference uses)
 # ---------------------------------------------------------------------------
@@ -696,6 +1095,18 @@ def dump(finished=True, profile_process="worker"):
     for name, ts, value in counters:
         trace.append({"name": name, "ph": "C", "ts": ts, "pid": 0,
                       "args": {"value": _finite(value, 0)}})
+    if attribution_enabled():
+        # self clock anchor: maps this process's perf_counter trace
+        # timebase onto its own wall clock, so tools/trace_merge.py can
+        # place it on a shared timeline even when no peer clock_sync
+        # sample exists (the server side never dials anyone)
+        trace.append({"name": "clock_sync", "cat": "__metadata", "ph": "M",
+                      "ts": 0, "pid": 0, "tid": 0,
+                      "args": {"peer": "self", "offset_us": 0.0,
+                               "rtt_us": 0.0,
+                               "perf_anchor_us": time.perf_counter() * 1e6,
+                               "wall_anchor_us": time.time() * 1e6,
+                               "trace": trace_id()}})
     with open(path, "w") as f:
         json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
     return path
@@ -752,15 +1163,45 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     comp = compile_stats()
     mem = memory_stats() if (_mem["enabled"] or _mem["allocs"]
                              or _mem["peak"]) else None
-    if reset:
-        with _clock:
-            _compile.clear()
-            _compile_warned.clear()
-        _reset_memory_locked()
+    attr = phase_stats()
+    costs = cost_stats()
+    mfu = mfu_stats()
     exec_cache = _exec_cache_stats()
     tune_snap = _tune_stats()
     fault_snap = _fault_stats()
     sl_snap = _shardlint_stats()
+    if reset:
+        # reset=True means reset: every stat family this dump reports
+        # restarts, not just the event/counter/compile subset (the old
+        # behavior left exec-cache/tune/fault/shardlint counters — and
+        # their disk counters — accumulating across "reset" windows)
+        with _clock:
+            _compile.clear()
+            _compile_warned.clear()
+            _costs.clear()
+        with _lock:
+            _reset_phases_locked()
+        _reset_memory_locked()
+        try:
+            from . import compile_cache as _cc
+            _cc.clear(memory=False, disk=False, stats=True)
+        except Exception:       # noqa: BLE001 — torn-down interpreter
+            pass
+        try:
+            from . import tune as _tn
+            _tn.clear(memory=False, stats=True)
+        except Exception:       # noqa: BLE001
+            pass
+        try:
+            from . import fault as _ft
+            _ft._reset_stats()
+        except Exception:       # noqa: BLE001
+            pass
+        try:
+            from . import shardlint as _sl
+            _sl.clear(stats=True)
+        except Exception:       # noqa: BLE001
+            pass
     if format == "json":
         out = {
             "stats": {k: {"count": v[0], "total_us": _finite(v[1], 0.0),
@@ -770,6 +1211,15 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
                          for k, (c, v) in cagg.items()},
             "compile": comp,
         }
+        if attr["phases"] or attr["steps"]:
+            out["step_attribution"] = {
+                "steps": attr["steps"], "spans": attr["spans"],
+                "phases": {p: {k: _finite(v) for k, v in rec.items()}
+                           for p, rec in attr["phases"].items()}}
+        if costs:
+            out["cost"] = costs
+        if mfu is not None:
+            out["mfu"] = {k: _finite(v) for k, v in mfu.items()}
         if exec_cache is not None:
             out["exec_cache"] = exec_cache
         if tune_snap is not None:
@@ -800,6 +1250,17 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         for name, (cnt, val) in sorted(cagg.items()):
             sval = f"{val:.3f}" if isinstance(val, float) else f"{val}"
             lines.append(f"{name:<48}{cnt:>10}{sval:>16}")
+    if attr["phases"]:
+        lines += ["", f"{'Step breakdown (phase)':<28}{'Count':>8}"
+                      f"{'ms/step':>12}{'Total(ms)':>12}{'Max(ms)':>12}"
+                      f"{'Last(ms)':>12}",
+                  "-" * 84]
+        for p, rec in sorted(attr["phases"].items(),
+                             key=lambda kv: -kv[1]["total_ms"]):
+            lines.append(f"{p:<28}{rec['count']:>8}{rec['avg_ms']:>12.3f}"
+                         f"{rec['total_ms']:>12.1f}{rec['max_ms']:>12.3f}"
+                         f"{rec['last_ms']:>12.3f}")
+        lines.append(f"{'(steps closed)':<28}{attr['steps']:>8}")
     if comp:
         lines += ["", f"{'Compile cache':<48}{'Hits':>8}{'Disk':>8}"
                       f"{'Misses':>8}{'Compile(ms)':>14}",
@@ -808,6 +1269,27 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             lines.append(f"{name:<48}{rec['hits']:>8}"
                          f"{rec.get('disk_hits', 0):>8}{rec['misses']:>8}"
                          f"{rec['compile_ms']:>14.1f}")
+    if costs:
+        lines += ["", f"{'Compiler cost (per executable)':<48}"
+                      f"{'GFLOP':>10}{'MB':>10}{'F/B':>8}",
+                  "-" * 76]
+        for name, rec in sorted(costs.items()):
+            gf = rec.get("flops")
+            mb = rec.get("bytes_accessed")
+            it = rec.get("intensity")
+            lines.append(
+                f"{name:<48}"
+                + (f"{gf / 1e9:>10.3f}" if gf else f"{'-':>10}")
+                + (f"{mb / 1e6:>10.2f}" if mb else f"{'-':>10}")
+                + (f"{it:>8.1f}" if it else f"{'-':>8}"))
+    if mfu is not None:
+        lines += ["", f"{'MFU (compiler cost / compute phase)':<48}"]
+        lines.append(f"  key={mfu['key']}  "
+                     f"flops/step={mfu['flops_per_step']:.3e}"
+                     + (f"  compute={mfu['compute_ms_per_step']:.3f}ms"
+                        if mfu["compute_ms_per_step"] else "")
+                     + (f"  MFU={mfu['mfu'] * 100:.1f}%"
+                        if mfu["mfu"] is not None else "  MFU=n/a"))
     if exec_cache is not None:
         lines += ["", f"{'Executable cache (two-tier)':<34}{'Value':>12}",
                   "-" * 46]
@@ -930,6 +1412,62 @@ def render_prometheus():
                 f'mxnet_compile_time_ms_total'
                 f'{{key="{_prom_label(name)}"}} '
                 f'{comp[name]["compile_ms"]:.3f}')
+
+    with _lock:
+        phase_snap = {p: (v[0], v[1], list(v[4])) for p, v in _phases.items()}
+    if phase_snap:
+        family("mxnet_step_phase_ms", "histogram",
+               "attributed per-phase step time in ms "
+               "(MXNET_STEP_ATTRIBUTION)")
+        for p in sorted(phase_snap):
+            cnt, total, buckets = phase_snap[p]
+            lbl = _prom_label(p)
+            cum = 0
+            for i, b in enumerate(_PHASE_BOUNDS):
+                cum += buckets[i]
+                lines.append(
+                    f'mxnet_step_phase_ms_bucket{{phase="{lbl}",'
+                    f'le="{b:.6g}"}} {cum}')
+            cum += buckets[-1]
+            lines.append(
+                f'mxnet_step_phase_ms_bucket{{phase="{lbl}",le="+Inf"}} '
+                f'{cum}')
+            lines.append(f'mxnet_step_phase_ms_sum{{phase="{lbl}"}} '
+                         f'{total:.3f}')
+            lines.append(f'mxnet_step_phase_ms_count{{phase="{lbl}"}} '
+                         f'{cnt}')
+
+    costs = cost_stats()
+    if costs:
+        _COST_FAMILIES = (
+            ("flops", "mxnet_executable_flops",
+             "compiler cost_analysis FLOPs per call of this executable"),
+            ("bytes_accessed", "mxnet_executable_bytes_accessed",
+             "compiler cost_analysis bytes accessed per call"),
+            ("peak_bytes", "mxnet_executable_peak_bytes",
+             "compiler memory_analysis arg+output+temp bytes"),
+            ("intensity", "mxnet_executable_intensity",
+             "roofline arithmetic intensity (flops per byte accessed)"),
+        )
+        for stat, fam, help_text in _COST_FAMILIES:
+            rows = [(k, v[stat]) for k, v in sorted(costs.items())
+                    if v.get(stat)]
+            if not rows:
+                continue
+            family(fam, "gauge", help_text)
+            for key, v in rows:
+                lines.append(f'{fam}{{key="{_prom_label(key)}"}} {v:.6g}')
+    mfu = mfu_stats()
+    if mfu is not None:
+        family("mxnet_model_flops_per_step", "gauge",
+               "model FLOPs per train step from compiler cost accounting")
+        lines.append(
+            f"mxnet_model_flops_per_step {mfu['flops_per_step']:.6g}")
+        if mfu["mfu"] is not None:
+            family("mxnet_mfu_ratio", "gauge",
+                   "model FLOP utilization from cost_analysis over the "
+                   "attributed compute phase")
+            lines.append(f"mxnet_mfu_ratio {mfu['mfu']:.6g}")
 
     ec = _exec_cache_stats(always=True)
     if ec is not None:

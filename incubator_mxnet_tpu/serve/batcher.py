@@ -215,6 +215,7 @@ class DynamicBatcher:
             self._run_group(reqs)
 
     def _run_group(self, reqs):
+        from .. import profiler
         t0 = time.monotonic()
         n = len(reqs)
         bucket = self._bucket_for(n)
@@ -227,8 +228,11 @@ class DynamicBatcher:
                     widths = [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1)
                     arr = _np.pad(arr, widths)
                 stacked[name] = arr
-            outs = self._predict(stacked)
-            outs = [_np.asarray(o) for o in outs]
+            # attribution: the predict call is the request's device time;
+            # off (the default) this is the shared no-op span
+            with profiler.span("compute", args={"bucket": bucket}):
+                outs = self._predict(stacked)
+                outs = [_np.asarray(o) for o in outs]
         except Exception as e:  # noqa: BLE001 — fail the requests, not the loop
             self.stats.incr("errors", n)
             for r in reqs:
@@ -242,12 +246,21 @@ class DynamicBatcher:
             self.stats.latency.observe(t1 - r.enqueue_t)
             self.stats.queue_wait.observe(t0 - r.enqueue_t)
         self.stats.forward_time.observe(t1 - t0)
+        self.stats.observe_bucket(
+            bucket, [t0 - r.enqueue_t for r in reqs], t1 - t0)
         self.stats.incr("responses_ok", n)
         self.stats.incr("batches_total")
         self.stats.incr("padded_rows_total", bucket - n)
         self.stats.set_gauge("batch_occupancy", n / bucket)
         self.stats.publish()
-        from .. import profiler
+        if profiler.attribution_enabled():
+            # queue_wait cannot be a `with` span (enqueue happened on the
+            # submit thread): book the OLDEST request's measured wait, then
+            # close this dispatch as one attribution step
+            profiler.observe_phase(
+                "queue_wait", (t0 - reqs[0].enqueue_t) * 1e3,
+                t0=reqs[0].enqueue_t, args={"bucket": bucket})
+            profiler.phase_step_end()
         if profiler._state["running"]:
             profiler._record(f"{self.stats.name}::batch[{bucket}]",
                              "serving", t0 * 1e6, (t1 - t0) * 1e6)

@@ -85,7 +85,8 @@ class _Handler(BaseHTTPRequestHandler):
             # sees current values regardless of batch cadence
             ms.stats.publish()
             self._reply_text(
-                200, profiler.render_prometheus(),
+                200,
+                profiler.render_prometheus() + ms.stats.render_prometheus(),
                 content_type="text/plain; version=0.0.4; charset=utf-8")
         else:
             self._reply(404, {"error": "not found", "retryable": False})

@@ -14,10 +14,13 @@ chrome trace timeline.
 """
 from __future__ import annotations
 
+import logging
 import math
 import threading
 
 __all__ = ["LatencyHistogram", "ServingStats"]
+
+_log = logging.getLogger("incubator_mxnet_tpu.serve")
 
 
 class LatencyHistogram:
@@ -107,6 +110,11 @@ class ServingStats:
         self.queue_depth = 0
         self.batch_occupancy = 0.0
         self._profiler_counters = {}
+        # per-bucket latency split: how much of the end-to-end time each
+        # compiled bucket spends WAITING vs ON DEVICE — a queue-bound
+        # endpoint and a compute-bound one need opposite remedies
+        self._bucket_hists = {}     # bucket -> (queue_wait LH, device LH)
+        self._queue_warned = False
 
     # -- recording (called by batcher/server) ---------------------------
     def incr(self, field, n=1):
@@ -116,6 +124,48 @@ class ServingStats:
     def set_gauge(self, field, value):
         with self._lock:
             setattr(self, field, value)
+
+    def observe_bucket(self, bucket, queue_waits, device_seconds):
+        """Record one dispatch of `bucket`: each request's queue wait
+        (seconds) and the single batched device/forward time."""
+        bucket = int(bucket)
+        with self._lock:
+            pair = self._bucket_hists.get(bucket)
+            if pair is None:
+                pair = self._bucket_hists[bucket] = (LatencyHistogram(),
+                                                     LatencyHistogram())
+        qh, dh = pair
+        for s in queue_waits:
+            qh.observe(s)
+        dh.observe(device_seconds)
+
+    def bucket_snapshot(self):
+        """{bucket: {queue_wait_p50_ms, queue_wait_p95_ms, device_p50_ms,
+        device_p95_ms, dispatches}} for every bucket seen so far."""
+        with self._lock:
+            pairs = sorted(self._bucket_hists.items())
+        return {b: {"queue_wait_p50_ms": round(qh.percentile(50) * 1e3, 4),
+                    "queue_wait_p95_ms": round(qh.percentile(95) * 1e3, 4),
+                    "device_p50_ms": round(dh.percentile(50) * 1e3, 4),
+                    "device_p95_ms": round(dh.percentile(95) * 1e3, 4),
+                    "dispatches": dh.count}
+                for b, (qh, dh) in pairs}
+
+    def _warn_if_queue_bound(self):
+        """Warn ONCE when queue_wait p95 exceeds device p95: requests
+        spend longer waiting for a bucket slot than being computed — the
+        endpoint needs replicas / larger buckets, not a faster model."""
+        if self._queue_warned or self.queue_wait.count < 20:
+            return
+        qp95 = self.queue_wait.percentile(95)
+        dp95 = self.forward_time.percentile(95)
+        if dp95 > 0.0 and qp95 > dp95:
+            self._queue_warned = True
+            _log.warning(
+                "[%s] queue_wait p95 %.2f ms exceeds device p95 %.2f ms: "
+                "the endpoint is queue-bound; add replicas, widen the "
+                "bucket ladder, or raise max_latency_ms",
+                self.name, qp95 * 1e3, dp95 * 1e3)
 
     # -- export ---------------------------------------------------------
     def snapshot(self):
@@ -139,6 +189,9 @@ class ServingStats:
             snap[f"{prefix}_p95_ms"] = round(h.percentile(95) * 1e3, 4)
             snap[f"{prefix}_p99_ms"] = round(h.percentile(99) * 1e3, 4)
             snap[f"{prefix}_mean_ms"] = round(h.mean * 1e3, 4)
+        for b, row in self.bucket_snapshot().items():
+            for k, v in row.items():
+                snap[f"bucket{b}_{k}"] = v
         return snap
 
     def publish(self):
@@ -156,7 +209,29 @@ class ServingStats:
                 c = self._profiler_counters[name] = \
                     profiler.Counter(None, name)
             c.set_value(snap[key])
+        self._warn_if_queue_bound()
         return snap
+
+    def render_prometheus(self):
+        """Prometheus text lines for the per-bucket queue/device latency
+        split (appended to profiler.render_prometheus() at /metrics)."""
+        buckets = self.bucket_snapshot()
+        if not buckets:
+            return ""
+        lines = ["# HELP mxnet_serve_bucket_latency_ms per-bucket serving "
+                 "latency split: queue_wait vs device time",
+                 "# TYPE mxnet_serve_bucket_latency_ms gauge"]
+        for b, row in buckets.items():
+            for kind in ("queue_wait", "device"):
+                for q in ("p50", "p95"):
+                    lines.append(
+                        f'mxnet_serve_bucket_latency_ms{{model="{self.name}"'
+                        f',bucket="{b}",kind="{kind}",q="{q}"}} '
+                        f'{row[f"{kind}_{q}_ms"]:.6g}')
+            lines.append(
+                f'mxnet_serve_bucket_dispatches{{model="{self.name}"'
+                f',bucket="{b}"}} {row["dispatches"]}')
+        return "\n".join(lines) + "\n"
 
     def table(self):
         snap = self.snapshot()

@@ -258,6 +258,23 @@ ENV_VARS = collections.OrderedDict([
      "Test-suite only: jax platform the suite pins itself to.")),
     ("MXTPU_TEST_SEED", EnvSpec(0, "int",
      "Test-suite only: base RNG seed for the randomized operator tests.")),
+    ("MXNET_STEP_ATTRIBUTION", EnvSpec(False, "bool",
+     "Enable step-time attribution: profiler.span(phase) wired into "
+     "TrainStep.run_epoch / Trainer.step / the serve batcher records "
+     "per-phase ms/step (input_wait, h2d, compute, collective, "
+     "optimizer, ckpt_snapshot, queue_wait) into dumps(), nested "
+     "chrome-trace spans, and mxnet_step_phase_ms histograms. Off (the "
+     "default), the span API returns a shared no-op and the hot paths "
+     "do zero bookkeeping.")),
+    ("MXNET_FLIGHT_RECORDER", EnvSpec("", "str",
+     "Directory for the crash flight recorder. When set, fault.py keeps "
+     "a bounded ring of recent step records/events and dumps it "
+     "atomically as JSON on SIGUSR1, on a FaultInjector trip, and on an "
+     "unhandled exception in run_epoch. Empty (the default) disables "
+     "the recorder entirely.")),
+    ("MXNET_FLIGHT_RECORDER_SIZE", EnvSpec(256, "int",
+     "Flight-recorder ring capacity: how many recent step records and "
+     "events the postmortem dump retains (oldest dropped first).")),
 ])
 
 _FALSY = frozenset(("", "0", "false", "off", "no"))

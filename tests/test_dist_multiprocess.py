@@ -559,6 +559,7 @@ def test_elastic_kill9_respawn_converges(tmp_path):
             env=env)
         env_kill = dict(env)
         env_kill["MXNET_FAULT_INJECT"] = "push@5:kill"
+        env_kill["MXNET_FLIGHT_RECORDER"] = str(tmp_path / "flight")
         doomed = subprocess.Popen(
             [sys.executable, str(wrk_script), str(addr_files[1]),
              str(ck_int), str(TOTAL)],
@@ -569,6 +570,17 @@ def test_elastic_kill9_respawn_converges(tmp_path):
         assert doomed.returncode == -9, (          # ACTUALLY kill -9'd
             doomed.returncode, out_d, err_d[-1500:])
         assert "ELASTIC_DONE" not in out_d
+
+        # SIGKILL is uncatchable, yet the postmortem IS on disk: the
+        # injector dumped the flight recorder BEFORE pulling the trigger
+        import json
+        flight = tmp_path / "flight" / f"flight-{doomed.pid}.json"
+        assert flight.exists(), list((tmp_path / "flight").iterdir()
+                                     if (tmp_path / "flight").exists()
+                                     else [])
+        payload = json.loads(flight.read_text())
+        assert payload["reason"] == "fault:push#5"
+        assert payload["pid"] == doomed.pid
 
         out_o, err_o = oracle.communicate(timeout=240)
         assert oracle.returncode == 0, err_o[-2000:]
@@ -677,3 +689,150 @@ def test_midepoch_exact_cursor_resume(tmp_path):
     for k in w_full:
         np.testing.assert_allclose(w_res[k], w_full[k], rtol=1e-6,
                                    atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# PR 10: distributed trace spans — a worker and its parameter server each
+# dump an attribution trace on their own perf_counter timebase; heartbeat
+# replies carry the server clock, so tools/trace_merge.py can place both on
+# one wall-clock timeline, with worker pushpull spans linked to the server's
+# handler spans by the span id carried on the authenticated wire.
+# ---------------------------------------------------------------------------
+
+SERVER_TRACED = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MXNET_STEP_ATTRIBUTION"] = "1"
+    addrfile, tracefile, donefile = sys.argv[1], sys.argv[2], sys.argv[3]
+    sys.path.insert(0, {repo!r})
+    from incubator_mxnet_tpu import profiler
+    from incubator_mxnet_tpu.kvstore_server import start_async_server
+    profiler.set_config(filename=tracefile)
+    profiler.start()
+    addr_token = start_async_server()
+    with open(addrfile + ".tmp", "w") as f:
+        f.write(addr_token)
+    os.replace(addrfile + ".tmp", addrfile)         # atomic publish
+    deadline = time.time() + 180
+    while time.time() < deadline and not os.path.exists(donefile):
+        time.sleep(0.5)
+    assert os.path.exists(donefile), "worker never finished"
+    profiler.stop()
+    profiler.dump()
+    sys.stdout.write("SERVER_TRACE_OK\\n")
+    sys.stdout.flush()
+    os._exit(0)
+""")
+
+WORKER_TRACED = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MXNET_STEP_ATTRIBUTION"] = "1"
+    addrfile, tracefile, donefile = sys.argv[1], sys.argv[2], sys.argv[3]
+    with open(addrfile) as f:
+        os.environ["MXNET_KVSTORE_ASYNC_ADDR"] = f.read()
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import profiler
+    profiler.set_config(filename=tracefile)
+    profiler.start()
+    kv = mx.kv.create("dist_async")
+    kv.init("w", mx.nd.zeros((4,)))
+    out = mx.nd.zeros((4,))
+    for step in range(5):
+        with profiler.span("compute"):
+            time.sleep(0.05)            # dominant phase, by construction
+        with profiler.span("pushpull"):
+            kv.push("w", mx.nd.ones((4,)))
+            kv.pull("w", out=out)
+        profiler.phase_step_end()
+    time.sleep(2.5)     # a few 1s-period v2 beats: the server learns this
+    #                     rank's phase vector, this process gets NTP-style
+    #                     clock_sync samples off the beat replies
+    m = kv._async_client.call("membership", kv._async_gen, 60.0, 5)
+    assert kv.rank in m["phases"], m
+    assert m["phases"][kv.rank]["compute"] >= 40.0, m
+    assert m["slow_phase"][kv.rank] == "compute", m
+    sys.stdout.write("WORKER_PHASES_OK\\n")
+    profiler.stop()
+    profiler.dump()
+    with open(donefile + ".tmp", "w") as f:
+        f.write("done")
+    os.replace(donefile + ".tmp", donefile)
+    sys.stdout.flush()
+    kv.close()
+    os._exit(0)
+""")
+
+
+@pytest.mark.timeout(300)
+def test_dist_trace_spans_merge_onto_one_timeline(tmp_path):
+    import json
+    import time
+
+    srv_script = tmp_path / "server.py"
+    srv_script.write_text(SERVER_TRACED.format(repo=REPO))
+    wrk_script = tmp_path / "worker.py"
+    wrk_script.write_text(WORKER_TRACED.format(repo=REPO))
+    addr_file = tmp_path / "addr"
+    done_file = tmp_path / "done"
+    srv_trace = tmp_path / "server_trace.json"
+    wrk_trace = tmp_path / "worker_trace.json"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_FAULT_INJECT", None)
+    env["MXNET_HEARTBEAT_INTERVAL"] = "1"
+
+    server = subprocess.Popen(
+        [sys.executable, str(srv_script), str(addr_file), str(srv_trace),
+         str(done_file)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not addr_file.exists():
+            time.sleep(0.5)
+        assert addr_file.exists(), "server never published its address"
+        worker = subprocess.Popen(
+            [sys.executable, str(wrk_script), str(addr_file),
+             str(wrk_trace), str(done_file)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        out_w, err_w = worker.communicate(timeout=240)
+        assert worker.returncode == 0, err_w[-2000:]
+        assert "WORKER_PHASES_OK" in out_w, (out_w, err_w[-1500:])
+        out_s, err_s = server.communicate(timeout=60)
+        assert server.returncode == 0, err_s[-2000:]
+        assert "SERVER_TRACE_OK" in out_s
+    finally:
+        server.kill()
+
+    # the worker aligned its clock to the server via heartbeat replies
+    wrk_events = json.loads(wrk_trace.read_text())["traceEvents"]
+    peer_syncs = [e for e in wrk_events
+                  if e.get("name") == "clock_sync"
+                  and (e.get("args") or {}).get("peer") == "server"]
+    assert peer_syncs, "worker recorded no heartbeat clock_sync sample"
+    assert all(e["args"]["rtt_us"] > 0 for e in peer_syncs)
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_merge
+    from validate_trace import validate_trace
+    merged = trace_merge.merge_traces([str(wrk_trace), str(srv_trace)])
+    validate_trace(merged)      # schema-valid, span nesting intact
+    evs = merged["traceEvents"]
+    assert {e.get("pid") for e in evs} == {0, 1}
+
+    # worker pushpull spans and the server handler spans they caused are
+    # both on the merged timeline, joined by the wire-carried span id
+    # 5 explicit outer spans + a nested kvstore-site span per push and
+    # per pull (the innermost is what travels on the wire)
+    wrk_push = {e["args"]["span_id"] for e in evs
+                if e.get("pid") == 0 and e.get("name") == "phase:pushpull"}
+    srv_push = [e for e in evs
+                if e.get("pid") == 1
+                and e.get("name") == "phase:server:push"]
+    assert len(wrk_push) == 15, len(wrk_push)
+    assert srv_push, [e.get("name") for e in evs if e.get("pid") == 1]
+    linked = {e["args"]["link_span"] for e in srv_push}
+    assert linked & wrk_push, (sorted(linked), sorted(wrk_push))

@@ -355,3 +355,112 @@ def test_fault_counters_in_profiler(clean_fault_state):
     fault._bump("heartbeats_sent", 5)
     js = json.loads(profiler.dumps(format="json"))
     assert js["fault"]["heartbeats_sent"] == 5
+
+
+# ---------------------------------------------------------------------------
+# crash flight recorder
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def flight_dir(tmp_path, monkeypatch):
+    """Recorder pointed at a per-test directory; cache cleared around."""
+    d = tmp_path / "flight"
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER", str(d))
+    fault.flight_reset()
+    yield d
+    fault.flight_reset()
+
+
+def test_flight_recorder_off_is_inert(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_FLIGHT_RECORDER", raising=False)
+    fault.flight_reset()
+    try:
+        assert not fault.flight_enabled()
+        fault.flight_record("step", step=1)     # must not raise or write
+        assert fault.flight_dump("manual") is None
+        assert list(tmp_path.iterdir()) == []
+    finally:
+        fault.flight_reset()
+
+
+def test_flight_ring_bounded_and_dump_atomic(flight_dir, monkeypatch):
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER_SIZE", "8")
+    fault.flight_reset()
+    assert fault.flight_enabled()
+    for i in range(20):
+        fault.flight_record("step", step=i, cursor=None)  # None dropped
+    path = fault.flight_dump("manual")
+    assert path is not None and os.path.exists(path)
+    assert os.path.basename(path) == f"flight-{os.getpid()}.json"
+    with open(path) as f:
+        payload = json.load(f)
+    recs = payload["records"]
+    # drop-oldest ring: exactly the last 8 of 20 survive, in order
+    assert [r["step"] for r in recs] == list(range(12, 20))
+    assert all("cursor" not in r for r in recs)
+    assert all(r["kind"] == "step" and r["t"] > 0 for r in recs)
+    assert payload["reason"] == "manual"
+    assert payload["pid"] == os.getpid()
+    assert "faults_injected" in payload["fault_stats"]
+    assert "phases" in payload["phase_stats"]
+    # atomic write: no temp litter next to the dump
+    assert [p.name for p in flight_dir.iterdir()] == [os.path.basename(path)]
+
+
+def test_flight_sigusr1_dump(flight_dir):
+    old = signal.getsignal(signal.SIGUSR1)
+    try:
+        fault.flight_record("step", step=7)     # installs the handler
+        os.kill(os.getpid(), signal.SIGUSR1)
+        path = flight_dir / f"flight-{os.getpid()}.json"
+        deadline = __import__("time").time() + 5
+        while not path.exists() and __import__("time").time() < deadline:
+            __import__("time").sleep(0.01)
+        assert path.exists()
+        payload = json.loads(path.read_text())
+        assert payload["reason"] == "SIGUSR1"
+        assert payload["records"][-1]["step"] == 7
+    finally:
+        signal.signal(signal.SIGUSR1, old)
+
+
+def test_flight_dump_fires_before_injected_fault_action(flight_dir):
+    try:
+        fault.set_fault_spec("push@2:delay=0")
+        fault.inject("push")                    # hit #1: no rule fires
+        path = flight_dir / f"flight-{os.getpid()}.json"
+        assert not path.exists()
+        fault.inject("push")                    # hit #2: dump, then act
+        assert path.exists()
+        payload = json.loads(path.read_text())
+        assert payload["reason"] == "fault:push#2"
+        # pre-mortem semantics: the dump lands BEFORE the action runs,
+        # so this trip is not yet in the injected counter it snapshots
+        assert "faults_injected" in payload["fault_stats"]
+    finally:
+        fault.set_fault_spec("")
+
+
+def test_run_epoch_exception_dumps_flight_record(flight_dir):
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.parallel import TrainStep
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    step = TrainStep(net, lambda o, l: jnp.mean((o - l) ** 2),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.05},
+                     example_inputs=[mx.nd.ones((2, 3))])
+    rs = np.random.RandomState(3)
+    good = (rs.randn(2, 3).astype(np.float32),
+            rs.randn(2, 2).astype(np.float32))
+    # one good batch (lands in the ring), then a poisoned one; the
+    # prefetch pipeline may rewrap the error, so accept any Exception
+    with pytest.raises(Exception):
+        step.run_epoch([good, None])
+    path = flight_dir / f"flight-{os.getpid()}.json"
+    assert path.exists()
+    payload = json.loads(path.read_text())
+    assert payload["reason"].startswith("exception:")
+    steps = [r for r in payload["records"] if r["kind"] == "step"]
+    assert steps, payload["records"]    # the good step made the ring

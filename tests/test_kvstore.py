@@ -499,3 +499,166 @@ def test_elastic_kvstore_registry_end_to_end(monkeypatch):
 def test_rejoin_requires_dist_async():
     with pytest.raises(mx.base.MXNetError, match="dist_async"):
         kvstore.create("local").rejoin()
+
+
+def test_async_wire_v2_trace_header_and_v1_compat(tmp_path):
+    """Protocol v2: with attribution on, calls carry a trace/span header
+    inside the authenticated payload and the server handler runs under a
+    linked server:<op> span; with attribution off, the plain v1 tuples go
+    over the wire and dispatch unchanged (old peers keep working)."""
+    import json
+
+    from incubator_mxnet_tpu import profiler
+    from incubator_mxnet_tpu.kvstore_server import (AsyncClient,
+                                                    AsyncServer)
+
+    srv = AsyncServer()
+    addr = srv.start()
+    prev = profiler.attribution_enable(False)
+    try:
+        c = AsyncClient(addr, srv.token)
+        # v1 (attribution off): roundtrip works, nothing is recorded
+        c.call("init", 0, "w", np.zeros(3, np.float32))
+        np.testing.assert_allclose(c.call("pull", 0, "w"), 0.0)
+        assert profiler.span_records() == 0
+
+        # v2 (attribution on): server handler books a linked span
+        profiler.attribution_enable(True)
+        path = tmp_path / "trace.json"
+        profiler.set_config(filename=str(path))
+        profiler.start()
+        with profiler.span("pushpull") as sp:
+            np.testing.assert_allclose(c.call("pull", 0, "w"), 0.0)
+        profiler.stop()
+        profiler.dump()
+        st = profiler.phase_stats()     # in-process server: shared stats
+        assert st["phases"]["server:pull"]["count"] == 1
+        assert st["phases"]["pushpull"]["count"] == 1
+        evs = json.loads(path.read_text())["traceEvents"]
+        handler = [e for e in evs if e.get("name") == "phase:server:pull"]
+        assert handler, [e.get("name") for e in evs]
+        assert handler[0]["args"]["link_span"] == sp.span_id
+        assert handler[0]["args"]["link_trace"] == profiler.trace_id()
+
+        # back to v1: the SAME connection keeps serving plain tuples
+        profiler.attribution_enable(False)
+        np.testing.assert_allclose(c.call("pull", 0, "w"), 0.0)
+    finally:
+        profiler.attribution_enable(prev)
+        profiler.dumps(reset=True)
+        srv.stop()
+
+
+def test_async_wire_tampered_trace_header_fails_hmac():
+    """The v2 header travels inside the MAC'd payload: flipping one byte
+    of an authenticated frame (header included) makes the server close
+    the connection without replying — tampering is indistinguishable
+    from a wrong token."""
+    import pickle
+    import socket as _socket
+    import struct
+
+    from incubator_mxnet_tpu.kvstore_server import (AsyncServer,
+                                                    _frame_mac,
+                                                    _session_key)
+
+    srv = AsyncServer()
+    addr = srv.start()
+    try:
+        host, port = addr.rsplit(":", 1)
+        conn = _socket.create_connection((host, int(port)), timeout=10)
+        client_nonce = b"\x07" * 16
+        conn.sendall(client_nonce)
+        server_nonce = conn.recv(16)
+        assert len(server_nonce) == 16
+        key = _session_key(srv.token, client_nonce, server_nonce)
+        payload = pickle.dumps(
+            ("__v2__", {"trace": "t-evil", "span": 1}, ("pull", 0, "w")))
+        mac = _frame_mac(key, b"C", 0, payload)
+        tampered = bytearray(payload)
+        tampered[len(payload) // 2] ^= 0xFF     # flip one payload byte
+        conn.sendall(struct.pack("<Q", len(tampered)) + bytes(tampered)
+                     + mac)
+        conn.settimeout(5)
+        try:
+            reply = conn.recv(1)
+        except ConnectionError:
+            reply = b""
+        assert reply == b""             # closed; never unpickled a reply
+        conn.close()
+
+        # sanity: the untampered frame with the same key DOES round-trip
+        conn2 = _socket.create_connection((host, int(port)), timeout=10)
+        conn2.sendall(client_nonce)
+        sn2 = conn2.recv(16)
+        key2 = _session_key(srv.token, client_nonce, sn2)
+        conn2.sendall(struct.pack("<Q", len(payload)) + payload
+                      + _frame_mac(key2, b"C", 0, payload))
+        hdr = conn2.recv(8)
+        assert len(hdr) == 8            # a reply frame came back
+        conn2.close()
+    finally:
+        srv.stop()
+
+
+def test_span_id_allocation_is_thread_safe():
+    """8 concurrent allocators, 500 ids each: all 4000 unique (span ids
+    are the cross-process linkage key on the wire — a duplicate corrupts
+    the merged timeline)."""
+    import threading
+
+    from incubator_mxnet_tpu import profiler
+
+    n_threads, per = 8, 500
+    out = [None] * n_threads
+    barrier = threading.Barrier(n_threads)
+
+    def alloc(i):
+        barrier.wait()
+        out[i] = [profiler.next_span_id() for _ in range(per)]
+
+    ts = [threading.Thread(target=alloc, args=(i,))
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    ids = [sid for chunk in out for sid in chunk]
+    assert len(set(ids)) == n_threads * per
+    assert all(isinstance(s, int) and s > 0 for s in ids)
+
+
+def test_async_heartbeat_v2_phase_reports_and_slow_phase():
+    """v1 4-tuple heartbeats still get the bare int epoch; v2 5-tuple
+    beats (with the last step's phase vector) get the dict reply carrying
+    the server clock, and membership names each rank's dominant phase."""
+    import time as _time
+
+    from incubator_mxnet_tpu.kvstore_server import (AsyncClient,
+                                                    AsyncServer)
+
+    srv = AsyncServer()
+    addr = srv.start()
+    try:
+        c = AsyncClient(addr, srv.token)
+        r0 = c.call("register", 0, None)
+        rank = r0["rank"]
+        # v1 shape: int epoch reply, unchanged
+        epoch = c.call("heartbeat", 0, rank, 3)
+        assert isinstance(epoch, int)
+        # v2 shape: dict reply with the server wall clock
+        t0 = _time.time()
+        rep = c.call("heartbeat", 0, rank, 4,
+                     {"compute": 80.0, "input_wait": 3.0})
+        t1 = _time.time()
+        assert rep["epoch"] == epoch
+        assert t0 - 60 <= rep["server_time"] <= t1 + 60
+        # a second (slower) rank reporting a different dominant phase
+        r1 = c.call("register", 0, None)["rank"]
+        c.call("heartbeat", 0, r1, 1, {"compute": 5.0, "input_wait": 50.0})
+        m = c.call("membership", 0, 60.0, 5)
+        assert m["phases"][rank] == {"compute": 80.0, "input_wait": 3.0}
+        assert m["slow_phase"][rank] == "compute"
+        assert m["slow_phase"][r1] == "input_wait"
+    finally:
+        srv.stop()

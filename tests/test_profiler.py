@@ -542,3 +542,319 @@ def test_metrics_endpoint_serves_serving_and_trainer_counters(tmp_path):
     assert 'name="recompiles_per_step"' in text
     assert "mxnet_compile_cache_misses_total" in text
     assert 'key="serve:exec[' in text
+
+
+# ---------------------------------------------------------------------------
+# Step-time attribution (StepTimeline) + compiler cost accounting
+# ---------------------------------------------------------------------------
+
+def test_attribution_off_is_zero_overhead():
+    prev = profiler.attribution_enable(False)
+    try:
+        # off, span() hands back ONE shared no-op object: no allocation,
+        # no lock, no counter — and the records counter stays exactly 0
+        assert profiler.span("compute") is profiler.span("h2d")
+        for _ in range(100):
+            with profiler.span("compute", args={"k": 1}):
+                with profiler.span("collective"):
+                    pass
+            profiler.observe_phase("queue_wait", 1.0)
+            profiler.phase_step_end()
+        assert profiler.span_records() == 0
+        assert profiler.phase_stats() == {"steps": 0, "spans": 0,
+                                          "phases": {}}
+        assert profiler.last_step_phases() == {}
+    finally:
+        profiler.attribution_enable(prev)
+
+
+def test_span_nesting_books_only_top_level_into_step_vector():
+    prev = profiler.attribution_enable(True)
+    try:
+        with profiler.span("compute"):
+            time.sleep(0.01)
+            with profiler.span("collective"):
+                time.sleep(0.002)
+        profiler.observe_phase("queue_wait", 2.5)
+        profiler.phase_step_end()
+        st = profiler.phase_stats()
+        assert st["spans"] == 3 and st["steps"] == 1
+        assert st["phases"]["compute"]["count"] == 1
+        assert st["phases"]["collective"]["count"] == 1
+        v = profiler.last_step_phases()
+        # the nested collective's ms is already inside compute's: only
+        # top-level spans accumulate into the per-step vector
+        assert set(v) == {"compute", "queue_wait"}
+        assert v["compute"] >= 10.0
+        assert v["queue_wait"] == pytest.approx(2.5)
+        # the next step starts clean
+        with profiler.span("optimizer"):
+            pass
+        profiler.phase_step_end()
+        assert set(profiler.last_step_phases()) == {"optimizer"}
+        assert profiler.phase_stats()["steps"] == 2
+    finally:
+        profiler.attribution_enable(prev)
+
+
+def test_span_trace_events_nest_and_carry_linkage(tmp_path):
+    path = tmp_path / "trace.json"
+    prev = profiler.attribution_enable(True)
+    profiler.set_config(filename=str(path))
+    profiler.start()
+    try:
+        with profiler.span("compute"):
+            with profiler.span("collective", args={"op": "push"}):
+                time.sleep(0.002)
+        profiler.phase_step_end()
+        profiler.stop()
+        profiler.dump()
+        assert validate_trace(str(path)) > 0
+        evs = json.loads(path.read_text())["traceEvents"]
+        spans = {e["name"]: e for e in evs if e.get("cat") == "step"}
+        parent = spans["phase:compute"]
+        child = spans["phase:collective"]
+        assert child["args"]["parent"] == parent["args"]["span_id"]
+        assert child["args"]["trace"] == profiler.trace_id()
+        assert child["args"]["op"] == "push"
+        assert "parent" not in parent["args"]
+        # attribution dumps anchor the perf_counter timebase to the wall
+        # clock so tools/trace_merge.py can place this process's timeline
+        anchors = [e for e in evs if e["name"] == "clock_sync"]
+        assert anchors and anchors[-1]["args"]["peer"] == "self"
+        for k in ("offset_us", "rtt_us", "perf_anchor_us",
+                  "wall_anchor_us"):
+            assert isinstance(anchors[-1]["args"][k], float)
+    finally:
+        profiler.attribution_enable(prev)
+
+
+def test_validate_trace_rejects_malformed_spans():
+    def ev(**kw):
+        base = {"name": "phase:x", "ph": "X", "ts": 100, "dur": 50,
+                "pid": 0, "cat": "step"}
+        base.update(kw)
+        return base
+
+    # well-formed nesting (child inside parent) passes
+    good = [ev(args={"span_id": 2, "parent": 1, "trace": "t"},
+               ts=110, dur=10),
+            ev(args={"span_id": 1, "trace": "t"})]
+    assert validate_trace({"traceEvents": good}) == 2
+    # a parent flushed into an earlier rolling segment is tolerated
+    assert validate_trace({"traceEvents": [
+        ev(args={"span_id": 2, "parent": 99, "trace": "t"})]}) == 1
+    with pytest.raises(TraceFormatError):    # non-positive span id
+        validate_trace({"traceEvents": [ev(args={"span_id": 0})]})
+    with pytest.raises(TraceFormatError):    # duplicate id in one scope
+        validate_trace({"traceEvents": [
+            ev(args={"span_id": 3, "trace": "t"}),
+            ev(args={"span_id": 3, "trace": "t"})]})
+    with pytest.raises(TraceFormatError):    # child escapes its parent
+        validate_trace({"traceEvents": [
+            ev(args={"span_id": 1, "trace": "t"}),
+            ev(args={"span_id": 2, "parent": 1, "trace": "t"},
+               ts=140, dur=100)]})
+    # same id on DIFFERENT pids is fine (merged multi-process timeline)
+    assert validate_trace({"traceEvents": [
+        ev(args={"span_id": 5, "trace": "a"}),
+        ev(args={"span_id": 5, "trace": "b"}, pid=1)]}) == 2
+    with pytest.raises(TraceFormatError):    # clock_sync without anchors
+        validate_trace({"traceEvents": [
+            {"name": "clock_sync", "ph": "M", "ts": 0,
+             "args": {"offset_us": 1.0}}]})
+
+
+def test_phase_histogram_rendered_in_prometheus():
+    prev = profiler.attribution_enable(True)
+    try:
+        profiler.observe_phase("queue_wait", 0.5)
+        profiler.observe_phase("queue_wait", 50.0)
+        text = profiler.render_prometheus()
+        assert ('mxnet_step_phase_ms_bucket{phase="queue_wait",le="+Inf"}'
+                ' 2') in text
+        assert 'mxnet_step_phase_ms_count{phase="queue_wait"} 2' in text
+        assert 'mxnet_step_phase_ms_sum{phase="queue_wait"} 50.500' in text
+        # histogram buckets are cumulative
+        counts = [int(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+                  if l.startswith('mxnet_step_phase_ms_bucket')]
+        assert counts == sorted(counts)
+    finally:
+        profiler.attribution_enable(prev)
+
+
+def test_dumps_reset_clears_attribution_and_cost_families():
+    prev = profiler.attribution_enable(True)
+    try:
+        with profiler.span("compute"):
+            pass
+        profiler.phase_step_end()
+        profiler.cost_event("trainstep:reset-probe", flops=1e9,
+                            bytes_accessed=1e6)
+        payload = json.loads(profiler.dumps(reset=True, format="json"))
+        assert payload["step_attribution"]["spans"] == 1
+        assert payload["step_attribution"]["steps"] == 1
+        assert payload["cost"]["trainstep:reset-probe"]["flops"] == 1e9
+        # reset means reset: the NEXT dump starts from zero for every
+        # family this dump reported
+        after = json.loads(profiler.dumps(format="json"))
+        assert "step_attribution" not in after and "cost" not in after
+        assert profiler.span_records() == 0
+        assert profiler.cost_stats() == {}
+        assert profiler.last_step_phases() == {}
+        assert profiler.mfu_stats() is None
+    finally:
+        profiler.attribution_enable(prev)
+
+
+def test_cost_accounting_populates_cached_jit_choke_points():
+    """op:*, fused:*, kvstore:flat_pack* and trainstep:* all record
+    compiler cost at their cached_jit executable acquisition
+    (serve:exec[*], the fourth choke point, is asserted in test_serve.py
+    where the predictor fixtures live). Odd shapes so every executable
+    compiles fresh inside this test. The automatic compile-cache cost
+    hook is gated on attribution, so the compiles run under the flag."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu import optimizer as opt
+    from incubator_mxnet_tpu.kvstore import _flat_pack_fn
+    from incubator_mxnet_tpu.parallel import TrainStep
+
+    prev = profiler.attribution_enable(True)
+    try:
+        rs = np.random.RandomState(5)
+        mx.nd.dot(nd.array(rs.rand(23, 29).astype(np.float32)),
+                  nd.array(rs.rand(29, 31).astype(np.float32)))
+        ws = [nd.array(rs.randn(5, 9).astype(np.float32)) for _ in range(2)]
+        gs = [nd.array(rs.randn(5, 9).astype(np.float32)) for _ in range(2)]
+        upd = opt.get_updater(opt.create("sgd", learning_rate=0.1))
+        upd([0, 1], gs, ws)
+        _flat_pack_fn(((11,), (13,)))(jnp.ones((11,)), jnp.ones((13,)))
+        net = gluon.nn.Dense(3, in_units=23)
+        net.initialize()
+        step = TrainStep(net, lambda o, l: jnp.mean((o - l) ** 2),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.05},
+                         example_inputs=[mx.nd.ones((6, 23))])
+        step(rs.rand(6, 23).astype(np.float32),
+             rs.rand(6, 3).astype(np.float32))
+
+        costs = profiler.cost_stats()
+    finally:
+        profiler.attribution_enable(prev)
+
+    def rec(prefix):
+        match = {k: v for k, v in costs.items() if k.startswith(prefix)}
+        assert match, (prefix, sorted(costs))
+        return next(iter(match.values()))
+
+    assert rec("op:dot")["flops"] > 0
+    assert rec("fused:sgd_update")["flops"] > 0
+    # flat-pack is pure data movement: zero flops, real bytes
+    assert rec("kvstore:flat_pack")["bytes_accessed"] > 0
+    ts = rec("trainstep:sgd")
+    assert ts["flops"] > 0 and ts["bytes_accessed"] > 0
+    assert ts["intensity"] == pytest.approx(
+        ts["flops"] / ts["bytes_accessed"])
+
+
+def test_mfu_stats_derive_from_compiler_cost():
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.parallel import TrainStep
+    prev = profiler.attribution_enable(True)
+    try:
+        net = gluon.nn.Dense(5, in_units=17)
+        net.initialize()
+        step = TrainStep(net, lambda o, l: jnp.mean((o - l) ** 2),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.05},
+                         example_inputs=[mx.nd.ones((4, 17))])
+        rs = np.random.RandomState(7)
+        x = rs.rand(4, 17).astype(np.float32)
+        y = rs.rand(4, 5).astype(np.float32)
+        for _ in range(3):
+            step(x, y)
+            profiler.phase_step_end()
+        mfu = profiler.mfu_stats()
+        assert mfu is not None
+        assert mfu["key"].startswith("trainstep:")
+        assert mfu["flops_per_step"] > 0
+        assert mfu["compute_ms_per_step"] > 0
+        assert mfu["flops_per_sec"] > 0
+        # CPU: no trustworthy peak -> mfu is null, never a made-up number
+        assert mfu["peak_flops"] is None and mfu["mfu"] is None
+        payload = json.loads(profiler.dumps(format="json"))
+        assert payload["mfu"]["flops_per_step"] == mfu["flops_per_step"]
+        assert "trainstep:sgd" in payload["cost"]
+        table = profiler.dumps()
+        assert "MFU (compiler cost / compute phase)" in table
+        assert "Step breakdown (phase)" in table
+        assert "Compiler cost (per executable)" in table
+    finally:
+        profiler.attribution_enable(prev)
+
+
+def test_run_epoch_attributes_input_wait_and_closes_steps():
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.parallel import TrainStep
+    net = gluon.nn.Dense(4, in_units=19)
+    net.initialize()
+    step = TrainStep(net, lambda o, l: jnp.mean((o - l) ** 2),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.05},
+                     example_inputs=[mx.nd.ones((8, 19))])
+    rs = np.random.RandomState(13)
+    batches = [(rs.randn(8, 19).astype(np.float32),
+                rs.randn(8, 4).astype(np.float32)) for _ in range(4)]
+    prev = profiler.attribution_enable(True)
+    try:
+        step.run_epoch(batches)
+        st = profiler.phase_stats()
+        assert st["steps"] == 4
+        for phase in ("h2d", "compute"):
+            assert st["phases"][phase]["count"] == 4, st["phases"]
+        # one extra input_wait: the end-of-iterator probe that returns
+        # the sentinel is itself a (tiny) wait on the input pipeline
+        assert st["phases"]["input_wait"]["count"] in (4, 5)
+        assert set(profiler.last_step_phases()) >= {"input_wait",
+                                                    "compute"}
+    finally:
+        profiler.attribution_enable(prev)
+
+
+def test_attributed_phases_explain_wall_step_time():
+    """Acceptance oracle: with attribution on, the per-step phase sum
+    explains the measured wall step time within 15% on CPU — the compute
+    span syncs on the result, so attributed time is real wall time."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.parallel import TrainStep
+    net = gluon.nn.Dense(256, in_units=512)
+    net.initialize()
+    step = TrainStep(net, lambda o, l: jnp.mean((o - l) ** 2),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.05},
+                     example_inputs=[mx.nd.ones((128, 512))])
+    rs = np.random.RandomState(11)
+    x = rs.rand(128, 512).astype(np.float32)
+    y = rs.rand(128, 256).astype(np.float32)
+    step(x, y)                       # compile outside the timed window
+    prev = profiler.attribution_enable(True)
+    try:
+        profiler.dumps(reset=True)
+        t0 = time.perf_counter()
+        for _ in range(6):
+            step(x, y)
+            profiler.phase_step_end()
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        st = profiler.phase_stats()
+        assert st["steps"] == 6
+        phase_ms = sum(r["total_ms"] for r in st["phases"].values())
+        assert phase_ms == pytest.approx(wall_ms, rel=0.15), \
+            (phase_ms, wall_ms, st["phases"])
+        # compute dominates a CPU train step
+        assert st["phases"]["compute"]["total_ms"] > 0.5 * phase_ms
+    finally:
+        profiler.attribution_enable(prev)

@@ -404,3 +404,90 @@ def test_skip_reasons_are_allowlisted():
     assert not offenders, (
         "skip reasons outside the environment-gate allowlist "
         "(silenced failures are not allowed):\n  " + "\n  ".join(offenders))
+
+
+# -- per-bucket queue/device latency split -----------------------------
+
+
+def test_serving_stats_bucket_split_and_prometheus():
+    st = ServingStats(name="m")
+    assert st.render_prometheus() == ""         # nothing seen -> no lines
+    # 25 dispatches of bucket 4, two requests each: queue waits dominate
+    # device time (50-60ms waiting vs 2ms on device)
+    for _ in range(25):
+        st.observe_bucket(4, [0.050, 0.060], 0.002)
+    snap = st.bucket_snapshot()
+    assert set(snap) == {4}
+    row = snap[4]
+    assert row["dispatches"] == 25
+    assert row["queue_wait_p95_ms"] > row["device_p95_ms"] > 0
+    assert row["queue_wait_p50_ms"] >= 40.0
+    # the flat snapshot()/publish() surface carries the same rows
+    assert st.snapshot()["bucket4_dispatches"] == 25
+    text = st.render_prometheus()
+    assert ('mxnet_serve_bucket_latency_ms{model="m",bucket="4"'
+            ',kind="queue_wait",q="p95"}') in text
+    assert ('mxnet_serve_bucket_latency_ms{model="m",bucket="4"'
+            ',kind="device",q="p50"}') in text
+    assert 'mxnet_serve_bucket_dispatches{model="m",bucket="4"} 25' in text
+
+
+def test_serving_stats_warns_once_when_queue_bound(caplog):
+    st = ServingStats(name="m")
+    for _ in range(25):                 # >= 20 samples arm the warning
+        st.queue_wait.observe(0.055)
+        st.forward_time.observe(0.002)
+        st.latency.observe(0.057)
+    with caplog.at_level("WARNING", logger="incubator_mxnet_tpu.serve"):
+        st.publish()
+        st.publish()                    # second publish must stay silent
+    hits = [r for r in caplog.records if "queue-bound" in r.getMessage()]
+    assert len(hits) == 1
+
+
+def test_batcher_books_queue_wait_and_compute_phases(predictor):
+    from incubator_mxnet_tpu import profiler
+    prev = profiler.attribution_enable(False)
+    try:
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        # off: traffic flows, zero attribution records
+        with DynamicBatcher(predictor.predict,
+                            buckets=predictor.ladder.sizes,
+                            max_latency_ms=5.0) as bat:
+            bat({"data": x}, timeout=60)
+        assert profiler.span_records() == 0
+
+        profiler.attribution_enable(True)
+        with DynamicBatcher(predictor.predict,
+                            buckets=predictor.ladder.sizes,
+                            max_latency_ms=5.0) as bat:
+            bat({"data": x}, timeout=60)
+        st = profiler.phase_stats()
+        # each dispatch = one attribution step: device span + measured
+        # queue wait, then the step closes
+        assert st["steps"] >= 1
+        assert st["phases"]["compute"]["count"] >= 1
+        assert st["phases"]["queue_wait"]["count"] >= 1
+    finally:
+        profiler.attribution_enable(prev)
+        profiler.dumps(reset=True)
+
+
+def test_predictor_records_compiler_cost(artifact):
+    """serve:exec[...] is the fourth cached_jit choke point: a fresh
+    bucket compile records its XLA cost analysis."""
+    from incubator_mxnet_tpu import profiler
+    path, _ = artifact
+    # bucket size 3 is unique to this test -> guaranteed fresh compile;
+    # the compile-cache cost hook only records under the attribution flag
+    prev = profiler.attribution_enable(True)
+    try:
+        pred = Predictor.from_artifact(path, bucket_sizes=(3,))
+        pred.predict({"data": np.random.rand(3, IN_DIM).astype(np.float32)})
+        costs = {k: v for k, v in profiler.cost_stats().items()
+                 if k.startswith("serve:exec[")}
+    finally:
+        profiler.attribution_enable(prev)
+    assert costs, sorted(profiler.cost_stats())
+    rec = next(iter(costs.values()))
+    assert rec["flops"] > 0 and rec["bytes_accessed"] > 0

@@ -101,6 +101,7 @@ def test_diagnose_runs():
     for section in ("JAX / Device Info", "Declared Env Vars (util.ENV_VARS)",
                     "Executable Cache (compile_cache)",
                     "Kernel Autotuner (tune)", "Fault Tolerance (fault)",
+                    "Step Breakdown (profiler attribution)",
                     "Static Analysis (mxlint)",
                     "Graph Analysis (shardlint)"):
         assert section in r.stdout, f"missing section {section!r}"
@@ -123,3 +124,96 @@ def test_measure_bandwidth_harness():
     assert payload["unit"] == "GB/s"
     assert payload["value"] > 0
     assert payload["devices"] == 4
+
+
+# -- trace_merge -------------------------------------------------------
+
+sys.path.insert(0, TOOLS)
+import trace_merge  # noqa: E402
+from validate_trace import validate_trace  # noqa: E402
+
+
+def _anchor(peer, offset_us, rtt_us, perf_us=0.0, wall_us=10_000.0):
+    return {"name": "clock_sync", "ph": "M", "ts": 0, "pid": 0,
+            "args": {"peer": peer, "offset_us": offset_us,
+                     "rtt_us": rtt_us, "perf_anchor_us": perf_us,
+                     "wall_anchor_us": wall_us}}
+
+
+def _span_event(ts, span_id, trace="t0", dur=500.0):
+    return {"name": "phase:compute", "ph": "X", "cat": "step", "ts": ts,
+            "dur": dur, "pid": 0, "tid": 1,
+            "args": {"span_id": span_id, "trace": trace}}
+
+
+def _write_trace(path, events):
+    import json
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(path)
+
+
+def test_trace_merge_aligns_clocks_and_assigns_pids(tmp_path):
+    # A: self anchor only (perf 0 -> wall 10000, offset 0): shift +10000.
+    # B: peer anchor with a +5000us measured server offset: shift +15000.
+    # Same raw ts 1000 in both -> 5000us apart on the merged timeline.
+    a = _write_trace(tmp_path / "a.json",
+                     [_span_event(1000.0, 1, trace="ta"),
+                      _anchor("self", 0.0, 0.0)])
+    b = _write_trace(tmp_path / "b.json",
+                     [_span_event(1000.0, 1, trace="tb"),
+                      _anchor("server", 5000.0, 120.0)])
+    merged = trace_merge.merge_traces([a, b])
+    validate_trace(merged)              # duplicate span ids OK: new pids
+    evs = merged["traceEvents"]
+    spans = {e["args"]["trace"]: e for e in evs if e.get("ph") == "X"}
+    assert spans["ta"]["pid"] == 0 and spans["tb"]["pid"] == 1
+    # origin normalized to the earliest real event
+    assert spans["ta"]["ts"] == 0.0
+    assert spans["tb"]["ts"] == 5000.0
+    names = [e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"]
+    assert any("a.json" in n and "ta" in n for n in names)
+    assert any("b.json" in n and "tb" in n for n in names)
+    # metadata rows pinned to the origin
+    assert all(e["ts"] == 0 for e in evs if e.get("ph") == "M")
+
+
+def test_trace_merge_requires_clock_anchor(tmp_path):
+    a = _write_trace(tmp_path / "a.json", [_span_event(1000.0, 1)])
+    with pytest.raises(trace_merge.MergeError):
+        trace_merge.merge_traces([a])
+    merged = trace_merge.merge_traces([a], allow_unsynced=True)
+    assert merged["traceEvents"][-1]["ts"] == 0.0   # origin-aligned only
+
+
+def test_trace_merge_prefers_smallest_rtt_peer_sample():
+    events = [_anchor("self", 0.0, 0.0),
+              _anchor("server", 900.0, 300.0),
+              _anchor("server", 1000.0, 100.0)]
+    best = trace_merge.best_clock_sync(events)
+    # a measured peer offset beats the self anchor; lowest RTT wins
+    assert best["offset_us"] == 1000.0 and best["rtt_us"] == 100.0
+    assert trace_merge.best_clock_sync(
+        [_anchor("self", 0.0, 0.0)])["peer"] == "self"
+    assert trace_merge.best_clock_sync([_span_event(1.0, 1)]) is None
+
+
+def test_trace_merge_cli(tmp_path):
+    import json
+    a = _write_trace(tmp_path / "a.json",
+                     [_span_event(1000.0, 1), _anchor("self", 0.0, 0.0)])
+    b = _write_trace(tmp_path / "b.json",
+                     [_span_event(2000.0, 2), _anchor("self", 0.0, 0.0)])
+    out = str(tmp_path / "merged.json")
+    r = _run([os.path.join(TOOLS, "trace_merge.py"), a, b, "-o", out])
+    assert r.returncode == 0, r.stderr
+    # 2 spans + 2 carried clock anchors + 2 added process_name labels
+    assert "6 events from 2 processes" in r.stdout
+    validate_trace(out)
+    assert len(json.load(open(out))["traceEvents"]) == 6
+    # a file without an anchor fails loudly (exit 1, stderr names it)
+    c = _write_trace(tmp_path / "c.json", [_span_event(1.0, 1)])
+    r = _run([os.path.join(TOOLS, "trace_merge.py"), c, "-o", out])
+    assert r.returncode == 1
+    assert "clock_sync" in r.stderr

@@ -138,6 +138,40 @@ def main():
     except Exception as e:
         print("fault probe FAILED:", e)
 
+    print("----------Step Breakdown (profiler attribution)----------")
+    try:
+        from incubator_mxnet_tpu import profiler
+        ps = profiler.phase_stats()
+        print("attribution  :", "on" if profiler.attribution_enabled()
+              else "off (MXNET_STEP_ATTRIBUTION unset)")
+        print("steps closed :", ps["steps"], " spans:", ps["spans"])
+        for phase in sorted(ps["phases"],
+                            key=lambda p: -ps["phases"][p]["total_ms"]):
+            row = ps["phases"][phase]
+            print(f"  {phase:<14} {row['count']:>7}x "
+                  f"avg {row['avg_ms']:8.3f}ms "
+                  f"max {row['max_ms']:8.3f}ms")
+        costs = profiler.cost_stats()
+        if costs:
+            print("compiler cost:")
+            for key in sorted(costs):
+                row = costs[key]
+                gf = row.get("flops")
+                inten = row.get("intensity")
+                print(f"  {key:<28} "
+                      + (f"{gf / 1e9:9.3f} GFLOP" if gf else "   (no flops)")
+                      + (f"  {inten:8.2f} F/B" if inten else ""))
+        mfu = profiler.mfu_stats()
+        if mfu:
+            print(f"MFU          : {mfu['mfu'] * 100:.1f}% "
+                  f"({mfu['key']}, compiler cost / compute phase)")
+        from incubator_mxnet_tpu import fault as _flt
+        print("flight rec   :", "on -> " + os.environ.get(
+            "MXNET_FLIGHT_RECORDER", "") if _flt.flight_enabled()
+            else "off (MXNET_FLIGHT_RECORDER unset)")
+    except Exception as e:
+        print("step breakdown probe FAILED:", e)
+
     print("----------Static Analysis (mxlint)----------")
     try:
         from tools.mxlint import lint_paths

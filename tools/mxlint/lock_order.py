@@ -46,8 +46,11 @@ LOCK_ORDER = {
     # fault: AsyncCheckpointManager's queue lock and FaultInjector's hit
     # counter (both spelled self._lock at their sites) stay outermost of
     # the module-level stats-counter leaf lock (_bump runs under _wlock
-    # holders' call chains via _commit).
-    "fault.py": ("self._wlock", "self._lock", "_stats_lock"),
+    # holders' call chains via _commit). The flight-recorder ring lock is
+    # a LEAF after it: flight_dump copies the ring under _flight_lock and
+    # only then reads stats()/phase_stats() with no lock held.
+    "fault.py": ("self._wlock", "self._lock", "_stats_lock",
+                 "_flight_lock"),
     "gluon/block.py": ("cls._lock",),
     "symbol/symbol.py": ("cls._lock",),
     "native/__init__.py": ("_lock",),

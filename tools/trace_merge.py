@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Merge per-process profiler traces into one wall-clock timeline.
+
+Each training/serving process dumps its own chrome trace (plus rolling
+segments) with timestamps on its private ``time.perf_counter()`` base —
+two files from two workers cannot be eyeballed side by side, and a
+straggler hunt needs exactly that. This tool merges N such files into a
+single Perfetto-loadable timeline:
+
+  1. Per input file, pick the best ``clock_sync`` metadata sample: the
+     smallest-RTT peer sample when the process heartbeated a server
+     (kvstore _hb_loop records offset = server_time - midpoint(t0, t1),
+     the classic NTP estimate), else the ``peer: "self"`` anchor the
+     profiler writes at dump time.
+  2. Shift every event:  ts' = ts - perf_anchor + wall_anchor + offset —
+     first onto the process's wall clock, then onto the server's.
+  3. Assign each input file a distinct pid (with a ``process_name``
+     metadata event naming the source file + trace id), normalize the
+     origin to the earliest event, sort, and emit one trace.
+
+Span linkage (worker pushpull span ids carried on the kvstore wire into
+server handler span args) survives the merge untouched, so a server
+``server:push`` span can be matched to the worker span that caused it by
+``args.link_span`` + ``args.link_trace``.
+
+CLI:
+  python tools/trace_merge.py -o merged.json worker0.json worker1.json ...
+
+Library:
+  merge_traces([path, ...]) -> {"traceEvents": [...], ...}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+__all__ = ["MergeError", "best_clock_sync", "merge_traces"]
+
+
+class MergeError(ValueError):
+    """Input trace cannot be placed on the shared timeline."""
+
+
+def _load_events(path):
+    with open(path) as f:
+        trace = json.load(f)
+    if isinstance(trace, list):
+        return trace
+    events = trace.get("traceEvents") if isinstance(trace, dict) else None
+    if not isinstance(events, list):
+        raise MergeError(f"{path}: top level has no traceEvents list")
+    return events
+
+
+def best_clock_sync(events):
+    """The clock_sync sample to align this process with: smallest RTT
+    among peer samples (a measured offset to the server's clock beats any
+    self anchor), else the self anchor (offset 0 to its own wall clock).
+    Returns the args dict, or None when the trace carries no sample."""
+    peer_best = self_best = None
+    for ev in events:
+        if ev.get("ph") != "M" or ev.get("name") != "clock_sync":
+            continue
+        args = ev.get("args") or {}
+        if not all(isinstance(args.get(k), (int, float))
+                   for k in ("offset_us", "rtt_us", "perf_anchor_us",
+                             "wall_anchor_us")):
+            continue
+        if args.get("peer") == "self":
+            self_best = args
+        elif peer_best is None or args["rtt_us"] < peer_best["rtt_us"]:
+            peer_best = args
+    return peer_best or self_best
+
+
+def merge_traces(paths, allow_unsynced=False):
+    """Merge per-process trace files into one timeline dict. Raises
+    MergeError when a file has no clock_sync anchor (pass
+    allow_unsynced=True to keep such a file on its raw timebase,
+    origin-aligned only)."""
+    merged = []
+    for pid, path in enumerate(paths):
+        events = _load_events(path)
+        sync = best_clock_sync(events)
+        if sync is None and not allow_unsynced:
+            raise MergeError(
+                f"{path}: no clock_sync sample; run with "
+                "MXNET_STEP_ATTRIBUTION=1 so dumps carry a clock anchor, "
+                "or pass --allow-unsynced")
+        shift = 0.0
+        if sync is not None:
+            shift = (sync["wall_anchor_us"] - sync["perf_anchor_us"]
+                     + sync["offset_us"])
+        trace_ids = set()
+        for ev in events:
+            e = dict(ev)
+            e["pid"] = pid
+            if isinstance(e.get("ts"), (int, float)):
+                e["ts"] = e["ts"] + shift
+            t = (e.get("args") or {}).get("trace") \
+                if isinstance(e.get("args"), dict) else None
+            if isinstance(t, str):
+                trace_ids.add(t)
+            merged.append(e)
+        label = os.path.basename(path)
+        if trace_ids:
+            label += f" [{', '.join(sorted(trace_ids))}]"
+        merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "ts": 0, "cat": "__metadata",
+                       "args": {"name": label}})
+    # one shared origin: earliest REAL event (metadata rows sit at ts 0
+    # by convention and must not drag the origin around)
+    real = [e["ts"] for e in merged
+            if e.get("ph") != "M" and isinstance(e.get("ts"), (int, float))]
+    origin = min(real) if real else 0.0
+    for e in merged:
+        if e.get("ph") == "M":
+            e["ts"] = 0
+        elif isinstance(e.get("ts"), (int, float)):
+            e["ts"] = max(0.0, e["ts"] - origin)
+    merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+def main(argv):
+    ap = argparse.ArgumentParser(
+        description="merge per-process profiler traces onto one "
+                    "wall-clock timeline")
+    ap.add_argument("traces", nargs="+", help="per-process trace JSONs")
+    ap.add_argument("-o", "--output", default="merged_trace.json")
+    ap.add_argument("--allow-unsynced", action="store_true",
+                    help="keep files without a clock_sync anchor on "
+                         "their raw timebase instead of failing")
+    args = ap.parse_args(argv)
+    try:
+        merged = merge_traces(args.traces,
+                              allow_unsynced=args.allow_unsynced)
+    except (MergeError, OSError, json.JSONDecodeError) as e:
+        print(f"trace_merge: {e}", file=sys.stderr)
+        return 1
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from validate_trace import validate_trace
+    validate_trace(merged)      # never emit a timeline Perfetto drops
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    print(f"{args.output}: {len(merged['traceEvents'])} events from "
+          f"{len(args.traces)} processes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -16,6 +16,17 @@ Checked invariants:
   * C (counter) events carry numeric args values (the counter track)
   * "pid"/"tid", when present, are int or string
 
+Attribution-span invariants (X events whose args carry "span_id" — the
+step-time attribution layer, including merged multi-process timelines
+from tools/trace_merge.py):
+  * span_id is a positive int, unique within its (pid, trace) scope
+  * "parent", when present, is a positive int; when the parent span is in
+    the same file, the child's [ts, ts+dur] interval must lie inside the
+    parent's (a parent flushed into an earlier rolling segment is
+    tolerated — the child exits before the parent books itself)
+  * "clock_sync" metadata events carry numeric offset_us / rtt_us /
+    perf_anchor_us / wall_anchor_us (what trace_merge aligns clocks with)
+
 Usable as a library (`validate_trace(path_or_dict)` returns the event
 count, raises TraceFormatError) or a CLI (`python tools/validate_trace.py
 trace.json ...` exits non-zero on the first invalid file).
@@ -73,6 +84,59 @@ def _check_event(i, ev):
                 _fail(i, ev, f"counter args[{k!r}] not numeric: {v!r}")
 
 
+# float µs arithmetic (ms -> µs conversions, clock-offset shifting in
+# trace_merge) can nudge interval endpoints by sub-µs amounts
+_SPAN_TOL_US = 5.0
+_CLOCK_SYNC_ARGS = ("offset_us", "rtt_us", "perf_anchor_us",
+                    "wall_anchor_us")
+
+
+def _check_spans(events):
+    """Nested-span well-formedness across the whole (possibly merged,
+    multi-process) event list; see the module docstring."""
+    spans = {}      # (pid, trace, span_id) -> (ts, ts_end)
+    children = []
+    for i, ev in enumerate(events):
+        if ev.get("ph") == "M" and ev.get("name") == "clock_sync":
+            args = ev.get("args")
+            if not isinstance(args, dict):
+                _fail(i, ev, "clock_sync event needs args")
+            for k in _CLOCK_SYNC_ARGS:
+                v = args.get(k)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    _fail(i, ev, f"clock_sync args[{k!r}] not numeric: {v!r}")
+            continue
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args")
+        if not isinstance(args, dict) or "span_id" not in args:
+            continue
+        sid = args["span_id"]
+        if not isinstance(sid, int) or isinstance(sid, bool) or sid <= 0:
+            _fail(i, ev, f"bad span_id {sid!r}")
+        trace = args.get("trace")
+        if trace is not None and not isinstance(trace, str):
+            _fail(i, ev, f"bad trace id {trace!r}")
+        key = (ev.get("pid"), trace, sid)
+        if key in spans:
+            _fail(i, ev, f"duplicate span_id {sid} in scope {key[:2]!r}")
+        spans[key] = (ev["ts"], ev["ts"] + ev["dur"])
+        parent = args.get("parent")
+        if parent is not None:
+            if not isinstance(parent, int) or isinstance(parent, bool) \
+                    or parent <= 0:
+                _fail(i, ev, f"bad parent {parent!r}")
+            children.append((i, ev, key, (key[0], key[1], parent)))
+    for i, ev, ckey, pkey in children:
+        if pkey not in spans:
+            continue        # parent in an earlier rolling segment
+        cts, cend = spans[ckey]
+        pts, pend = spans[pkey]
+        if cts + _SPAN_TOL_US < pts or cend - _SPAN_TOL_US > pend:
+            _fail(i, ev, f"span {ckey[2]} [{cts},{cend}] escapes parent "
+                         f"{pkey[2]} [{pts},{pend}]")
+
+
 def validate_trace(trace):
     """Validate a chrome trace; `trace` is a file path, a JSON string, or
     an already-parsed dict. Returns the number of events checked."""
@@ -92,6 +156,7 @@ def validate_trace(trace):
         raise TraceFormatError(f"trace is not an object: {type(trace)}")
     for i, ev in enumerate(events):
         _check_event(i, ev)
+    _check_spans(events)
     return len(events)
 
 
