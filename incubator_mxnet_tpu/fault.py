@@ -73,6 +73,7 @@ _counters = {
     "rejoins": 0,               # elastic re-registrations after a loss
     "membership_changes": 0,    # server membership epoch changes observed
     "faults_injected": 0,       # MXNET_FAULT_INJECT actions fired
+    "slo_alerts": 0,            # fleet SLO alerts raised (fleetobs engine)
 }
 
 

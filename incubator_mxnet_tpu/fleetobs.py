@@ -1,0 +1,948 @@
+"""Fleet observability plane: cross-rank metrics aggregation, SLO
+burn-rate alerting, and on-demand remote profiling.
+
+PR 10 gave every *process* step-time attribution, compiler cost
+accounting, and trace spans; this module pools them fleet-wide without
+adding a single new connection. Each rank attaches a bounded metric
+snapshot to the authenticated v2 kvstore heartbeat it already sends
+(kvstore._hb_loop), the coordinator folds snapshots into a
+FleetRegistry (kvstore_server heartbeat handler), and the registry
+serves three operator surfaces:
+
+  /metrics  fleet Prometheus text: per-rank families labeled rank="N"
+            plus cross-rank aggregated phase histograms and quantiles
+  /fleet    JSON: per-rank liveness, step rate, slow phase, MFU
+  /alerts   JSON: the SLO engine's alert table
+
+The SLO engine evaluates declarative specs (``p99(queue_wait) < 50ms``,
+``mfu > 0.3``, ``straggler_lag < 1.5x``) with two burn-rate windows (one
+evaluation interval and five); an alert fires only when BOTH windows
+breach, so a single slow step cannot page anyone, and a sustained
+breach fires within two evaluations. Transitions warn once per spec,
+bump fault counters, and leave a flight-recorder breadcrumb.
+
+Remote profiling closes the loop: ``fleet_profile_request`` queues a
+control op that rides the next heartbeat *reply* to the target rank
+(the coordinator never dials workers), the rank runs an attribution +
+continuous-dump session for N steps, and ships the bounded trace back
+over the MAC'd wire, where tools/trace_merge.py can merge it onto the
+server clock.
+
+Everything is gated behind MXNET_FLEET_OBS with the established
+cached-bool pattern: off (the default), the heartbeat payload is
+byte-identical to the non-fleet wire and no snapshot is ever built.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import re
+import shutil
+import tempfile
+import threading
+import time
+import weakref
+from collections import deque
+
+__all__ = ["enabled", "fleet_enable", "fleet_reset", "stats", "clear",
+           "build_snapshot", "heartbeat_snapshot", "handle_command",
+           "SLOSpec", "SLOEngine", "load_slo_specs", "FleetRegistry",
+           "registries", "start_http", "stop_http"]
+
+_log = logging.getLogger("incubator_mxnet_tpu.fleetobs")
+
+# lock order (declared in tools/mxlint/lock_order.py): a FleetRegistry's
+# self._lock may be held when the module _lock is taken (_bump from
+# fold()); never the other way around
+_lock = threading.Lock()
+_enabled = None
+
+_counters = {
+    "snapshots_built": 0,       # worker: snapshots attached to heartbeats
+    "snapshots_skipped": 0,     # worker: beats skipped by the cadence knob
+    "snapshots_folded": 0,      # coordinator: snapshots folded in
+    "slo_evals": 0,             # coordinator: SLO engine evaluations
+    "alerts_raised": 0,         # coordinator: ok -> firing transitions
+    "alerts_resolved": 0,       # coordinator: firing -> ok transitions
+    "profile_requests": 0,      # coordinator: control ops queued
+    "profile_runs": 0,          # worker: remote profile sessions completed
+    "profile_pushes": 0,        # coordinator: trace segments received
+    "profile_fetches": 0,       # coordinator: stored traces handed out
+    "profile_bytes": 0,         # coordinator: trace bytes received
+}
+
+# worker-side state: heartbeat cadence + one-profile-at-a-time latch
+_beat_seq = 0
+_profile_active = False
+
+
+def enabled():
+    """True when the fleet observability plane is on. The env var is
+    read once and cached — the gate sits on the heartbeat hot path."""
+    global _enabled
+    if _enabled is None:
+        from .util import getenv_bool
+        _enabled = getenv_bool("MXNET_FLEET_OBS")
+    return _enabled
+
+
+def fleet_enable(on=True):
+    """Force the plane on/off for this process (tests, operators);
+    returns the previous effective state."""
+    global _enabled
+    prev = enabled()
+    _enabled = bool(on)
+    return prev
+
+
+def fleet_reset():
+    """Forget the cached MXNET_FLEET_OBS read and the worker-side beat
+    cadence — the next enabled() consults the environment."""
+    global _enabled, _beat_seq
+    with _lock:
+        _enabled = None
+        _beat_seq = 0
+
+
+def _bump(name, delta=1):
+    with _lock:
+        _counters[name] += delta
+
+
+def stats():
+    """Counter snapshot (dumps()/diagnose surface)."""
+    with _lock:
+        return dict(_counters)
+
+
+def clear(stats=True):
+    """dumps(reset=True) hook: restart the counter family."""
+    if stats:
+        with _lock:
+            for k in _counters:
+                _counters[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# worker side: bounded heartbeat snapshots
+# ---------------------------------------------------------------------------
+
+SNAPSHOT_VERSION = 1
+_MAX_PHASES = 16        # phase families shipped per snapshot
+_MAX_COSTS = 8          # compiler cost records shipped per snapshot
+
+
+def build_snapshot(step):
+    """One bounded metric snapshot for a heartbeat: last-step phase
+    vector, cumulative phase histograms (the registry diffs successive
+    snapshots into deltas), MFU, exec-cache/tune counters, and the top
+    compiler cost records. Every family is best-effort — a torn-down
+    subsystem must never kill the heartbeat loop."""
+    from . import profiler as _prof
+    snap = {"v": SNAPSHOT_VERSION, "t": time.time(), "step": int(step)}
+    try:
+        phases = _prof.last_step_phases()
+        if phases:
+            top = sorted(phases.items(), key=lambda kv: -kv[1])
+            snap["phases"] = {p: round(ms, 4) for p, ms in
+                              top[:_MAX_PHASES]}
+    except Exception:       # noqa: BLE001
+        pass
+    try:
+        hist = _prof.phase_histograms()
+        if hist:
+            top = sorted(hist.items(), key=lambda kv: -kv[1]["sum_ms"])
+            snap["hist"] = dict(top[:_MAX_PHASES])
+    except Exception:       # noqa: BLE001
+        pass
+    try:
+        mfu = _prof.mfu_stats()
+        if mfu is not None:
+            snap["mfu"] = mfu.get("mfu")
+            snap["flops_per_step"] = mfu.get("flops_per_step")
+    except Exception:       # noqa: BLE001
+        pass
+    try:
+        counters = {}
+        ec = _prof._exec_cache_stats()
+        if ec:
+            for k in ("hits", "misses", "disk_hits", "evictions"):
+                counters[f"exec_cache_{k}"] = ec.get(k, 0)
+        tn = _prof._tune_stats()
+        if tn:
+            for k in ("searches", "hits", "fallbacks"):
+                counters[f"tune_{k}"] = tn.get(k, 0)
+        ft = _prof._fault_stats()
+        if ft:
+            for k in ("heartbeats_sent", "faults_injected", "rejoins"):
+                counters[f"fault_{k}"] = ft.get(k, 0)
+        if counters:
+            snap["counters"] = counters
+    except Exception:       # noqa: BLE001
+        pass
+    try:
+        costs = _prof.cost_stats()
+        if costs:
+            top = sorted(costs.items(),
+                         key=lambda kv: -(kv[1].get("flops") or 0))
+            snap["costs"] = {
+                k: {"flops": v.get("flops"),
+                    "bytes_accessed": v.get("bytes_accessed")}
+                for k, v in top[:_MAX_COSTS]}
+    except Exception:       # noqa: BLE001
+        pass
+    _bump("snapshots_built")
+    return snap
+
+
+def heartbeat_snapshot(step):
+    """Cadence-gated build_snapshot for the heartbeat loop: returns the
+    snapshot on every Nth beat (MXNET_FLEET_SNAPSHOT_INTERVAL), None on
+    skipped beats. Callers must check enabled() first — this function
+    assumes the plane is on."""
+    global _beat_seq
+    from .util import getenv_int
+    every = max(1, getenv_int("MXNET_FLEET_SNAPSHOT_INTERVAL"))
+    with _lock:
+        seq = _beat_seq
+        _beat_seq += 1
+    if seq % every:
+        _bump("snapshots_skipped")
+        return None
+    try:
+        return build_snapshot(step)
+    except Exception:       # noqa: BLE001 — never break the heartbeat
+        _log.debug("fleet snapshot build failed", exc_info=True)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# SLO specs + burn-rate engine
+# ---------------------------------------------------------------------------
+
+_QUANTILE_RE = re.compile(
+    r"^p(\d{1,2}(?:\.\d+)?)\s*\(\s*([\w.]+)\s*\)\s*"
+    r"(<=|>=|<|>)\s*([\d.]+)\s*(ms|s|us)?$")
+_LAG_RE = re.compile(r"^straggler_lag\s*(<=|>=|<|>)\s*([\d.]+)\s*x?$")
+_GAUGE_RE = re.compile(r"^([\w.]+)\s*(<=|>=|<|>)\s*([\d.]+)$")
+
+_UNIT_MS = {None: 1.0, "ms": 1.0, "s": 1e3, "us": 1e-3}
+
+
+class SLOSpec:
+    """One parsed SLO objective. `kind` is 'quantile' (phase-histogram
+    percentile in ms), 'lag' (straggler step ratio), or 'gauge' (a
+    scalar fleet metric like mfu). The spec states the GOOD condition;
+    breach(value) is its negation."""
+
+    __slots__ = ("raw", "kind", "metric", "q", "op", "threshold")
+
+    def __init__(self, raw, kind, metric, q, op, threshold):
+        self.raw = raw
+        self.kind = kind
+        self.metric = metric
+        self.q = q
+        self.op = op
+        self.threshold = threshold
+
+    @classmethod
+    def parse(cls, text):
+        text = text.strip()
+        m = _QUANTILE_RE.match(text)
+        if m:
+            q, metric, op, val, unit = m.groups()
+            # 'serve.queue_wait' names the same attribution phase the
+            # batcher books as 'queue_wait'; accept both spellings
+            metric = metric.rsplit(".", 1)[-1]
+            return cls(text, "quantile", metric, float(q), op,
+                       float(val) * _UNIT_MS[unit])
+        m = _LAG_RE.match(text)
+        if m:
+            op, val = m.groups()
+            return cls(text, "lag", "straggler_lag", None, op, float(val))
+        m = _GAUGE_RE.match(text)
+        if m:
+            metric, op, val = m.groups()
+            return cls(text, "gauge", metric.rsplit(".", 1)[-1], None,
+                       op, float(val))
+        raise ValueError(f"unparseable SLO spec {text!r}")
+
+    def breach(self, value):
+        good = {"<": value < self.threshold,
+                "<=": value <= self.threshold,
+                ">": value > self.threshold,
+                ">=": value >= self.threshold}[self.op]
+        return not good
+
+
+DEFAULT_SLO_SPECS = ("straggler_lag < 1.5x",)
+
+
+def load_slo_specs(path=None):
+    """Parse the SLO spec file (MXNET_FLEET_SLO_PATH; one spec per
+    line, '#' comments). Unreadable file or unparseable lines degrade
+    to a warning + the built-in defaults — a bad spec file must not
+    take down the coordinator."""
+    from .util import getenv_str
+    if path is None:
+        path = getenv_str("MXNET_FLEET_SLO_PATH")
+    if not path:
+        return [SLOSpec.parse(s) for s in DEFAULT_SLO_SPECS]
+    specs = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                try:
+                    specs.append(SLOSpec.parse(line))
+                except ValueError as e:
+                    _log.warning("fleet SLO spec skipped: %s", e)
+    except OSError as e:
+        _log.warning("fleet SLO spec file %s unreadable (%s); using "
+                     "defaults", path, e)
+    return specs or [SLOSpec.parse(s) for s in DEFAULT_SLO_SPECS]
+
+
+class SLOEngine:
+    """Multi-window burn-rate evaluator over a set of SLOSpecs.
+
+    Each evaluation appends one breach sample per spec (skipping specs
+    whose metric has no data yet). An alert fires when the breach
+    fraction is >= 0.5 in BOTH the short window (one evaluation
+    interval) and the long window (five intervals), with at least two
+    samples on the books — so a lone outlier evaluation never pages,
+    and a sustained breach fires by the second evaluation. It resolves
+    when both windows drop below the threshold again."""
+
+    _BURN = 0.5
+    _MIN_SAMPLES = 2
+
+    def __init__(self, specs, interval_s=None):
+        if interval_s is None:
+            from .util import getenv_int
+            interval_s = max(1, getenv_int("MXNET_FLEET_SLO_INTERVAL"))
+        self.interval_s = float(interval_s)
+        self.short_s = self.interval_s * 1.5   # tolerate eval jitter
+        self.long_s = self.interval_s * 5
+        self.specs = list(specs)
+        self._samples = {s.raw: deque() for s in self.specs}
+        self._state = {s.raw: {"state": "ok", "since": None, "value": None,
+                               "burn_short": 0.0, "burn_long": 0.0}
+                       for s in self.specs}
+        self.breaches_total = 0
+
+    def _burn(self, samples, window_s, now):
+        hits = [b for t, b in samples if now - t <= window_s]
+        if not hits:
+            return 0.0
+        return sum(hits) / len(hits)
+
+    def evaluate(self, values, quantile_fn, now, wall=None):
+        """One evaluation pass. `values` maps metric name -> scalar for
+        gauge/lag specs; `quantile_fn(metric, q)` resolves quantile
+        specs (ms) or returns None when the histogram is empty. Returns
+        [(spec, "firing"|"resolved", value)] transitions."""
+        if wall is None:
+            wall = time.time()
+        transitions = []
+        for spec in self.specs:
+            if spec.kind == "quantile":
+                value = quantile_fn(spec.metric, spec.q)
+            else:
+                value = values.get(spec.metric)
+            if value is None:
+                continue
+            breach = spec.breach(value)
+            if breach:
+                self.breaches_total += 1
+            samples = self._samples[spec.raw]
+            samples.append((now, breach))
+            while samples and now - samples[0][0] > self.long_s:
+                samples.popleft()
+            st = self._state[spec.raw]
+            st["value"] = value
+            st["burn_short"] = self._burn(samples, self.short_s, now)
+            st["burn_long"] = self._burn(samples, self.long_s, now)
+            hot = (len(samples) >= self._MIN_SAMPLES
+                   and st["burn_short"] >= self._BURN
+                   and st["burn_long"] >= self._BURN)
+            if hot and st["state"] == "ok":
+                st["state"] = "firing"
+                st["since"] = wall
+                transitions.append((spec, "firing", value))
+            elif not hot and st["state"] == "firing" \
+                    and st["burn_short"] < self._BURN:
+                st["state"] = "ok"
+                st["since"] = wall
+                transitions.append((spec, "resolved", value))
+        return transitions
+
+    def view(self):
+        out = []
+        for spec in self.specs:
+            st = self._state[spec.raw]
+            out.append({"spec": spec.raw, "kind": spec.kind,
+                        "metric": spec.metric, "state": st["state"],
+                        "since": st["since"], "value": st["value"],
+                        "burn_short": round(st["burn_short"], 4),
+                        "burn_long": round(st["burn_long"], 4)})
+        return out
+
+    def active(self):
+        return [row for row in self.view() if row["state"] == "firing"]
+
+
+# ---------------------------------------------------------------------------
+# coordinator side: FleetRegistry
+# ---------------------------------------------------------------------------
+
+_registries = weakref.WeakSet()     # live registries (diagnose surface)
+
+
+def registries():
+    """Live FleetRegistry instances in this process (the coordinator
+    has one per AsyncServer; workers none)."""
+    return list(_registries)
+
+
+class FleetRegistry:
+    """Coordinator-side fold of per-rank heartbeat snapshots.
+
+    Per (gen, rank) it keeps the latest snapshot-derived state (step,
+    step rate, last-step phases, MFU, counters, cost records) plus the
+    previous cumulative phase histogram so successive snapshots diff
+    into fleet-wide bucket deltas — the cross-rank aggregate the
+    quantile families and quantile SLO specs read. It also owns the
+    control-op queue and the stored remote-profile traces."""
+
+    LIVE_WINDOW_S = 30.0    # a rank silent this long is down in /fleet
+
+    def __init__(self, specs=None, interval_s=None):
+        self._lock = threading.Lock()
+        self._ranks = {}        # (gen, rank) -> state dict
+        self._fleet_hist = {}   # phase -> [count, sum_ms, buckets]
+        self._pending = {}      # (gen, rank) -> control dict
+        self._profiles = {}     # (gen, rank) -> stored trace record
+        self._last_fetch = None
+        self._req_seq = 0
+        if specs is None:
+            specs = load_slo_specs()
+        self.engine = SLOEngine(specs, interval_s=interval_s)
+        self._last_eval = None
+        _registries.add(self)
+
+    # -- folding --------------------------------------------------------
+
+    def _diff_hist_locked(self, st, hist):
+        """Fold the cumulative per-rank histograms into the fleet-wide
+        delta aggregate. A count regression means the rank reset its
+        attribution registry — restart the diff base from zero."""
+        prev = st["hist_prev"]
+        for phase, rec in hist.items():
+            if not isinstance(rec, dict):
+                continue
+            buckets = rec.get("buckets")
+            if not isinstance(buckets, list):
+                continue
+            count = rec.get("count", 0)
+            sum_ms = rec.get("sum_ms", 0.0)
+            p = prev.get(phase)
+            if p is None or count < p["count"] \
+                    or len(buckets) != len(p["buckets"]):
+                p = {"count": 0, "sum_ms": 0.0,
+                     "buckets": [0] * len(buckets)}
+            agg = self._fleet_hist.get(phase)
+            if agg is None or len(agg[2]) != len(buckets):
+                agg = self._fleet_hist[phase] = [0, 0.0,
+                                                 [0] * len(buckets)]
+            agg[0] += max(0, count - p["count"])
+            agg[1] += max(0.0, sum_ms - p["sum_ms"])
+            for i, b in enumerate(buckets):
+                agg[2][i] += max(0, b - p["buckets"][i])
+            prev[phase] = {"count": count, "sum_ms": sum_ms,
+                           "buckets": list(buckets)}
+
+    def fold(self, gen, rank, step, snap, now=None):
+        """Fold one heartbeat snapshot; returns a pending control dict
+        for this rank (popped — control ops are one-shot) or None.
+        Runs the SLO engine when an evaluation interval elapsed."""
+        if not isinstance(snap, dict) or snap.get("v") != SNAPSHOT_VERSION:
+            return None
+        if now is None:
+            now = time.monotonic()
+        key = (int(gen), int(rank))
+        step = int(step)
+        transitions = []
+        with self._lock:
+            st = self._ranks.get(key)
+            if st is None:
+                st = self._ranks[key] = {
+                    "step": 0, "step_rate": 0.0, "phases": {},
+                    "mfu": None, "counters": {}, "costs": {},
+                    "hist_prev": {}, "seen_mono": now,
+                    "seen_wall": snap.get("t"), "snapshots": 0,
+                }
+            prev_step, prev_seen = st["step"], st["seen_mono"]
+            if step > prev_step and now > prev_seen:
+                st["step_rate"] = (step - prev_step) / (now - prev_seen)
+            st["step"] = step
+            st["seen_mono"] = now
+            st["seen_wall"] = snap.get("t")
+            st["snapshots"] += 1
+            if isinstance(snap.get("phases"), dict):
+                st["phases"] = snap["phases"]
+            if "mfu" in snap:
+                st["mfu"] = snap["mfu"]
+            if isinstance(snap.get("counters"), dict):
+                st["counters"] = snap["counters"]
+            if isinstance(snap.get("costs"), dict):
+                st["costs"] = snap["costs"]
+            if isinstance(snap.get("hist"), dict):
+                self._diff_hist_locked(st, snap["hist"])
+            cmd = self._pending.pop(key, None)
+            if self._last_eval is None \
+                    or now - self._last_eval >= self.engine.interval_s:
+                self._last_eval = now
+                transitions = self.engine.evaluate(
+                    self._metric_values_locked(now),
+                    self._quantile_locked, now)
+                _counters_bump = True
+            else:
+                _counters_bump = False
+        _bump("snapshots_folded")
+        if _counters_bump:
+            _bump("slo_evals")
+        for spec, what, value in transitions:
+            self._alert_transition(spec, what, value)
+        return cmd
+
+    def _metric_values_locked(self, now):
+        live = [st for st in self._ranks.values()
+                if now - st["seen_mono"] <= self.LIVE_WINDOW_S]
+        values = {}
+        steps = [st["step"] for st in live]
+        # the lag ratio needs two live ranks and a little warmup, or
+        # startup skew (rank 0 registering first) reads as a straggler
+        if len(steps) >= 2 and max(steps) >= 5:
+            values["straggler_lag"] = max(steps) / max(min(steps), 1)
+        mfus = [st["mfu"] for st in live
+                if isinstance(st["mfu"], (int, float))]
+        if mfus:
+            values["mfu"] = sum(mfus) / len(mfus)
+        return values
+
+    def _quantile_locked(self, metric, q):
+        """Percentile (ms) of the fleet-wide delta histogram for one
+        phase, interpolated inside the winning log bucket (same trade
+        as serve.LatencyHistogram.percentile). None when empty."""
+        from . import profiler as _prof
+        agg = self._fleet_hist.get(metric)
+        if agg is None or agg[0] == 0:
+            return None
+        bounds = _prof.phase_bounds()
+        total, _, buckets = agg
+        rank = q / 100.0 * total
+        seen = 0
+        for i, c in enumerate(buckets):
+            if seen + c >= rank and c > 0:
+                lo = 0.0 if i == 0 else bounds[i - 1]
+                hi = bounds[min(i, len(bounds) - 1)]
+                return lo + (hi - lo) * min(1.0, (rank - seen) / c)
+            seen += c
+        return bounds[-1]
+
+    def _alert_transition(self, spec, what, value):
+        if what == "firing":
+            _bump("alerts_raised")
+            _log.warning("fleet SLO alert FIRING: %s (value %.4g)",
+                         spec.raw, value)
+            try:
+                from . import fault as _fault
+                _fault._bump("slo_alerts")
+                _fault.flight_record("slo_alert", spec=spec.raw,
+                                     value=value)
+            except Exception:       # noqa: BLE001
+                pass
+        else:
+            _bump("alerts_resolved")
+            _log.warning("fleet SLO alert resolved: %s (value %.4g)",
+                         spec.raw, value)
+            try:
+                from . import fault as _fault
+                _fault.flight_record("slo_alert_resolved", spec=spec.raw,
+                                     value=value)
+            except Exception:       # noqa: BLE001
+                pass
+
+    # -- remote profiling -----------------------------------------------
+
+    def request_profile(self, gen, rank, steps):
+        """Queue a one-shot remote-profile control op for (gen, rank);
+        it rides the rank's next heartbeat reply. Returns the request
+        id the shipped trace will carry."""
+        from .util import getenv_int
+        steps = max(1, min(int(steps),
+                           getenv_int("MXNET_FLEET_PROFILE_MAX_STEPS")))
+        with self._lock:
+            self._req_seq += 1
+            rid = self._req_seq
+            self._pending[(int(gen), int(rank))] = {
+                "op": "profile", "id": rid, "steps": steps}
+        _bump("profile_requests")
+        return rid
+
+    def store_profile(self, gen, rank, request_id, payload):
+        """Accept one shipped trace (a chrome-trace JSON string).
+        Oversized pushes are refused outright — the worker-side cap
+        should have trimmed them, so size here means a bug or abuse."""
+        from .util import getenv_int
+        if not isinstance(payload, str):
+            raise ValueError("profile payload must be a JSON string")
+        cap = getenv_int("MXNET_FLEET_PROFILE_MAX_BYTES")
+        nbytes = len(payload.encode("utf-8", "replace"))
+        if nbytes > cap:
+            raise ValueError(
+                f"profile payload {nbytes} bytes exceeds "
+                f"MXNET_FLEET_PROFILE_MAX_BYTES={cap}")
+        with self._lock:
+            self._profiles[(int(gen), int(rank))] = {
+                "request_id": int(request_id), "trace": payload,
+                "bytes": nbytes, "received_at": time.time()}
+        _bump("profile_pushes")
+        _bump("profile_bytes", nbytes)
+
+    def fetch_profile(self, gen, rank):
+        """Stored trace record for (gen, rank) or None; remembers the
+        fetch for the diagnose surface."""
+        with self._lock:
+            rec = self._profiles.get((int(gen), int(rank)))
+            if rec is not None:
+                self._last_fetch = {"gen": int(gen), "rank": int(rank),
+                                    "request_id": rec["request_id"],
+                                    "at": time.time()}
+                rec = dict(rec)
+        if rec is not None:
+            _bump("profile_fetches")
+        return rec
+
+    # -- operator views --------------------------------------------------
+
+    def occupancy(self):
+        """Small registry introspection dict (diagnose surface)."""
+        with self._lock:
+            return {"ranks": len(self._ranks),
+                    "phases": len(self._fleet_hist),
+                    "pending_commands": len(self._pending),
+                    "stored_profiles": len(self._profiles),
+                    "alerts_active": len(self.engine.active()),
+                    "last_fetch": dict(self._last_fetch)
+                    if self._last_fetch else None}
+
+    def fleet_view(self, now=None):
+        """The /fleet JSON: per-rank liveness, step rate, slow phase,
+        MFU, plus the active-alert count."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            ranks = {}
+            for (gen, rank), st in sorted(self._ranks.items()):
+                age = now - st["seen_mono"]
+                phases = st["phases"]
+                slow = max(phases, key=phases.get) if phases else None
+                ranks[str(rank)] = {
+                    "gen": gen, "step": st["step"],
+                    "step_rate": round(st["step_rate"], 4),
+                    "alive": age <= self.LIVE_WINDOW_S,
+                    "age_s": round(age, 3),
+                    "slow_phase": slow,
+                    "phases_ms": phases,
+                    "mfu": st["mfu"],
+                    "snapshots": st["snapshots"],
+                }
+            return {"ranks": ranks,
+                    "alerts_active": len(self.engine.active())}
+
+    def alerts_view(self):
+        """The /alerts JSON: every spec's state + burn rates."""
+        with self._lock:
+            return {"alerts": self.engine.view(),
+                    "breaches_total": self.engine.breaches_total}
+
+    def render_prometheus(self, now=None):
+        """Fleet families for the coordinator /metrics scrape: per-rank
+        gauges labeled rank="N" plus the cross-rank aggregated phase
+        histogram (spec-conformant cumulative le buckets) and quantile
+        gauges derived from it."""
+        from . import profiler as _prof
+        if now is None:
+            now = time.monotonic()
+        esc = _prof._prom_label
+        lines = []
+
+        def family(name, mtype, help_text):
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {mtype}")
+
+        with self._lock:
+            ranks = {k: dict(st) for k, st in sorted(self._ranks.items())}
+            hist = {p: (v[0], v[1], list(v[2]))
+                    for p, v in self._fleet_hist.items()}
+            alerts = self.engine.view()
+            breaches = self.engine.breaches_total
+
+        family("mxnet_fleet_ranks", "gauge",
+               "ranks the fleet registry has folded snapshots from")
+        lines.append(f"mxnet_fleet_ranks {len(ranks)}")
+        if ranks:
+            family("mxnet_fleet_rank_up", "gauge",
+                   "1 while the rank's snapshots are fresh")
+            for (gen, rank), st in ranks.items():
+                up = 1 if now - st["seen_mono"] <= self.LIVE_WINDOW_S else 0
+                lines.append(f'mxnet_fleet_rank_up{{rank="{rank}"}} {up}')
+            family("mxnet_fleet_rank_step", "gauge",
+                   "latest step the rank reported")
+            for (gen, rank), st in ranks.items():
+                lines.append(
+                    f'mxnet_fleet_rank_step{{rank="{rank}"}} {st["step"]}')
+            family("mxnet_fleet_rank_step_rate", "gauge",
+                   "steps per second between the rank's last snapshots")
+            for (gen, rank), st in ranks.items():
+                lines.append(f'mxnet_fleet_rank_step_rate{{rank="{rank}"}} '
+                             f'{st["step_rate"]:.6g}')
+            mfus = [(rank, st["mfu"]) for (gen, rank), st in ranks.items()
+                    if isinstance(st["mfu"], (int, float))]
+            if mfus:
+                family("mxnet_fleet_rank_mfu", "gauge",
+                       "rank-reported model FLOP utilization")
+                for rank, mfu in mfus:
+                    lines.append(
+                        f'mxnet_fleet_rank_mfu{{rank="{rank}"}} {mfu:.6g}')
+            phase_rows = [(rank, p, ms) for (gen, rank), st in ranks.items()
+                          for p, ms in sorted(st["phases"].items())]
+            if phase_rows:
+                family("mxnet_fleet_rank_phase_ms", "gauge",
+                       "rank's last-step attributed time per phase")
+                for rank, p, ms in phase_rows:
+                    lines.append(
+                        f'mxnet_fleet_rank_phase_ms{{rank="{rank}",'
+                        f'phase="{esc(p)}"}} {ms:.6g}')
+        if hist:
+            bounds = _prof.phase_bounds()
+            family("mxnet_fleet_phase_ms", "histogram",
+                   "cross-rank aggregated per-phase step time in ms")
+            for p in sorted(hist):
+                cnt, total, buckets = hist[p]
+                lbl = esc(p)
+                cum = 0
+                for i, b in enumerate(bounds):
+                    cum += buckets[i] if i < len(buckets) else 0
+                    lines.append(f'mxnet_fleet_phase_ms_bucket{{'
+                                 f'phase="{lbl}",le="{b:.6g}"}} {cum}')
+                cum = sum(buckets)
+                lines.append(f'mxnet_fleet_phase_ms_bucket{{phase="{lbl}",'
+                             f'le="+Inf"}} {cum}')
+                lines.append(f'mxnet_fleet_phase_ms_sum{{phase="{lbl}"}} '
+                             f'{total:.3f}')
+                lines.append(f'mxnet_fleet_phase_ms_count{{phase="{lbl}"}} '
+                             f'{cnt}')
+            family("mxnet_fleet_phase_ms_quantile", "gauge",
+                   "cross-rank phase-time quantiles from the aggregated "
+                   "histogram")
+            with self._lock:
+                for p in sorted(hist):
+                    for q in (50.0, 90.0, 99.0):
+                        v = self._quantile_locked(p, q)
+                        if v is None:
+                            continue
+                        lines.append(
+                            f'mxnet_fleet_phase_ms_quantile{{'
+                            f'phase="{esc(p)}",q="{q / 100:g}"}} {v:.6g}')
+        family("mxnet_fleet_slo_breaches_total", "counter",
+               "SLO evaluations that found a spec in breach")
+        lines.append(f"mxnet_fleet_slo_breaches_total {breaches}")
+        family("mxnet_fleet_alerts_active", "gauge",
+               "SLO alerts currently firing")
+        lines.append(f"mxnet_fleet_alerts_active "
+                     f"{sum(1 for a in alerts if a['state'] == 'firing')}")
+        if alerts:
+            family("mxnet_fleet_alert_firing", "gauge",
+                   "1 while the labeled SLO spec's alert is firing")
+            for a in alerts:
+                lines.append(
+                    f'mxnet_fleet_alert_firing{{spec="{esc(a["spec"])}"}} '
+                    f'{1 if a["state"] == "firing" else 0}')
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# worker side: remote-profile control ops
+# ---------------------------------------------------------------------------
+
+def handle_command(cmd, kv, addr):
+    """Act on a control dict delivered in a heartbeat reply. Profile
+    commands run in a daemon thread (the heartbeat loop must keep
+    beating while the session records); anything malformed is dropped.
+    Never raises — it runs inside the heartbeat loop."""
+    global _profile_active
+    try:
+        if not isinstance(cmd, dict) or cmd.get("op") != "profile":
+            return
+        with _lock:
+            if _profile_active:
+                return      # one session at a time; the op is one-shot
+            _profile_active = True
+        threading.Thread(target=_run_remote_profile,
+                         args=(dict(cmd), kv, addr),
+                         name="mxtpu-fleet-profile", daemon=True).start()
+    except Exception:       # noqa: BLE001
+        _log.debug("fleet control op dropped", exc_info=True)
+
+
+def _cap_trace_events(events, cap_bytes):
+    """Drop the oldest non-metadata events until the serialized trace
+    fits the byte cap (metadata events — clock anchors, the
+    remote_profile stamp — are load-bearing for the merge and kept)."""
+    while True:
+        payload = json.dumps({"traceEvents": events,
+                              "displayTimeUnit": "ms"})
+        if len(payload.encode("utf-8", "replace")) <= cap_bytes:
+            return payload
+        body = [i for i, ev in enumerate(events) if ev.get("ph") != "M"]
+        if not body:
+            return payload      # nothing left to trim; let the server judge
+        drop = body[:max(1, len(body) // 8)]
+        keep = set(range(len(events))) - set(drop)
+        events[:] = [ev for i, ev in enumerate(events) if i in keep]
+
+
+def _run_remote_profile(cmd, kv, addr):
+    global _profile_active
+    from . import profiler as _prof
+    from .util import getenv_int
+    tmpdir = None
+    try:
+        if _prof.is_running():
+            _log.warning("remote profile request skipped: a local "
+                         "profiling session is already running")
+            return
+        steps = max(1, min(int(cmd.get("steps", 1)),
+                           getenv_int("MXNET_FLEET_PROFILE_MAX_STEPS")))
+        max_s = max(1, getenv_int("MXNET_FLEET_PROFILE_MAX_SECONDS"))
+        tmpdir = tempfile.mkdtemp(prefix="mxtpu-fleetprof-")
+        base = os.path.join(tmpdir, "remote_profile.json")
+        prev_attr = _prof.attribution_enable(True)
+        _prof.set_config(filename=base, continuous_dump=True,
+                         dump_period=0.25)
+        _prof.start()
+        start_step = kv._local_steps
+        deadline = time.monotonic() + max_s
+        while kv._local_steps - start_step < steps \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        _prof.stop()
+        _prof.dump(finished=True)
+        _prof.attribution_enable(prev_attr)
+        events = []
+        segments = sorted(glob.glob(
+            os.path.join(tmpdir, "remote_profile*.json")))
+        for path in segments:
+            try:
+                with open(path) as f:
+                    events.extend(json.load(f).get("traceEvents", []))
+            except Exception:       # noqa: BLE001 — torn segment
+                pass
+        events.append({"name": "remote_profile", "cat": "__metadata",
+                       "ph": "M", "ts": 0, "pid": 0, "tid": 0,
+                       "args": {"rank": int(kv.rank),
+                                "request_id": int(cmd.get("id", 0)),
+                                "steps": int(kv._local_steps - start_step),
+                                "segments": len(segments)}})
+        payload = _cap_trace_events(
+            events, getenv_int("MXNET_FLEET_PROFILE_MAX_BYTES"))
+        from .base import MXNetError
+        from . import kvstore_server as _ksrv
+        client = _ksrv.connect_async_server(addr)
+        try:
+            client.call("fleet_profile_push", kv._async_gen,
+                        kv.rank, int(cmd.get("id", 0)), payload)
+        except MXNetError as e:     # server refused (oversize, bad op)
+            _log.warning("fleet profile push refused: %s", e)
+        finally:
+            client.close()
+        _bump("profile_runs")
+    except Exception:       # noqa: BLE001 — telemetry must not kill ranks
+        _log.warning("remote profile session failed", exc_info=True)
+    finally:
+        with _lock:
+            _profile_active = False
+        if tmpdir is not None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# coordinator HTTP surface (/metrics, /fleet, /alerts)
+# ---------------------------------------------------------------------------
+
+def start_http(registry, host="127.0.0.1", port=0):
+    """Serve the registry over HTTP: /metrics (coordinator-local
+    profiler families + the fleet families), /fleet, /alerts,
+    /healthz. Returns the live HTTPServer; its bound address is
+    server_address."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def _send(self, code, body, ctype):
+            data = body.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            reg = self.server.fleet_registry
+            try:
+                if self.path == "/healthz":
+                    self._send(200, "ok\n", "text/plain; charset=utf-8")
+                elif self.path == "/metrics":
+                    from . import profiler as _prof
+                    body = _prof.render_prometheus() \
+                        + reg.render_prometheus()
+                    self._send(200, body, "text/plain; version=0.0.4; "
+                                          "charset=utf-8")
+                elif self.path == "/fleet":
+                    self._send(200, json.dumps(reg.fleet_view()),
+                               "application/json")
+                elif self.path == "/alerts":
+                    self._send(200, json.dumps(reg.alerts_view()),
+                               "application/json")
+                else:
+                    self._send(404, "not found\n",
+                               "text/plain; charset=utf-8")
+            except Exception as e:      # noqa: BLE001
+                self._send(500, f"error: {e}\n",
+                           "text/plain; charset=utf-8")
+
+        def log_message(self, fmt, *args):
+            _log.debug("fleet http: " + fmt, *args)
+
+    srv = ThreadingHTTPServer((host, port), _Handler)
+    srv.daemon_threads = True
+    srv.fleet_registry = registry
+    threading.Thread(target=srv.serve_forever, name="mxtpu-fleet-http",
+                     daemon=True).start()
+    return srv
+
+
+def stop_http(srv):
+    if srv is None:
+        return
+    try:
+        srv.shutdown()
+        srv.server_close()
+    except Exception:       # noqa: BLE001
+        pass

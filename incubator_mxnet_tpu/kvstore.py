@@ -811,9 +811,33 @@ class KVStore:
             name="mxtpu-kvstore-heartbeat", daemon=True)
         self._hb_thread.start()
 
+    def _hb_beat(self):
+        """Build one heartbeat op list. With both planes off this is the
+        plain 4-element v1 beat — byte-identical on the wire to the
+        pre-fleet protocol (the zero-overhead contract fleetobs tests
+        pickle-assert)."""
+        from . import fleetobs as _fobs
+        from . import profiler as _prof
+        beat = ["heartbeat", self._async_gen,
+                self.rank, self._local_steps]
+        snap = None
+        if _fobs.enabled():
+            snap = _fobs.heartbeat_snapshot(self._local_steps)
+        if _prof.attribution_enabled() or snap is not None:
+            # v2 beat: append the last closed step's {phase: ms}
+            # vector (feeds the server's straggler report) and
+            # NTP-style clock-offset estimation off the reply
+            beat.append(_prof.last_step_phases())
+        if snap is not None:
+            # v2+fleet beat: the bounded metric snapshot the coordinator
+            # folds into its FleetRegistry
+            beat.append(snap)
+        return beat
+
     def _hb_loop(self, addr, period):
         import time
         from . import fault as _fault
+        from . import fleetobs as _fobs
         from . import kvstore_server as _ksrv
         from . import profiler as _prof
         client = None
@@ -821,13 +845,7 @@ class KVStore:
             try:
                 if client is None:
                     client = _ksrv.connect_async_server(addr)
-                beat = ["heartbeat", self._async_gen,
-                        self.rank, self._local_steps]
-                if _prof.attribution_enabled():
-                    # v2 beat: append the last closed step's {phase: ms}
-                    # vector (feeds the server's straggler report) and
-                    # NTP-style clock-offset estimation off the reply
-                    beat.append(_prof.last_step_phases())
+                beat = self._hb_beat()
                 t0 = time.time()
                 reply = client.call(*beat)
                 t1 = time.time()
@@ -839,6 +857,10 @@ class KVStore:
                             "server",
                             offset_us=(server_time - (t0 + t1) / 2.0) * 1e6,
                             rtt_us=(t1 - t0) * 1e6)
+                    if "fleet" in reply:
+                        # coordinator control op (remote profiling);
+                        # runs off-thread so the beats keep flowing
+                        _fobs.handle_command(reply["fleet"], self, addr)
                 else:
                     epoch = reply
                 _fault._bump("heartbeats_sent")

@@ -28,6 +28,13 @@ Wire ops (reference message vocabulary, kvstore_dist_server.h DataHandleEx):
                     sends the serialized optimizer to servers,
                     python/mxnet/kvstore.py:450 _send_command_to_servers)
   stats / stop    — introspection / shutdown
+  fleet_*         — fleet observability plane (fleetobs.py): heartbeat
+                    snapshots fold into a FleetRegistry; fleet_view /
+                    fleet_alerts / fleet_metrics read the aggregate,
+                    fleet_profile_request queues a remote-profile control
+                    op (delivered in the target's heartbeat reply),
+                    fleet_profile_push ships the captured trace back and
+                    fleet_profile_fetch hands it to the operator
 
 Wire security: the payload is pickle, so authentication must happen before
 a single byte is unpickled. Each side sends a random 16-byte nonce at
@@ -46,6 +53,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import logging
 import pickle
 import secrets
 import socket
@@ -159,6 +167,13 @@ class AsyncServer:
         self._stopped = threading.Event()
         self._sock = None
         self._threads = []
+        # fleet observability plane (lazy: built on the first fleet
+        # snapshot or fleet_* op, so a non-fleet server allocates
+        # nothing). Its HTTP surface starts in start() when the plane
+        # is enabled; the socket wire serves the same views either way.
+        self._fleet = None
+        self.fleet_http = None
+        self.fleet_http_addr = None
         # per-cluster shared secret: the wire is pickle, so an
         # unauthenticated peer could execute arbitrary code — every
         # connection must present this token (distributed to workers
@@ -282,19 +297,51 @@ class AsyncServer:
             # get a dict reply that also carries the server wall clock for
             # client-side clock-offset estimation (tools/trace_merge.py);
             # v1 senders keep the original 4-tuple / int-epoch shape.
-            phases = None
-            if len(msg) == 5:
-                _, gen, rank, step, phases = msg
-            else:
-                _, gen, rank, step = msg
+            # MXNET_FLEET_OBS senders append a sixth element — the bounded
+            # metric snapshot — folded into the FleetRegistry AFTER
+            # _hb_lock is released (registry lock and _hb_lock never nest);
+            # a pending control op for the rank rides back in the reply.
+            phases = snap = None
+            if len(msg) >= 5:
+                phases = msg[4]
+            if len(msg) >= 6:
+                snap = msg[5]
+            _, gen, rank, step = msg[:4]
             with self._hb_lock:
                 self._members.setdefault(gen, set()).add(rank)
                 self._liveness[(gen, rank)] = (time.monotonic(), int(step))
                 epoch = self._epoch.setdefault(gen, 1)
-                if phases is None:
+                if phases is None and snap is None:
                     return ("ok", epoch)
-                self._phase_reports[(gen, rank)] = dict(phases)
-            return ("ok", {"epoch": epoch, "server_time": time.time()})
+                if phases is not None:
+                    self._phase_reports[(gen, rank)] = dict(phases)
+            reply = {"epoch": epoch, "server_time": time.time()}
+            if snap is not None:
+                cmd = self._fleet_registry().fold(gen, rank, step, snap)
+                if cmd is not None:
+                    reply["fleet"] = cmd
+            return ("ok", reply)
+        if op == "fleet_view":
+            return ("ok", self._fleet_registry().fleet_view())
+        if op == "fleet_alerts":
+            return ("ok", self._fleet_registry().alerts_view())
+        if op == "fleet_metrics":
+            return ("ok", self._fleet_registry().render_prometheus())
+        if op == "fleet_profile_request":
+            _, gen, rank, steps = msg
+            return ("ok",
+                    self._fleet_registry().request_profile(gen, rank, steps))
+        if op == "fleet_profile_push":
+            _, gen, rank, request_id, payload = msg
+            try:
+                self._fleet_registry().store_profile(gen, rank,
+                                                     request_id, payload)
+            except ValueError as e:
+                return ("err", str(e))
+            return ("ok",)
+        if op == "fleet_profile_fetch":
+            _, gen, rank = msg
+            return ("ok", self._fleet_registry().fetch_profile(gen, rank))
         if op == "dead_nodes":
             _, gen, timeout = msg
             with self._hb_lock:
@@ -325,6 +372,15 @@ class AsyncServer:
             self._stopped.set()
             return ("ok",)
         return ("err", f"unknown op {op!r}")
+
+    def _fleet_registry(self):
+        """Lazily build the FleetRegistry (first fleet snapshot or
+        fleet_* op); cheap double-checked create — a duplicate build
+        under race is harmless, the attribute write is atomic."""
+        if self._fleet is None:
+            from . import fleetobs as _fobs
+            self._fleet = _fobs.FleetRegistry()
+        return self._fleet
 
     def _dead_locked(self, gen, timeout):
         """Registered ranks with no beat/push within `timeout` seconds,
@@ -421,6 +477,19 @@ class AsyncServer:
         t.start()
         self._threads.append(t)
         advertise = _host_ip() if bind in ("0.0.0.0", "::") else bind
+        from . import fleetobs as _fobs
+        if _fobs.enabled() and self.fleet_http is None:
+            # the coordinator's operator surface: fleet /metrics, /fleet,
+            # /alerts on an ephemeral loopback-or-bind-addr HTTP port
+            try:
+                self.fleet_http = _fobs.start_http(
+                    self._fleet_registry(), host=bind)
+                h, p = self.fleet_http.server_address[:2]
+                self.fleet_http_addr = f"{h}:{p}"
+                logging.info("fleet observability HTTP at %s",
+                             self.fleet_http_addr)
+            except OSError:
+                logging.exception("fleet HTTP endpoint failed to start")
         return f"{advertise}:{port}"
 
     def stop(self):
@@ -430,6 +499,10 @@ class AsyncServer:
                 self._sock.close()
             except OSError:
                 pass
+        if self.fleet_http is not None:
+            from . import fleetobs as _fobs
+            _fobs.stop_http(self.fleet_http)
+            self.fleet_http = None
 
 
 def _updater_key(key):

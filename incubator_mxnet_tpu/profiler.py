@@ -632,6 +632,24 @@ def last_step_phases():
         return dict(_step_phases_last)
 
 
+def phase_bounds():
+    """Upper bucket bounds (ms) of the attribution histograms — shared
+    by the local Prometheus exposition and the fleetobs cross-rank
+    aggregation (both sides must agree on the bucket layout)."""
+    return _PHASE_BOUNDS
+
+
+def phase_histograms():
+    """{phase: {"count", "sum_ms", "buckets"}} snapshot of the raw
+    per-phase histogram counts (cumulative since the last reset; the
+    final bucket is the +Inf overflow). What fleetobs ships on the
+    heartbeat — the coordinator diffs successive snapshots into
+    fleet-wide deltas."""
+    with _lock:
+        return {p: {"count": v[0], "sum_ms": v[1], "buckets": list(v[4])}
+                for p, v in _phases.items()}
+
+
 def phase_stats():
     """Snapshot of the attribution registry: {"steps", "spans",
     "phases": {phase: {count, total_ms, avg_ms, max_ms, last_ms}}}."""
@@ -1030,6 +1048,20 @@ def _fault_stats(always=False):
     return snap
 
 
+def _fleetobs_stats(always=False):
+    """Fleet-observability counters (fleetobs.stats(): snapshots built/
+    folded, SLO evaluations, alert transitions, remote-profile traffic),
+    or None when the plane saw no traffic (unless `always`)."""
+    try:
+        from . import fleetobs as _fo
+        snap = _fo.stats()
+    except Exception:       # noqa: BLE001 — torn-down interpreter
+        return None
+    if not always and not any(snap.values()):
+        return None
+    return snap
+
+
 # ---------------------------------------------------------------------------
 # dump / dumps
 # ---------------------------------------------------------------------------
@@ -1170,6 +1202,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     tune_snap = _tune_stats()
     fault_snap = _fault_stats()
     sl_snap = _shardlint_stats()
+    fleet_snap = _fleetobs_stats()
     if reset:
         # reset=True means reset: every stat family this dump reports
         # restarts, not just the event/counter/compile subset (the old
@@ -1202,6 +1235,11 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             _sl.clear(stats=True)
         except Exception:       # noqa: BLE001
             pass
+        try:
+            from . import fleetobs as _fo
+            _fo.clear(stats=True)
+        except Exception:       # noqa: BLE001
+            pass
     if format == "json":
         out = {
             "stats": {k: {"count": v[0], "total_us": _finite(v[1], 0.0),
@@ -1228,6 +1266,8 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             out["fault"] = fault_snap
         if sl_snap is not None:
             out["shardlint"] = sl_snap
+        if fleet_snap is not None:
+            out["fleetobs"] = fleet_snap
         if mem is not None:
             out["memory"] = {"live_bytes": mem["live_bytes"],
                              "peak_bytes": mem["peak_bytes"],
@@ -1315,6 +1355,11 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         for k in ("enabled", "captures", "jit", "tuned", "partition",
                   "dropped"):
             lines.append(f"{'shardlint_' + k:<34}{sl_snap[k]:>12}")
+    if fleet_snap is not None:
+        lines += ["", f"{'Fleet observability (fleetobs)':<34}{'Value':>12}",
+                  "-" * 46]
+        for k in sorted(fleet_snap):
+            lines.append(f"{'fleet_' + k:<34}{fleet_snap[k]:>12}")
     if mem is not None and (mem["live_bytes"] or mem["peak_bytes"]):
         lines += ["", f"{'Memory (device)':<48}{'Live(bytes)':>14}"
                       f"{'Peak(bytes)':>14}",
@@ -1557,6 +1602,8 @@ def render_prometheus():
              "newest step durably checkpointed"),
             ("faults_injected", "faults_injected_total", "counter",
              "MXNET_FAULT_INJECT actions fired (tests only)"),
+            ("slo_alerts", "slo_alerts_total", "counter",
+             "fleet SLO alerts raised by the fleetobs burn-rate engine"),
         )
         for stat, prom, mtype, help_text in _WORKER_FAMILIES:
             family(f"mxnet_worker_{prom}", mtype, help_text)

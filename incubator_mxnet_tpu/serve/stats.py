@@ -78,6 +78,14 @@ class LatencyHistogram:
         with self._lock:
             return self.sum / self.count if self.count else 0.0
 
+    def snapshot_state(self):
+        """Consistent raw view for exposition: {"bounds" (upper bounds,
+        seconds), "counts" (per-bucket, +1 overflow), "count", "sum"}."""
+        with self._lock:
+            return {"bounds": list(self._bounds),
+                    "counts": list(self._counts),
+                    "count": self.count, "sum": self.sum}
+
 
 class ServingStats:
     """Aggregated serving counters + histograms for one model endpoint.
@@ -214,10 +222,19 @@ class ServingStats:
 
     def render_prometheus(self):
         """Prometheus text lines for the per-bucket queue/device latency
-        split (appended to profiler.render_prometheus() at /metrics)."""
+        split (appended to profiler.render_prometheus() at /metrics).
+
+        Spec-conformant exposition: one HELP/TYPE per family with all of
+        the family's samples contiguous, the quantile gauges kept as the
+        cheap operator surface, a declared dispatches counter, and TRUE
+        histogram families (cumulative `le` buckets ending at +Inf plus
+        `_sum`/`_count`) so a scraper can do histogram_quantile() over
+        any window instead of trusting our precomputed p50/p95."""
         buckets = self.bucket_snapshot()
         if not buckets:
             return ""
+        with self._lock:
+            pairs = sorted(self._bucket_hists.items())
         lines = ["# HELP mxnet_serve_bucket_latency_ms per-bucket serving "
                  "latency split: queue_wait vs device time",
                  "# TYPE mxnet_serve_bucket_latency_ms gauge"]
@@ -228,9 +245,34 @@ class ServingStats:
                         f'mxnet_serve_bucket_latency_ms{{model="{self.name}"'
                         f',bucket="{b}",kind="{kind}",q="{q}"}} '
                         f'{row[f"{kind}_{q}_ms"]:.6g}')
+        lines += ["# HELP mxnet_serve_bucket_dispatches batched dispatches "
+                  "of each compiled bucket",
+                  "# TYPE mxnet_serve_bucket_dispatches counter"]
+        for b, row in buckets.items():
             lines.append(
                 f'mxnet_serve_bucket_dispatches{{model="{self.name}"'
                 f',bucket="{b}"}} {row["dispatches"]}')
+        for kind, idx, help_text in (
+                ("queue_wait", 0,
+                 "per-request wait for a bucket slot, in ms"),
+                ("device", 1,
+                 "batched forward/device time per dispatch, in ms")):
+            fam = f"mxnet_serve_bucket_{kind}_ms"
+            lines += [f"# HELP {fam} {help_text}",
+                      f"# TYPE {fam} histogram"]
+            for b, hs in pairs:
+                state = hs[idx].snapshot_state()
+                labels = f'model="{self.name}",bucket="{b}"'
+                cum = 0
+                for bound, n in zip(state["bounds"], state["counts"]):
+                    cum += n
+                    lines.append(f'{fam}_bucket{{{labels},'
+                                 f'le="{bound * 1e3:.6g}"}} {cum}')
+                cum += state["counts"][-1]
+                lines.append(f'{fam}_bucket{{{labels},le="+Inf"}} {cum}')
+                lines.append(f'{fam}_sum{{{labels}}} '
+                             f'{state["sum"] * 1e3:.6g}')
+                lines.append(f'{fam}_count{{{labels}}} {state["count"]}')
         return "\n".join(lines) + "\n"
 
     def table(self):

@@ -275,6 +275,37 @@ ENV_VARS = collections.OrderedDict([
     ("MXNET_FLIGHT_RECORDER_SIZE", EnvSpec(256, "int",
      "Flight-recorder ring capacity: how many recent step records and "
      "events the postmortem dump retains (oldest dropped first).")),
+    ("MXNET_FLEET_OBS", EnvSpec(False, "bool",
+     "Enable the fleet observability plane (fleetobs.py): each rank "
+     "attaches a bounded metric snapshot (phase histogram deltas, MFU, "
+     "exec-cache/tune counters, top compiler cost records) to its "
+     "authenticated kvstore heartbeat; the coordinator folds them into a "
+     "FleetRegistry serving fleet-wide /metrics, /fleet, and /alerts and "
+     "evaluates the SLO burn-rate engine. Off (the default), the "
+     "heartbeat payload is byte-identical to the non-fleet wire and no "
+     "snapshot work happens.")),
+    ("MXNET_FLEET_SNAPSHOT_INTERVAL", EnvSpec(1, "int",
+     "Attach a fleet snapshot to every Nth heartbeat (>=1). Raising it "
+     "bounds per-beat wire overhead on large fleets; intermediate beats "
+     "stay plain v2 heartbeats.")),
+    ("MXNET_FLEET_SLO_PATH", EnvSpec("", "str",
+     "Path to a fleet SLO spec file (one spec per line, '#' comments; "
+     "grammar: 'p99(queue_wait) < 50ms', 'mfu > 0.3', "
+     "'straggler_lag < 1.5x'). Empty (the default) loads the built-in "
+     "straggler_lag spec only.")),
+    ("MXNET_FLEET_SLO_INTERVAL", EnvSpec(5, "int",
+     "Seconds between SLO burn-rate evaluations at the coordinator; the "
+     "short burn window is one interval, the long window five.")),
+    ("MXNET_FLEET_PROFILE_MAX_STEPS", EnvSpec(50, "int",
+     "Upper bound on the step count a remote-profile control op may "
+     "request from a rank; larger requests are clamped.")),
+    ("MXNET_FLEET_PROFILE_MAX_SECONDS", EnvSpec(30, "int",
+     "Wall-clock cap on one remote-profile session; the rank stops and "
+     "ships whatever it captured when the cap expires before N steps.")),
+    ("MXNET_FLEET_PROFILE_MAX_BYTES", EnvSpec(4 << 20, "int",
+     "Byte cap on a shipped remote-profile trace segment; oldest events "
+     "are dropped until the JSON payload fits, and the coordinator "
+     "refuses oversized pushes outright.")),
 ])
 
 _FALSY = frozenset(("", "0", "false", "off", "no"))

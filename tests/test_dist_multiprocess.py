@@ -836,3 +836,228 @@ def test_dist_trace_spans_merge_onto_one_timeline(tmp_path):
     assert srv_push, [e.get("name") for e in evs if e.get("pid") == 1]
     linked = {e["args"]["link_span"] for e in srv_push}
     assert linked & wrk_push, (sorted(linked), sorted(wrk_push))
+
+
+# ---------------------------------------------------------------------------
+# PR 11: fleet observability plane — three ranks heartbeat bounded metric
+# snapshots to the coordinator; one rank is made a straggler by injected
+# per-step delays, the coordinator's burn-rate SLO engine pages on the lag,
+# and an on-demand remote profile of the slow rank ships back over the
+# authenticated wire, validates, and merges onto the server timeline naming
+# the injected phase.
+# ---------------------------------------------------------------------------
+
+SERVER_FLEET = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MXNET_FLEET_OBS"] = "1"
+    os.environ["MXNET_STEP_ATTRIBUTION"] = "1"
+    addrfile, httpfile, tracefile, donefile = sys.argv[1:5]
+    sys.path.insert(0, {repo!r})
+    from incubator_mxnet_tpu import profiler
+    from incubator_mxnet_tpu.kvstore_server import (_SERVER_SINGLETON,
+                                                    start_async_server)
+    profiler.set_config(filename=tracefile)
+    profiler.start()
+    addr_token = start_async_server()
+    srv = _SERVER_SINGLETON["server"]
+    assert srv.fleet_http_addr, "fleet plane on but no HTTP endpoint"
+    with open(addrfile + ".tmp", "w") as f:
+        f.write(addr_token)
+    os.replace(addrfile + ".tmp", addrfile)         # atomic publish
+    with open(httpfile + ".tmp", "w") as f:
+        f.write(srv.fleet_http_addr)
+    os.replace(httpfile + ".tmp", httpfile)
+    deadline = time.time() + 240
+    while time.time() < deadline and not os.path.exists(donefile):
+        time.sleep(0.5)
+    assert os.path.exists(donefile), "test driver never finished"
+    profiler.stop()
+    profiler.dump()
+    sys.stdout.write("SERVER_FLEET_OK\\n")
+    sys.stdout.flush()
+    os._exit(0)
+""")
+
+WORKER_FLEET = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MXNET_FLEET_OBS"] = "1"
+    os.environ["MXNET_STEP_ATTRIBUTION"] = "1"
+    addrfile, donefile, hint = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    with open(addrfile) as f:
+        os.environ["MXNET_KVSTORE_ASYNC_ADDR"] = f.read()
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import fault, profiler
+
+    if hint == 1:
+        # THE straggler: every step hits an injected input_wait delay
+        # (each spec clause fires on its n-th hit of the site)
+        fault.set_fault_spec(",".join(
+            "step@%d:delay=0.25" % i for i in range(1, 400)))
+    kv = mx.kv.create("dist_async", rank_hint=hint)
+    assert kv.rank == hint, (kv.rank, hint)
+    kv.init("w", mx.nd.zeros((4,)))
+    out = mx.nd.zeros((4,))
+    deadline = time.time() + 240
+    while not os.path.exists(donefile) and time.time() < deadline:
+        with profiler.span("input_wait"):
+            fault.inject("step")        # delay lands in a named phase
+        with profiler.span("compute"):
+            time.sleep(0.01)
+        kv.push("w", mx.nd.ones((4,)))  # advances kv._local_steps
+        kv.pull("w", out=out)
+        profiler.phase_step_end()
+    sys.stdout.write("FLEET_WORKER_OK_%d steps=%d\\n"
+                     % (kv.rank, kv._local_steps))
+    sys.stdout.flush()
+    kv.close()
+    os._exit(0)
+""")
+
+
+@pytest.mark.timeout(600)
+def test_dist_fleet_straggler_alert_and_remote_profile(tmp_path):
+    """End-to-end fleet plane on a 3-rank job with rank 1 delayed: the
+    coordinator's /metrics shows per-rank AND aggregated families, the
+    straggler-lag SLO fires at the coordinator, a remote profile of the
+    slow rank round-trips over the wire, and the merged timeline names
+    the injected slow phase."""
+    import json
+    import time
+    import urllib.request
+
+    srv_script = tmp_path / "server.py"
+    srv_script.write_text(SERVER_FLEET.format(repo=REPO))
+    wrk_script = tmp_path / "worker.py"
+    wrk_script.write_text(WORKER_FLEET.format(repo=REPO))
+    slo_file = tmp_path / "slo.txt"
+    slo_file.write_text("straggler_lag < 1.5x\n")
+    addr_file = tmp_path / "addr"
+    http_file = tmp_path / "http"
+    done_file = tmp_path / "done"
+    srv_trace = tmp_path / "server_trace.json"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_FAULT_INJECT", None)
+    env["MXNET_HEARTBEAT_INTERVAL"] = "1"
+    env["MXNET_FLEET_SLO_INTERVAL"] = "1"
+    env["MXNET_FLEET_SLO_PATH"] = str(slo_file)
+
+    server = subprocess.Popen(
+        [sys.executable, str(srv_script), str(addr_file), str(http_file),
+         str(srv_trace), str(done_file)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    workers = []
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not (
+                addr_file.exists() and http_file.exists()):
+            time.sleep(0.5)
+        assert addr_file.exists(), "server never published its address"
+        workers = [subprocess.Popen(
+            [sys.executable, str(wrk_script), str(addr_file),
+             str(done_file), str(rank)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for rank in range(3)]
+
+        from incubator_mxnet_tpu.kvstore_server import connect_async_server
+        client = connect_async_server(addr_file.read_text())
+
+        # 1) the straggler SLO fires at the coordinator
+        deadline = time.monotonic() + 120
+        firing = None
+        while time.monotonic() < deadline:
+            alerts = client.call("fleet_alerts")["alerts"]
+            firing = next((a for a in alerts
+                           if a["state"] == "firing"), None)
+            if firing is not None:
+                break
+            time.sleep(0.5)
+        assert firing is not None, "straggler SLO never fired"
+        assert firing["spec"] == "straggler_lag < 1.5x"
+        assert firing["value"] >= 1.5
+        assert firing["burn_short"] >= 0.5 and firing["burn_long"] >= 0.5
+
+        # 2) remote-profile the slow rank: request -> command rides the
+        # heartbeat reply -> rank records N steps -> pushes the trace
+        rid = client.call("fleet_profile_request", 0, 1, 3)
+        deadline = time.monotonic() + 90
+        rec = None
+        while time.monotonic() < deadline:
+            rec = client.call("fleet_profile_fetch", 0, 1)
+            if rec is not None:
+                break
+            time.sleep(0.5)
+        assert rec is not None, "remote profile never arrived"
+        assert rec["request_id"] == rid
+
+        # 3) the fleet view + metrics know all three ranks and the
+        # aggregated histogram families are spec-conformant
+        view = client.call("fleet_view")
+        assert sorted(view["ranks"]) == ["0", "1", "2"]
+        assert view["ranks"]["1"]["slow_phase"] == "input_wait", view
+        assert view["alerts_active"] >= 1
+        base = "http://" + http_file.read_text()
+        metrics = urllib.request.urlopen(base + "/metrics",
+                                         timeout=10).read().decode()
+        for fam in ('mxnet_fleet_rank_step{rank="0"}',
+                    'mxnet_fleet_rank_step{rank="1"}',
+                    'mxnet_fleet_rank_step{rank="2"}',
+                    'mxnet_fleet_rank_phase_ms{rank="1",'
+                    'phase="input_wait"}',
+                    'mxnet_fleet_phase_ms_bucket{phase="input_wait",'
+                    'le="+Inf"}',
+                    'mxnet_fleet_phase_ms_quantile{phase="input_wait",'
+                    'q="0.99"}',
+                    'mxnet_fleet_alert_firing'
+                    '{spec="straggler_lag < 1.5x"} 1'):
+            assert fam in metrics, (fam, metrics)
+        fleet_json = json.loads(urllib.request.urlopen(
+            base + "/fleet", timeout=10).read())
+        assert sorted(fleet_json["ranks"]) == ["0", "1", "2"]
+        client.close()
+
+        # wind down: workers exit, the server dumps its trace
+        done_file.write_text("done")
+        for i, w in enumerate(workers):
+            out_w, err_w = w.communicate(timeout=120)
+            assert w.returncode == 0, err_w[-2000:]
+            assert f"FLEET_WORKER_OK_{i}" in out_w, (out_w, err_w[-1000:])
+        out_s, err_s = server.communicate(timeout=60)
+        assert server.returncode == 0, err_s[-2000:]
+        assert "SERVER_FLEET_OK" in out_s
+    finally:
+        for w in workers:
+            w.kill()
+        server.kill()
+
+    # 4) the fetched trace validates against the remote-profile schema
+    # and merges onto the server timeline, naming the injected phase
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_merge
+    from validate_trace import validate_trace
+    payload = rec["trace"]
+    validate_trace(payload)
+    remote_events = json.loads(payload)["traceEvents"]
+    stamp = [e for e in remote_events if e.get("name") == "remote_profile"]
+    assert stamp and stamp[0]["args"]["rank"] == 1
+    assert stamp[0]["args"]["request_id"] == rid
+    assert stamp[0]["args"]["steps"] >= 1
+
+    merged = trace_merge.merge_traces([str(srv_trace), payload])
+    validate_trace(merged)
+    names = [e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("name") == "process_name"]
+    assert any(n.startswith("remote_profile:rank1") for n in names), names
+    # the slow rank's profiled window is dominated by the injected phase
+    by_phase = {}
+    for e in merged["traceEvents"]:
+        if e.get("pid") == 1 and e.get("ph") == "X" \
+                and str(e.get("name", "")).startswith("phase:"):
+            by_phase[e["name"]] = by_phase.get(e["name"], 0.0) + e["dur"]
+    assert by_phase, "remote trace carried no phase spans"
+    assert by_phase.get("phase:input_wait", 0.0) \
+        > by_phase.get("phase:compute", 0.0), by_phase

@@ -1,0 +1,435 @@
+"""Fleet observability plane tests (fleetobs).
+
+Covers the coordinator-side FleetRegistry fold/aggregate/alert path and
+the worker-side snapshot/control-op path in-process; the true 3-rank
+wire path lives in tests/test_dist_multiprocess.py.
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from incubator_mxnet_tpu import fleetobs, profiler
+
+
+@pytest.fixture(autouse=True)
+def _fleet_state():
+    """Each test starts with the plane off, fresh counters, and a clean
+    attribution registry."""
+    prev = profiler.attribution_enable(False)
+    fleetobs.fleet_reset()
+    fleetobs.clear(stats=True)
+    yield
+    fleetobs.fleet_reset()
+    fleetobs.clear(stats=True)
+    profiler.attribution_enable(prev)
+    profiler.dumps(reset=True)
+
+
+def _snap(step, phases=None, hist=None, mfu=None, t=None):
+    snap = {"v": 1, "t": time.time() if t is None else t, "step": step}
+    if phases is not None:
+        snap["phases"] = phases
+    if hist is not None:
+        snap["hist"] = hist
+    if mfu is not None:
+        snap["mfu"] = mfu
+    return snap
+
+
+def _hist(count, sum_ms, hot_bucket=5):
+    buckets = [0] * 31
+    buckets[hot_bucket] = count
+    return {"count": count, "sum_ms": sum_ms, "buckets": buckets}
+
+
+# ---------------------------------------------------------------------------
+# SLO spec grammar
+# ---------------------------------------------------------------------------
+
+def test_slo_spec_quantile_grammar_and_units():
+    s = fleetobs.SLOSpec.parse("p99(serve.queue_wait) < 50ms")
+    assert (s.kind, s.metric, s.q, s.op) == ("quantile", "queue_wait",
+                                             99.0, "<")
+    assert s.threshold == 50.0
+    # units normalize to ms; dotted prefixes are display sugar
+    assert fleetobs.SLOSpec.parse("p95(compute) <= 0.1s").threshold == 100.0
+    assert fleetobs.SLOSpec.parse("p50(h2d) > 500us").threshold == 0.5
+    # the good condition is stated; breach() is its negation
+    assert not s.breach(49.0)
+    assert s.breach(50.0)
+
+
+def test_slo_spec_lag_and_gauge_grammar():
+    lag = fleetobs.SLOSpec.parse("straggler_lag < 1.5x")
+    assert (lag.kind, lag.metric, lag.threshold) == ("lag",
+                                                     "straggler_lag", 1.5)
+    assert lag.breach(2.0) and not lag.breach(1.1)
+    mfu = fleetobs.SLOSpec.parse("mfu > 0.3")
+    assert (mfu.kind, mfu.metric) == ("gauge", "mfu")
+    assert mfu.breach(0.2) and not mfu.breach(0.4)
+
+
+def test_slo_spec_rejects_garbage():
+    for bad in ("p99 queue_wait < 50", "faster please", "p200(x) < 1",
+                ""):
+        with pytest.raises(ValueError):
+            fleetobs.SLOSpec.parse(bad)
+
+
+def test_load_slo_specs_file_comments_and_bad_lines(tmp_path):
+    p = tmp_path / "slo.txt"
+    p.write_text("# fleet objectives\n"
+                 "p99(queue_wait) < 50ms   # latency\n"
+                 "this line is noise\n"
+                 "mfu > 0.3\n")
+    specs = fleetobs.load_slo_specs(str(p))
+    assert [s.kind for s in specs] == ["quantile", "gauge"]
+    # unreadable file degrades to the built-in defaults
+    fallback = fleetobs.load_slo_specs(str(tmp_path / "missing.txt"))
+    assert [s.raw for s in fallback] == list(fleetobs.DEFAULT_SLO_SPECS)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate engine
+# ---------------------------------------------------------------------------
+
+def test_slo_engine_fires_on_second_eval_not_first():
+    """One bad scrape never pages (min-sample guard); a sustained breach
+    fires by the second evaluation; recovery resolves the alert."""
+    spec = fleetobs.SLOSpec.parse("straggler_lag < 1.5x")
+    eng = fleetobs.SLOEngine([spec], interval_s=1)
+    t = 1000.0
+    assert eng.evaluate({"straggler_lag": 3.0}, lambda m, q: None, t) == []
+    assert eng.active() == []
+    trans = eng.evaluate({"straggler_lag": 3.0}, lambda m, q: None, t + 1)
+    assert [(s.raw, w) for s, w, _ in trans] \
+        == [("straggler_lag < 1.5x", "firing")]
+    assert eng.active()[0]["state"] == "firing"
+    # stays firing without re-transitioning
+    assert eng.evaluate({"straggler_lag": 3.0}, lambda m, q: None,
+                        t + 2) == []
+    # sustained recovery resolves once the short window clears
+    resolved = []
+    for i in range(3, 10):
+        resolved += eng.evaluate({"straggler_lag": 1.0},
+                                 lambda m, q: None, t + i)
+        if resolved:
+            break
+    assert [w for _, w, _ in resolved] == ["resolved"]
+    assert eng.active() == []
+    assert eng.breaches_total == 3
+
+
+def test_slo_engine_skips_metrics_without_data():
+    eng = fleetobs.SLOEngine([fleetobs.SLOSpec.parse("mfu > 0.3")],
+                             interval_s=1)
+    for i in range(5):
+        assert eng.evaluate({}, lambda m, q: None, 1000.0 + i) == []
+    assert eng.breaches_total == 0
+    assert eng.view()[0]["state"] == "ok"
+
+
+def test_slo_engine_quantile_spec_uses_quantile_fn():
+    eng = fleetobs.SLOEngine(
+        [fleetobs.SLOSpec.parse("p99(queue_wait) < 50ms")], interval_s=1)
+    calls = []
+
+    def qfn(metric, q):
+        calls.append((metric, q))
+        return 80.0
+
+    eng.evaluate({}, qfn, 1000.0)
+    trans = eng.evaluate({}, qfn, 1001.0)
+    assert calls == [("queue_wait", 99.0)] * 2
+    assert [w for _, w, _ in trans] == ["firing"]
+
+
+# ---------------------------------------------------------------------------
+# worker-side snapshots
+# ---------------------------------------------------------------------------
+
+def test_build_snapshot_bounded_and_versioned():
+    profiler.attribution_enable(True)
+    for _ in range(3):
+        for p in range(20):     # more phases than the per-snapshot cap
+            profiler.observe_phase(f"ph{p:02d}", float(p + 1))
+        profiler.phase_step_end()
+    snap = fleetobs.build_snapshot(9)
+    assert snap["v"] == fleetobs.SNAPSHOT_VERSION
+    assert snap["step"] == 9
+    assert len(snap["phases"]) == fleetobs._MAX_PHASES
+    assert len(snap["hist"]) == fleetobs._MAX_PHASES
+    # top-by-time wins the budget: the heaviest phase is shipped
+    assert "ph19" in snap["phases"] and "ph00" not in snap["phases"]
+    rec = snap["hist"]["ph19"]
+    assert rec["count"] == 3 and len(rec["buckets"]) == 31
+    assert fleetobs.stats()["snapshots_built"] == 1
+    json.dumps(snap)    # wire-safe
+
+
+def test_heartbeat_snapshot_cadence(monkeypatch):
+    monkeypatch.setenv("MXNET_FLEET_SNAPSHOT_INTERVAL", "3")
+    fleetobs.fleet_enable(True)
+    got = [fleetobs.heartbeat_snapshot(i) for i in range(9)]
+    built = [g for g in got if g is not None]
+    assert len(built) == 3      # beats 0, 3, 6
+    s = fleetobs.stats()
+    assert s["snapshots_built"] == 3 and s["snapshots_skipped"] == 6
+
+
+def test_zero_overhead_when_off():
+    """The acceptance bar: with MXNET_FLEET_OBS unset the beat an
+    attribution-off worker builds is byte-identical to the pre-fleet
+    4-tuple and no snapshot is ever built."""
+    import pickle
+
+    import incubator_mxnet_tpu as mx
+    assert not fleetobs.enabled()
+    kv = mx.kv.create("local")
+    kv._rank_override = 2
+    kv._async_gen = 1
+    kv._local_steps = 17
+    beat = kv._hb_beat()
+    assert pickle.dumps(beat) == pickle.dumps(["heartbeat", 1, 2, 17])
+    assert fleetobs.stats()["snapshots_built"] == 0
+    # flipping the plane on grows the same beat to the 6-element form
+    fleetobs.fleet_enable(True)
+    beat = kv._hb_beat()
+    assert len(beat) == 6 and beat[5]["v"] == fleetobs.SNAPSHOT_VERSION
+    assert fleetobs.stats()["snapshots_built"] == 1
+
+
+# ---------------------------------------------------------------------------
+# FleetRegistry: fold, aggregate, views
+# ---------------------------------------------------------------------------
+
+def test_registry_fold_rejects_unknown_version():
+    reg = fleetobs.FleetRegistry(specs=[], interval_s=3600)
+    assert reg.fold(0, 0, 1, {"v": 99, "step": 1}) is None
+    assert reg.fold(0, 0, 1, "not a dict") is None
+    assert reg.occupancy()["ranks"] == 0
+
+
+def test_registry_step_rate_and_fleet_view():
+    reg = fleetobs.FleetRegistry(specs=[], interval_s=3600)
+    reg.fold(0, 0, 10, _snap(10, phases={"compute": 80.0, "h2d": 2.0}),
+             now=100.0)
+    reg.fold(0, 0, 20, _snap(20, phases={"compute": 80.0, "h2d": 2.0},
+                             mfu=0.42), now=102.0)
+    view = reg.fleet_view(now=103.0)
+    row = view["ranks"]["0"]
+    assert row["step"] == 20
+    assert row["step_rate"] == pytest.approx(5.0)
+    assert row["slow_phase"] == "compute"
+    assert row["mfu"] == 0.42
+    assert row["alive"] and row["snapshots"] == 2
+    # a rank silent past the live window reads as down
+    stale = reg.fleet_view(now=102.0 + reg.LIVE_WINDOW_S + 1)
+    assert not stale["ranks"]["0"]["alive"]
+
+
+def test_registry_hist_delta_fold_and_quantile():
+    """Ranks ship CUMULATIVE histograms; the registry folds successive
+    diffs, so re-sent totals don't double-count, and a count regression
+    (rank-side reset) restarts the diff base instead of going negative."""
+    reg = fleetobs.FleetRegistry(specs=[], interval_s=3600)
+    reg.fold(0, 0, 1, _snap(1, hist={"compute": _hist(4, 40.0)}), now=1.0)
+    reg.fold(0, 0, 2, _snap(2, hist={"compute": _hist(6, 60.0)}), now=2.0)
+    assert reg._fleet_hist["compute"][0] == 6     # 4 + (6-4), not 4+6
+    # second rank contributes into the same aggregate
+    reg.fold(0, 1, 2, _snap(2, hist={"compute": _hist(2, 20.0)}), now=2.0)
+    assert reg._fleet_hist["compute"][0] == 8
+    # rank reset: counts regress -> base restarts, aggregate only grows
+    reg.fold(0, 0, 3, _snap(3, hist={"compute": _hist(1, 10.0)}), now=3.0)
+    assert reg._fleet_hist["compute"][0] == 9
+    q = reg._quantile_locked("compute", 50.0)
+    bounds = profiler.phase_bounds()
+    assert bounds[4] <= q <= bounds[5]      # inside the hot log bucket
+    assert reg._quantile_locked("never_seen", 50.0) is None
+
+
+def test_registry_straggler_alert_and_breadcrumb(tmp_path, monkeypatch):
+    """A sustained straggler fires the lag SLO by the second evaluation
+    and the transition leaves fault-counter + flight-ring breadcrumbs."""
+    from incubator_mxnet_tpu import fault
+
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER", str(tmp_path))
+    fault.flight_reset()
+    fault._reset_stats()
+    reg = fleetobs.FleetRegistry(
+        specs=[fleetobs.SLOSpec.parse("straggler_lag < 1.5x")],
+        interval_s=1)
+    t = 100.0
+    # seed both ranks (the registry's very first fold runs an evaluation
+    # before the second rank even exists — no lag sample yet)
+    reg.fold(0, 0, 10, _snap(10), now=t)
+    reg.fold(0, 1, 2, _snap(2), now=t)
+    t += 1.1
+    fired = False
+    for i in range(2, 6):
+        reg.fold(0, 0, 10 * i, _snap(10 * i), now=t)
+        reg.fold(0, 1, 2 * i, _snap(2 * i), now=t)
+        t += 1.1
+        if reg.engine.active():
+            fired = True
+            # sustained breach pages by the SECOND evaluation with data
+            assert reg.engine.breaches_total == 2
+            break
+    assert fired
+    assert fleetobs.stats()["alerts_raised"] == 1
+    assert fault.stats()["slo_alerts"] == 1
+    with fault._flight_lock:
+        ring = list(fault._flight_ring or ())
+    assert any(r.get("kind") == "slo_alert" for r in ring)
+    alerts = reg.alerts_view()
+    row = alerts["alerts"][0]
+    assert row["state"] == "firing" and row["value"] >= 1.5
+    assert row["burn_short"] >= 0.5 and row["burn_long"] >= 0.5
+    fault.flight_reset()
+    fault._reset_stats()
+
+
+def test_registry_lag_needs_two_live_ranks_and_warmup():
+    reg = fleetobs.FleetRegistry(specs=[], interval_s=3600)
+    reg.fold(0, 0, 100, _snap(100), now=1.0)
+    assert "straggler_lag" not in reg._metric_values_locked(1.0)
+    # two ranks but still warming up (max step < 5): no lag metric yet
+    reg2 = fleetobs.FleetRegistry(specs=[], interval_s=3600)
+    reg2.fold(0, 0, 3, _snap(3), now=1.0)
+    reg2.fold(0, 1, 1, _snap(1), now=1.0)
+    assert "straggler_lag" not in reg2._metric_values_locked(1.0)
+    reg2.fold(0, 0, 30, _snap(30), now=2.0)
+    assert reg2._metric_values_locked(2.0)["straggler_lag"] \
+        == pytest.approx(30.0)
+
+
+def test_registry_prometheus_families_and_conformant_histogram():
+    reg = fleetobs.FleetRegistry(specs=None, interval_s=3600)
+    reg.fold(0, 0, 5, _snap(5, phases={"compute": 9.0},
+                            hist={"compute": _hist(4, 40.0)}, mfu=0.5),
+             now=1.0)
+    reg.fold(0, 1, 5, _snap(5, phases={"compute": 7.0},
+                            hist={"compute": _hist(2, 14.0)}, mfu=0.3),
+             now=1.0)
+    text = reg.render_prometheus(now=1.5)
+    assert "mxnet_fleet_ranks 2" in text
+    for fam in ('mxnet_fleet_rank_up{rank="0"} 1',
+                'mxnet_fleet_rank_step{rank="1"} 5',
+                'mxnet_fleet_rank_mfu{rank="0"} 0.5',
+                'mxnet_fleet_rank_phase_ms{rank="1",phase="compute"} 7',
+                "mxnet_fleet_slo_breaches_total 0",
+                "mxnet_fleet_alerts_active 0",
+                'mxnet_fleet_alert_firing{spec="straggler_lag < 1.5x"} 0'):
+        assert fam in text, text
+    # exposition-format conformance: one HELP/TYPE per family, family
+    # samples contiguous, histogram buckets cumulative and +Inf-closed
+    lines = text.strip().splitlines()
+    helps = [ln.split()[2] for ln in lines if ln.startswith("# HELP")]
+    assert len(helps) == len(set(helps))
+    seen_families = []
+    for ln in lines:
+        if ln.startswith("# HELP"):
+            fam = ln.split()[2]
+            assert fam not in seen_families, f"family {fam} interleaved"
+            seen_families.append(fam)
+    hist_lines = [ln for ln in lines
+                  if ln.startswith("mxnet_fleet_phase_ms_bucket")]
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in hist_lines]
+    assert counts == sorted(counts)     # cumulative
+    assert 'le="+Inf"} 6' in hist_lines[-1]
+    assert "mxnet_fleet_phase_ms_sum" in text
+    assert 'mxnet_fleet_phase_ms_count{phase="compute"} 6' in text
+    assert 'mxnet_fleet_phase_ms_quantile{phase="compute",q="0.5"}' in text
+
+
+# ---------------------------------------------------------------------------
+# remote-profile plumbing (registry side + helpers)
+# ---------------------------------------------------------------------------
+
+def test_profile_request_rides_fold_once_and_is_clamped(monkeypatch):
+    monkeypatch.setenv("MXNET_FLEET_PROFILE_MAX_STEPS", "10")
+    reg = fleetobs.FleetRegistry(specs=[], interval_s=3600)
+    rid = reg.request_profile(0, 1, steps=500)
+    cmd = reg.fold(0, 1, 1, _snap(1), now=1.0)
+    assert cmd == {"op": "profile", "id": rid, "steps": 10}
+    # one-shot: the next fold carries nothing
+    assert reg.fold(0, 1, 2, _snap(2), now=2.0) is None
+    # other ranks never see it
+    reg.request_profile(0, 1, steps=3)
+    assert reg.fold(0, 0, 1, _snap(1), now=3.0) is None
+
+
+def test_profile_store_fetch_and_oversize_refusal(monkeypatch):
+    monkeypatch.setenv("MXNET_FLEET_PROFILE_MAX_BYTES", "64")
+    reg = fleetobs.FleetRegistry(specs=[], interval_s=3600)
+    rid = reg.request_profile(0, 0, steps=1)
+    reg.store_profile(0, 0, rid, '{"traceEvents": []}')
+    rec = reg.fetch_profile(0, 0)
+    assert rec["request_id"] == rid
+    assert rec["trace"] == '{"traceEvents": []}'
+    assert reg.fetch_profile(0, 7) is None
+    with pytest.raises(ValueError, match="MXNET_FLEET_PROFILE_MAX_BYTES"):
+        reg.store_profile(0, 0, rid, "x" * 100)
+    with pytest.raises(ValueError, match="JSON string"):
+        reg.store_profile(0, 0, rid, {"traceEvents": []})
+    occ = reg.occupancy()
+    assert occ["stored_profiles"] == 1
+    assert occ["last_fetch"]["rank"] == 0
+    s = fleetobs.stats()
+    assert s["profile_pushes"] == 1 and s["profile_fetches"] == 1
+
+
+def test_cap_trace_events_drops_oldest_keeps_metadata():
+    events = [{"name": "clock_sync", "ph": "M", "ts": 0,
+               "args": {"offset_us": 0.0, "rtt_us": 1.0,
+                        "perf_anchor_us": 0.0, "wall_anchor_us": 0.0}}]
+    events += [{"name": f"phase:compute{i}", "ph": "X", "ts": i * 10.0,
+                "dur": 5.0, "pid": 0, "tid": 0} for i in range(200)]
+    payload = fleetobs._cap_trace_events(events, 4096)
+    assert len(payload.encode()) <= 4096
+    out = json.loads(payload)["traceEvents"]
+    assert any(e["ph"] == "M" for e in out)       # anchors survive
+    kept = [e for e in out if e["ph"] == "X"]
+    assert kept and kept[0]["ts"] > 0             # oldest were dropped
+
+
+def test_handle_command_drops_malformed_and_latches():
+    fleetobs.handle_command({"op": "nonsense"}, None, "addr tok")
+    fleetobs.handle_command("garbage", None, "addr tok")
+    assert fleetobs.stats()["profile_runs"] == 0
+    assert not fleetobs._profile_active
+
+
+# ---------------------------------------------------------------------------
+# coordinator HTTP surface
+# ---------------------------------------------------------------------------
+
+def test_http_endpoints_serve_registry():
+    reg = fleetobs.FleetRegistry(specs=None, interval_s=3600)
+    reg.fold(0, 0, 5, _snap(5, phases={"compute": 9.0}))
+    srv = fleetobs.start_http(reg, host="127.0.0.1", port=0)
+    try:
+        host, port = srv.server_address[:2]
+        base = f"http://{host}:{port}"
+        metrics = urllib.request.urlopen(base + "/metrics",
+                                         timeout=10).read().decode()
+        assert "mxnet_fleet_ranks 1" in metrics
+        fleet = json.loads(urllib.request.urlopen(
+            base + "/fleet", timeout=10).read())
+        assert fleet["ranks"]["0"]["step"] == 5
+        alerts = json.loads(urllib.request.urlopen(
+            base + "/alerts", timeout=10).read())
+        assert "breaches_total" in alerts
+        hz = urllib.request.urlopen(base + "/healthz", timeout=10)
+        assert hz.read() == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=10)
+    finally:
+        fleetobs.stop_http(srv)
+
+
+def test_registry_weakset_feeds_diagnose_surface():
+    reg = fleetobs.FleetRegistry(specs=[], interval_s=3600)
+    assert reg in fleetobs.registries()

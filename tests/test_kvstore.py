@@ -662,3 +662,125 @@ def test_async_heartbeat_v2_phase_reports_and_slow_phase():
         assert m["slow_phase"][r1] == "input_wait"
     finally:
         srv.stop()
+
+
+def test_async_heartbeat_fleet_snapshot_and_v1_compat():
+    """Wire evolution stays backward-compatible under the fleet plane:
+    the v1 4-tuple beat gets the bare int epoch, the v2 5-tuple the dict
+    reply, and only the 6-element fleet beat folds into the registry —
+    where a queued remote-profile command rides the reply back."""
+    import time as _time
+
+    from incubator_mxnet_tpu import fleetobs
+    from incubator_mxnet_tpu.kvstore_server import (AsyncClient,
+                                                    AsyncServer)
+
+    fleetobs.clear(stats=True)
+    srv = AsyncServer()
+    addr = srv.start()
+    try:
+        c = AsyncClient(addr, srv.token)
+        rank = c.call("register", 0, None)["rank"]
+        # v1: int epoch, nothing folded
+        assert isinstance(c.call("heartbeat", 0, rank, 1), int)
+        # v2: dict reply without a "fleet" key, still nothing folded
+        rep = c.call("heartbeat", 0, rank, 2, {"compute": 5.0})
+        assert "fleet" not in rep and "server_time" in rep
+        assert srv._fleet is None or \
+            srv._fleet.occupancy()["ranks"] == 0
+        # fleet beat: the snapshot folds, the view sees the rank
+        snap = {"v": 1, "t": _time.time(), "step": 3,
+                "phases": {"compute": 5.0}}
+        rep = c.call("heartbeat", 0, rank, 3, {"compute": 5.0}, snap)
+        assert "fleet" not in rep       # nothing queued yet
+        view = c.call("fleet_view")
+        assert view["ranks"][str(rank)]["step"] == 3
+        assert view["ranks"][str(rank)]["slow_phase"] == "compute"
+        # a profile request rides the NEXT fleet beat's reply, once
+        rid = c.call("fleet_profile_request", 0, rank, 5)
+        rep = c.call("heartbeat", 0, rank, 4, {"compute": 5.0},
+                     dict(snap, step=4))
+        assert rep["fleet"] == {"op": "profile", "id": rid, "steps": 5}
+        rep = c.call("heartbeat", 0, rank, 5, {"compute": 5.0},
+                     dict(snap, step=5))
+        assert "fleet" not in rep
+        # push -> fetch round trip over the authenticated wire
+        c.call("fleet_profile_push", 0, rank, rid,
+               '{"traceEvents": []}')
+        rec = c.call("fleet_profile_fetch", 0, rank)
+        assert rec["request_id"] == rid
+        assert rec["trace"] == '{"traceEvents": []}'
+        assert c.call("fleet_profile_fetch", 0, rank + 9) is None
+        # fleet_metrics serves the Prometheus families
+        text = c.call("fleet_metrics")
+        assert f'mxnet_fleet_rank_step{{rank="{rank}"}} 5' in text
+        # snapshot with an unknown version is refused at the fold
+        before = srv._fleet.occupancy()["ranks"]
+        c.call("heartbeat", 0, rank + 1, 1, {}, {"v": 99, "step": 1})
+        assert srv._fleet.occupancy()["ranks"] == before
+    finally:
+        fleetobs.clear(stats=True)
+        srv.stop()
+
+
+def test_async_fleet_push_oversize_refused_and_err_not_retried():
+    """The coordinator refuses oversized profile pushes with an "err"
+    reply (application error: surfaced as MXNetError, never retried)."""
+    import pytest as _pytest
+
+    from incubator_mxnet_tpu.kvstore_server import (AsyncClient,
+                                                    AsyncServer)
+
+    srv = AsyncServer()
+    addr = srv.start()
+    try:
+        c = AsyncClient(addr, srv.token)
+        big = "x" * (5 << 20)       # > MXNET_FLEET_PROFILE_MAX_BYTES
+        with _pytest.raises(mx.base.MXNetError,
+                            match="MXNET_FLEET_PROFILE_MAX_BYTES"):
+            c.call("fleet_profile_push", 0, 0, 1, big)
+        # the connection survives the refusal
+        assert c.call("fleet_profile_fetch", 0, 0) is None
+    finally:
+        srv.stop()
+
+
+def test_async_fleet_op_tampered_frame_fails_hmac():
+    """Fleet ops ride the same MAC'd frames as everything else: flip one
+    byte of a fleet_profile_push frame and the server closes the
+    connection without storing or replying."""
+    import pickle
+    import socket as _socket
+    import struct
+
+    from incubator_mxnet_tpu.kvstore_server import (AsyncServer,
+                                                    _frame_mac,
+                                                    _session_key)
+
+    srv = AsyncServer()
+    addr = srv.start()
+    try:
+        host, port = addr.rsplit(":", 1)
+        conn = _socket.create_connection((host, int(port)), timeout=10)
+        client_nonce = b"\x0b" * 16
+        conn.sendall(client_nonce)
+        server_nonce = conn.recv(16)
+        key = _session_key(srv.token, client_nonce, server_nonce)
+        payload = pickle.dumps(
+            ("fleet_profile_push", 0, 0, 1, '{"traceEvents": []}'))
+        mac = _frame_mac(key, b"C", 0, payload)
+        tampered = bytearray(payload)
+        tampered[len(payload) // 2] ^= 0xFF
+        conn.sendall(struct.pack("<Q", len(tampered)) + bytes(tampered)
+                     + mac)
+        conn.settimeout(5)
+        try:
+            reply = conn.recv(1)
+        except ConnectionError:
+            reply = b""
+        assert reply == b""             # closed, nothing unpickled
+        conn.close()
+        assert srv._fleet is None or \
+            srv._fleet.occupancy()["stored_profiles"] == 0
+    finally:
+        srv.stop()

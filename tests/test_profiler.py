@@ -684,6 +684,8 @@ def test_phase_histogram_rendered_in_prometheus():
 
 
 def test_dumps_reset_clears_attribution_and_cost_families():
+    from incubator_mxnet_tpu import fleetobs
+
     prev = profiler.attribution_enable(True)
     try:
         with profiler.span("compute"):
@@ -691,18 +693,22 @@ def test_dumps_reset_clears_attribution_and_cost_families():
         profiler.phase_step_end()
         profiler.cost_event("trainstep:reset-probe", flops=1e9,
                             bytes_accessed=1e6)
+        fleetobs._bump("snapshots_built", 2)
         payload = json.loads(profiler.dumps(reset=True, format="json"))
         assert payload["step_attribution"]["spans"] == 1
         assert payload["step_attribution"]["steps"] == 1
         assert payload["cost"]["trainstep:reset-probe"]["flops"] == 1e9
+        assert payload["fleetobs"]["snapshots_built"] == 2
         # reset means reset: the NEXT dump starts from zero for every
         # family this dump reported
         after = json.loads(profiler.dumps(format="json"))
         assert "step_attribution" not in after and "cost" not in after
+        assert "fleetobs" not in after
         assert profiler.span_records() == 0
         assert profiler.cost_stats() == {}
         assert profiler.last_step_phases() == {}
         assert profiler.mfu_stats() is None
+        assert fleetobs.stats()["snapshots_built"] == 0
     finally:
         profiler.attribution_enable(prev)
 

@@ -102,6 +102,7 @@ def test_diagnose_runs():
                     "Executable Cache (compile_cache)",
                     "Kernel Autotuner (tune)", "Fault Tolerance (fault)",
                     "Step Breakdown (profiler attribution)",
+                    "Fleet Observability (fleetobs)",
                     "Static Analysis (mxlint)",
                     "Graph Analysis (shardlint)"):
         assert section in r.stdout, f"missing section {section!r}"
@@ -217,3 +218,56 @@ def test_trace_merge_cli(tmp_path):
     r = _run([os.path.join(TOOLS, "trace_merge.py"), c, "-o", out])
     assert r.returncode == 1
     assert "clock_sync" in r.stderr
+
+
+def _remote_profile_meta(rank=1, request_id=3, steps=5, segments=2):
+    return {"name": "remote_profile", "ph": "M", "ts": 0, "pid": 0,
+            "tid": 0, "cat": "__metadata",
+            "args": {"rank": rank, "request_id": request_id,
+                     "steps": steps, "segments": segments}}
+
+
+def test_validate_trace_remote_profile_schema():
+    from validate_trace import TraceFormatError
+    ok = {"traceEvents": [_remote_profile_meta(),
+                          _span_event(1.0, 1),
+                          _anchor("self", 0.0, 0.0)]}
+    assert validate_trace(ok) == 3
+    for bad_args in ({"rank": -1, "request_id": 3, "steps": 5,
+                      "segments": 2},
+                     {"rank": 1, "request_id": 0, "steps": 5,
+                      "segments": 2},
+                     {"rank": 1, "request_id": 3, "steps": "5",
+                      "segments": 2},
+                     {"rank": 1, "request_id": 3, "steps": 5},
+                     None):
+        ev = _remote_profile_meta()
+        if bad_args is None:
+            del ev["args"]
+        else:
+            ev["args"] = bad_args
+        with pytest.raises(TraceFormatError, match="remote_profile"):
+            validate_trace({"traceEvents": [ev]})
+
+
+def test_trace_merge_accepts_remote_profile_json_string(tmp_path):
+    """A fetched remote-profile payload (a raw JSON string, never a
+    file) merges next to an on-disk coordinator trace and is labelled
+    by the rank that shipped it."""
+    import json
+    srv = _write_trace(tmp_path / "server.json",
+                       [_span_event(1000.0, 1, trace="ts"),
+                        _anchor("self", 0.0, 0.0)])
+    remote = json.dumps({"traceEvents": [
+        _span_event(2000.0, 1, trace="tr"),
+        _anchor("self", 0.0, 0.0),
+        _remote_profile_meta(rank=2, request_id=7)]})
+    merged = trace_merge.merge_traces([srv, remote])
+    validate_trace(merged)
+    names = [e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("name") == "process_name"]
+    assert any("server.json" in n for n in names)
+    assert any(n.startswith("remote_profile:rank2") for n in names), names
+    spans = {e["args"]["trace"]: e for e in merged["traceEvents"]
+             if e.get("ph") == "X"}
+    assert spans["tr"]["pid"] == 1

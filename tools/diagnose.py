@@ -172,6 +172,43 @@ def main():
     except Exception as e:
         print("step breakdown probe FAILED:", e)
 
+    print("----------Fleet Observability (fleetobs)----------")
+    try:
+        from incubator_mxnet_tpu import fleetobs
+        print("plane        :", "on" if fleetobs.enabled()
+              else "off (MXNET_FLEET_OBS unset)")
+        s = fleetobs.stats()
+        print("snapshots    :",
+              {k.replace("snapshots_", ""): s[k] for k in
+               ("snapshots_built", "snapshots_skipped",
+                "snapshots_folded")})
+        print("slo engine   :",
+              {k: s[k] for k in ("slo_evals", "alerts_raised",
+                                 "alerts_resolved")})
+        print("profiling    :",
+              {k.replace("profile_", ""): s[k] for k in
+               ("profile_requests", "profile_runs", "profile_pushes",
+                "profile_fetches", "profile_bytes")})
+        regs = fleetobs.registries()
+        if not regs:
+            print("registries   : (none live in this process)")
+        for reg in regs:
+            occ = reg.occupancy()
+            print("registry     :",
+                  {k: occ[k] for k in ("ranks", "phases",
+                                       "pending_commands",
+                                       "stored_profiles",
+                                       "alerts_active")})
+            for alert in reg.engine.active():
+                print(f"  ALERT {alert['spec']} value={alert['value']} "
+                      f"burn={alert['burn_short']}/{alert['burn_long']}")
+            lf = occ["last_fetch"]
+            if lf:
+                print(f"  last fetch : rank {lf['rank']} gen {lf['gen']} "
+                      f"req {lf['request_id']}")
+    except Exception as e:
+        print("fleetobs probe FAILED:", e)
+
     print("----------Static Analysis (mxlint)----------")
     try:
         from tools.mxlint import lint_paths

@@ -35,6 +35,13 @@ LOCK_ORDER = {
     "shardlint.py": ("_lock",),
     "serve/batcher.py": ("self._lock",),
     "serve/stats.py": ("self._lock",),
+    # fleetobs: a FleetRegistry's instance lock guards the per-rank fold
+    # state, SLO engine, control-op queue, and stored profiles; the
+    # module lock is a LEAF guarding the counter registry and the
+    # worker-side beat-cadence/profile-latch state. fold() bumps module
+    # counters and fires alert side effects (fault._bump/flight_record)
+    # only AFTER releasing the registry lock.
+    "fleetobs.py": ("self._lock", "_lock"),
     "serve/predictor.py": ("self._compile_lock",),
     # kvstore_server: update lock outermost (it serializes pushes, like
     # the reference's executor queue); the heartbeat/liveness registry

@@ -43,15 +43,24 @@ class MergeError(ValueError):
     """Input trace cannot be placed on the shared timeline."""
 
 
-def _load_events(path):
-    with open(path) as f:
-        trace = json.load(f)
+def _load_events(trace):
+    """Events of one input: a file path, an already-parsed trace dict,
+    or a JSON string (fleetobs remote-profile payloads fetched over the
+    kvstore wire merge without a temp-file round trip)."""
+    label = "<trace>"
+    if isinstance(trace, str):
+        if trace.lstrip().startswith(("{", "[")):
+            trace = json.loads(trace)
+        else:
+            label = trace
+            with open(trace) as f:
+                trace = json.load(f)
     if isinstance(trace, list):
-        return trace
+        return trace, label
     events = trace.get("traceEvents") if isinstance(trace, dict) else None
     if not isinstance(events, list):
-        raise MergeError(f"{path}: top level has no traceEvents list")
-    return events
+        raise MergeError(f"{label}: top level has no traceEvents list")
+    return events, label
 
 
 def best_clock_sync(events):
@@ -76,17 +85,17 @@ def best_clock_sync(events):
 
 
 def merge_traces(paths, allow_unsynced=False):
-    """Merge per-process trace files into one timeline dict. Raises
-    MergeError when a file has no clock_sync anchor (pass
-    allow_unsynced=True to keep such a file on its raw timebase,
-    origin-aligned only)."""
+    """Merge per-process traces (file paths, parsed dicts, or JSON
+    strings) into one timeline dict. Raises MergeError when an input
+    has no clock_sync anchor (pass allow_unsynced=True to keep it on
+    its raw timebase, origin-aligned only)."""
     merged = []
     for pid, path in enumerate(paths):
-        events = _load_events(path)
+        events, label = _load_events(path)
         sync = best_clock_sync(events)
         if sync is None and not allow_unsynced:
             raise MergeError(
-                f"{path}: no clock_sync sample; run with "
+                f"{label}: no clock_sync sample; run with "
                 "MXNET_STEP_ATTRIBUTION=1 so dumps carry a clock anchor, "
                 "or pass --allow-unsynced")
         shift = 0.0
@@ -104,7 +113,15 @@ def merge_traces(paths, allow_unsynced=False):
             if isinstance(t, str):
                 trace_ids.add(t)
             merged.append(e)
-        label = os.path.basename(path)
+        rp = next((ev for ev in events if ev.get("ph") == "M"
+                   and ev.get("name") == "remote_profile"
+                   and isinstance(ev.get("args"), dict)), None)
+        if label != "<trace>":
+            label = os.path.basename(label)
+        elif rp is not None:
+            label = f"remote_profile:rank{rp['args'].get('rank')}"
+        else:
+            label = f"trace{pid}"
         if trace_ids:
             label += f" [{', '.join(sorted(trace_ids))}]"
         merged.append({"name": "process_name", "ph": "M", "pid": pid,

@@ -26,6 +26,9 @@ from tools/trace_merge.py):
     tolerated — the child exits before the parent books itself)
   * "clock_sync" metadata events carry numeric offset_us / rtt_us /
     perf_anchor_us / wall_anchor_us (what trace_merge aligns clocks with)
+  * "remote_profile" metadata events (stamped by fleetobs on traces a
+    rank ships back over the kvstore wire) carry an int rank >= 0, a
+    positive int request_id, and int steps/segments >= 0
 
 Usable as a library (`validate_trace(path_or_dict)` returns the event
 count, raises TraceFormatError) or a CLI (`python tools/validate_trace.py
@@ -89,6 +92,21 @@ def _check_event(i, ev):
 _SPAN_TOL_US = 5.0
 _CLOCK_SYNC_ARGS = ("offset_us", "rtt_us", "perf_anchor_us",
                     "wall_anchor_us")
+_REMOTE_PROFILE_INTS = ("rank", "request_id", "steps", "segments")
+
+
+def _check_remote_profile(i, ev):
+    args = ev.get("args")
+    if not isinstance(args, dict):
+        _fail(i, ev, "remote_profile event needs args")
+    for k in _REMOTE_PROFILE_INTS:
+        v = args.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            _fail(i, ev, f"remote_profile args[{k!r}] not a non-negative "
+                         f"int: {v!r}")
+    if args["request_id"] <= 0:
+        _fail(i, ev, f"remote_profile request_id must be positive: "
+                     f"{args['request_id']!r}")
 
 
 def _check_spans(events):
@@ -97,6 +115,9 @@ def _check_spans(events):
     spans = {}      # (pid, trace, span_id) -> (ts, ts_end)
     children = []
     for i, ev in enumerate(events):
+        if ev.get("ph") == "M" and ev.get("name") == "remote_profile":
+            _check_remote_profile(i, ev)
+            continue
         if ev.get("ph") == "M" and ev.get("name") == "clock_sync":
             args = ev.get("args")
             if not isinstance(args, dict):
