@@ -113,7 +113,11 @@ class FaultInjector:
     Sites wired in-tree: ``push`` (every kvstore push), ``frame_send`` /
     ``frame_recv`` (every authenticated dist_async wire frame),
     ``step`` (every TrainStep call), ``ckpt_write`` (every background
-    checkpoint write). Empty spec = zero per-call overhead.
+    checkpoint write), ``route`` (every router HTTP attempt against a
+    serving replica — drop exercises retry/breaker, delay exercises
+    hedging), ``rollout`` (every RolloutManager wave — kill is the
+    mid-rollout operator death, delay a wedged wave). Empty spec =
+    zero per-call overhead.
     """
 
     def __init__(self, spec=None):

@@ -49,7 +49,7 @@ from collections import deque
 __all__ = ["enabled", "fleet_enable", "fleet_reset", "stats", "clear",
            "build_snapshot", "heartbeat_snapshot", "handle_command",
            "SLOSpec", "SLOEngine", "load_slo_specs", "FleetRegistry",
-           "registries", "start_http", "stop_http"]
+           "registries", "start_http", "stop_http", "rollout_alert"]
 
 _log = logging.getLogger("incubator_mxnet_tpu.fleetobs")
 
@@ -71,6 +71,8 @@ _counters = {
     "profile_pushes": 0,        # coordinator: trace segments received
     "profile_fetches": 0,       # coordinator: stored traces handed out
     "profile_bytes": 0,         # coordinator: trace bytes received
+    "rollout_alerts": 0,        # serving control plane: SLO-gated
+    #                             rollout rollbacks and kindred events
 }
 
 # worker-side state: heartbeat cadence + one-profile-at-a-time latch
@@ -883,14 +885,29 @@ def _run_remote_profile(cmd, kv, addr):
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def rollout_alert(name, **data):
+    """Record a serving-rollout alert event (the control plane calls
+    this on SLO-gated rollbacks): bumps the ``rollout_alerts`` counter
+    and leaves a flight-recorder breadcrumb so a post-incident dump
+    shows WHY traffic moved back."""
+    _bump("rollout_alerts")
+    from . import fault as _fault
+    _fault.flight_record("rollout_alert", alert=name, **data)
+    _log.warning("rollout alert %s: %s", name, data)
+
+
 # ---------------------------------------------------------------------------
 # coordinator HTTP surface (/metrics, /fleet, /alerts)
 # ---------------------------------------------------------------------------
 
-def start_http(registry, host="127.0.0.1", port=0):
+def start_http(registry, host="127.0.0.1", port=0, ready_fn=None):
     """Serve the registry over HTTP: /metrics (coordinator-local
     profiler families + the fleet families), /fleet, /alerts,
-    /healthz. Returns the live HTTPServer; its bound address is
+    /healthz (LIVENESS: 200 while the process answers at all) and
+    /readyz (READINESS: gated on ``ready_fn`` when provided — e.g. a
+    ModelServer's ``readiness`` composite of warm buckets + registered
+    + not draining — else ready once the registry exists, which it does
+    here). Returns the live HTTPServer; its bound address is
     server_address."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -908,6 +925,13 @@ def start_http(registry, host="127.0.0.1", port=0):
             try:
                 if self.path == "/healthz":
                     self._send(200, "ok\n", "text/plain; charset=utf-8")
+                elif self.path == "/readyz":
+                    fn = self.server.ready_fn
+                    ready, why = (True, []) if fn is None else fn()
+                    self._send(200 if ready else 503,
+                               json.dumps({"ready": bool(ready),
+                                           "why": list(why)}),
+                               "application/json")
                 elif self.path == "/metrics":
                     from . import profiler as _prof
                     body = _prof.render_prometheus() \
@@ -933,6 +957,7 @@ def start_http(registry, host="127.0.0.1", port=0):
     srv = ThreadingHTTPServer((host, port), _Handler)
     srv.daemon_threads = True
     srv.fleet_registry = registry
+    srv.ready_fn = ready_fn
     threading.Thread(target=srv.serve_forever, name="mxtpu-fleet-http",
                      daemon=True).start()
     return srv

@@ -35,6 +35,14 @@ Wire ops (reference message vocabulary, kvstore_dist_server.h DataHandleEx):
                     op (delivered in the target's heartbeat reply),
                     fleet_profile_push ships the captured trace back and
                     fleet_profile_fetch hands it to the operator
+  serve_*         — serving control plane (serve/control_plane.py):
+                    ModelServer replicas serve_register
+                    (model, generation, buckets, http_addr), refresh
+                    liveness + readiness with serve_beat, and
+                    serve_deregister on drain; routers pull the ready
+                    set with serve_view. Rides the same MAC'd wire, so
+                    replica registration inherits the cluster trust
+                    boundary
 
 Wire security: the payload is pickle, so authentication must happen before
 a single byte is unpickled. Each side sends a random 16-byte nonce at
@@ -55,6 +63,7 @@ import hashlib
 import hmac
 import logging
 import pickle
+import random
 import secrets
 import socket
 import struct
@@ -174,6 +183,9 @@ class AsyncServer:
         self._fleet = None
         self.fleet_http = None
         self.fleet_http_addr = None
+        # serving control plane (lazy like _fleet: built on the first
+        # serve_* op, so a training-only server allocates nothing)
+        self._serve = None
         # per-cluster shared secret: the wire is pickle, so an
         # unauthenticated peer could execute arbitrary code — every
         # connection must present this token (distributed to workers
@@ -368,6 +380,21 @@ class AsyncServer:
                                "workers": members, "dead": dead,
                                "stragglers": stragglers, "steps": steps,
                                "phases": phases, "slow_phase": slow_phase})
+        if op == "serve_register":
+            _, model, replica_id, generation, buckets, http_addr = msg
+            return ("ok", self._serve_registry().register(
+                model, replica_id, generation, buckets, http_addr))
+        if op == "serve_beat":
+            _, model, replica_id, generation, ready, draining = msg
+            return ("ok", self._serve_registry().beat(
+                model, replica_id, generation, ready, draining))
+        if op == "serve_deregister":
+            _, model, replica_id = msg
+            return ("ok", self._serve_registry().deregister(
+                model, replica_id))
+        if op == "serve_view":
+            _, model = msg
+            return ("ok", self._serve_registry().view(model))
         if op == "stop":
             self._stopped.set()
             return ("ok",)
@@ -381,6 +408,14 @@ class AsyncServer:
             from . import fleetobs as _fobs
             self._fleet = _fobs.FleetRegistry()
         return self._fleet
+
+    def _serve_registry(self):
+        """Lazily build the serving-replica registry (first serve_* op);
+        same cheap double-checked create as _fleet_registry."""
+        if self._serve is None:
+            from .serve.control_plane import ServeRegistry
+            self._serve = ServeRegistry()
+        return self._serve
 
     def _dead_locked(self, gen, timeout):
         """Registered ranks with no beat/push within `timeout` seconds,
@@ -520,7 +555,11 @@ class AsyncClient:
     MXNET_KVSTORE_CALL_TIMEOUT on the socket, and both paths retry up to
     MXNET_KVSTORE_RETRIES times over a FRESH connection with exponential
     backoff (MXNET_KVSTORE_RETRY_BACKOFF_MS initial, doubling, capped at
-    10s) before raising a clear MXNetError naming the budget spent.
+    10s) before raising a clear MXNetError naming the budget spent. Each
+    client jitters its schedule by a per-client uniform [0.5, 1.5)
+    factor (MXNET_KVSTORE_RETRY_JITTER to disable): after a coordinator
+    restart a whole fleet would otherwise redial in lockstep at exactly
+    backoff * 2^k — the thundering herd the jitter de-synchronizes.
 
     At-least-once caveat: a call that timed out may still have been
     applied by the server before the retry lands (e.g. a push counted
@@ -530,7 +569,7 @@ class AsyncClient:
     """
 
     def __init__(self, addr, token):
-        from .util import getenv_int
+        from .util import getenv_bool, getenv_int
         self._addr = addr
         self._token = token
         self._lock = threading.Lock()
@@ -541,6 +580,10 @@ class AsyncClient:
         self._retries = max(0, getenv_int("MXNET_KVSTORE_RETRIES"))
         self._backoff_ms = max(
             1, getenv_int("MXNET_KVSTORE_RETRY_BACKOFF_MS"))
+        # per-client RNG (os.urandom-seeded): two clients built in the
+        # same instant must still draw different retry schedules
+        self._rng = random.Random() \
+            if getenv_bool("MXNET_KVSTORE_RETRY_JITTER") else None
         with self._lock:
             last = None
             for attempt in range(self._retries + 1):
@@ -559,7 +602,10 @@ class AsyncClient:
                 f"MXNET_KVSTORE_RETRIES={self._retries}): {last!r}")
 
     def _backoff_s(self, attempt):
-        return min(10.0, self._backoff_ms / 1e3 * (2 ** (attempt - 1)))
+        base = min(10.0, self._backoff_ms / 1e3 * (2 ** (attempt - 1)))
+        if self._rng is None:
+            return base
+        return min(10.0, base * self._rng.uniform(0.5, 1.5))
 
     def _close_locked(self):
         if self._sock is not None:

@@ -18,6 +18,13 @@ shape-bucketing discipline):
   stats.py      ServingStats — p50/p95/p99 histograms, queue/shed/
                 occupancy counters, published via profiler.Counter so
                 profiler.dumps() shows the serving table.
+  control_plane.py  ServeRegistry / ReplicaAgent / RolloutManager —
+                coordinator-side replica registry over the kvstore v2
+                wire, replica-side heartbeat agent, and zero-downtime
+                generation rollout with SLO-gated automatic rollback.
+  router.py     Router — client-side load balancing across ready
+                replicas with deadlines, jittered retries, hedged
+                requests, and per-replica circuit breakers.
 
 Typical use::
 
@@ -32,7 +39,11 @@ from .predictor import BucketLadder, Predictor
 from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
 from .server import ModelServer
 from .stats import LatencyHistogram, ServingStats
+from .control_plane import ReplicaAgent, RolloutManager, ServeRegistry
+from .router import NoReplicaAvailable, RouteError, Router, RouterStats
 
 __all__ = ["Predictor", "BucketLadder", "DynamicBatcher", "ModelServer",
            "ServingStats", "LatencyHistogram", "Overloaded",
-           "DeadlineExceeded"]
+           "DeadlineExceeded", "ServeRegistry", "ReplicaAgent",
+           "RolloutManager", "Router", "RouterStats", "RouteError",
+           "NoReplicaAvailable"]

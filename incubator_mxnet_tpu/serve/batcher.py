@@ -90,6 +90,12 @@ class DynamicBatcher:
         self._thread = None
         self._running = False
         self._lock = threading.Lock()
+        # drain support (control plane / graceful shutdown): pause()
+        # closes admission (submit sheds with a retryable Overloaded so
+        # routers reroute), quiesce() waits for the queue + the in-flight
+        # batch to flush, swap_predict() retargets the dispatch loop.
+        self._accepting = True
+        self._pause_reason = ""
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
@@ -127,16 +133,60 @@ class DynamicBatcher:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 return
+            self._queue.task_done()
             if req is not _STOP:
                 req.future.set_exception(err)
+
+    # -- drain hooks (rollout / graceful shutdown) ----------------------
+    @property
+    def accepting(self):
+        return self._accepting
+
+    def pause(self, reason="draining"):
+        """Close admission: every subsequent submit() sheds with a
+        retryable Overloaded naming `reason`. Queued and in-flight
+        requests still complete — pause starts a drain, it does not
+        cancel anything."""
+        self._pause_reason = reason
+        self._accepting = False
+
+    def resume(self):
+        self._accepting = True
+
+    def quiesce(self, timeout=None):
+        """Wait until the admission queue is empty AND no batch is in
+        flight (pause() first, or new arrivals can starve this forever).
+        Tracked through the queue's unfinished-task count — task_done is
+        only called AFTER a batch's futures resolve, so there is no
+        popped-but-not-yet-dispatching race window. Returns True when
+        drained, False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._queue.unfinished_tasks:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+    def swap_predict(self, predict):
+        """Atomically retarget the dispatch loop at a new predict
+        callable (zero-downtime weight swap: the attribute store is
+        atomic, and _run_group reads it once per batch — an in-flight
+        batch finishes on the generation it started with)."""
+        self._predict = predict
 
     # -- admission ------------------------------------------------------
     def submit(self, inputs, deadline_ms=None):
         """Enqueue one request; returns a Future resolving to the list of
         per-sample outputs. Raises Overloaded when the admission queue is
-        full (retryable — the caller should back off)."""
+        full or paused for drain (retryable — the caller should back
+        off / reroute)."""
         if not self._running:
             raise MXNetError("batcher not started")
+        if not self._accepting:
+            self.stats.incr("shed_draining")
+            raise Overloaded(
+                f"admission paused ({self._pause_reason or 'draining'}); "
+                "retry against another replica")
         deadline = None
         if deadline_ms is not None:
             deadline = time.monotonic() + deadline_ms / 1e3
@@ -168,8 +218,10 @@ class DynamicBatcher:
                     return
                 continue
             if first is _STOP:
+                self._queue.task_done()
                 return
             batch = [first]
+            stop_after = False
             window_end = first.enqueue_t + self._max_latency
             while len(batch) < self._max_batch:
                 wait = window_end - time.monotonic()
@@ -179,10 +231,19 @@ class DynamicBatcher:
                 except queue.Empty:
                     break
                 if item is _STOP:
-                    self._dispatch(batch)
-                    return
+                    self._queue.task_done()
+                    stop_after = True
+                    break
                 batch.append(item)
-            self._dispatch(batch)
+            try:
+                self._dispatch(batch)
+            finally:
+                # quiesce() keys off unfinished_tasks: a request counts
+                # until its future is RESOLVED, not merely popped
+                for _ in batch:
+                    self._queue.task_done()
+            if stop_after:
+                return
 
     def _bucket_for(self, n):
         for s in self._buckets:

@@ -1,0 +1,502 @@
+"""Fleet serving control plane: replica registry, heartbeat agent,
+zero-downtime rollouts.
+
+The reference framework stopped at a single predict process (c_predict
+embedded into user binaries); the fleet shape — N ModelServer replicas
+behind a liveness-checked coordinator, rolling weight updates with zero
+failed requests — composes three planes this repo already ships:
+
+* the MAC'd dist_async wire (kvstore_server.py) carries registration:
+  replicas ``serve_register (model, generation, buckets, http_addr)``
+  with the coordinator and refresh liveness + readiness with
+  ``serve_beat``, so replica membership inherits the cluster trust
+  boundary instead of inventing a second discovery protocol;
+* the AOT executable cache (compile_cache + ``Predictor.warmup``)
+  defines READINESS: a replica advertises ready only when every ladder
+  bucket is warm, so the router never sends traffic into an XLA trace;
+* the fleet observability plane (fleetobs.py) defines HEALTH
+  (``LIVE_WINDOW`` liveness from heartbeat age) and gates rollouts (the
+  SLO burn-rate engine firing during a wave triggers auto-rollback).
+
+Three roles live here:
+
+``ServeRegistry``   coordinator-side table of serving replicas, owned by
+                    AsyncServer (lazily, like its FleetRegistry) and
+                    exposed over the serve_* wire ops.
+``ReplicaAgent``    replica-side registration + heartbeat loop wrapping
+                    a ModelServer; deregisters on drain.
+``RolloutManager``  operator-side zero-downtime weight update: prewarm
+                    the new generation against the disk cache, shift
+                    traffic in waves through each replica's drain-swap
+                    admin endpoint, consult the SLO gate between waves,
+                    roll every updated replica back if it fires.
+
+Lock discipline: each role has one instance ``self._lock``; the module
+``_lock`` guarding the counter registry is a LEAF (never held while
+calling out). Flight-recorder breadcrumbs and counter bumps happen
+AFTER instance locks are released (the fleetobs discipline).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from .. import fault as _fault
+from ..base import MXNetError
+from ..util import getenv_bool, getenv_int
+
+__all__ = ["ServeRegistry", "ReplicaAgent", "RolloutManager"]
+
+_log = logging.getLogger("incubator_mxnet_tpu.serve.control_plane")
+
+# -- module counter registry (diagnose.py Control Plane section) -----------
+_lock = threading.Lock()
+_counters = {
+    "registrations": 0,         # serve_register ops handled
+    "deregistrations": 0,       # serve_deregister ops handled
+    "beats": 0,                 # serve_beat ops handled
+    "rollouts_started": 0,      # RolloutManager.rollout entered
+    "rollout_waves": 0,         # waves completed (incl. the one rolled back)
+    "rollout_replicas_updated": 0,
+    "rollout_replica_failures": 0,  # reload attempts that errored
+    "rollbacks": 0,             # SLO-gated automatic rollbacks
+    "graceful_shutdowns": 0,    # ModelServer drain-then-stop sequences
+}
+
+
+def _bump(name, n=1):
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def stats():
+    with _lock:
+        return dict(_counters)
+
+
+def clear():
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+
+
+def _live_window_s():
+    from .. import fleetobs as _fobs
+    return _fobs.FleetRegistry.LIVE_WINDOW_S
+
+
+def _http_json(addr, path, payload=None, timeout=10.0):
+    """Tiny JSON-over-HTTP helper for replica admin endpoints. Raises
+    urllib.error.HTTPError (status) / URLError (connect) on failure."""
+    data = None if payload is None else json.dumps(payload).encode("utf-8")
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=data,
+        headers={"Content-Type": "application/json"},
+        method="POST" if data is not None else "GET")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# coordinator side
+# ---------------------------------------------------------------------------
+
+class ServeRegistry:
+    """Coordinator-side serving-replica table.
+
+    One row per (model, replica_id): generation, bucket ladder, HTTP
+    address, readiness (replica-reported: warm + registered + not
+    draining) and liveness (beat age within the fleetobs LIVE_WINDOW,
+    judged by THIS host's monotonic clock — same rule as the training
+    liveness registry). ``view()`` is what routers poll; it never blocks
+    on anything but the registry lock.
+    """
+
+    def __init__(self, live_window_s=None):
+        self._lock = threading.Lock()
+        self._replicas = {}     # (model, rid) -> row dict
+        self._next_id = 0
+        self._epoch = 0         # bumps on register/deregister
+        self._live_window = (live_window_s if live_window_s is not None
+                             else _live_window_s())
+
+    def register(self, model, replica_id, generation, buckets, http_addr):
+        with self._lock:
+            if replica_id is None:
+                replica_id = f"r{self._next_id}"
+                self._next_id += 1
+            self._replicas[(model, replica_id)] = {
+                "generation": int(generation),
+                "buckets": tuple(int(b) for b in (buckets or ())),
+                "http_addr": str(http_addr),
+                "ready": False,     # readiness arrives with the first beat
+                "draining": False,
+                "seen_mono": time.monotonic(),
+            }
+            self._epoch += 1
+            epoch = self._epoch
+        _bump("registrations")
+        _fault.flight_record("serve_register", model=model,
+                             replica=replica_id, generation=int(generation),
+                             http_addr=str(http_addr))
+        return {"replica_id": replica_id, "epoch": epoch}
+
+    def beat(self, model, replica_id, generation, ready, draining=False):
+        with self._lock:
+            row = self._replicas.get((model, replica_id))
+            if row is None:
+                # coordinator restarted / replica predates this registry:
+                # tell the agent to re-register (it keeps its id)
+                return {"registered": False, "epoch": self._epoch}
+            row["generation"] = int(generation)
+            row["ready"] = bool(ready)
+            row["draining"] = bool(draining)
+            row["seen_mono"] = time.monotonic()
+            epoch = self._epoch
+        _bump("beats")
+        return {"registered": True, "epoch": epoch}
+
+    def deregister(self, model, replica_id):
+        with self._lock:
+            row = self._replicas.pop((model, replica_id), None)
+            if row is not None:
+                self._epoch += 1
+            epoch = self._epoch
+        if row is not None:
+            _bump("deregistrations")
+            _fault.flight_record("serve_deregister", model=model,
+                                 replica=replica_id)
+        return {"removed": row is not None, "epoch": epoch}
+
+    def view(self, model=None):
+        """Routing view: every row plus computed ``live`` and ``age_s``.
+        model=None returns all models (operator surface)."""
+        now = time.monotonic()
+        with self._lock:
+            replicas = {}
+            for (m, rid), row in self._replicas.items():
+                if model is not None and m != model:
+                    continue
+                age = now - row["seen_mono"]
+                replicas[rid] = {
+                    "model": m,
+                    "generation": row["generation"],
+                    "buckets": list(row["buckets"]),
+                    "http_addr": row["http_addr"],
+                    "ready": row["ready"],
+                    "draining": row["draining"],
+                    "live": age <= self._live_window,
+                    "age_s": round(age, 3),
+                }
+            return {"epoch": self._epoch, "replicas": replicas}
+
+
+# ---------------------------------------------------------------------------
+# replica side
+# ---------------------------------------------------------------------------
+
+class ReplicaAgent:
+    """Registers a ModelServer with the coordinator and keeps beating.
+
+    The beat carries (generation, ready, draining) — readiness is the
+    server's composite gate (every bucket AOT-warm, registered, not
+    draining), so the registry's view and the replica's /readyz endpoint
+    answer from the same truth. A beat answered with registered=False
+    (coordinator restart) re-registers under the same replica_id. The
+    loop never crashes on a missed beat — like the training heartbeat
+    sender, missed beats ARE the death signal.
+    """
+
+    def __init__(self, server, coordinator, model="default", period_s=None):
+        self._server = server
+        self._coordinator = coordinator     # "addr token" string
+        self.model = model
+        self._period = (period_s if period_s is not None
+                        else max(1, getenv_int("MXNET_HEARTBEAT_INTERVAL")))
+        self.replica_id = None
+        self.registered = False
+        self._lock = threading.Lock()       # guards the wire client handle
+        self._client = None
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _client_locked(self):
+        if self._client is None:
+            from .. import kvstore_server as _ksrv
+            self._client = _ksrv.connect_async_server(self._coordinator)
+        return self._client
+
+    def _drop_client_locked(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def register(self):
+        srv = self._server
+        host, port = srv.address
+        with self._lock:
+            reply = self._client_locked().call(
+                "serve_register", self.model, self.replica_id,
+                srv.generation, list(srv.buckets), f"{host}:{port}")
+        self.replica_id = reply["replica_id"]
+        self.registered = True
+        return reply
+
+    def beat_now(self):
+        """One beat; re-registers first if the coordinator forgot us."""
+        srv = self._server
+        with self._lock:
+            reply = self._client_locked().call(
+                "serve_beat", self.model, self.replica_id,
+                srv.generation, srv.ready, srv.draining)
+        if not reply.get("registered", True):
+            self.register()
+            self.beat_now()
+        return reply
+
+    def start(self):
+        self.register()
+        self.beat_now()     # readiness lands before the first period
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="mxtpu-serve-agent", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self._period):
+            try:
+                self.beat_now()
+            except (MXNetError, OSError, ConnectionError):
+                # coordinator unreachable this beat: drop the connection
+                # and redial next period
+                with self._lock:
+                    self._drop_client_locked()
+
+    def stop(self, deregister=True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if deregister and self.registered:
+            try:
+                with self._lock:
+                    self._client_locked().call(
+                        "serve_deregister", self.model, self.replica_id)
+            except (MXNetError, OSError, ConnectionError):
+                pass
+            self.registered = False
+        with self._lock:
+            self._drop_client_locked()
+
+
+# ---------------------------------------------------------------------------
+# operator side: zero-downtime rollout
+# ---------------------------------------------------------------------------
+
+class RolloutManager:
+    """Wave-based zero-downtime weight rollout with an SLO rollback gate.
+
+    State machine (every transition leaves a flight-recorder breadcrumb
+    and is visible in ``render_prometheus()`` / ``history``)::
+
+        idle -> started -> wave[i] -> settling -> wave[i+1] -> ... -> done
+                                          |
+                                          v (SLO engine firing)
+                                    rolling_back -> rolled_back
+
+    Per replica the shift is delegated to the ModelServer's
+    ``/admin/reload`` endpoint, whose sequence IS the zero-downtime
+    contract: prewarm the new generation's executables from the disk
+    cache (no traffic touched), then drain the old generation through
+    the batcher's admission control (pause -> quiesce), swap, resume —
+    requests arriving in the milliseconds of drain get retryable 503s
+    the router reroutes.
+
+    A replica that is UNREACHABLE during its wave (the kill -9 chaos
+    case) is skipped, counted, and left to the liveness registry — a
+    dead replica must not abort a rollout. A replica that ANSWERS with
+    a reload error is a bad generation signal and triggers rollback,
+    same as the SLO gate.
+    """
+
+    STATES = ("idle", "started", "wave", "settling", "done",
+              "rolling_back", "rolled_back")
+
+    def __init__(self, coordinator, model="default", wave_size=None,
+                 slo_check=None, settle_s=None, reload_timeout_s=60.0):
+        self._coordinator = coordinator
+        self.model = model
+        self._wave_size = max(1, wave_size if wave_size is not None
+                              else getenv_int("MXNET_ROLLOUT_WAVE_SIZE"))
+        self._settle = (settle_s if settle_s is not None
+                        else getenv_int("MXNET_ROLLOUT_SETTLE_MS") / 1e3)
+        self._reload_timeout = reload_timeout_s
+        self._slo_check = slo_check
+        self._lock = threading.Lock()   # guards state/history/counters
+        self.state = "idle"
+        self.generation = None
+        self.history = []               # [(monotonic, state, info)]
+        self._counts = {"waves_total": 0, "replicas_updated_total": 0,
+                        "replica_failures_total": 0, "rollbacks_total": 0,
+                        "slo_gate_checks_total": 0}
+        self._client = None
+
+    # -- wire/client helpers -------------------------------------------
+    def _client_handle(self):
+        if self._client is None:
+            from .. import kvstore_server as _ksrv
+            self._client = _ksrv.connect_async_server(self._coordinator)
+        return self._client
+
+    def _set_state(self, state, **info):
+        with self._lock:
+            self.state = state
+            self.history.append((time.monotonic(), state, info))
+        _fault.flight_record("rollout", state=state, model=self.model,
+                             **info)
+        _log.info("rollout[%s] -> %s %s", self.model, state, info or "")
+
+    def _count(self, name, n=1):
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def _slo_firing(self):
+        """Names of firing SLO alerts gating the next wave."""
+        self._count("slo_gate_checks_total")
+        if self._slo_check is not None:
+            return list(self._slo_check())
+        if not getenv_bool("MXNET_ROLLOUT_SLO_GATE"):
+            return []
+        try:
+            reply = self._client_handle().call("fleet_alerts")
+        except (MXNetError, OSError, ConnectionError):
+            return []       # no fleet plane -> no gate
+        rows = reply.get("alerts", []) if isinstance(reply, dict) else reply
+        return [a["spec"] for a in rows if a.get("state") == "firing"]
+
+    # -- the rollout ----------------------------------------------------
+    def rollout(self, params, generation):
+        """Shift every live replica of ``model`` to ``params`` (a
+        .params path readable by the replicas) as ``generation``.
+        Returns a result dict; ``ok`` is False when the SLO gate (or a
+        reload error) rolled the fleet back."""
+        _bump("rollouts_started")
+        view = self._client_handle().call("serve_view", self.model)
+        targets = sorted(
+            (rid, row) for rid, row in view["replicas"].items()
+            if row["live"])
+        if not targets:
+            raise MXNetError(
+                f"no live replicas registered for model {self.model!r}")
+        self._set_state("started", generation=generation,
+                        replicas=[rid for rid, _ in targets])
+        self.generation = generation
+        updated, skipped = [], []
+        bad_generation = None
+        waves = [targets[i:i + self._wave_size]
+                 for i in range(0, len(targets), self._wave_size)]
+        for wi, wave in enumerate(waves):
+            _fault.inject("rollout")    # MXNET_FAULT_INJECT: rollout@n
+            self._set_state("wave", wave=wi,
+                            replicas=[rid for rid, _ in wave])
+            for rid, row in wave:
+                try:
+                    resp = _http_json(
+                        row["http_addr"], "/admin/reload",
+                        {"params": params, "generation": generation},
+                        timeout=self._reload_timeout)
+                except urllib.error.HTTPError as e:
+                    # the replica ANSWERED and refused: bad weights/config
+                    # — a per-replica failure the gate must act on
+                    self._count("replica_failures_total")
+                    _bump("rollout_replica_failures")
+                    bad_generation = f"replica {rid} reload failed: {e}"
+                    break
+                except (urllib.error.URLError, OSError,
+                        ConnectionError) as e:
+                    # unreachable (killed mid-wave): skip, liveness owns it
+                    self._count("replica_failures_total")
+                    _bump("rollout_replica_failures")
+                    skipped.append(rid)
+                    _log.warning("rollout[%s] replica %s unreachable "
+                                 "(%s); skipping", self.model, rid, e)
+                    continue
+                updated.append((rid, row))
+                self._count("replicas_updated_total")
+                _bump("rollout_replicas_updated")
+                cold = resp.get("cold_buckets") or []
+                if cold:
+                    _log.warning(
+                        "rollout[%s] replica %s compiled buckets %s "
+                        "(disk cache cold) — prewarm the cache to keep "
+                        "rollouts retrace-free", self.model, rid, cold)
+            self._count("waves_total")
+            _bump("rollout_waves")
+            # settle, then consult the gate before touching the next wave
+            self._set_state("settling", wave=wi)
+            if self._settle > 0:
+                time.sleep(self._settle)
+            firing = [] if bad_generation is None else [bad_generation]
+            firing += self._slo_firing()
+            if firing:
+                return self._rollback(updated, firing, generation)
+        self._set_state("done", generation=generation,
+                        updated=[rid for rid, _ in updated],
+                        skipped=skipped)
+        return {"ok": True, "state": "done", "generation": generation,
+                "updated": [rid for rid, _ in updated],
+                "skipped": skipped}
+
+    def _rollback(self, updated, firing, generation):
+        self._set_state("rolling_back", alerts=firing,
+                        replicas=[rid for rid, _ in updated])
+        self._count("rollbacks_total")
+        _bump("rollbacks")
+        from .. import fleetobs as _fobs
+        _fobs.rollout_alert("rollout_rollback", model=self.model,
+                            generation=generation, alerts=firing)
+        failed = []
+        for rid, row in updated:
+            try:
+                _http_json(row["http_addr"], "/admin/rollback", {},
+                           timeout=self._reload_timeout)
+            except (urllib.error.URLError, OSError, ConnectionError):
+                failed.append(rid)
+        self._set_state("rolled_back", alerts=firing,
+                        rollback_failed=failed)
+        return {"ok": False, "state": "rolled_back", "alerts": firing,
+                "generation": generation,
+                "updated": [rid for rid, _ in updated],
+                "rollback_failed": failed}
+
+    # -- observability --------------------------------------------------
+    def render_prometheus(self):
+        """mxnet_rollout_* families (scraped live by tests/operators,
+        e.g. through Router.start_metrics_http extra renderers)."""
+        with self._lock:
+            state = self.state
+            counts = dict(self._counts)
+            generation = self.generation
+        lines = ["# HELP mxnet_rollout_state 1 for the rollout manager's "
+                 "current state machine node",
+                 "# TYPE mxnet_rollout_state gauge"]
+        for s in self.STATES:
+            lines.append(
+                f'mxnet_rollout_state{{model="{self.model}",state="{s}"}} '
+                f'{1 if s == state else 0}')
+        lines += ["# HELP mxnet_rollout_generation target generation of "
+                  "the most recent rollout",
+                  "# TYPE mxnet_rollout_generation gauge",
+                  f'mxnet_rollout_generation{{model="{self.model}"}} '
+                  f'{-1 if generation is None else generation}']
+        for name, val in sorted(counts.items()):
+            fam = f"mxnet_rollout_{name}"
+            lines += [f"# HELP {fam} rollout manager counter",
+                      f"# TYPE {fam} counter",
+                      f'{fam}{{model="{self.model}"}} {val}']
+        return "\n".join(lines) + "\n"

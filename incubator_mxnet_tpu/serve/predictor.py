@@ -151,6 +151,7 @@ class Predictor:
                                        else 16))
         self._batch_axis = batch_axis
         self._executables = {}
+        self._warm_buckets = set()      # buckets warmup() has realized
         self._cap_warned = False
         self._compile_lock = threading.Lock()
         self._run = self._sym._build_eval(training=False)
@@ -257,7 +258,18 @@ class Predictor:
                         for name, a in sorted(avals.items()))
             fn = self._executable_for(sig)
             out[b] = fn.warmup(self._param_vals, avals)
+        self._warm_buckets.update(out)
         return out
+
+    @property
+    def is_warm(self):
+        """True once warmup() has materialized an executable for every
+        ladder bucket — the readiness gate the serving control plane
+        consults: a replica advertises ready only when a request for any
+        bucket runs without an XLA trace."""
+        if self.ladder is None:
+            return bool(self._warm_buckets)
+        return set(self.ladder.sizes) <= self._warm_buckets
 
     def _pad_batch(self, arrays):
         """Pad dict of batched host/device arrays up the bucket ladder.

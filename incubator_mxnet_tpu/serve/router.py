@@ -1,0 +1,571 @@
+"""Health-checked request router over the serving fleet.
+
+Front end of the serving control plane (control_plane.py): per-model
+load balancing across READY replicas (registered, every bucket AOT-warm,
+live within the fleetobs window, not draining) with the defensive-client
+triad production serving systems converge on:
+
+* **bounded jittered retries** — only on RETRYABLE failures: connect
+  errors and 503/504 sheds, which the serving protocol explicitly marks
+  ``retryable: true``. Application errors (400/500) are surfaced to the
+  caller untouched; retrying them would re-run a request the replica
+  already answered. Backoff doubles per attempt with uniform [0.5, 1.5)
+  jitter and is always clipped to the request deadline.
+* **hedged requests** — when the first attempt has not answered after a
+  p99-derived delay (MXNET_ROUTER_HEDGE_DELAY_MS to pin it), a second
+  replica is tried and the first success wins; the tail of a slow or
+  dying replica costs one duplicate request, not a deadline.
+* **per-replica circuit breakers** — consecutive connect/timeout
+  failures open the breaker (traffic skips the replica), a half-open
+  probe is admitted after the cooldown, and its outcome closes or
+  re-opens. 503 sheds do NOT count: a shedding replica is alive and the
+  fix is elsewhere-routing, not exile. Every transition leaves a
+  flight-recorder breadcrumb and bumps an ``mxnet_router_*`` family.
+
+Discovery is registry-polling (serve_view over the MAC'd wire every
+MXNET_ROUTER_REFRESH_MS); a coordinator outage freezes the last-known
+table instead of emptying it — stale routing degrades, no routing
+fails. Static replica lists (``replicas=[...]``) skip discovery for
+tests and single-host use.
+
+Lock discipline: ``self._rlock`` guards the replica table + breakers
+and is OUTERMOST; RouterStats' ``self._lock`` is a LEAF — stats calls
+and breadcrumbs happen after _rlock is released.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as _np
+
+from .. import fault as _fault
+from ..base import MXNetError
+from ..util import getenv_int
+from .batcher import DeadlineExceeded, Overloaded
+from .stats import LatencyHistogram
+
+__all__ = ["Router", "RouterStats", "RouteError", "NoReplicaAvailable"]
+
+_log = logging.getLogger("incubator_mxnet_tpu.serve.router")
+
+
+class RouteError(MXNetError):
+    """A replica ANSWERED with a non-retryable application error
+    (400 malformed / 500 predict raised); never retried."""
+    retryable = False
+
+    def __init__(self, msg, status=500):
+        super().__init__(msg)
+        self.status = status
+
+
+class NoReplicaAvailable(MXNetError):
+    """No ready replica (none registered, none warm, or every breaker
+    open); retryable — the fleet may be mid-rollout or mid-recovery."""
+    retryable = True
+    status = 503
+
+
+class RouterStats:
+    """``mxnet_router_*`` metric registry: flat counters + gauges + one
+    request-latency histogram, same shed-nothing lock discipline as
+    ServingStats (one leaf lock, O(1) hot-path updates)."""
+
+    def __init__(self, name="router"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        self.latency = LatencyHistogram()   # internally locked
+
+    def incr(self, field, n=1):
+        with self._lock:
+            self._counters[field] = self._counters.get(field, 0) + n
+
+    def set_gauge(self, field, value):
+        with self._lock:
+            self._gauges[field] = value
+
+    def snapshot(self):
+        with self._lock:
+            snap = {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges)}
+        snap["latency_ms"] = {
+            "p50": self.latency.percentile(50) * 1e3,
+            "p99": self.latency.percentile(99) * 1e3,
+            "count": self.latency.count}
+        return snap
+
+    def render_prometheus(self):
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+        lines = []
+        for field, val in sorted(counters.items()):
+            fam = f"mxnet_router_{field}"
+            lines += [f"# HELP {fam} router counter",
+                      f"# TYPE {fam} counter",
+                      f'{fam}{{router="{self.name}"}} {val}']
+        for field, val in sorted(gauges.items()):
+            fam = f"mxnet_router_{field}"
+            lines += [f"# HELP {fam} router gauge",
+                      f"# TYPE {fam} gauge",
+                      f'{fam}{{router="{self.name}"}} {val}']
+        h = self.latency.snapshot_state()
+        fam = "mxnet_router_request_latency_ms"
+        lines += [f"# HELP {fam} end-to-end routed request latency "
+                  "(retries and hedges included)",
+                  f"# TYPE {fam} histogram"]
+        cum = 0
+        for bound, cnt in zip(h["bounds"], h["counts"]):
+            cum += cnt
+            lines.append(f'{fam}_bucket{{router="{self.name}",'
+                         f'le="{bound * 1e3:.6g}"}} {cum}')
+        lines += [f'{fam}_bucket{{router="{self.name}",le="+Inf"}} '
+                  f'{h["count"]}',
+                  f'{fam}_sum{{router="{self.name}"}} {h["sum"] * 1e3:.6g}',
+                  f'{fam}_count{{router="{self.name}"}} {h["count"]}']
+        return "\n".join(lines) + "\n"
+
+
+class _Breaker:
+    """Per-replica circuit breaker state; mutated ONLY under the
+    router's _rlock. Methods return the transition name ("open",
+    "half_open", "close") for the caller to record outside the lock."""
+
+    __slots__ = ("state", "failures", "opened_at")
+
+    def __init__(self):
+        self.state = "closed"
+        self.failures = 0
+        self.opened_at = 0.0
+
+    def allow(self, now, cooldown_s):
+        """(allowed, transition): closed always allows; open admits one
+        half-open probe slot per cooldown. A half_open slot that was
+        never exercised (the request was answered by another replica
+        before the probe fired) regenerates after another cooldown —
+        otherwise an unlucky rotation wedges the breaker half-open
+        forever with the replica unreachable by anyone."""
+        if self.state == "closed":
+            return True, None
+        if now - self.opened_at < cooldown_s:
+            return False, None
+        if self.state == "open":
+            self.state = "half_open"
+            self.opened_at = now
+            return True, "half_open"
+        self.opened_at = now        # regenerate the unexercised slot
+        return True, None
+
+    def note(self, ok, now, threshold):
+        if ok:
+            was = self.state
+            self.state = "closed"
+            self.failures = 0
+            return "close" if was != "closed" else None
+        self.failures += 1
+        if self.state == "half_open" or (self.state == "closed"
+                                         and self.failures >= threshold):
+            self.state = "open"
+            self.opened_at = now
+            return "open"
+        if self.state == "open":
+            self.opened_at = now    # still failing: restart the cooldown
+        return None
+
+
+class Router:
+    """Load-balancing front end over ready serving replicas.
+
+    coordinator: "addr token" of the kvstore coordinator (discovery via
+        serve_view), or None with a static ``replicas`` list of
+        "host:port" strings (tests / single host).
+    Knobs default from util.ENV_VARS (MXNET_ROUTER_*); constructor
+    arguments override per instance.
+    """
+
+    def __init__(self, coordinator=None, model="default", replicas=None,
+                 deadline_ms=None, retries=None, backoff_ms=None,
+                 hedge_delay_ms=None, breaker_failures=None,
+                 breaker_cooldown_ms=None, refresh_ms=None, stats=None,
+                 name="router"):
+        if coordinator is None and not replicas:
+            raise MXNetError("Router needs a coordinator or a static "
+                             "replica list")
+        self._coordinator = coordinator
+        self._model = model
+        self._deadline_ms = (deadline_ms if deadline_ms is not None
+                             else getenv_int("MXNET_ROUTER_DEADLINE_MS"))
+        self._retries = max(0, retries if retries is not None
+                            else getenv_int("MXNET_ROUTER_RETRIES"))
+        self._backoff_ms = max(1, backoff_ms if backoff_ms is not None
+                               else getenv_int(
+                                   "MXNET_ROUTER_RETRY_BACKOFF_MS"))
+        self._hedge_delay_ms = (hedge_delay_ms if hedge_delay_ms is not None
+                                else getenv_int(
+                                    "MXNET_ROUTER_HEDGE_DELAY_MS"))
+        self._breaker_failures = max(
+            1, breaker_failures if breaker_failures is not None
+            else getenv_int("MXNET_ROUTER_BREAKER_FAILURES"))
+        self._breaker_cooldown = (
+            breaker_cooldown_ms if breaker_cooldown_ms is not None
+            else getenv_int("MXNET_ROUTER_BREAKER_COOLDOWN_MS")) / 1e3
+        self._refresh_s = max(0.05, (
+            refresh_ms if refresh_ms is not None
+            else getenv_int("MXNET_ROUTER_REFRESH_MS")) / 1e3)
+        self.stats = stats if stats is not None else RouterStats(name)
+        self._rng = random.Random()
+        self._rlock = threading.Lock()  # replica table + breakers;
+        #                                 OUTERMOST, stats lock is a leaf
+        self._replicas = {}             # rid -> {"addr", "ready", "generation"}
+        self._breakers = {}             # rid -> _Breaker
+        self._rr = 0
+        self._client = None
+        self._stop = threading.Event()
+        self._thread = None
+        self._metrics_httpd = None
+        if replicas:
+            with self._rlock:
+                for i, addr in enumerate(replicas):
+                    rid = f"static{i}"
+                    self._replicas[rid] = {"addr": str(addr), "ready": True,
+                                           "generation": -1}
+                    self._breakers[rid] = _Breaker()
+
+    # -- discovery ------------------------------------------------------
+    def start(self):
+        if self._coordinator is not None and self._thread is None:
+            self.refresh()
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._discovery_loop, name="mxtpu-router-discovery",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        if self._metrics_httpd is not None:
+            try:
+                self._metrics_httpd.shutdown()
+                self._metrics_httpd.server_close()
+            except OSError:
+                pass
+            self._metrics_httpd = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _discovery_loop(self):
+        while not self._stop.wait(self._refresh_s):
+            try:
+                self.refresh()
+            except (MXNetError, OSError, ConnectionError):
+                # coordinator unreachable: keep the last-known table
+                # (stale routing degrades; empty routing fails) and
+                # redial next tick
+                if self._client is not None:
+                    self._client.close()
+                    self._client = None
+
+    def refresh(self):
+        """One discovery poll: pull serve_view, rebuild the table.
+        Breakers persist across refreshes for surviving replica ids."""
+        if self._client is None:
+            from .. import kvstore_server as _ksrv
+            self._client = _ksrv.connect_async_server(self._coordinator)
+        view = self._client.call("serve_view", self._model)
+        rows = view["replicas"]
+        ready = 0
+        with self._rlock:
+            table = {}
+            for rid, row in rows.items():
+                eligible = (row["ready"] and row["live"]
+                            and not row.get("draining"))
+                ready += 1 if eligible else 0
+                table[rid] = {"addr": row["http_addr"], "ready": eligible,
+                              "generation": row["generation"]}
+                if rid not in self._breakers:
+                    self._breakers[rid] = _Breaker()
+            self._replicas = table
+            for rid in [r for r in self._breakers if r not in table]:
+                del self._breakers[rid]
+        self.stats.set_gauge("replicas_known", len(rows))
+        self.stats.set_gauge("replicas_ready", ready)
+        return view
+
+    def set_replicas(self, replicas):
+        """Replace the static table (tests / manual operation)."""
+        with self._rlock:
+            self._replicas = {
+                f"static{i}": {"addr": str(a), "ready": True,
+                               "generation": -1}
+                for i, a in enumerate(replicas)}
+            self._breakers = {rid: self._breakers.get(rid, _Breaker())
+                              for rid in self._replicas}
+
+    # -- breaker plumbing ----------------------------------------------
+    def _candidates(self):
+        """Ready, breaker-admitted (rid, addr) pairs in round-robin
+        order; breaker half-open transitions are recorded on the way."""
+        now = time.monotonic()
+        transitions = []
+        with self._rlock:
+            out = []
+            for rid in sorted(self._replicas):
+                info = self._replicas[rid]
+                if not info["ready"]:
+                    continue
+                allowed, moved = self._breakers[rid].allow(
+                    now, self._breaker_cooldown)
+                if moved:
+                    transitions.append((rid, moved))
+                if allowed:
+                    out.append((rid, info["addr"]))
+            self._rr += 1
+            k = self._rr % len(out) if out else 0
+        for rid, moved in transitions:
+            self._record_transition(rid, moved)
+        return out[k:] + out[:k]
+
+    def _note_result(self, rid, ok):
+        """Feed a call outcome to the replica's breaker (connect-layer
+        truth only: 503 sheds never reach here as failures)."""
+        now = time.monotonic()
+        with self._rlock:
+            br = self._breakers.get(rid)
+            moved = br.note(ok, now, self._breaker_failures) if br else None
+        if moved:
+            self._record_transition(rid, moved)
+
+    def _record_transition(self, rid, transition):
+        self.stats.incr(f"breaker_{transition}_total")
+        _fault.flight_record("router_breaker", router=self.stats.name,
+                             replica=rid, transition=transition)
+        _log.warning("router[%s] breaker %s -> %s",
+                     self.stats.name, rid, transition)
+
+    def breaker_states(self):
+        with self._rlock:
+            return {rid: br.state for rid, br in self._breakers.items()}
+
+    # -- request path ---------------------------------------------------
+    def _backoff_s(self, attempt, deadline):
+        base = min(1.0, self._backoff_ms / 1e3 * (2 ** (attempt - 1)))
+        jittered = base * self._rng.uniform(0.5, 1.5)
+        return max(0.0, min(jittered, deadline - time.monotonic() - 1e-3))
+
+    def _hedge_delay_s(self):
+        if self._hedge_delay_ms > 0:
+            return self._hedge_delay_ms / 1e3
+        # p99-derived: needs a populated histogram; a 50ms floor covers
+        # the cold start and stops hedging on micro-jitter
+        if self.stats.latency.count >= 20:
+            return max(0.01, self.stats.latency.percentile(99))
+        return 0.05
+
+    def request(self, inputs, deadline_ms=None):
+        """Route one prediction: dict of UNBATCHED sample arrays ->
+        list of per-output numpy arrays. Raises RouteError (replica
+        application error, non-retryable), NoReplicaAvailable, or
+        DeadlineExceeded once the deadline/retry budget is spent."""
+        if deadline_ms is None:
+            deadline_ms = self._deadline_ms
+        deadline = time.monotonic() + deadline_ms / 1e3
+        inputs_json = {k: _np.asarray(v).tolist() for k, v in inputs.items()}
+        self.stats.incr("requests_total")
+        t0 = time.monotonic()
+        last_err = None
+        for attempt in range(self._retries + 1):
+            if attempt:
+                self.stats.incr("retries_total")
+                pause = self._backoff_s(attempt, deadline)
+                if pause > 0:
+                    time.sleep(pause)
+            if time.monotonic() >= deadline:
+                break
+            cands = self._candidates()
+            if not cands:
+                self.stats.incr("no_replica_total")
+                last_err = NoReplicaAvailable(
+                    f"no ready replica for model {self._model!r}")
+                continue
+            kind, value = self._attempt(cands, inputs_json, deadline)
+            if kind == "ok":
+                self.stats.latency.observe(time.monotonic() - t0)
+                self.stats.incr("responses_ok_total")
+                return value
+            if kind == "fatal":
+                self.stats.incr("responses_fatal_total")
+                raise value
+            last_err = value
+        self.stats.incr("requests_failed_total")
+        if isinstance(last_err, MXNetError):
+            raise last_err
+        raise DeadlineExceeded(
+            f"router deadline {deadline_ms}ms exhausted "
+            f"({self._retries} retries)")
+
+    def _attempt(self, cands, inputs_json, deadline):
+        """One (possibly hedged) attempt against up to two replicas.
+        Returns ("ok", outputs) | ("retryable", err) | ("fatal", err)."""
+        results = queue.Queue()
+
+        def run(rid, addr, hedged):
+            results.put((self._one_call(rid, addr, inputs_json, deadline),
+                         rid, hedged))
+
+        threading.Thread(target=run, args=(*cands[0], False),
+                         daemon=True).start()
+        outstanding, hedge_fired = 1, False
+        first_failure = None
+        while outstanding:
+            now = time.monotonic()
+            if now >= deadline:
+                return ("retryable",
+                        first_failure or DeadlineExceeded(
+                            "deadline during routed attempt"))
+            if not hedge_fired and len(cands) > 1:
+                wait = min(self._hedge_delay_s(), deadline - now)
+            else:
+                wait = deadline - now
+            try:
+                (kind, value), rid, hedged = results.get(
+                    timeout=max(1e-3, wait))
+            except queue.Empty:
+                if not hedge_fired and len(cands) > 1:
+                    hedge_fired = True
+                    outstanding += 1
+                    self.stats.incr("hedges_total")
+                    threading.Thread(target=run, args=(*cands[1], True),
+                                     daemon=True).start()
+                continue
+            outstanding -= 1
+            if kind == "ok":
+                if hedged:
+                    self.stats.incr("hedge_wins_total")
+                return ("ok", value)
+            if kind == "fatal":
+                return ("fatal", value)
+            if first_failure is None:
+                first_failure = value
+            # retryable: if a hedge is still in flight, wait it out
+        return ("retryable", first_failure)
+
+    def _one_call(self, rid, addr, inputs_json, deadline):
+        """One HTTP /predict against one replica. Returns (kind, value);
+        classification is the whole policy: connect errors feed the
+        breaker and retry, 503/504 sheds retry without breaker blame,
+        anything the replica answered decisively is final."""
+        timeout = max(1e-3, deadline - time.monotonic())
+        body = json.dumps({"inputs": inputs_json,
+                           "deadline_ms": timeout * 1e3}).encode("utf-8")
+        try:
+            _fault.inject("route")      # MXNET_FAULT_INJECT: route@n
+            req = urllib.request.Request(
+                f"http://{addr}/predict", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                payload = json.loads(r.read().decode("utf-8"))
+            self._note_result(rid, True)
+            return ("ok", [_np.asarray(o) for o in payload["outputs"]])
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read().decode("utf-8"))
+            except (ValueError, OSError):
+                detail = {"error": str(e)}
+            # an HTTP answer proves the replica's wire: not a breaker
+            # failure, whatever the status
+            self._note_result(rid, True)
+            if e.code in (503, 504) and detail.get("retryable", True):
+                self.stats.incr("sheds_total")
+                return ("retryable", Overloaded(
+                    f"replica {rid} shed ({e.code}): "
+                    f"{detail.get('error', '')}"))
+            return ("fatal", RouteError(
+                f"replica {rid}: {detail.get('error', e)}", status=e.code))
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            self.stats.incr("connect_errors_total")
+            self._note_result(rid, False)
+            return ("retryable", NoReplicaAvailable(
+                f"replica {rid} at {addr} unreachable: {e}"))
+
+    # -- observability --------------------------------------------------
+    def render_prometheus(self):
+        """RouterStats families + per-replica breaker-state gauges."""
+        with self._rlock:
+            states = {rid: br.state for rid, br in self._breakers.items()}
+        lines = [self.stats.render_prometheus().rstrip("\n"),
+                 "# HELP mxnet_router_breaker_state per-replica circuit "
+                 "breaker (0 closed, 1 half_open, 2 open)",
+                 "# TYPE mxnet_router_breaker_state gauge"]
+        code = {"closed": 0, "half_open": 1, "open": 2}
+        for rid, st in sorted(states.items()):
+            lines.append(
+                f'mxnet_router_breaker_state{{router="{self.stats.name}",'
+                f'replica="{rid}"}} {code[st]}')
+        return "\n".join(lines) + "\n"
+
+    def start_metrics_http(self, host="127.0.0.1", port=0, extra=()):
+        """Serve /metrics (router families + any ``extra`` renderer
+        callables, e.g. a RolloutManager's) and /replicas JSON on an
+        ephemeral port; returns (host, port)."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        router = self
+        extras = tuple(extra)
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _send(self, code, text, ctype="text/plain; charset=utf-8"):
+                data = text.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    if self.path == "/metrics":
+                        body = router.render_prometheus() + "".join(
+                            fn() for fn in extras)
+                        self._send(200, body, "text/plain; version=0.0.4; "
+                                              "charset=utf-8")
+                    elif self.path == "/replicas":
+                        with router._rlock:
+                            table = {rid: dict(info) for rid, info
+                                     in router._replicas.items()}
+                        self._send(200, json.dumps(table),
+                                   "application/json")
+                    else:
+                        self._send(404, "not found\n")
+                except Exception as e:      # noqa: BLE001
+                    self._send(500, f"error: {e}\n")
+
+            def log_message(self, fmt, *args):
+                _log.debug("router http: " + fmt, *args)
+
+        srv = ThreadingHTTPServer((host, port), _Handler)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever,
+                         name="mxtpu-router-metrics", daemon=True).start()
+        self._metrics_httpd = srv
+        return srv.server_address[:2]

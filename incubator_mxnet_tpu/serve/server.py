@@ -20,22 +20,44 @@ Protocol (JSON):
   POST /predict   {"inputs": {"data": [[...]]}, "deadline_ms": 250}
                   -> {"outputs": [[...], ...]}   (one list per output,
                      sample-shaped — requests are UNBATCHED samples)
-  GET  /healthz   -> {"status": "ok", "queue_depth": n}
+  GET  /healthz   -> LIVENESS: 200 {"status": "ok", ...} while the
+                     process serves at all (a draining replica is alive)
+  GET  /readyz    -> READINESS: 200 only when the replica should take
+                     traffic — every ladder bucket AOT-warm
+                     (Predictor.warmup completed), registered with the
+                     control plane (when one is attached), and not
+                     draining; otherwise 503 naming each failing gate
   GET  /stats     -> ServingStats.snapshot()
   GET  /metrics   -> Prometheus text exposition (serving counters +
                      trainer counters + compile-cache + memory gauges,
                      profiler.render_prometheus())
+
+Control-plane admin surface (loopback-bound by default; see
+docs/architecture/note_control_plane.md for the trust model):
+  POST /admin/reload    {"params": path, "generation": g} — prewarm the
+                        new generation from the disk cache, drain the
+                        old through the batcher's admission control,
+                        swap, resume (the zero-downtime weight shift)
+  POST /admin/rollback  — swap back to the retained previous generation
+  POST /admin/drain     — begin drain (deregister + shed new requests)
+
+Graceful shutdown: ``install_sigterm()`` turns SIGTERM into
+deregister -> 503 + Retry-After for new requests -> drain in-flight ->
+flush stats -> stop, instead of the stdlib server dying mid-batch.
 """
 from __future__ import annotations
 
 import json
+import signal
 import threading
+import time
 from concurrent.futures import TimeoutError as _FutTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as _np
 
 from ..base import MXNetError
+from ..util import getenv_int
 from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
 from .stats import ServingStats
 
@@ -75,8 +97,17 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         ms = self._ms
         if self.path == "/healthz":
+            # liveness ONLY: a draining or cold replica is still alive —
+            # orchestrators must not restart it for being unready
             self._reply(200, {"status": "ok",
-                              "queue_depth": ms.stats.queue_depth})
+                              "queue_depth": ms.stats.queue_depth,
+                              "draining": ms.draining,
+                              "generation": ms.generation})
+        elif self.path == "/readyz":
+            ready, why = ms.readiness()
+            self._reply(200 if ready else 503,
+                        {"ready": ready, "why": why,
+                         "generation": ms.generation})
         elif self.path == "/stats":
             self._reply(200, ms.stats.snapshot())
         elif self.path == "/metrics":
@@ -92,10 +123,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": "not found", "retryable": False})
 
     def do_POST(self):
+        if self.path.startswith("/admin/"):
+            self._admin()
+            return
         if self.path != "/predict":
             self._reply(404, {"error": "not found", "retryable": False})
             return
         ms = self._ms
+        if ms.draining:
+            # graceful-shutdown / rollout contract: a draining replica
+            # answers fast with a retryable shed, never queues
+            self._reply(503, {"error": "draining", "retryable": True},
+                        retry_after="0.1")
+            return
         try:
             length = int(self.headers.get("Content-Length", "0"))
             req = json.loads(self.rfile.read(length) or b"{}")
@@ -124,6 +164,35 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, {"outputs": [o.tolist() for o in outs]})
 
+    def _admin(self):
+        ms = self._ms
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": f"malformed request: {e}",
+                              "retryable": False})
+            return
+        try:
+            if self.path == "/admin/reload":
+                out = ms.reload(req["params"], int(req["generation"]))
+            elif self.path == "/admin/rollback":
+                out = ms.rollback()
+            elif self.path == "/admin/drain":
+                ms.begin_drain(reason=req.get("reason", "admin"))
+                out = {"draining": True}
+            else:
+                self._reply(404, {"error": "not found", "retryable": False})
+                return
+        except KeyError as e:
+            self._reply(400, {"error": f"missing field {e}",
+                              "retryable": False})
+            return
+        except Exception as e:      # noqa: BLE001 — admin failure -> 500
+            self._reply(500, {"error": str(e), "retryable": False})
+            return
+        self._reply(200, out)
+
 
 class _HTTPServer(ThreadingHTTPServer):
     # accept backlog must exceed the admission queue: shedding is the
@@ -134,11 +203,27 @@ class _HTTPServer(ThreadingHTTPServer):
 
 class ModelServer:
     """Serve a Predictor over HTTP with dynamic batching + admission
-    control. `port=0` binds an ephemeral port (returned by start())."""
+    control. `port=0` binds an ephemeral port (returned by start()).
+
+    Control-plane integration (all optional — a bare ModelServer keeps
+    the original single-process behavior):
+
+    model / generation:  identity advertised to the serve registry.
+    coordinator:         "addr token" of the kvstore coordinator; when
+                         set, start() registers a ReplicaAgent that
+                         heartbeats (generation, ready, draining) and
+                         stop()/drain deregisters.
+    require_warm:        readiness gate on Predictor.warmup having
+                         realized every ladder bucket. None (default)
+                         auto-enables when the predictor declares input
+                         shapes (i.e. warmup is possible).
+    """
 
     def __init__(self, predictor, host="127.0.0.1", port=0,
                  max_latency_ms=5.0, max_queue=128,
-                 default_deadline_ms=1000.0, stats=None, name="serve"):
+                 default_deadline_ms=1000.0, stats=None, name="serve",
+                 model="default", generation=0, coordinator=None,
+                 require_warm=None):
         self.predictor = predictor
         buckets = (predictor.ladder.sizes if predictor.ladder is not None
                    else (1, 2, 4, 8, 16, 32))
@@ -148,9 +233,50 @@ class ModelServer:
             max_latency_ms=max_latency_ms, max_queue=max_queue,
             default_deadline_ms=default_deadline_ms, stats=self.stats)
         self.default_deadline_ms = default_deadline_ms
+        self.model = model
+        self.generation = int(generation)
+        self._coordinator = coordinator
+        if require_warm is None:
+            require_warm = (predictor.ladder is not None
+                            and bool(predictor._input_shapes))
+        self._require_warm = require_warm
         self._host, self._port = host, port
         self._httpd = None
         self._thread = None
+        self._agent = None
+        self._draining = False
+        self._drain_lock = threading.Lock()     # serializes drain/swap
+        self._prev = None       # (predictor, generation) for rollback
+        self._prev_sigterm = None
+
+    # -- health/readiness ----------------------------------------------
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def buckets(self):
+        return self.batcher._buckets
+
+    def readiness(self):
+        """(ready, why): the composite readiness gate /readyz serves and
+        the ReplicaAgent beats to the registry — one truth for the
+        router, the orchestrator, and the control plane."""
+        why = []
+        if self._httpd is None:
+            why.append("not started")
+        if self._draining:
+            why.append("draining")
+        if self._require_warm and not self.predictor.is_warm:
+            why.append("cold buckets (Predictor.warmup incomplete)")
+        if self._coordinator is not None and (
+                self._agent is None or not self._agent.registered):
+            why.append("not registered with control plane")
+        return (not why, why)
+
+    @property
+    def ready(self):
+        return self.readiness()[0]
 
     @property
     def address(self):
@@ -158,6 +284,7 @@ class ModelServer:
             raise MXNetError("server not started")
         return self._httpd.server_address[:2]
 
+    # -- lifecycle ------------------------------------------------------
     def start(self):
         if self._httpd is not None:
             return self.address
@@ -168,9 +295,21 @@ class ModelServer:
                                         name="mxtpu-serve-http",
                                         daemon=True)
         self._thread.start()
+        if self._coordinator is not None:
+            from .control_plane import ReplicaAgent
+            self._agent = ReplicaAgent(self, self._coordinator,
+                                       model=self.model)
+            try:
+                self._agent.start()
+            except MXNetError:
+                self.stop()
+                raise
         return self.address
 
     def stop(self):
+        if self._agent is not None:
+            self._agent.stop(deregister=True)
+            self._agent = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -186,3 +325,109 @@ class ModelServer:
 
     def __exit__(self, *exc):
         self.stop()
+
+    # -- graceful shutdown / drain -------------------------------------
+    def begin_drain(self, reason="shutdown"):
+        """Stop taking traffic without dropping anything in flight:
+        deregister (routers stop picking us within one refresh), shed
+        new requests with retryable 503 + Retry-After, flush the
+        batcher's queue, publish final stats. Idempotent."""
+        with self._drain_lock:
+            if self._draining:
+                return
+            self._draining = True
+        from .. import fault as _fault
+        if self._agent is not None:
+            self._agent.stop(deregister=True)
+        self.batcher.pause(reason)
+        drained = self.batcher.quiesce(
+            timeout=getenv_int("MXNET_SERVE_DRAIN_TIMEOUT"))
+        self.stats.publish()
+        _fault.flight_record("serve_drain", model=self.model,
+                             generation=self.generation, reason=reason,
+                             drained=drained)
+        from . import control_plane as _cp
+        _cp._bump("graceful_shutdowns")
+
+    def shutdown_gracefully(self, reason="sigterm"):
+        self.begin_drain(reason=reason)
+        self.stop()
+
+    def install_sigterm(self):
+        """Route SIGTERM through the graceful drain (main thread only).
+        The handler only sets work in motion on a helper thread — signal
+        context is no place for socket teardown. Returns self;
+        restore_sigterm() undoes it (tests)."""
+        def _on_term(signum, frame):
+            threading.Thread(target=self.shutdown_gracefully,
+                             name="mxtpu-serve-sigterm",
+                             daemon=True).start()
+        self._prev_sigterm = signal.signal(signal.SIGTERM, _on_term)
+        return self
+
+    def restore_sigterm(self):
+        if self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
+
+    # -- zero-downtime weight rollout ----------------------------------
+    def reload(self, params, generation):
+        """Shift this replica to a new generation with zero failed
+        requests: build + AOT-prewarm the new Predictor from the disk
+        cache while the old one serves, then drain the old generation
+        through admission control (pause -> quiesce), swap, resume.
+        The displaced generation is retained for rollback()."""
+        from .predictor import Predictor
+        pred = self.predictor
+        new_pred = Predictor(
+            pred._sym, params,
+            input_shapes=(pred._input_shapes or None),
+            bucket_sizes=(pred.ladder.sizes if pred.ladder else None),
+            batch_axis=pred._batch_axis)
+        warm = (new_pred.warmup() if self._require_warm else {})
+        cold = sorted(b for b, v in warm.items() if v == "miss")
+        info = self._swap(new_pred, generation, reason="reload")
+        info["warmup"] = {str(b): v for b, v in warm.items()}
+        info["cold_buckets"] = cold
+        return info
+
+    def rollback(self):
+        """Swap back to the generation reload() displaced."""
+        if self._prev is None:
+            raise MXNetError("no previous generation retained")
+        old_pred, old_gen = self._prev
+        return self._swap(old_pred, old_gen, reason="rollback")
+
+    def _swap(self, new_pred, generation, reason):
+        from .. import fault as _fault
+        t0 = time.monotonic()
+        with self._drain_lock:
+            if self._draining:
+                raise MXNetError(f"cannot {reason}: replica is draining")
+            # the drain window: requests arriving now get retryable 503s
+            # (the router reroutes); everything already admitted flushes
+            # on the OLD generation before the swap
+            self._draining = True
+            self.batcher.pause(f"{reason} gen {generation}")
+            drained = self.batcher.quiesce(
+                timeout=getenv_int("MXNET_SERVE_DRAIN_TIMEOUT"))
+            self._prev = (self.predictor, self.generation)
+            self.predictor = new_pred
+            self.batcher.swap_predict(new_pred.predict)
+            old_gen, self.generation = self.generation, int(generation)
+            self.batcher.resume()
+            self._draining = False
+        swap_ms = (time.monotonic() - t0) * 1e3
+        _fault.flight_record("serve_swap", model=self.model,
+                             reason=reason, generation=int(generation),
+                             previous=old_gen, drained=drained,
+                             swap_ms=round(swap_ms, 3))
+        if self._agent is not None:
+            try:
+                # readiness + generation reach the registry now, not at
+                # the next beat period
+                self._agent.beat_now()
+            except MXNetError:
+                pass
+        return {"generation": self.generation, "previous": old_gen,
+                "drained": drained, "swap_ms": swap_ms}

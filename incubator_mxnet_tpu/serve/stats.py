@@ -95,6 +95,7 @@ class ServingStats:
       responses_ok       completed with a result
       shed_queue_full    rejected at admission (bounded queue full)
       shed_deadline      expired before or during dispatch
+      shed_draining      rejected while admission was paused (drain/swap)
       errors             predict raised
       batches_total      compiled-bucket dispatches
       padded_rows_total  bucket_size - real rows, summed over batches
@@ -112,6 +113,7 @@ class ServingStats:
         self.responses_ok = 0
         self.shed_queue_full = 0
         self.shed_deadline = 0
+        self.shed_draining = 0
         self.errors = 0
         self.batches_total = 0
         self.padded_rows_total = 0
@@ -183,7 +185,9 @@ class ServingStats:
                 "responses_ok": self.responses_ok,
                 "shed_queue_full": self.shed_queue_full,
                 "shed_deadline": self.shed_deadline,
-                "shed_total": self.shed_queue_full + self.shed_deadline,
+                "shed_draining": self.shed_draining,
+                "shed_total": (self.shed_queue_full + self.shed_deadline
+                               + self.shed_draining),
                 "errors": self.errors,
                 "batches_total": self.batches_total,
                 "padded_rows_total": self.padded_rows_total,

@@ -306,6 +306,57 @@ ENV_VARS = collections.OrderedDict([
      "Byte cap on a shipped remote-profile trace segment; oldest events "
      "are dropped until the JSON payload fits, and the coordinator "
      "refuses oversized pushes outright.")),
+    ("MXNET_KVSTORE_RETRY_JITTER", EnvSpec(True, "bool",
+     "Randomize AsyncClient retry backoff by a uniform [0.5, 1.5) "
+     "factor so a fleet of workers does not retry in lockstep after a "
+     "coordinator restart (thundering herd). Off restores the "
+     "deterministic doubling schedule (tests that assert exact retry "
+     "timing).")),
+    ("MXNET_ROUTER_DEADLINE_MS", EnvSpec(1000, "int",
+     "Default end-to-end deadline for one Router.request, covering "
+     "every retry and hedge; a request that cannot complete inside it "
+     "fails with a retryable deadline error.")),
+    ("MXNET_ROUTER_RETRIES", EnvSpec(3, "int",
+     "Retry budget per routed request on RETRYABLE failures only "
+     "(connect error, 503 shed); application errors (400/500) are "
+     "never retried.")),
+    ("MXNET_ROUTER_RETRY_BACKOFF_MS", EnvSpec(10, "int",
+     "Initial router retry backoff; doubles per attempt with uniform "
+     "[0.5, 1.5) jitter, capped at 1s and always bounded by the "
+     "request deadline.")),
+    ("MXNET_ROUTER_HEDGE_DELAY_MS", EnvSpec(0, "int",
+     "Hedged-request trigger: a second replica is tried when the first "
+     "attempt has not answered after this long. 0 (the default) "
+     "derives the delay from the router's observed p99 latency "
+     "(50ms floor until enough samples exist).")),
+    ("MXNET_ROUTER_BREAKER_FAILURES", EnvSpec(5, "int",
+     "Consecutive connect/timeout failures that open a replica's "
+     "circuit breaker (the replica stops receiving traffic until a "
+     "half-open probe succeeds). 503 sheds do NOT count — a shedding "
+     "replica is alive.")),
+    ("MXNET_ROUTER_BREAKER_COOLDOWN_MS", EnvSpec(2000, "int",
+     "How long an open circuit breaker waits before letting one "
+     "half-open probe request through; the probe's outcome closes or "
+     "re-opens the breaker.")),
+    ("MXNET_ROUTER_REFRESH_MS", EnvSpec(500, "int",
+     "Router discovery period: how often the replica table is "
+     "re-pulled from the coordinator's serve registry.")),
+    ("MXNET_ROLLOUT_WAVE_SIZE", EnvSpec(1, "int",
+     "Replicas updated per rollout wave; the SLO gate is evaluated "
+     "between waves, so smaller waves bound the blast radius of a bad "
+     "generation.")),
+    ("MXNET_ROLLOUT_SLO_GATE", EnvSpec(True, "bool",
+     "Gate rollout waves on the fleet SLO engine: any alert firing "
+     "after a wave settles triggers automatic rollback of every "
+     "already-updated replica. Off, waves proceed unconditionally.")),
+    ("MXNET_ROLLOUT_SETTLE_MS", EnvSpec(200, "int",
+     "Post-wave settle time before the SLO gate is consulted, so the "
+     "new generation's traffic is actually represented in the "
+     "evaluated window.")),
+    ("MXNET_SERVE_DRAIN_TIMEOUT", EnvSpec(30, "int",
+     "Seconds a draining ModelServer (SIGTERM / rollout weight swap) "
+     "waits for in-flight batches to flush before forcing shutdown; "
+     "new requests get fast 503 + Retry-After for the duration.")),
 ])
 
 _FALSY = frozenset(("", "0", "false", "off", "no"))

@@ -1,0 +1,585 @@
+"""Fleet serving control plane: ServeRegistry, ReplicaAgent, Router
+discovery, RolloutManager — and the multiprocess chaos rollout.
+
+Acceptance criteria from the control-plane milestone:
+  * replicas register/beat over the MAC'd kvstore wire; liveness is
+    beat age, readiness is the replica's composite warm gate,
+  * the router discovers the ready set, survives replica death through
+    retries + breakers, and a coordinator outage only STALES the table,
+  * a rollout shifts generations with zero failed client requests and
+    zero XLA recompiles (disk exec cache prewarm), skips replicas that
+    die mid-wave, and rolls back automatically when the SLO gate fires,
+  * the mxnet_router_* / mxnet_rollout_* Prometheus families are
+    scrapeable live and breaker trips leave flight-recorder breadcrumbs.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import fault, nd
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.kvstore_server import AsyncServer
+from incubator_mxnet_tpu.serve import (ModelServer, Predictor,
+                                       ReplicaAgent, RolloutManager,
+                                       Router, ServeRegistry)
+from incubator_mxnet_tpu.serve import control_plane as cp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+IN_DIM, OUT_DIM = 6, 4
+
+
+@pytest.fixture(scope="module")
+def artifact():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(OUT_DIM))
+    net.initialize()
+    net(nd.array(np.zeros((1, IN_DIM), np.float32)))
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "model")
+    net.export(path)
+    # generation 1: same graph, visibly different weights
+    arrs = nd.load(path + "-0000.params")
+    nd.save(os.path.join(d, "gen1.params"),
+            {k: v * 2.0 + 1.0 for k, v in arrs.items()})
+    return path, os.path.join(d, "gen1.params"), net
+
+
+def _coordinator():
+    srv = AsyncServer()
+    addr = srv.start()
+    return srv, f"{addr} {srv.token}"
+
+
+# -- ServeRegistry -----------------------------------------------------
+
+
+def test_serve_registry_lifecycle_and_liveness_window():
+    reg = ServeRegistry(live_window_s=0.25)
+    r = reg.register("m", None, 0, (2, 4), "h:1")
+    assert r["replica_id"] == "r0"
+    # second registration gets a distinct auto id; explicit ids stick
+    assert reg.register("m", None, 0, (2, 4), "h:2")["replica_id"] == "r1"
+    assert reg.register("m", "mine", 0, (), "h:3")["replica_id"] == "mine"
+    view = reg.view("m")["replicas"]
+    assert set(view) == {"r0", "r1", "mine"}
+    assert all(not row["ready"] for row in view.values())
+
+    reg.beat("m", "r0", 7, ready=True, draining=False)
+    row = reg.view("m")["replicas"]["r0"]
+    assert row["ready"] and row["live"] and row["generation"] == 7
+    # a beat for a replica this registry never saw: re-register signal
+    assert reg.beat("m", "ghost", 0, True)["registered"] is False
+
+    # liveness decays with beat age — no deregistration needed
+    time.sleep(0.35)
+    assert reg.view("m")["replicas"]["r0"]["live"] is False
+    reg.beat("m", "r0", 7, ready=True)
+    assert reg.view("m")["replicas"]["r0"]["live"] is True
+
+    # model scoping: another model's replicas don't leak into the view
+    reg.register("other", None, 0, (), "h:9")
+    assert "r2" not in reg.view("m")["replicas"]
+    assert set(reg.view(None)["replicas"]) >= {"r0", "r2"}
+
+    e0 = reg.view("m")["epoch"]
+    assert reg.deregister("m", "r0")["removed"] is True
+    assert reg.view("m")["epoch"] == e0 + 1
+    assert reg.deregister("m", "r0")["removed"] is False
+
+
+# -- ReplicaAgent ------------------------------------------------------
+
+
+class _FakeServer:
+    """The agent's view of a ModelServer: identity + health properties."""
+    generation = 0
+    buckets = (2, 4)
+    ready = True
+    draining = False
+    address = ("127.0.0.1", 65000)
+
+
+def test_replica_agent_beats_and_reregisters_after_registry_loss():
+    srv, handle = _coordinator()
+    try:
+        agent = ReplicaAgent(_FakeServer(), handle, model="m",
+                             period_s=3600)     # loop idle; beat manually
+        agent.start()
+        rid = agent.replica_id
+        view = srv._serve_registry().view("m")["replicas"]
+        assert view[rid]["ready"] is True       # start() beat readiness in
+        assert view[rid]["http_addr"] == "127.0.0.1:65000"
+
+        # simulate coordinator state loss: the row vanishes, the next
+        # beat sees registered=False and re-registers under the SAME id
+        srv._serve_registry().deregister("m", rid)
+        agent.beat_now()
+        assert agent.replica_id == rid
+        assert rid in srv._serve_registry().view("m")["replicas"]
+
+        agent.stop(deregister=True)
+        assert srv._serve_registry().view("m")["replicas"] == {}
+    finally:
+        srv.stop()
+
+
+def test_model_server_registers_and_drain_deregisters(artifact):
+    path, _, _ = artifact
+    srv, handle = _coordinator()
+    pred = Predictor.from_artifact(path, bucket_sizes=(2, 4))
+    ms = ModelServer(pred, max_latency_ms=2.0, max_queue=16,
+                     model="m", generation=5, coordinator=handle)
+    try:
+        ms.start()
+        rid = ms._agent.replica_id
+        row = srv._serve_registry().view("m")["replicas"][rid]
+        assert row["generation"] == 5 and row["ready"] is True
+        assert row["buckets"] == [2, 4]
+        ms.begin_drain("drain for the registry audit")
+        # drain deregistered us: routers stop seeing the replica at all
+        assert rid not in srv._serve_registry().view("m")["replicas"]
+    finally:
+        ms.stop()
+        srv.stop()
+
+
+# -- Router discovery --------------------------------------------------
+
+
+def test_router_discovers_and_survives_coordinator_outage(artifact):
+    path, _, net = artifact
+    srv, handle = _coordinator()
+    pred = Predictor.from_artifact(path, bucket_sizes=(2, 4))
+    ms = ModelServer(pred, max_latency_ms=2.0, max_queue=32,
+                     model="m", coordinator=handle)
+    router = Router(coordinator=handle, model="m", deadline_ms=30000,
+                    refresh_ms=60)
+    try:
+        ms.start()
+        router.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if router.stats.snapshot()["gauges"].get("replicas_ready"):
+                break
+            time.sleep(0.05)
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        out = router.request({"data": x})
+        want = net(nd.array(x[None])).asnumpy()[0]
+        np.testing.assert_allclose(np.asarray(out[0], np.float32), want,
+                                   rtol=1e-5)
+
+        # coordinator dies: discovery fails but the LAST table keeps
+        # routing (stale beats empty)
+        srv.stop()
+        time.sleep(0.2)
+        out = router.request({"data": x})
+        np.testing.assert_allclose(np.asarray(out[0], np.float32), want,
+                                   rtol=1e-5)
+    finally:
+        router.stop()
+        ms.stop()
+        srv.stop()
+
+
+# -- RolloutManager ----------------------------------------------------
+
+
+def test_rollout_shifts_generations_zero_downtime(artifact):
+    """Two replicas, wave_size=1: the rollout shifts both to gen 1 under
+    sustained client load with zero failed requests, and the swap reuses
+    the warm executables (no cold buckets reported)."""
+    path, gen1_params, _ = artifact
+    srv, handle = _coordinator()
+    preds = [Predictor.from_artifact(path, bucket_sizes=(2, 4),
+                                     input_shapes={"data": (1, IN_DIM)})
+             for _ in range(2)]
+    for p in preds:
+        p.warmup()
+    servers = [ModelServer(p, max_latency_ms=2.0, max_queue=64,
+                           model="m", generation=0, coordinator=handle)
+               for p in preds]
+    router = Router(coordinator=handle, model="m", deadline_ms=30000,
+                    retries=6, backoff_ms=10, refresh_ms=60)
+    stop_load = threading.Event()
+    failures, oks = [], []
+
+    def load():
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        while not stop_load.is_set():
+            try:
+                router.request({"data": x})
+                oks.append(1)
+            except Exception as e:      # noqa: BLE001
+                failures.append(repr(e))
+
+    try:
+        for s in servers:
+            s.start()
+        router.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if router.stats.snapshot()["gauges"].get("replicas_ready") == 2:
+                break
+            time.sleep(0.05)
+        threads = [threading.Thread(target=load) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+
+        rm = RolloutManager(handle, model="m", wave_size=1, settle_s=0.05,
+                            slo_check=lambda: [])
+        res = rm.rollout(gen1_params, generation=1)
+        time.sleep(0.3)
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        assert res["ok"] is True and res["state"] == "done"
+        assert sorted(res["updated"]) == sorted(
+            srv._serve_registry().view("m")["replicas"])
+        assert res["skipped"] == []
+        assert all(s.generation == 1 for s in servers)
+        for rid, row in srv._serve_registry().view("m")["replicas"].items():
+            assert row["generation"] == 1, (rid, row)
+        assert len(oks) > 0
+        assert failures == [], failures[:5]
+        # warm swap: every reload warmed from memory/disk, none compiled
+        assert rm.state == "done"
+        prom = rm.render_prometheus()
+        assert 'mxnet_rollout_state{model="m",state="done"} 1' in prom
+        assert 'mxnet_rollout_generation{model="m"} 1' in prom
+        assert 'mxnet_rollout_replicas_updated_total{model="m"} 2' in prom
+    finally:
+        stop_load.set()
+        router.stop()
+        for s in servers:
+            s.stop()
+        srv.stop()
+
+
+def test_rollout_slo_gate_rolls_back(artifact):
+    """The SLO gate fires after the first wave: every updated replica is
+    rolled back to its previous generation, the rest are never touched,
+    and a rollout_rollback alert + counters record it."""
+    path, gen1_params, _ = artifact
+    srv, handle = _coordinator()
+    from incubator_mxnet_tpu import fleetobs
+    alerts_before = fleetobs.stats()["rollout_alerts"]
+    rollbacks_before = cp.stats()["rollbacks"]
+    preds = [Predictor.from_artifact(path, bucket_sizes=(2, 4))
+             for _ in range(2)]
+    servers = [ModelServer(p, max_latency_ms=2.0, max_queue=16,
+                           model="m", generation=0, coordinator=handle)
+               for p in preds]
+    calls = []
+
+    def slo_check():
+        calls.append(1)
+        return ["p99(serve.latency) < 50ms"]    # firing from wave 0 on
+
+    try:
+        for s in servers:
+            s.start()
+        rm = RolloutManager(handle, model="m", wave_size=1, settle_s=0,
+                            slo_check=slo_check)
+        res = rm.rollout(gen1_params, generation=1)
+        assert res["ok"] is False and res["state"] == "rolled_back"
+        assert res["alerts"] == ["p99(serve.latency) < 50ms"]
+        assert len(res["updated"]) == 1 and res["rollback_failed"] == []
+        # the one updated replica is back on gen 0; nobody is on gen 1
+        assert all(s.generation == 0 for s in servers)
+        assert rm.state == "rolled_back"
+        assert cp.stats()["rollbacks"] == rollbacks_before + 1
+        assert fleetobs.stats()["rollout_alerts"] == alerts_before + 1
+        prom = rm.render_prometheus()
+        assert ('mxnet_rollout_state{model="m",state="rolled_back"} 1'
+                in prom)
+        assert 'mxnet_rollout_rollbacks_total{model="m"} 1' in prom
+    finally:
+        for s in servers:
+            s.stop()
+        srv.stop()
+
+
+def test_rollout_reload_error_triggers_rollback(artifact):
+    """A replica that ANSWERS /admin/reload with an error (bad params
+    path) is a bad-generation signal: rollback, not skip."""
+    path, _, _ = artifact
+    srv, handle = _coordinator()
+    pred = Predictor.from_artifact(path, bucket_sizes=(2, 4))
+    ms = ModelServer(pred, max_latency_ms=2.0, max_queue=16,
+                     model="m", coordinator=handle)
+    try:
+        ms.start()
+        rm = RolloutManager(handle, model="m", settle_s=0,
+                            slo_check=lambda: [])
+        res = rm.rollout("/nonexistent/weights.params", generation=1)
+        assert res["ok"] is False and res["state"] == "rolled_back"
+        assert res["updated"] == []
+        assert any("reload failed" in a for a in res["alerts"])
+        assert ms.generation == 0
+    finally:
+        ms.stop()
+        srv.stop()
+
+
+def test_rollout_requires_live_replicas():
+    srv, handle = _coordinator()
+    try:
+        rm = RolloutManager(handle, model="nobody", slo_check=lambda: [])
+        with pytest.raises(MXNetError, match="no live replicas"):
+            rm.rollout("x.params", generation=1)
+    finally:
+        srv.stop()
+
+
+# -- multiprocess chaos rollout ----------------------------------------
+
+REPLICA = textwrap.dedent("""
+    import json, os, sys, time
+    repo, addr_token, art, cache_dir, outdir, idx = sys.argv[1:7]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MXNET_EXEC_CACHE_DIR"] = cache_dir
+    os.environ["MXNET_HEARTBEAT_INTERVAL"] = "1"
+    sys.path.insert(0, repo)
+    from incubator_mxnet_tpu.serve import ModelServer, Predictor
+
+    pred = Predictor.from_artifact(art, bucket_sizes=(2, 4),
+                                   input_shapes={"data": (1, 6)})
+    warm = pred.warmup()
+    # the builder prewarmed the shared disk tier: a fleet replica must
+    # reach readiness without a single XLA compile
+    assert "miss" not in warm.values(), f"cold disk cache: {warm}"
+    srv = ModelServer(pred, max_latency_ms=2.0, max_queue=64,
+                      model="chaos", generation=0, coordinator=addr_token)
+    host, port = srv.start()
+    assert srv.ready, srv.readiness()
+    tmp = os.path.join(outdir, f"ready-{idx}.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(), "addr": f"{host}:{port}"}, f)
+    os.replace(tmp, os.path.join(outdir, f"ready-{idx}.json"))
+
+    stop = os.path.join(outdir, "stop")
+    deadline = time.monotonic() + 240
+    while not os.path.exists(stop) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    # the survivor must have been shifted to generation 1 by the rollout
+    assert srv.generation == 1, f"generation {srv.generation}"
+    sys.stdout.write("GEN_OK_1\\n")
+    srv.shutdown_gracefully("chaos-drill-exit")
+    sys.stdout.write("REPLICA_EXIT_OK\\n")
+""")
+
+BUILDER = textwrap.dedent("""
+    import os, sys
+    repo, outdir, cache_dir = sys.argv[1:4]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MXNET_EXEC_CACHE_DIR"] = cache_dir
+    sys.path.insert(0, repo)
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.serve import Predictor
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net(nd.array(np.zeros((1, 6), np.float32)))
+    art = os.path.join(outdir, "model")
+    net.export(art)
+    arrs = nd.load(art + "-0000.params")
+    nd.save(os.path.join(outdir, "gen1.params"),
+            {k: v * 2.0 + 1.0 for k, v in arrs.items()})
+    # prewarm the shared disk tier for every ladder bucket so replica
+    # processes (and rollouts) never compile
+    pred = Predictor.from_artifact(art, bucket_sizes=(2, 4),
+                                   input_shapes={"data": (1, 6)})
+    warm = pred.warmup()
+    assert set(warm) == {2, 4}, warm
+    sys.stdout.write("BUILDER_OK\\n")
+""")
+
+
+@pytest.mark.timeout(420)
+def test_chaos_rollout_multiprocess(tmp_path, monkeypatch):
+    """The acceptance chaos drill: 2 replica processes behind a router
+    under sustained load; a rollout shifts generations wave by wave
+    while one replica is kill -9'd mid-rollout. Zero failed client
+    requests, zero XLA recompiles (shared disk exec cache), the rollout
+    skips the corpse, Prometheus families scrape live, and the router's
+    breaker trip leaves flight-recorder breadcrumbs."""
+    outdir = tmp_path / "chaos"
+    cache_dir = tmp_path / "exec-cache"
+    flight_dir = tmp_path / "flight"
+    for d in (outdir, cache_dir, flight_dir):
+        d.mkdir()
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}
+    build = subprocess.run(
+        [sys.executable, "-c", BUILDER, REPO, str(outdir), str(cache_dir)],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert build.returncode == 0, build.stderr[-2000:]
+    assert "BUILDER_OK" in build.stdout
+    art = str(outdir / "model")
+    gen1 = str(outdir / "gen1.params")
+
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER", str(flight_dir))
+    fault.flight_reset()
+    coord, handle = _coordinator()
+    procs = []
+    stop_load = threading.Event()
+    failures, oks = [], []
+    try:
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", REPLICA, REPO, handle, art,
+                 str(cache_dir), str(outdir), str(i)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env))
+        # wait for both replicas to come up warm + registered
+        info = {}
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and len(info) < 2:
+            for i in range(2):
+                f = outdir / f"ready-{i}.json"
+                if i not in info and f.exists():
+                    info[i] = json.loads(f.read_text())
+                if procs[i].poll() is not None:
+                    pytest.fail(f"replica {i} died early:\n"
+                                f"{procs[i].stderr.read()[-2000:]}")
+            time.sleep(0.1)
+        assert len(info) == 2, "replicas never became ready"
+        addr_to_pid = {v["addr"]: v["pid"] for v in info.values()}
+
+        router = Router(coordinator=handle, model="chaos",
+                        deadline_ms=30000, retries=8, backoff_ms=20,
+                        hedge_delay_ms=100, breaker_failures=2,
+                        breaker_cooldown_ms=60000, refresh_ms=100)
+        router.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if router.stats.snapshot()["gauges"].get("replicas_ready") == 2:
+                break
+            time.sleep(0.05)
+
+        def load():
+            x = np.random.rand(IN_DIM).astype(np.float32)
+            while not stop_load.is_set():
+                try:
+                    out = router.request({"data": x})
+                    assert np.asarray(out[0]).shape == (OUT_DIM,)
+                    oks.append(1)
+                except Exception as e:      # noqa: BLE001
+                    failures.append(repr(e))
+
+        threads = [threading.Thread(target=load) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+
+        # rollout r0 then r1; the SLO-gate hook doubles as the chaos
+        # hand: after wave 0 settles, kill -9 the wave-1 replica
+        view = coord._serve_registry().view("chaos")["replicas"]
+        order = sorted(view)
+        victim_pid = addr_to_pid[view[order[1]]["http_addr"]]
+        killed = []
+
+        def gate():
+            if not killed:
+                os.kill(victim_pid, signal.SIGKILL)
+                killed.append(victim_pid)
+                time.sleep(0.3)     # let the corpse go cold on the wire
+            return []
+
+        rm = RolloutManager(handle, model="chaos", wave_size=1,
+                            settle_s=0.2, slo_check=gate,
+                            reload_timeout_s=120)
+        res = rm.rollout(gen1, generation=1)
+
+        # live Prometheus scrape: router + rollout families together
+        mh, mp = router.start_metrics_http(extra=(rm.render_prometheus,))
+        scrape = urllib.request.urlopen(
+            f"http://{mh}:{mp}/metrics", timeout=30).read().decode()
+
+        # keep load running long enough for the breaker to trip on the
+        # corpse, then stop
+        time.sleep(1.0)
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        # -- the acceptance assertions ---------------------------------
+        assert res["ok"] is True and res["state"] == "done", res
+        assert res["updated"] == [order[0]], res
+        assert res["skipped"] == [order[1]], res
+        assert killed == [victim_pid]
+        assert len(oks) > 20, f"load never flowed ({len(oks)} oks)"
+        assert failures == [], failures[:5]
+
+        assert "mxnet_router_requests_total" in scrape
+        assert 'mxnet_rollout_state{model="chaos",state="done"} 1' \
+            in scrape
+        assert 'mxnet_rollout_generation{model="chaos"} 1' in scrape
+        assert "mxnet_router_request_latency_ms_bucket" in scrape
+
+        # the corpse's breaker opened and left a breadcrumb
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if "open" in router.breaker_states().values():
+                break
+            try:
+                router.request(
+                    {"data": np.zeros(IN_DIM, np.float32)})
+            except Exception:       # noqa: BLE001
+                pass
+        assert "open" in router.breaker_states().values()
+        dump = fault.flight_dump("chaos-test-postmortem")
+        assert dump is not None
+        recs = json.loads(open(dump).read())["records"]
+        assert any(r["kind"] == "router_breaker" and
+                   r["transition"] == "open" for r in recs), \
+            [r["kind"] for r in recs]
+
+        # the survivor serves generation 1 and exits cleanly
+        router.stop()
+        (outdir / "stop").write_text("")
+        survivor = procs[0] if info[0]["pid"] != victim_pid else procs[1]
+        out, err = survivor.communicate(timeout=120)
+        assert survivor.returncode == 0, err[-2000:]
+        assert "GEN_OK_1" in out and "REPLICA_EXIT_OK" in out
+    finally:
+        stop_load.set()
+        (outdir / "stop").write_text("")
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            try:
+                p.communicate(timeout=30)
+            except (ValueError, OSError, subprocess.TimeoutExpired):
+                pass
+        coord.stop()
+        fault.flight_reset()
+
+
+# -- module counters / diagnose surface --------------------------------
+
+
+def test_control_plane_counters_cover_roles():
+    s = cp.stats()
+    for key in ("registrations", "deregistrations", "beats",
+                "rollouts_started", "rollout_waves",
+                "rollout_replicas_updated", "rollout_replica_failures",
+                "rollbacks", "graceful_shutdowns"):
+        assert key in s and s[key] >= 0

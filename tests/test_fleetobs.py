@@ -430,6 +430,45 @@ def test_http_endpoints_serve_registry():
         fleetobs.stop_http(srv)
 
 
+def test_http_readyz_ready_fn():
+    """/healthz is liveness (always 200); /readyz consults ready_fn."""
+    reg = fleetobs.FleetRegistry(specs=None, interval_s=3600)
+    state = {"ready": False, "why": ["warming"]}
+    srv = fleetobs.start_http(reg, host="127.0.0.1", port=0,
+                              ready_fn=lambda: (state["ready"],
+                                                list(state["why"])))
+    try:
+        host, port = srv.server_address[:2]
+        base = f"http://{host}:{port}"
+        # not ready: liveness still 200, readiness 503 naming why
+        assert urllib.request.urlopen(base + "/healthz",
+                                      timeout=10).status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/readyz", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read()) == {"ready": False,
+                                               "why": ["warming"]}
+        # flip ready: readiness follows
+        state["ready"], state["why"] = True, []
+        rz = urllib.request.urlopen(base + "/readyz", timeout=10)
+        assert rz.status == 200
+        assert json.loads(rz.read()) == {"ready": True, "why": []}
+    finally:
+        fleetobs.stop_http(srv)
+
+
+def test_http_readyz_without_ready_fn_is_healthz():
+    reg = fleetobs.FleetRegistry(specs=None, interval_s=3600)
+    srv = fleetobs.start_http(reg, host="127.0.0.1", port=0)
+    try:
+        host, port = srv.server_address[:2]
+        rz = urllib.request.urlopen(f"http://{host}:{port}/readyz",
+                                    timeout=10)
+        assert rz.status == 200
+    finally:
+        fleetobs.stop_http(srv)
+
+
 def test_registry_weakset_feeds_diagnose_surface():
     reg = fleetobs.FleetRegistry(specs=[], interval_s=3600)
     assert reg in fleetobs.registries()

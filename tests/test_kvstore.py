@@ -432,6 +432,76 @@ def test_async_client_call_retries_over_fresh_connection(monkeypatch):
         srv.stop()
 
 
+def test_async_client_retry_backoff_jitter(monkeypatch):
+    """Two clients' retry schedules DIVERGE (thundering-herd fix): after a
+    coordinator restart a fleet must not redial in lockstep at exactly
+    backoff * 2^k. Jitter is per-client uniform [0.5, 1.5); the env kill
+    switch restores the deterministic schedule."""
+    from incubator_mxnet_tpu.kvstore_server import AsyncClient, AsyncServer
+
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_BACKOFF_MS", "100")
+    srv = AsyncServer()
+    addr = srv.start()
+    try:
+        c1 = AsyncClient(addr, srv.token)
+        c2 = AsyncClient(addr, srv.token)
+        base = [min(10.0, 0.1 * 2 ** (a - 1)) for a in range(1, 7)]
+        s1 = [c1._backoff_s(a) for a in range(1, 7)]
+        s2 = [c2._backoff_s(a) for a in range(1, 7)]
+        # every jittered delay stays within the [0.5, 1.5) envelope of
+        # the deterministic schedule (and under the 10s cap)
+        for sched in (s1, s2):
+            for got, b in zip(sched, base):
+                assert 0.5 * b <= got <= min(10.0, 1.5 * b)
+        # two clients drawing 6 delays each from a continuous range
+        # colliding on ALL of them means the rng is shared or dead
+        assert s1 != s2
+        # re-sampling the same client also varies (jitter per attempt,
+        # not a fixed per-client factor)
+        assert [c1._backoff_s(a) for a in range(1, 7)] != s1
+        c1.close()
+        c2.close()
+
+        monkeypatch.setenv("MXNET_KVSTORE_RETRY_JITTER", "0")
+        c3 = AsyncClient(addr, srv.token)
+        assert [c3._backoff_s(a) for a in range(1, 7)] == base
+        c3.close()
+    finally:
+        srv.stop()
+
+
+def test_serve_registry_wire_ops():
+    """The serving control plane's serve_* ops ride the same MAC'd wire:
+    register (auto-id), beat (readiness/liveness), view, deregister —
+    and a beat for an unknown replica answers registered=False (the
+    re-register-after-coordinator-restart signal) instead of erring."""
+    from incubator_mxnet_tpu.kvstore_server import AsyncClient, AsyncServer
+
+    srv = AsyncServer()
+    addr = srv.start()
+    try:
+        c = AsyncClient(addr, srv.token)
+        reply = c.call("serve_register", "m", None, 3, [4, 8], "h:1234")
+        rid = reply["replica_id"]
+        assert rid == "r0" and reply["epoch"] >= 1
+        # registered but never beaten: present, not ready
+        row = c.call("serve_view", "m")["replicas"][rid]
+        assert row["ready"] is False and row["live"] is True
+        assert row["generation"] == 3 and row["buckets"] == [4, 8]
+        assert c.call("serve_beat", "m", rid, 3, True, False) == {
+            "registered": True, "epoch": reply["epoch"]}
+        row = c.call("serve_view", "m")["replicas"][rid]
+        assert row["ready"] is True and row["draining"] is False
+        # unknown replica (coordinator restarted): signal, not error
+        assert c.call("serve_beat", "m", "ghost", 0, True,
+                      False)["registered"] is False
+        assert c.call("serve_deregister", "m", rid)["removed"] is True
+        assert c.call("serve_view", "m")["replicas"] == {}
+        c.close()
+    finally:
+        srv.stop()
+
+
 def test_elastic_kvstore_registry_end_to_end(monkeypatch):
     """Elastic direct-connect mode (MXNET_KVSTORE_ASYNC_ADDR): server
     assigns ranks, a join flips the membership-dirty flag via heartbeat,

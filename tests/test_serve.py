@@ -491,3 +491,220 @@ def test_predictor_records_compiler_cost(artifact):
     assert costs, sorted(profiler.cost_stats())
     rec = next(iter(costs.values()))
     assert rec["flops"] > 0 and rec["bytes_accessed"] > 0
+
+
+# -- control plane: liveness vs readiness, drain, chaos ----------------
+
+
+@pytest.fixture()
+def clean_faults():
+    from incubator_mxnet_tpu import fault
+    fault.set_fault_spec("")
+    yield fault
+    fault.set_fault_spec("")
+
+
+def test_healthz_vs_readyz_lifecycle(artifact):
+    """A replica is LIVE the whole time but READY only in the middle:
+    cold -> (warmup) ready -> (drain) unready-but-still-live."""
+    path, _ = artifact
+    pred = Predictor.from_artifact(path, bucket_sizes=(2, 4),
+                                   input_shapes={"data": (1, IN_DIM)})
+    srv = serve.ModelServer(pred, max_latency_ms=2.0, max_queue=16)
+    assert srv._require_warm      # auto-enabled: shapes are declared
+    host, port = srv.start()
+    url = f"http://{host}:{port}"
+    try:
+        def get(path):
+            try:
+                with urllib.request.urlopen(url + path, timeout=30) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        # cold: alive, not ready, why names the warm gate
+        assert get("/healthz")[0] == 200
+        code, body = get("/readyz")
+        assert code == 503 and not body["ready"]
+        assert any("cold buckets" in w for w in body["why"])
+
+        warm = pred.warmup()
+        assert set(warm) == {2, 4}
+        code, body = get("/readyz")
+        assert code == 200 and body["ready"] and body["why"] == []
+
+        # drain: still alive, no longer ready, new requests shed
+        # retryable 503 with Retry-After
+        srv.begin_drain("lifecycle drill")
+        code, body = get("/healthz")
+        assert code == 200 and body["draining"] is True
+        code, body = get("/readyz")
+        assert code == 503 and "draining" in body["why"]
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        req = urllib.request.Request(
+            url + "/predict",
+            json.dumps({"inputs": {"data": x.tolist()}}).encode(),
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+        assert json.loads(ei.value.read())["retryable"] is True
+    finally:
+        srv.stop()
+
+
+def test_graceful_shutdown_on_sigterm(predictor):
+    """SIGTERM -> drain -> stop, without killing in-flight work: the
+    handler thread runs the same begin_drain()+stop() sequence."""
+    import signal as _signal
+
+    from incubator_mxnet_tpu.serve import control_plane as cp
+
+    before = cp.stats()["graceful_shutdowns"]
+    srv = serve.ModelServer(predictor, max_latency_ms=2.0, max_queue=16)
+    srv.start()
+    srv.install_sigterm()
+    try:
+        os.kill(os.getpid(), _signal.SIGTERM)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and srv._httpd is not None:
+            time.sleep(0.02)
+        assert srv._httpd is None, "SIGTERM did not stop the server"
+        assert srv.draining is True
+        assert cp.stats()["graceful_shutdowns"] == before + 1
+    finally:
+        srv.restore_sigterm()
+        srv.stop()
+
+
+def test_batcher_pause_quiesce_swap(predictor):
+    """The drain primitives under the rollout: pause sheds retryable,
+    quiesce waits for ADMITTED work (not just an empty queue), resume
+    reopens, swap_predict changes the dispatch function atomically."""
+    bat = DynamicBatcher(predictor.predict, buckets=(2, 4, 8),
+                         max_latency_ms=1.0, max_queue=16)
+    bat.start()
+    try:
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        fut = bat.submit({"data": x})
+        assert np.asarray(fut.result(timeout=60)[0]).shape == (OUT_DIM,)
+        bat.pause("rollout test")
+        assert bat.accepting is False
+        with pytest.raises(Overloaded, match="admission paused"):
+            bat.submit({"data": x})
+        assert bat.quiesce(timeout=30) is True
+        seen = []
+        bat.swap_predict(lambda inputs: (seen.append(True)
+                                         or predictor.predict(inputs)))
+        bat.resume()
+        fut = bat.submit({"data": x})
+        fut.result(timeout=60)
+        assert seen, "swapped predict fn was not dispatched"
+        assert bat.stats.snapshot()["shed_draining"] >= 1
+    finally:
+        bat.stop()
+
+
+def test_router_chaos_drop_then_retry(predictor, clean_faults):
+    """route@1:drop — the first routed call dies on an injected connect
+    error; the bounded-retry/hedge policy completes the request against
+    the other replica with zero caller-visible failures."""
+    from incubator_mxnet_tpu.serve import Router
+
+    s1 = serve.ModelServer(predictor, max_latency_ms=2.0, max_queue=32)
+    s2 = serve.ModelServer(predictor, max_latency_ms=2.0, max_queue=32)
+    a1, a2 = s1.start(), s2.start()
+    try:
+        r = Router(replicas=[f"{a1[0]}:{a1[1]}", f"{a2[0]}:{a2[1]}"],
+                   deadline_ms=30000, retries=3, backoff_ms=5,
+                   hedge_delay_ms=50)
+        clean_faults.set_fault_spec("route@1:drop")
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        out = r.request({"data": x})
+        assert np.asarray(out[0]).shape == (OUT_DIM,)
+        snap = r.stats.snapshot()
+        assert snap["counters"]["connect_errors_total"] >= 1
+        assert snap["counters"]["responses_ok_total"] == 1
+        assert snap["counters"].get("requests_failed_total", 0) == 0
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_router_chaos_delay_hedges(predictor, clean_faults):
+    """route@1:delay — a slow primary is hedged after the configured
+    delay and the hedge's answer wins well before the primary's."""
+    from incubator_mxnet_tpu.serve import Router
+
+    s1 = serve.ModelServer(predictor, max_latency_ms=2.0, max_queue=32)
+    s2 = serve.ModelServer(predictor, max_latency_ms=2.0, max_queue=32)
+    a1, a2 = s1.start(), s2.start()
+    try:
+        r = Router(replicas=[f"{a1[0]}:{a1[1]}", f"{a2[0]}:{a2[1]}"],
+                   deadline_ms=30000, retries=1, hedge_delay_ms=50)
+        clean_faults.set_fault_spec("route@1:delay=2.0")
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        t0 = time.monotonic()
+        out = r.request({"data": x})
+        took = time.monotonic() - t0
+        assert np.asarray(out[0]).shape == (OUT_DIM,)
+        assert took < 1.9, f"hedge did not win ({took:.2f}s)"
+        snap = r.stats.snapshot()
+        assert snap["counters"]["hedges_total"] >= 1
+        assert snap["counters"]["hedge_wins_total"] >= 1
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_router_breaker_opens_and_half_open_probe(predictor, clean_faults):
+    """Consecutive connect failures open the per-replica breaker (the
+    dead replica leaves the candidate set); after the cooldown a single
+    half-open probe is admitted and its failure re-opens. A 503 shed
+    never counts as a breaker failure."""
+    from incubator_mxnet_tpu.serve import Router
+
+    s1 = serve.ModelServer(predictor, max_latency_ms=2.0, max_queue=32)
+    a1 = s1.start()
+    # static table: one live replica + one black hole (refused connect)
+    import socket as _socket
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_addr = f"127.0.0.1:{s.getsockname()[1]}"
+    try:
+        r = Router(replicas=[dead_addr, f"{a1[0]}:{a1[1]}"],
+                   deadline_ms=30000, retries=4, backoff_ms=5,
+                   hedge_delay_ms=100, breaker_failures=2,
+                   breaker_cooldown_ms=150)
+        x = np.random.rand(IN_DIM).astype(np.float32)
+        for _ in range(6):
+            out = r.request({"data": x})     # always answered by s1
+            assert np.asarray(out[0]).shape == (OUT_DIM,)
+        assert r.breaker_states()["static0"] == "open"
+        snap = r.stats.snapshot()
+        assert snap["counters"]["breaker_open_total"] >= 1
+        assert snap["counters"]["connect_errors_total"] >= 2
+        # healthy replica's breaker stayed closed through its successes
+        assert r.breaker_states()["static1"] == "closed"
+        # cooldown elapses -> a half-open probe is admitted; its failure
+        # re-opens. The probe only fires when rotation hands the suspect
+        # replica the primary (or hedge) slot, so drive requests until
+        # the state machine has made the round trip.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            time.sleep(0.2)                  # > cooldown between tries
+            r.request({"data": x})
+            snap = r.stats.snapshot()["counters"]
+            if (snap.get("breaker_half_open_total", 0) >= 1
+                    and r.breaker_states()["static0"] == "open"):
+                break
+        assert r.breaker_states()["static0"] == "open"
+        assert r.stats.snapshot()["counters"]["breaker_half_open_total"] >= 1
+        # breaker-state gauge families render for scraping
+        prom = r.render_prometheus()
+        assert 'mxnet_router_breaker_state{router="router",' \
+               'replica="static0"} 2' in prom
+        assert "mxnet_router_request_latency_ms_bucket" in prom
+    finally:
+        s1.stop()

@@ -103,6 +103,7 @@ def test_diagnose_runs():
                     "Kernel Autotuner (tune)", "Fault Tolerance (fault)",
                     "Step Breakdown (profiler attribution)",
                     "Fleet Observability (fleetobs)",
+                    "Control Plane (serve)",
                     "Static Analysis (mxlint)",
                     "Graph Analysis (shardlint)"):
         assert section in r.stdout, f"missing section {section!r}"

@@ -209,6 +209,31 @@ def main():
     except Exception as e:
         print("fleetobs probe FAILED:", e)
 
+    print("----------Control Plane (serve)----------")
+    try:
+        from incubator_mxnet_tpu.serve import control_plane
+        from incubator_mxnet_tpu.util import getenv_int
+        s = control_plane.stats()
+        print("registry     :",
+              {k: s[k] for k in ("registrations", "deregistrations",
+                                 "beats", "graceful_shutdowns")})
+        print("rollout      :",
+              {k.replace("rollout_", ""): s[k] for k in
+               ("rollouts_started", "rollout_waves",
+                "rollout_replicas_updated", "rollout_replica_failures",
+                "rollbacks")})
+        print("router knobs :",
+              {"deadline_ms": getenv_int("MXNET_ROUTER_DEADLINE_MS"),
+               "retries": getenv_int("MXNET_ROUTER_RETRIES"),
+               "hedge_delay_ms": getenv_int("MXNET_ROUTER_HEDGE_DELAY_MS"),
+               "breaker_failures":
+                   getenv_int("MXNET_ROUTER_BREAKER_FAILURES"),
+               "breaker_cooldown_ms":
+                   getenv_int("MXNET_ROUTER_BREAKER_COOLDOWN_MS")})
+        print("live window  :", control_plane._live_window_s(), "s")
+    except Exception as e:
+        print("control plane probe FAILED:", e)
+
     print("----------Static Analysis (mxlint)----------")
     try:
         from tools.mxlint import lint_paths

@@ -35,6 +35,20 @@ LOCK_ORDER = {
     "shardlint.py": ("_lock",),
     "serve/batcher.py": ("self._lock",),
     "serve/stats.py": ("self._lock",),
+    # serve/control_plane: a ServeRegistry/ReplicaAgent/RolloutManager
+    # instance lock guards its own table or wire client; the module
+    # counter lock is a LEAF — _bump and flight_record run only after
+    # instance state is settled (fleetobs discipline).
+    "serve/control_plane.py": ("self._lock", "_lock"),
+    # serve/router: the routing lock (replica table + breakers +
+    # round-robin cursor) is OUTERMOST; RouterStats' counter lock is a
+    # LEAF. Breaker transitions are recorded (stats/flight/log) only
+    # after releasing the routing lock; network calls hold neither.
+    "serve/router.py": ("self._rlock", "self._lock"),
+    # serve/server: ModelServer's drain/swap lock serializes begin_drain
+    # against reload's pause→quiesce→swap→resume; batcher/stats locks
+    # are acquired by callees, not nested at this module's sites.
+    "serve/server.py": ("self._drain_lock",),
     # fleetobs: a FleetRegistry's instance lock guards the per-rank fold
     # state, SLO engine, control-op queue, and stored profiles; the
     # module lock is a LEAF guarding the counter registry and the
