@@ -116,7 +116,10 @@ class FaultInjector:
     checkpoint write), ``route`` (every router HTTP attempt against a
     serving replica — drop exercises retry/breaker, delay exercises
     hedging), ``rollout`` (every RolloutManager wave — kill is the
-    mid-rollout operator death, delay a wedged wave). Empty spec =
+    mid-rollout operator death, delay a wedged wave), ``decode`` (every
+    continuous-batching decode step, fired BEFORE the device call —
+    kill is the replica dying mid-stream with tokens already flushed,
+    the postmortem + router-failover chaos drill). Empty spec =
     zero per-call overhead.
     """
 

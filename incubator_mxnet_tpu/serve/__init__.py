@@ -25,6 +25,12 @@ shape-bucketing discipline):
   router.py     Router — client-side load balancing across ready
                 replicas with deadlines, jittered retries, hedged
                 requests, and per-replica circuit breakers.
+  decode.py     DecodeScheduler / DecodePredictor / PageAllocator —
+                continuous-batching autoregressive decode: iteration-
+                level admit/retire over a fixed slot batch, paged
+                KV-cache (free-list pages + per-sequence page tables),
+                AOT-warmed prefill buckets + ONE decode executable,
+                streamed per-token through ModelServer's /generate.
 
 Typical use::
 
@@ -41,9 +47,12 @@ from .server import ModelServer
 from .stats import LatencyHistogram, ServingStats
 from .control_plane import ReplicaAgent, RolloutManager, ServeRegistry
 from .router import NoReplicaAvailable, RouteError, Router, RouterStats
+from .decode import (DecodePredictor, DecodeScheduler, DecodeStream,
+                     PageAllocator)
 
 __all__ = ["Predictor", "BucketLadder", "DynamicBatcher", "ModelServer",
            "ServingStats", "LatencyHistogram", "Overloaded",
            "DeadlineExceeded", "ServeRegistry", "ReplicaAgent",
            "RolloutManager", "Router", "RouterStats", "RouteError",
-           "NoReplicaAvailable"]
+           "NoReplicaAvailable", "DecodePredictor", "DecodeScheduler",
+           "DecodeStream", "PageAllocator"]

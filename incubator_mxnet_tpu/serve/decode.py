@@ -1,0 +1,767 @@
+"""Continuous-batching autoregressive decode over a paged KV-cache.
+
+Predict-only serving (batcher.py) batches at REQUEST granularity: a
+batch runs to completion before the next one forms. Autoregressive
+decode would waste most of that batch — sequences finish at different
+lengths, and a request-level batch holds every slot hostage to its
+longest member. This module schedules at ITERATION granularity instead
+(the continuous-batching discipline of Orca/vLLM, applied here on the
+Ragged-Paged-Attention TPU layout, arXiv:2604.15464): every decode step
+first RETIRES finished sequences and ADMITS waiting ones into the freed
+slots, so the fixed-shape decode executable stays full under load.
+
+Three pieces:
+
+``PageAllocator``
+    Free-list allocator over a fixed pool of KV pages. Sequences own
+    whole pages (``page_size`` token rows each); admit pops page ids
+    off the free list, retire pushes them back — ZERO data copies in
+    either direction, because the pages themselves never move: only the
+    per-sequence page table (the indirection the ragged kernel reads)
+    changes.
+
+``DecodePredictor``
+    Owns the decode-side executables in the two-tier compile cache:
+    one PREFILL executable per prompt-length bucket (the Predictor
+    ladder discipline, keys ``serve:prefill[...]``) and exactly ONE
+    fixed-shape DECODE executable over the padded slot batch (key
+    ``serve:decode[...]``). Idle slots ride along with position -1 and
+    their KV writes dropped via out-of-bounds scatter, so steady-state
+    decode does ZERO retraces regardless of which sequences come and go.
+
+``DecodeScheduler``
+    The iteration-level loop: bounded admission queue (Overloaded shed
+    when full, when paused for drain/rollout, or when the projected
+    queue wait breaches ``MXNET_DECODE_QUEUE_BOUND_MS`` — the PR-10
+    queue-wait-histogram admission signal), per-step
+    ``fault.inject("decode")`` chaos hook, and pause/resume/quiesce
+    mirroring DynamicBatcher so the PR-12 control plane drains decode
+    exactly like predict.
+
+Lock hierarchy (declared in tools/mxlint/lock_order.py): scheduler
+``self._lock`` is outermost and guards queue + slot tables only — never
+held across device calls; predictor ``self._compile_lock`` guards
+executable construction; allocator ``self._alloc_lock`` is a leaf.
+The KV pool device arrays are touched ONLY by the scheduler loop
+thread, so they need no lock at all.
+"""
+from __future__ import annotations
+
+import math
+import queue
+import threading
+import time
+from collections import deque
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import util
+from .batcher import DeadlineExceeded, Overloaded
+from .predictor import BucketLadder
+from .stats import ServingStats
+
+__all__ = ["PageAllocator", "DecodePredictor", "DecodeScheduler",
+           "DecodeStream"]
+
+_EOS = object()
+
+
+class PageAllocator:
+    """Free-list allocator over ``num_pages`` fixed-size KV pages.
+
+    O(1) alloc/free of page IDS only; the backing (P, page_size, H, D)
+    pool arrays are owned by the scheduler and never reshaped or
+    compacted. Exhaustion raises the retryable ``Overloaded`` (the
+    caller either sheds 503 or leaves the request queued); freeing a
+    page that is not live raises — a double free here would silently
+    corrupt another sequence's context, so it must be loud.
+    """
+
+    def __init__(self, num_pages):
+        if num_pages < 1:
+            raise MXNetError("PageAllocator needs at least one page")
+        self.num_pages = int(num_pages)
+        self._alloc_lock = threading.Lock()
+        # pop() takes from the tail: keep low page ids first for
+        # readable tests, recency-reuse for cache locality in practice
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._live_set = set()
+        self.high_water = 0
+
+    def alloc(self, n):
+        """Pop `n` page ids; all-or-nothing (no partial grants)."""
+        n = int(n)
+        if n < 1:
+            raise MXNetError(f"alloc({n}): need at least one page")
+        with self._alloc_lock:
+            if n > len(self._free):
+                raise Overloaded(
+                    f"KV page pool exhausted: want {n} pages, "
+                    f"{len(self._free)}/{self.num_pages} free")
+            pages = [self._free.pop() for _ in range(n)]
+            self._live_set.update(pages)
+            self.high_water = max(self.high_water, len(self._live_set))
+        return pages
+
+    def free(self, pages):
+        with self._alloc_lock:
+            for p in pages:
+                if p not in self._live_set:
+                    raise MXNetError(f"double free of KV page {p}")
+                self._live_set.remove(p)
+                self._free.append(p)
+
+    @property
+    def live(self):
+        with self._alloc_lock:
+            return len(self._live_set)
+
+    @property
+    def free_count(self):
+        with self._alloc_lock:
+            return len(self._free)
+
+
+class DecodePredictor:
+    """Decode-side executables for a single-layer attention LM.
+
+    params (all float32 numpy/jax arrays):
+      emb (V, E) | wq, wk, wv, wo (E, E) | w_out (E, V), with
+      E = num_heads * head_dim. One pre-norm-free attention block plus
+      a residual and an output projection — deliberately small, but it
+      exercises every serving-side mechanism (paged KV scatter, ragged
+      attention reads, greedy sampling) the full model would.
+
+    Geometry (page_size/num_pages/max_pages_per_seq/slots) lives here
+    because the DECODE EXECUTABLE'S SHAPE bakes it in: changing any of
+    it is a recompile, so it is constructor state, not a runtime knob.
+    Prompts are padded up a `prompt_buckets` ladder exactly like
+    Predictor; generation is greedy argmax, which makes every stream's
+    token sequence a pure function of its prompt — the property the
+    continuous-vs-sequential bit-identity test relies on.
+    """
+
+    def __init__(self, params, *, num_heads, head_dim, vocab,
+                 prompt_buckets=(4, 8, 16), page_size=None, num_pages=None,
+                 max_pages_per_seq=None, slots=None):
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.vocab = int(vocab)
+        self.embed = self.num_heads * self.head_dim
+        self.page_size = int(page_size if page_size is not None
+                             else util.getenv_int("MXNET_KV_PAGE_SIZE"))
+        self.num_pages = int(num_pages if num_pages is not None
+                             else util.getenv_int("MXNET_KV_PAGES"))
+        self.max_pages_per_seq = int(
+            max_pages_per_seq if max_pages_per_seq is not None
+            else util.getenv_int("MXNET_KV_PAGES_PER_SEQ"))
+        self.slots = int(slots if slots is not None
+                         else util.getenv_int("MXNET_DECODE_SLOTS"))
+        if self.page_size < 1 or self.num_pages < 1 or self.slots < 1:
+            raise MXNetError("decode geometry must be positive")
+        if self.max_pages_per_seq > self.num_pages:
+            raise MXNetError("MXNET_KV_PAGES_PER_SEQ exceeds MXNET_KV_PAGES")
+        self.ladder = BucketLadder(prompt_buckets)
+        exp = {"emb": (self.vocab, self.embed),
+               "wq": (self.embed, self.embed),
+               "wk": (self.embed, self.embed),
+               "wv": (self.embed, self.embed),
+               "wo": (self.embed, self.embed),
+               "w_out": (self.embed, self.vocab)}
+        for name, shape in exp.items():
+            if name not in params:
+                raise MXNetError(f"param {name} missing (need {sorted(exp)})")
+            got = tuple(params[name].shape)
+            if got != shape:
+                raise MXNetError(f"param {name}: shape {got} != {shape}")
+        import jax.numpy as jnp
+        self._param_vals = {k: jnp.asarray(v, jnp.float32)
+                            for k, v in params.items()}
+        self._compile_lock = threading.Lock()
+        self._prefill_fns = {}
+        self._decode_fn = None
+        self._warm_keys = set()
+
+    @classmethod
+    def toy(cls, seed=0, *, vocab=32, num_heads=2, head_dim=8, **kw):
+        """Deterministically-initialized small model (tests/bench)."""
+        rng = _np.random.RandomState(seed)
+        e = num_heads * head_dim
+
+        def w(*shape, s=0.3):
+            return (rng.standard_normal(shape) * s).astype(_np.float32)
+
+        params = {"emb": w(vocab, e, s=0.5), "wq": w(e, e), "wk": w(e, e),
+                  "wv": w(e, e), "wo": w(e, e), "w_out": w(e, vocab)}
+        return cls(params, num_heads=num_heads, head_dim=head_dim,
+                   vocab=vocab, **kw)
+
+    # -- geometry helpers ----------------------------------------------
+    def pages_for(self, prompt_len, max_new_tokens):
+        """Pages a stream owns for its whole life (allocated up front at
+        admission — continuous batching never reallocates mid-flight)."""
+        return max(1, math.ceil((prompt_len + max_new_tokens)
+                                / self.page_size))
+
+    # -- traced model fns ----------------------------------------------
+    def _make_prefill(self, t_bucket):
+        h_, d_, ps, p_ = (self.num_heads, self.head_dim, self.page_size,
+                          self.num_pages)
+        e_ = self.embed
+        scale = 1.0 / math.sqrt(d_)
+
+        def call(params, tokens, n, k_pages, v_pages, ptrow):
+            # tokens (1, T) int32; n () int32 TRACED (real prompt len —
+            # one executable per bucket, not per length); ptrow
+            # (max_pages_per_seq,) int32 page ids for this sequence
+            import jax
+            import jax.numpy as jnp
+            t = t_bucket
+            h = params["emb"][tokens[0]]                     # (T, E)
+            q = (h @ params["wq"]).reshape(t, h_, d_)
+            k = (h @ params["wk"]).reshape(t, h_, d_)
+            v = (h @ params["wv"]).reshape(t, h_, d_)
+            s = jnp.einsum("qhd,khd->hqk", q * scale, k)
+            pos = jnp.arange(t, dtype=jnp.int32)
+            mask = (pos[:, None] >= pos[None, :]) & (pos[None, :] < n)
+            s = jnp.where(mask[None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            a = jnp.einsum("hqk,khd->qhd", p, v).reshape(t, e_)
+            o = a @ params["wo"] + h
+            logits = o @ params["w_out"]                     # (T, V)
+            nxt = jnp.argmax(logits[n - 1], axis=-1).astype(jnp.int32)
+            # scatter the prompt's KV rows into the owned pages; padded
+            # rows (pos >= n) aim past the pool and mode="drop" discards
+            flat = ptrow[pos // ps] * ps + pos % ps
+            flat = jnp.where(pos < n, flat, p_ * ps)
+            kp = k_pages.reshape(p_ * ps, h_, d_).at[flat].set(
+                k, mode="drop").reshape(p_, ps, h_, d_)
+            vp = v_pages.reshape(p_ * ps, h_, d_).at[flat].set(
+                v, mode="drop").reshape(p_, ps, h_, d_)
+            return nxt, kp, vp
+
+        return call
+
+    def _make_decode(self):
+        h_, d_, ps, p_, s_ = (self.num_heads, self.head_dim, self.page_size,
+                              self.num_pages, self.slots)
+        e_ = self.embed
+
+        def call(params, tokens, positions, k_pages, v_pages, page_tables):
+            # tokens (S,) int32 — last emitted token per slot;
+            # positions (S,) int32 — its KV write position, -1 = idle
+            # slot (writes dropped, attention reads page 0 harmlessly
+            # and the output row is ignored by the scheduler)
+            import jax.numpy as jnp
+            from ..parallel.paged_attention import paged_attention
+            active = positions >= 0
+            pos = jnp.maximum(positions, 0)
+            h = params["emb"][tokens]                        # (S, E)
+            q = (h @ params["wq"]).reshape(s_, h_, d_)
+            k = (h @ params["wk"]).reshape(s_, h_, d_)
+            v = (h @ params["wv"]).reshape(s_, h_, d_)
+            row = jnp.arange(s_, dtype=jnp.int32)
+            flat = page_tables[row, pos // ps] * ps + pos % ps
+            flat = jnp.where(active, flat, p_ * ps)
+            kp = k_pages.reshape(p_ * ps, h_, d_).at[flat].set(
+                k, mode="drop").reshape(p_, ps, h_, d_)
+            vp = v_pages.reshape(p_ * ps, h_, d_).at[flat].set(
+                v, mode="drop").reshape(p_, ps, h_, d_)
+            attn = paged_attention(q, kp, vp, page_tables, pos + 1)
+            o = attn.reshape(s_, e_) @ params["wo"] + h
+            logits = o @ params["w_out"]                     # (S, V)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, kp, vp
+
+        return call
+
+    # -- executables ----------------------------------------------------
+    def _geom_tag(self):
+        return (f"p{self.num_pages}x{self.page_size},h{self.num_heads}"
+                f"x{self.head_dim},v{self.vocab}")
+
+    def _prefill_key(self, t_bucket):
+        return f"serve:prefill[t{t_bucket},{self._geom_tag()}]"
+
+    def _decode_key(self):
+        return f"serve:decode[s{self.slots},{self._geom_tag()}]"
+
+    def _exec_prefill(self, t_bucket):
+        with self._compile_lock:
+            fn = self._prefill_fns.get(t_bucket)
+            if fn is None:
+                from .. import compile_cache as _cc
+                fn = _cc.cached_jit(self._prefill_key(t_bucket),
+                                    self._make_prefill(t_bucket))
+                self._prefill_fns[t_bucket] = fn
+        return fn
+
+    def _exec_decode(self):
+        with self._compile_lock:
+            if self._decode_fn is None:
+                from .. import compile_cache as _cc
+                self._decode_fn = _cc.cached_jit(self._decode_key(),
+                                                 self._make_decode())
+        return self._decode_fn
+
+    def kv_pool(self):
+        """Fresh zeroed (P, page_size, H, D) key and value pools."""
+        import jax.numpy as jnp
+        shape = (self.num_pages, self.page_size, self.num_heads,
+                 self.head_dim)
+        return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+
+    def warmup(self):
+        """AOT-compile every prefill bucket and THE decode executable.
+
+        Returns {"prefill:<bucket>": kind, ..., "decode": kind} with
+        kind in {"hit", "disk", "miss"} (compile_cache.warmup): a warm
+        boot against a populated MXNET_EXEC_CACHE_DIR reports no
+        "miss" anywhere, i.e. zero retraces before the first request.
+        """
+        import jax
+        import jax.numpy as jnp
+        i32 = jnp.int32
+        kv = jax.ShapeDtypeStruct((self.num_pages, self.page_size,
+                                   self.num_heads, self.head_dim),
+                                  jnp.float32)
+        ptrow = jax.ShapeDtypeStruct((self.max_pages_per_seq,), i32)
+        out = {}
+        for t_bucket in self.ladder.sizes:
+            fn = self._exec_prefill(t_bucket)
+            out[f"prefill:{t_bucket}"] = fn.warmup(
+                self._param_vals,
+                jax.ShapeDtypeStruct((1, t_bucket), i32),
+                jax.ShapeDtypeStruct((), i32), kv, kv, ptrow)
+            self._warm_keys.add(f"prefill:{t_bucket}")
+        fn = self._exec_decode()
+        out["decode"] = fn.warmup(
+            self._param_vals,
+            jax.ShapeDtypeStruct((self.slots,), i32),
+            jax.ShapeDtypeStruct((self.slots,), i32), kv, kv,
+            jax.ShapeDtypeStruct((self.slots, self.max_pages_per_seq), i32))
+        self._warm_keys.add("decode")
+        return out
+
+    @property
+    def is_warm(self):
+        want = {f"prefill:{b}" for b in self.ladder.sizes} | {"decode"}
+        return want <= self._warm_keys
+
+    # -- runtime entry points (called by the scheduler loop) ------------
+    def prefill(self, prompt, k_pages, v_pages, ptrow):
+        """Run one prompt; returns (first generated token id, updated
+        pools). Raises MXNetError when the prompt exceeds the ladder."""
+        import jax.numpy as jnp
+        n = len(prompt)
+        t_bucket = self.ladder.bucket_for(n)
+        if t_bucket is None:
+            raise MXNetError(f"prompt length {n} exceeds the prefill "
+                             f"ladder {self.ladder.sizes}")
+        toks = _np.zeros((1, t_bucket), _np.int32)
+        toks[0, :n] = prompt
+        fn = self._exec_prefill(t_bucket)
+        nxt, kp, vp = fn(self._param_vals, jnp.asarray(toks),
+                         jnp.asarray(n, jnp.int32), k_pages, v_pages,
+                         jnp.asarray(ptrow, jnp.int32))
+        self._warm_keys.add(f"prefill:{t_bucket}")
+        return int(nxt), kp, vp
+
+    def decode(self, tokens, positions, k_pages, v_pages, page_tables):
+        """One batched decode step over all slots (idle rows pos=-1)."""
+        import jax.numpy as jnp
+        fn = self._exec_decode()
+        nxt, kp, vp = fn(self._param_vals,
+                         jnp.asarray(tokens, jnp.int32),
+                         jnp.asarray(positions, jnp.int32),
+                         k_pages, v_pages,
+                         jnp.asarray(page_tables, jnp.int32))
+        self._warm_keys.add("decode")
+        return _np.asarray(nxt), kp, vp
+
+
+class DecodeStream:
+    """Handle for one in-flight generation: iterate for tokens as they
+    land (per-token streaming), or block on result() for the full list.
+    The first token arrives from PREFILL (its latency is the TTFT);
+    every later token from a decode step."""
+
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.submit_t = time.monotonic()
+        self.ttft_ms = None
+        self._q = queue.Queue()
+        self._tokens = []
+        self._done = threading.Event()
+        self._error = None
+        self._cancelled = False
+        # scheduler-owned bookkeeping
+        self._slot = -1
+        self._pages = None
+        self._pages_needed = 0
+        self._last_t = None
+
+    def _deliver(self, tok, now):
+        if self.ttft_ms is None:
+            self.ttft_ms = (now - self.submit_t) * 1e3
+        self._tokens.append(tok)
+        self._last_t = now
+        self._q.put(tok)
+
+    def _finish(self, error=None):
+        self._error = error
+        self._done.set()
+        self._q.put(_EOS)
+
+    def cancel(self):
+        """Ask the scheduler to retire this stream at its next step
+        (client went away); already-queued tokens stay readable."""
+        self._cancelled = True
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    @property
+    def error(self):
+        return self._error
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _EOS:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise DeadlineExceeded("stream still running")
+        if self._error is not None:
+            raise self._error
+        return list(self._tokens)
+
+
+class DecodeScheduler:
+    """Iteration-level scheduler: one loop thread interleaves
+    retire -> admit -> step so freed slots and freed KV pages are reused
+    on the very next iteration (see module docstring)."""
+
+    def __init__(self, predictor, *, stats=None, max_queue=None,
+                 max_new_tokens=None, queue_bound_ms=None, name="decode"):
+        self.predictor = predictor
+        self.stats = stats if stats is not None else ServingStats(name)
+        self._max_queue = int(max_queue if max_queue is not None
+                              else util.getenv_int("MXNET_DECODE_QUEUE"))
+        self._default_max_new = int(
+            max_new_tokens if max_new_tokens is not None
+            else util.getenv_int("MXNET_DECODE_MAX_NEW_TOKENS"))
+        self._queue_bound_ms = float(
+            queue_bound_ms if queue_bound_ms is not None
+            else util.getenv_int("MXNET_DECODE_QUEUE_BOUND_MS"))
+        self.allocator = PageAllocator(predictor.num_pages)
+        s = predictor.slots
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._waiting = deque()
+        self._active = [None] * s
+        self._positions = _np.full(s, -1, _np.int32)
+        self._tokens = _np.zeros(s, _np.int32)
+        self._page_tables = _np.zeros((s, predictor.max_pages_per_seq),
+                                      _np.int32)
+        self._k_pages = None
+        self._v_pages = None
+        self._running = False
+        self._accepting = True
+        self._pause_reason = ""
+        self._thread = None
+        self.stats.set_gauge("kv_pages_total", predictor.num_pages)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+        if self._k_pages is None:
+            self._k_pages, self._v_pages = self.predictor.kv_pool()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxtpu-decode", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        if drain and self._thread is not None:
+            self.pause("stop")
+            self.quiesce(timeout=30)
+        with self._lock:
+            if not self._running:
+                return
+            self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        self._fail_all(MXNetError("decode scheduler stopped"))
+
+    def _fail_all(self, err):
+        with self._lock:
+            victims = list(self._waiting) + [st for st in self._active
+                                             if st is not None]
+            self._waiting.clear()
+            self._active = [None] * self.predictor.slots
+            self._positions[:] = -1
+        for st in victims:
+            if st._pages:
+                self.allocator.free(st._pages)
+                st._pages = None
+            st._finish(err)
+        self._set_pool_gauges()
+
+    # -- admission control (control-plane surface) ----------------------
+    def pause(self, reason="pause"):
+        with self._lock:
+            self._accepting = False
+            self._pause_reason = reason
+
+    def resume(self):
+        with self._lock:
+            self._accepting = True
+            self._pause_reason = ""
+
+    @property
+    def accepting(self):
+        with self._lock:
+            return self._accepting
+
+    def quiesce(self, timeout=30.0):
+        """Wait until no stream is queued or in a slot. Pair with
+        pause(): quiescing with admission open may never converge."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = (not self._waiting
+                        and all(st is None for st in self._active))
+            if idle:
+                return True
+            self._wake.set()
+            time.sleep(0.005)
+        return False
+
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               deadline_ms=None):
+        """Queue one generation; returns a DecodeStream immediately.
+
+        Sheds (Overloaded, 503-retryable) rather than queueing into
+        collapse: when paused, when the bounded queue is full, and when
+        the PROJECTED queue wait — p95 of recent admission waits scaled
+        by the queue depth ahead of this request — breaches
+        MXNET_DECODE_QUEUE_BOUND_MS (0 disables). Oversized requests
+        (prompt beyond the ladder, page demand beyond the per-sequence
+        cap) raise plain MXNetError: retrying those elsewhere cannot
+        succeed, so they must not be labelled retryable.
+        """
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise MXNetError("empty prompt")
+        if not self._running:
+            raise MXNetError("decode scheduler not started")
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self._default_max_new)
+        if max_new < 1:
+            raise MXNetError(f"max_new_tokens={max_new}: need >= 1")
+        if self.predictor.ladder.bucket_for(len(prompt)) is None:
+            raise MXNetError(
+                f"prompt length {len(prompt)} exceeds the prefill "
+                f"ladder {self.predictor.ladder.sizes}")
+        pages_needed = self.predictor.pages_for(len(prompt), max_new)
+        if pages_needed > self.predictor.max_pages_per_seq:
+            raise MXNetError(
+                f"request needs {pages_needed} KV pages, per-sequence cap "
+                f"is {self.predictor.max_pages_per_seq} "
+                f"(MXNET_KV_PAGES_PER_SEQ)")
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms else None)
+        with self._lock:
+            if not self._accepting:
+                self.stats.incr("shed_draining")
+                raise Overloaded(
+                    f"decode admission paused: {self._pause_reason}")
+            if len(self._waiting) >= self._max_queue:
+                self.stats.incr("shed_queue_full")
+                raise Overloaded(
+                    f"decode queue full ({self._max_queue})")
+            self._shed_if_projected_wait_locked()
+            st = DecodeStream(prompt, max_new, eos_id, deadline)
+            st._pages_needed = pages_needed
+            self._waiting.append(st)
+            self.stats.incr("requests_total")
+            self.stats.incr("decode_streams_total")
+            self.stats.set_gauge("queue_depth", len(self._waiting))
+        self._wake.set()
+        return st
+
+    def _shed_if_projected_wait_locked(self):
+        if self._queue_bound_ms <= 0:
+            return
+        qw = self.stats.queue_wait
+        if qw.count < 8:
+            return  # no signal yet: admit optimistically
+        projected_ms = qw.percentile(95) * 1e3 * (len(self._waiting) + 1)
+        if projected_ms > self._queue_bound_ms:
+            self.stats.incr("shed_projected")
+            raise Overloaded(
+                f"projected queue wait {projected_ms:.1f} ms breaches "
+                f"MXNET_DECODE_QUEUE_BOUND_MS={self._queue_bound_ms:.0f}")
+
+    # -- the loop -------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+                busy = (bool(self._waiting)
+                        or any(st is not None for st in self._active))
+            if not busy:
+                self._wake.wait(0.05)
+                self._wake.clear()
+                continue
+            try:
+                self._admit()
+                self._step()
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                self.stats.incr("errors")
+                self._fail_all(e if isinstance(e, MXNetError)
+                               else MXNetError(f"decode step failed: {e}"))
+            self.stats.publish()
+
+    def _set_pool_gauges(self):
+        live = self.allocator.live
+        self.stats.set_gauge("kv_pages_live", live)
+        self.stats.set_gauge("kv_page_occupancy",
+                             live / self.allocator.num_pages)
+        with self._lock:
+            n_active = sum(st is not None for st in self._active)
+            depth = len(self._waiting)
+        self.stats.set_gauge("decode_active", n_active)
+        self.stats.set_gauge("queue_depth", depth)
+
+    def _admit(self):
+        """Move waiting streams into free slots until slots or pages run
+        out. Pages are claimed for the stream's WHOLE lifetime up front
+        — admission is the only place a stream can block on memory, so
+        an admitted stream always runs to completion."""
+        while True:
+            with self._lock:
+                if not self._waiting:
+                    return
+                free_slots = [i for i, st in enumerate(self._active)
+                              if st is None]
+                if not free_slots:
+                    return
+                st = self._waiting[0]
+                now = time.monotonic()
+                if st.deadline is not None and now > st.deadline:
+                    self._waiting.popleft()
+                    self.stats.incr("shed_deadline")
+                    st._finish(DeadlineExceeded(
+                        "deadline expired while queued"))
+                    continue
+                try:
+                    pages = self.allocator.alloc(st._pages_needed)
+                except Overloaded:
+                    return  # pool exhausted: hold the queue, a retire
+                    # will free pages and the next iteration re-admits
+                self._waiting.popleft()
+                slot = free_slots[0]
+                st._slot = slot
+                st._pages = pages
+                queue_wait = now - st.submit_t
+            ptrow = _np.zeros(self.predictor.max_pages_per_seq, _np.int32)
+            ptrow[:len(pages)] = pages
+            t0 = time.monotonic()
+            nxt, kp, vp = self.predictor.prefill(
+                st.prompt, self._k_pages, self._v_pages, ptrow)
+            self._k_pages, self._v_pages = kp, vp
+            now = time.monotonic()
+            self.stats.queue_wait.observe(queue_wait)
+            self.stats.prefill_time.observe(now - t0)
+            with self._lock:
+                self._page_tables[slot] = ptrow
+                self._positions[slot] = len(st.prompt)
+                self._tokens[slot] = nxt
+                self._active[slot] = st
+            st._deliver(nxt, now)
+            self.stats.ttft.observe(now - st.submit_t)
+            self.stats.incr("decode_tokens_total")
+            if (len(st._tokens) >= st.max_new_tokens
+                    or nxt == st.eos_id or st._cancelled):
+                self._retire(st)
+            self._set_pool_gauges()
+
+    def _step(self):
+        """One fixed-shape decode dispatch over all slots, then per-slot
+        deliver/retire. The chaos hook fires BEFORE the device call so a
+        kill lands mid-stream with tokens already flushed to clients."""
+        from .. import fault
+        with self._lock:
+            active = [(i, st) for i, st in enumerate(self._active)
+                      if st is not None]
+            if not active:
+                return
+            tokens = self._tokens.copy()
+            positions = self._positions.copy()
+            page_tables = self._page_tables.copy()
+        fault.inject("decode")
+        t0 = time.monotonic()
+        nxt, kp, vp = self.predictor.decode(
+            tokens, positions, self._k_pages, self._v_pages, page_tables)
+        self._k_pages, self._v_pages = kp, vp
+        now = time.monotonic()
+        step_s = now - t0
+        self.stats.decode_step_time.observe(step_s)
+        # PR-10 queue-wait-vs-device signal, bucket = the slot batch
+        self.stats.observe_bucket(self.predictor.slots, (), step_s)
+        self.stats.incr("batches_total")
+        self.stats.set_gauge("batch_occupancy",
+                             len(active) / self.predictor.slots)
+        for i, st in active:
+            tok = int(nxt[i])
+            with self._lock:
+                self._positions[i] += 1
+                self._tokens[i] = tok
+            if st.deadline is not None and now > st.deadline:
+                self.stats.incr("shed_deadline")
+                self._retire(st, DeadlineExceeded(
+                    "deadline expired mid-generation"))
+                continue
+            if st._cancelled:
+                self._retire(st)
+                continue
+            if st._last_t is not None:
+                self.stats.token_latency.observe(now - st._last_t)
+            st._deliver(tok, now)
+            self.stats.incr("decode_tokens_total")
+            if (len(st._tokens) >= st.max_new_tokens
+                    or tok == st.eos_id):
+                self._retire(st)
+        self._set_pool_gauges()
+
+    def _retire(self, st, error=None):
+        with self._lock:
+            if st._slot >= 0 and self._active[st._slot] is st:
+                self._active[st._slot] = None
+                self._positions[st._slot] = -1
+            pages, st._pages = st._pages, None
+        if pages:
+            self.allocator.free(pages)
+        st._finish(error)
+        self.stats.incr("decode_retired_total")
+        if error is None:
+            self.stats.incr("responses_ok")
+        self._wake.set()  # freed slot + pages: re-admit immediately

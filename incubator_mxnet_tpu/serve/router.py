@@ -366,6 +366,10 @@ class Router:
         with self._rlock:
             return {rid: br.state for rid, br in self._breakers.items()}
 
+    def replica_table(self):
+        with self._rlock:
+            return {rid: dict(info) for rid, info in self._replicas.items()}
+
     # -- request path ---------------------------------------------------
     def _backoff_s(self, attempt, deadline):
         base = min(1.0, self._backoff_ms / 1e3 * (2 ** (attempt - 1)))
@@ -469,6 +473,118 @@ class Router:
             # retryable: if a hedge is still in flight, wait it out
         return ("retryable", first_failure)
 
+    def generate(self, prompt, max_new_tokens=None, deadline_ms=None):
+        """Route one decode stream (/generate) and return its full token
+        list. Retry is WHOLE-STREAM: a stream cut mid-flight (replica
+        killed, connection reset) restarts from the prompt on the next
+        candidate — greedy decode is deterministic, so the retried
+        stream reproduces the tokens the dead replica already sent.
+        Connect-layer failures feed the replica's breaker exactly like
+        /predict; 503 sheds retry without breaker blame. No hedging: a
+        duplicate stream doubles token work for tail latency decode
+        rarely has."""
+        if deadline_ms is None:
+            deadline_ms = self._deadline_ms
+        deadline = time.monotonic() + deadline_ms / 1e3
+        self.stats.incr("requests_total")
+        t0 = time.monotonic()
+        last_err = None
+        for attempt in range(self._retries + 1):
+            if attempt:
+                self.stats.incr("retries_total")
+                pause = self._backoff_s(attempt, deadline)
+                if pause > 0:
+                    time.sleep(pause)
+            if time.monotonic() >= deadline:
+                break
+            cands = self._candidates()
+            if not cands:
+                self.stats.incr("no_replica_total")
+                last_err = NoReplicaAvailable(
+                    f"no ready replica for model {self._model!r}")
+                continue
+            kind, value = self._one_stream(cands[0][0], cands[0][1],
+                                           prompt, max_new_tokens, deadline)
+            if kind == "ok":
+                self.stats.latency.observe(time.monotonic() - t0)
+                self.stats.incr("responses_ok_total")
+                return value
+            if kind == "fatal":
+                self.stats.incr("responses_fatal_total")
+                raise value
+            last_err = value
+        self.stats.incr("requests_failed_total")
+        if isinstance(last_err, MXNetError):
+            raise last_err
+        raise DeadlineExceeded(
+            f"router deadline {deadline_ms}ms exhausted "
+            f"({self._retries} retries)")
+
+    def _one_stream(self, rid, addr, prompt, max_new_tokens, deadline):
+        """One streamed /generate against one replica, consuming the
+        ndjson chunks until the {"done"} line. A stream that dies before
+        "done" — reset, timeout, truncation — counts as a connect-layer
+        breaker failure: the replica proved unable to FINISH, which for
+        streams is the health contract."""
+        import http.client
+        timeout = max(1e-3, deadline - time.monotonic())
+        body = json.dumps({"prompt": [int(t) for t in prompt],
+                           "max_new_tokens": max_new_tokens,
+                           "stream": True,
+                           "deadline_ms": timeout * 1e3}).encode("utf-8")
+        tokens = []
+        try:
+            _fault.inject("route")      # MXNET_FAULT_INJECT: route@n
+            req = urllib.request.Request(
+                f"http://{addr}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                for line in r:
+                    if not line.strip():
+                        continue
+                    row = json.loads(line.decode("utf-8"))
+                    if "token" in row:
+                        tokens.append(int(row["token"]))
+                    elif row.get("done"):
+                        self._note_result(rid, True)
+                        return ("ok", tokens)
+                    elif "error" in row:
+                        # in-band error line: the replica answered
+                        # decisively — not a breaker failure
+                        self._note_result(rid, True)
+                        if row.get("retryable"):
+                            self.stats.incr("sheds_total")
+                            return ("retryable", Overloaded(
+                                f"replica {rid} shed mid-stream: "
+                                f"{row['error']}"))
+                        return ("fatal", RouteError(
+                            f"replica {rid}: {row['error']}"))
+            self._note_result(rid, False)
+            return ("retryable", NoReplicaAvailable(
+                f"replica {rid} stream ended without done marker "
+                f"({len(tokens)} tokens in)"))
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read().decode("utf-8"))
+            except (ValueError, OSError):
+                detail = {"error": str(e)}
+            self._note_result(rid, True)
+            if e.code in (503, 504) and detail.get("retryable", True):
+                self.stats.incr("sheds_total")
+                return ("retryable", Overloaded(
+                    f"replica {rid} shed ({e.code}): "
+                    f"{detail.get('error', '')}"))
+            return ("fatal", RouteError(
+                f"replica {rid}: {detail.get('error', e)}", status=e.code))
+        except (urllib.error.URLError, http.client.HTTPException,
+                ConnectionError, TimeoutError, OSError, ValueError) as e:
+            self.stats.incr("connect_errors_total")
+            self._note_result(rid, False)
+            return ("retryable", NoReplicaAvailable(
+                f"replica {rid} at {addr} died mid-stream after "
+                f"{len(tokens)} tokens: {e}"))
+
     def _one_call(self, rid, addr, inputs_json, deadline):
         """One HTTP /predict against one replica. Returns (kind, value);
         classification is the whole policy: connect errors feed the
@@ -550,10 +666,7 @@ class Router:
                         self._send(200, body, "text/plain; version=0.0.4; "
                                               "charset=utf-8")
                     elif self.path == "/replicas":
-                        with router._rlock:
-                            table = {rid: dict(info) for rid, info
-                                     in router._replicas.items()}
-                        self._send(200, json.dumps(table),
+                        self._send(200, json.dumps(router.replica_table()),
                                    "application/json")
                     else:
                         self._send(404, "not found\n")

@@ -20,6 +20,18 @@ Protocol (JSON):
   POST /predict   {"inputs": {"data": [[...]]}, "deadline_ms": 250}
                   -> {"outputs": [[...], ...]}   (one list per output,
                      sample-shaped — requests are UNBATCHED samples)
+  POST /generate  {"prompt": [ids...], "max_new_tokens": n,
+                   "stream": true, "deadline_ms": 5000}
+                  -> chunked application/x-ndjson, one {"token": id}
+                     line flushed PER TOKEN as the decode scheduler
+                     emits it, terminated by a {"done": true, ...}
+                     summary line (or {"error": ...} mid-stream);
+                     "stream": false buffers and answers one JSON
+                     {"tokens": [...]}. Requires a DecodeScheduler
+                     attached via ModelServer(decoder=...); sheds
+                     exactly like /predict (503 Overloaded + Retry-After
+                     when the queue or the KV page pool is saturated,
+                     504 on deadline, fast 503 while draining).
   GET  /healthz   -> LIVENESS: 200 {"status": "ok", ...} while the
                      process serves at all (a draining replica is alive)
   GET  /readyz    -> READINESS: 200 only when the replica should take
@@ -109,15 +121,22 @@ class _Handler(BaseHTTPRequestHandler):
                         {"ready": ready, "why": why,
                          "generation": ms.generation})
         elif self.path == "/stats":
-            self._reply(200, ms.stats.snapshot())
+            snap = ms.stats.snapshot()
+            if ms.decoder is not None and ms.decoder.stats is not ms.stats:
+                snap["decode"] = ms.decoder.stats.snapshot()
+            self._reply(200, snap)
         elif self.path == "/metrics":
             from .. import profiler
             # refresh this endpoint's serving counters so a scrape always
             # sees current values regardless of batch cadence
             ms.stats.publish()
+            body = (profiler.render_prometheus()
+                    + ms.stats.render_prometheus())
+            if ms.decoder is not None and ms.decoder.stats is not ms.stats:
+                ms.decoder.stats.publish()
+                body += ms.decoder.stats.render_prometheus()
             self._reply_text(
-                200,
-                profiler.render_prometheus() + ms.stats.render_prometheus(),
+                200, body,
                 content_type="text/plain; version=0.0.4; charset=utf-8")
         else:
             self._reply(404, {"error": "not found", "retryable": False})
@@ -125,6 +144,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path.startswith("/admin/"):
             self._admin()
+            return
+        if self.path == "/generate":
+            self._generate()
             return
         if self.path != "/predict":
             self._reply(404, {"error": "not found", "retryable": False})
@@ -163,6 +185,84 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(500, {"error": str(e), "retryable": False})
             return
         self._reply(200, {"outputs": [o.tolist() for o in outs]})
+
+    def _generate(self):
+        ms = self._ms
+        if ms.decoder is None:
+            self._reply(404, {"error": "no decoder attached",
+                              "retryable": False})
+            return
+        if ms.draining:
+            self._reply(503, {"error": "draining", "retryable": True},
+                        retry_after="0.1")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            prompt = [int(t) for t in req["prompt"]]
+            max_new = req.get("max_new_tokens")
+            eos_id = req.get("eos_id")
+            stream_mode = bool(req.get("stream", True))
+            deadline_ms = req.get("deadline_ms", ms.default_deadline_ms)
+        except (KeyError, ValueError, TypeError) as e:
+            self._reply(400, {"error": f"malformed request: {e}",
+                              "retryable": False})
+            return
+        try:
+            st = ms.decoder.submit(prompt, max_new_tokens=max_new,
+                                   eos_id=eos_id, deadline_ms=deadline_ms)
+        except Overloaded as e:
+            self._reply(e.status, {"error": str(e), "retryable": True},
+                        retry_after="0.05")
+            return
+        except DeadlineExceeded as e:
+            self._reply(504, {"error": str(e), "retryable": True})
+            return
+        except MXNetError as e:
+            self._reply(400, {"error": str(e), "retryable": False})
+            return
+        if not stream_mode:
+            try:
+                timeout = (deadline_ms / 1e3 + 5.0) if deadline_ms else None
+                toks = st.result(timeout=timeout)
+            except DeadlineExceeded as e:
+                self._reply(504, {"error": str(e), "retryable": True})
+                return
+            except Exception as e:  # noqa: BLE001 — decode failure -> 500
+                self._reply(500, {"error": str(e), "retryable": False})
+                return
+            self._reply(200, {"tokens": toks, "ttft_ms": st.ttft_ms})
+            return
+        # chunked streaming: one ndjson line per token, flushed as the
+        # scheduler emits it — the client sees its first token at TTFT,
+        # not at stream completion
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj):
+            data = (json.dumps(obj) + "\n").encode("utf-8")
+            self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            try:
+                for tok in st:
+                    chunk({"token": tok})
+                chunk({"done": True, "n": len(st._tokens),
+                       "ttft_ms": st.ttft_ms})
+            except MXNetError as e:
+                # the chunked response already started: the error must
+                # travel in-band as the final line
+                chunk({"error": str(e),
+                       "retryable": bool(getattr(e, "retryable", False))})
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except OSError:
+            # client went away mid-stream: stop generating for it
+            st.cancel()
+            self.close_connection = True
 
     def _admin(self):
         ms = self._ms
@@ -217,13 +317,19 @@ class ModelServer:
                          realized every ladder bucket. None (default)
                          auto-enables when the predictor declares input
                          shapes (i.e. warmup is possible).
+    decoder:             optional DecodeScheduler; attaches the
+                         streaming /generate endpoint, adds its warmth
+                         to the readiness gate, and ties its admission
+                         control into drain/rollout (pause + quiesce
+                         alongside the batcher, so PR-12 semantics cover
+                         decode streams too).
     """
 
     def __init__(self, predictor, host="127.0.0.1", port=0,
                  max_latency_ms=5.0, max_queue=128,
                  default_deadline_ms=1000.0, stats=None, name="serve",
                  model="default", generation=0, coordinator=None,
-                 require_warm=None):
+                 require_warm=None, decoder=None):
         self.predictor = predictor
         buckets = (predictor.ladder.sizes if predictor.ladder is not None
                    else (1, 2, 4, 8, 16, 32))
@@ -240,6 +346,7 @@ class ModelServer:
             require_warm = (predictor.ladder is not None
                             and bool(predictor._input_shapes))
         self._require_warm = require_warm
+        self.decoder = decoder
         self._host, self._port = host, port
         self._httpd = None
         self._thread = None
@@ -269,6 +376,9 @@ class ModelServer:
             why.append("draining")
         if self._require_warm and not self.predictor.is_warm:
             why.append("cold buckets (Predictor.warmup incomplete)")
+        if self.decoder is not None and not self.decoder.predictor.is_warm:
+            why.append("cold decode executables "
+                       "(DecodePredictor.warmup incomplete)")
         if self._coordinator is not None and (
                 self._agent is None or not self._agent.registered):
             why.append("not registered with control plane")
@@ -289,6 +399,8 @@ class ModelServer:
         if self._httpd is not None:
             return self.address
         self.batcher.start()
+        if self.decoder is not None:
+            self.decoder.start()
         self._httpd = _HTTPServer((self._host, self._port), _Handler)
         self._httpd.model_server = self
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -318,6 +430,8 @@ class ModelServer:
             self._thread.join(timeout=10)
             self._thread = None
         self.batcher.stop()
+        if self.decoder is not None:
+            self.decoder.stop()
 
     def __enter__(self):
         self.start()
@@ -342,6 +456,13 @@ class ModelServer:
         self.batcher.pause(reason)
         drained = self.batcher.quiesce(
             timeout=getenv_int("MXNET_SERVE_DRAIN_TIMEOUT"))
+        if self.decoder is not None:
+            # same admission contract for streams: shed new generations
+            # with retryable 503s, let in-flight streams run to their
+            # last token before the replica goes away
+            self.decoder.pause(reason)
+            drained = self.decoder.quiesce(
+                timeout=getenv_int("MXNET_SERVE_DRAIN_TIMEOUT")) and drained
         self.stats.publish()
         _fault.flight_record("serve_drain", model=self.model,
                              generation=self.generation, reason=reason,
@@ -411,11 +532,20 @@ class ModelServer:
             self.batcher.pause(f"{reason} gen {generation}")
             drained = self.batcher.quiesce(
                 timeout=getenv_int("MXNET_SERVE_DRAIN_TIMEOUT"))
+            if self.decoder is not None:
+                # in-flight streams belong to the old generation: flush
+                # them through the same admission gate before the swap
+                self.decoder.pause(f"{reason} gen {generation}")
+                drained = self.decoder.quiesce(
+                    timeout=getenv_int("MXNET_SERVE_DRAIN_TIMEOUT")) \
+                    and drained
             self._prev = (self.predictor, self.generation)
             self.predictor = new_pred
             self.batcher.swap_predict(new_pred.predict)
             old_gen, self.generation = self.generation, int(generation)
             self.batcher.resume()
+            if self.decoder is not None:
+                self.decoder.resume()
             self._draining = False
         swap_ms = (time.monotonic() - t0) * 1e3
         _fault.flight_record("serve_swap", model=self.model,

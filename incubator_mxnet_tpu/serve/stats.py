@@ -101,6 +101,17 @@ class ServingStats:
       padded_rows_total  bucket_size - real rows, summed over batches
       queue_depth        gauge, sampled at publish time
       batch_occupancy    real_rows / bucket_size of the last batch
+
+    Decode-side counters (DecodeScheduler; zero on predict-only
+    endpoints and omitted from /metrics until a stream is seen):
+      decode_streams_total   streams admitted to the queue
+      decode_tokens_total    tokens delivered (prefill + decode steps)
+      decode_retired_total   streams retired (ok, error, or deadline)
+      shed_projected         sheds by the projected-queue-wait bound
+      decode_active          gauge, occupied decode slots
+      kv_pages_live/total    gauge pair, KV page pool occupancy
+    plus four histograms: ttft (submit -> first token), token_latency
+    (inter-token gap), prefill_time, decode_step_time.
     """
 
     def __init__(self, name="serve"):
@@ -109,16 +120,28 @@ class ServingStats:
         self.latency = LatencyHistogram()      # end-to-end (submit->result)
         self.queue_wait = LatencyHistogram()   # submit->dispatch
         self.forward_time = LatencyHistogram()  # batched predict call
+        self.ttft = LatencyHistogram()          # submit->first token
+        self.token_latency = LatencyHistogram()  # gap between tokens
+        self.prefill_time = LatencyHistogram()   # prompt executable
+        self.decode_step_time = LatencyHistogram()  # slot-batch step
         self.requests_total = 0
         self.responses_ok = 0
         self.shed_queue_full = 0
         self.shed_deadline = 0
         self.shed_draining = 0
+        self.shed_projected = 0
         self.errors = 0
         self.batches_total = 0
         self.padded_rows_total = 0
         self.queue_depth = 0
         self.batch_occupancy = 0.0
+        self.decode_streams_total = 0
+        self.decode_tokens_total = 0
+        self.decode_retired_total = 0
+        self.decode_active = 0
+        self.kv_pages_live = 0
+        self.kv_pages_total = 0
+        self.kv_page_occupancy = 0.0
         self._profiler_counters = {}
         # per-bucket latency split: how much of the end-to-end time each
         # compiled bucket spends WAITING vs ON DEVICE — a queue-bound
@@ -186,17 +209,29 @@ class ServingStats:
                 "shed_queue_full": self.shed_queue_full,
                 "shed_deadline": self.shed_deadline,
                 "shed_draining": self.shed_draining,
+                "shed_projected": self.shed_projected,
                 "shed_total": (self.shed_queue_full + self.shed_deadline
-                               + self.shed_draining),
+                               + self.shed_draining + self.shed_projected),
                 "errors": self.errors,
                 "batches_total": self.batches_total,
                 "padded_rows_total": self.padded_rows_total,
                 "queue_depth": self.queue_depth,
                 "batch_occupancy": round(self.batch_occupancy, 4),
+                "decode_streams_total": self.decode_streams_total,
+                "decode_tokens_total": self.decode_tokens_total,
+                "decode_retired_total": self.decode_retired_total,
+                "decode_active": self.decode_active,
+                "kv_pages_live": self.kv_pages_live,
+                "kv_pages_total": self.kv_pages_total,
+                "kv_page_occupancy": round(self.kv_page_occupancy, 4),
             }
         for prefix, h in (("latency", self.latency),
                           ("queue_wait", self.queue_wait),
-                          ("forward", self.forward_time)):
+                          ("forward", self.forward_time),
+                          ("ttft", self.ttft),
+                          ("token", self.token_latency),
+                          ("prefill", self.prefill_time),
+                          ("decode_step", self.decode_step_time)):
             snap[f"{prefix}_p50_ms"] = round(h.percentile(50) * 1e3, 4)
             snap[f"{prefix}_p95_ms"] = round(h.percentile(95) * 1e3, 4)
             snap[f"{prefix}_p99_ms"] = round(h.percentile(99) * 1e3, 4)
@@ -211,10 +246,18 @@ class ServingStats:
         sample per counter per call; the batcher calls this per batch)."""
         from .. import profiler
         snap = self.snapshot()
-        for key in ("requests_total", "responses_ok", "shed_queue_full",
-                    "shed_deadline", "shed_total", "queue_depth",
-                    "batch_occupancy", "batches_total",
-                    "latency_p50_ms", "latency_p95_ms", "latency_p99_ms"):
+        keys = ["requests_total", "responses_ok", "shed_queue_full",
+                "shed_deadline", "shed_total", "queue_depth",
+                "batch_occupancy", "batches_total",
+                "latency_p50_ms", "latency_p95_ms", "latency_p99_ms"]
+        if snap["decode_streams_total"]:
+            # decode families only on endpoints that actually decode, so
+            # predict-only profiler tables stay exactly as before
+            keys += ["decode_streams_total", "decode_tokens_total",
+                     "decode_active", "kv_pages_live", "kv_page_occupancy",
+                     "ttft_p50_ms", "ttft_p99_ms",
+                     "token_p50_ms", "token_p99_ms"]
+        for key in keys:
             name = f"{self.name}:{key}"
             c = self._profiler_counters.get(name)
             if c is None:
@@ -235,49 +278,93 @@ class ServingStats:
         `_sum`/`_count`) so a scraper can do histogram_quantile() over
         any window instead of trusting our precomputed p50/p95."""
         buckets = self.bucket_snapshot()
-        if not buckets:
+        decode_seen = self.decode_streams_total > 0 or self.ttft.count > 0
+        if not buckets and not decode_seen:
             return ""
-        with self._lock:
-            pairs = sorted(self._bucket_hists.items())
-        lines = ["# HELP mxnet_serve_bucket_latency_ms per-bucket serving "
-                 "latency split: queue_wait vs device time",
-                 "# TYPE mxnet_serve_bucket_latency_ms gauge"]
-        for b, row in buckets.items():
-            for kind in ("queue_wait", "device"):
-                for q in ("p50", "p95"):
-                    lines.append(
-                        f'mxnet_serve_bucket_latency_ms{{model="{self.name}"'
-                        f',bucket="{b}",kind="{kind}",q="{q}"}} '
-                        f'{row[f"{kind}_{q}_ms"]:.6g}')
-        lines += ["# HELP mxnet_serve_bucket_dispatches batched dispatches "
-                  "of each compiled bucket",
-                  "# TYPE mxnet_serve_bucket_dispatches counter"]
-        for b, row in buckets.items():
-            lines.append(
-                f'mxnet_serve_bucket_dispatches{{model="{self.name}"'
-                f',bucket="{b}"}} {row["dispatches"]}')
-        for kind, idx, help_text in (
-                ("queue_wait", 0,
-                 "per-request wait for a bucket slot, in ms"),
-                ("device", 1,
-                 "batched forward/device time per dispatch, in ms")):
-            fam = f"mxnet_serve_bucket_{kind}_ms"
+        lines = []
+        if buckets:
+            with self._lock:
+                pairs = sorted(self._bucket_hists.items())
+            lines += ["# HELP mxnet_serve_bucket_latency_ms per-bucket "
+                      "serving latency split: queue_wait vs device time",
+                      "# TYPE mxnet_serve_bucket_latency_ms gauge"]
+            for b, row in buckets.items():
+                for kind in ("queue_wait", "device"):
+                    for q in ("p50", "p95"):
+                        lines.append(
+                            f'mxnet_serve_bucket_latency_ms'
+                            f'{{model="{self.name}"'
+                            f',bucket="{b}",kind="{kind}",q="{q}"}} '
+                            f'{row[f"{kind}_{q}_ms"]:.6g}')
+            lines += ["# HELP mxnet_serve_bucket_dispatches batched "
+                      "dispatches of each compiled bucket",
+                      "# TYPE mxnet_serve_bucket_dispatches counter"]
+            for b, row in buckets.items():
+                lines.append(
+                    f'mxnet_serve_bucket_dispatches{{model="{self.name}"'
+                    f',bucket="{b}"}} {row["dispatches"]}')
+            for kind, idx, help_text in (
+                    ("queue_wait", 0,
+                     "per-request wait for a bucket slot, in ms"),
+                    ("device", 1,
+                     "batched forward/device time per dispatch, in ms")):
+                fam = f"mxnet_serve_bucket_{kind}_ms"
+                lines += [f"# HELP {fam} {help_text}",
+                          f"# TYPE {fam} histogram"]
+                for b, hs in pairs:
+                    state = hs[idx].snapshot_state()
+                    labels = f'model="{self.name}",bucket="{b}"'
+                    lines += self._histogram_lines(fam, labels, state)
+        if decode_seen:
+            lines += self._decode_prometheus_lines()
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _histogram_lines(fam, labels, state):
+        """Cumulative-`le` exposition for one LatencyHistogram state."""
+        lines = []
+        cum = 0
+        for bound, n in zip(state["bounds"], state["counts"]):
+            cum += n
+            lines.append(f'{fam}_bucket{{{labels},'
+                         f'le="{bound * 1e3:.6g}"}} {cum}')
+        cum += state["counts"][-1]
+        lines.append(f'{fam}_bucket{{{labels},le="+Inf"}} {cum}')
+        lines.append(f'{fam}_sum{{{labels}}} {state["sum"] * 1e3:.6g}')
+        lines.append(f'{fam}_count{{{labels}}} {state["count"]}')
+        return lines
+
+    def _decode_prometheus_lines(self):
+        """`mxnet_serve_decode_*` families: TTFT and inter-token true
+        histograms plus the stream/token counters and KV-pool gauges a
+        capacity dashboard needs."""
+        labels = f'model="{self.name}"'
+        lines = []
+        for fam, h, help_text in (
+                ("mxnet_serve_decode_ttft_ms", self.ttft,
+                 "time to first token (submit -> prefill token), in ms"),
+                ("mxnet_serve_decode_token_ms", self.token_latency,
+                 "inter-token latency during decode, in ms")):
             lines += [f"# HELP {fam} {help_text}",
                       f"# TYPE {fam} histogram"]
-            for b, hs in pairs:
-                state = hs[idx].snapshot_state()
-                labels = f'model="{self.name}",bucket="{b}"'
-                cum = 0
-                for bound, n in zip(state["bounds"], state["counts"]):
-                    cum += n
-                    lines.append(f'{fam}_bucket{{{labels},'
-                                 f'le="{bound * 1e3:.6g}"}} {cum}')
-                cum += state["counts"][-1]
-                lines.append(f'{fam}_bucket{{{labels},le="+Inf"}} {cum}')
-                lines.append(f'{fam}_sum{{{labels}}} '
-                             f'{state["sum"] * 1e3:.6g}')
-                lines.append(f'{fam}_count{{{labels}}} {state["count"]}')
-        return "\n".join(lines) + "\n"
+            lines += self._histogram_lines(fam, labels, h.snapshot_state())
+        for fam, val, kind, help_text in (
+                ("mxnet_serve_decode_streams_total",
+                 self.decode_streams_total, "counter",
+                 "decode streams admitted"),
+                ("mxnet_serve_decode_tokens_total",
+                 self.decode_tokens_total, "counter",
+                 "tokens delivered across all streams"),
+                ("mxnet_serve_decode_active", self.decode_active, "gauge",
+                 "occupied decode slots"),
+                ("mxnet_serve_decode_kv_pages_live", self.kv_pages_live,
+                 "gauge", "KV pages currently owned by live sequences"),
+                ("mxnet_serve_decode_kv_pages_total", self.kv_pages_total,
+                 "gauge", "KV page pool capacity")):
+            lines += [f"# HELP {fam} {help_text}",
+                      f"# TYPE {fam} {kind}",
+                      f"{fam}{{{labels}}} {val}"]
+        return lines
 
     def table(self):
         snap = self.snapshot()

@@ -357,6 +357,36 @@ ENV_VARS = collections.OrderedDict([
      "Seconds a draining ModelServer (SIGTERM / rollout weight swap) "
      "waits for in-flight batches to flush before forcing shutdown; "
      "new requests get fast 503 + Retry-After for the duration.")),
+    ("MXNET_DECODE_SLOTS", EnvSpec(8, "int",
+     "Decode slot-batch width: the ONE fixed shape the continuous-"
+     "batching decode executable is compiled for. Sequences are "
+     "admitted into and retired from these slots every step; changing "
+     "it is a recompile.")),
+    ("MXNET_DECODE_QUEUE", EnvSpec(64, "int",
+     "Bounded decode admission queue; a stream submitted beyond it is "
+     "shed with a retryable Overloaded (503) instead of queueing into "
+     "collapse.")),
+    ("MXNET_DECODE_MAX_NEW_TOKENS", EnvSpec(32, "int",
+     "Default per-stream generation cap when the request does not set "
+     "max_new_tokens; also sizes the KV pages claimed at admission.")),
+    ("MXNET_DECODE_QUEUE_BOUND_MS", EnvSpec(0, "int",
+     "Projected-queue-wait admission bound in ms: shed (503 + "
+     "Retry-After) when p95 of recent admission waits scaled by the "
+     "current queue depth breaches it — the queue-wait-histogram "
+     "admission signal. 0 disables projection shedding (the bounded "
+     "queue still sheds).")),
+    ("MXNET_KV_PAGE_SIZE", EnvSpec(16, "int",
+     "Token rows per KV page. Internal fragmentation is bounded by "
+     "page_size-1 rows per sequence; the ragged paged-attention kernel "
+     "walks pages of exactly this many rows.")),
+    ("MXNET_KV_PAGES", EnvSpec(128, "int",
+     "KV page pool capacity shared by all decode slots. Exhaustion "
+     "holds the admission queue (retires free pages) and sheds once "
+     "the queue itself fills.")),
+    ("MXNET_KV_PAGES_PER_SEQ", EnvSpec(8, "int",
+     "Per-sequence page-table width (max pages one stream may own). "
+     "Requests whose prompt+max_new_tokens exceed it are rejected as "
+     "NON-retryable — no replica can serve them.")),
 ])
 
 _FALSY = frozenset(("", "0", "false", "off", "no"))

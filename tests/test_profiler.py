@@ -474,18 +474,27 @@ _PROM_LINE = re.compile(
 
 def _assert_prometheus_text(text):
     assert text.endswith("\n")
-    declared = set()
+    declared, histograms = set(), set()
     for line in text.splitlines():
         if not line:
             continue
         if line.startswith("# TYPE"):
-            declared.add(line.split()[2])
+            _, _, fam, kind = line.split()
+            declared.add(fam)
+            if kind == "histogram":
+                histograms.add(fam)
             continue
         if line.startswith("#"):
             continue
         assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
-        # no stray samples: every metric belongs to a declared family
+        # no stray samples: every metric belongs to a declared family.
+        # Histogram samples are declared under the BASE name and emitted
+        # with the spec's _bucket/_sum/_count suffixes.
         name = line.split("{", 1)[0].split(" ", 1)[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in histograms:
+                name = name[: -len(suffix)]
+                break
         assert name in declared, f"sample without HELP/TYPE family: {name}"
 
 

@@ -57,6 +57,13 @@ LOCK_ORDER = {
     # only AFTER releasing the registry lock.
     "fleetobs.py": ("self._lock", "_lock"),
     "serve/predictor.py": ("self._compile_lock",),
+    # serve/decode: the scheduler lock (queue + slot tables) is
+    # OUTERMOST and never held across device calls; DecodePredictor's
+    # executable-construction lock nests under nothing of ours; the
+    # PageAllocator free-list lock is a LEAF (alloc under the scheduler
+    # lock at admission, free with no lock held at retire).
+    "serve/decode.py": ("self._lock", "self._compile_lock",
+                        "self._alloc_lock"),
     # kvstore_server: update lock outermost (it serializes pushes, like
     # the reference's executor queue); the heartbeat/liveness registry
     # lock is a LEAF — push refreshes liveness only AFTER releasing the
