@@ -839,6 +839,88 @@ def bench_serve_cold_start():
     return cold, warm
 
 
+_COMPOSED_1F1B_SCRIPT = """
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+import jax
+import jax.numpy as jnp
+from incubator_mxnet_tpu import profiler
+from incubator_mxnet_tpu.parallel import make_mesh
+from incubator_mxnet_tpu.models.composed import (ComposedConfig,
+                                                 ComposedPipelineLM)
+
+S, M = 4, 8
+cfg = ComposedConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=4,
+                     d_ff=64, n_experts=4, moe_every=2, capacity_factor=4.0,
+                     aux_weight=0.01, max_len=64, dtype="float32")
+model = ComposedPipelineLM(cfg)
+mesh = make_mesh({{"dp": 2, "pp": S}})
+rng = np.random.RandomState(0)
+tokens = jnp.asarray(rng.randint(0, 64, (16, 16)).astype(np.int32))
+targets = jnp.asarray(rng.randint(0, 64, (16, 16)).astype(np.int32))
+prev = profiler.attribution_enable(True)
+out = {{}}
+for sched, remat in (("gpipe", "none"), ("1f1b", "dots_saveable")):
+    step, shard_params, init_opt = model.make_train_step(
+        mesh, n_microbatches=M, schedule=sched, remat=remat)
+    p = shard_params(model.init_params(jax.random.PRNGKey(0), S))
+    opt = init_opt(p)
+    for _ in range(2):   # cold compile + the one sharding respecialization
+        p, opt, loss = step(p, opt, tokens, targets, 0)
+    best = float("inf")
+    for i in range(3):
+        t0 = time.perf_counter()
+        p, opt, loss = step(p, opt, tokens, targets, i + 2)
+        jax.block_until_ready(loss)
+        best = min(best, time.perf_counter() - t0)
+    phases = profiler.last_step_phases()
+    bub = phases.get("pp_bubble", 0.0)
+    comp = phases.get("compute", 0.0)
+    exe = step._cached._jfn.lower(p, opt, tokens, targets, 0).compile()
+    cost = profiler.cost_from_executable(step.jit_key, exe)
+    ma = exe.memory_analysis()
+    out[sched] = {{
+        "step_ms": best * 1e3,
+        "bubble_grid": step.bubble_fraction,
+        "bubble_measured": bub / (bub + comp) if (bub + comp) else None,
+        "peak_bytes": cost.get("peak_bytes"),
+        "temp_bytes": getattr(ma, "temp_size_in_bytes", None),
+    }}
+profiler.attribution_enable(prev)
+print(json.dumps(out))
+"""
+
+
+def bench_composed_1f1b():
+    """Pipeline-schedule row: the composed-parallel train step racing
+    GPipe against 1F1B at fixed geometry (S=4 stages, M=8 microbatches,
+    dp2 x pp4) in a fresh subprocess with 8 forced host devices. Step
+    time on CPU is a tie by construction (one sequential XLA program
+    either way) — the metrics that carry the row are the bubble
+    fractions (schedule-grid analytic and the attributed pp_bubble
+    phase) and peak live memory from the compiler's memory_analysis():
+    1F1B+remat holds at most 2(S-1)+1 in-flight stage activations where
+    GPipe holds all M. CPU-pinned, so the row publishes even when the
+    accelerator is unreachable. Returns {schedule: {step_ms,
+    bubble_grid, bubble_measured, peak_bytes, temp_bytes}}."""
+    import os
+    import subprocess
+    xla = os.environ.get("XLA_FLAGS", "")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(xla +
+                          " --xla_force_host_platform_device_count=8")
+               .strip())
+    script = _COMPOSED_1F1B_SCRIPT.format(
+        repo=os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=900)
+    if r.returncode != 0:
+        raise RuntimeError(f"composed-1f1b subprocess failed: "
+                           f"{(r.stderr or '').strip()[-500:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def bench_decode(streams=16, slots=4):
     """Decode serving row: CONTINUOUS batching (iteration-level
     admit/retire over the fixed slot batch + paged KV-cache) against
@@ -1169,6 +1251,43 @@ def main():
               f"of step time", file=sys.stderr)
     except Exception as e:
         print(f"[bench] checkpoint: FAILED {e!r}", file=sys.stderr)
+
+    # pipeline-schedule row also runs in EVERY mode: the 1F1B-vs-GPipe
+    # bubble and memory gap is a schedule property, measured in grid
+    # ticks and compiler memory accounting inside a CPU-pinned
+    # subprocess (8 forced host devices)
+    try:
+        pr = bench_composed_1f1b()
+        g, f = pr["gpipe"], pr["1f1b"]
+        mem_ratio = (g["temp_bytes"] / f["temp_bytes"]
+                     if g.get("temp_bytes") and f.get("temp_bytes")
+                     else None)
+        results.append({"mode": "composed_1f1b", "batch": 16,
+                        "dtype": "float32",
+                        "stages": 4, "microbatches": 8,
+                        "gpipe_step_ms": round(g["step_ms"], 1),
+                        "pp1f1b_step_ms": round(f["step_ms"], 1),
+                        "gpipe_bubble": g["bubble_grid"],
+                        "pp1f1b_bubble": f["bubble_grid"],
+                        "pp1f1b_bubble_measured":
+                            round(f["bubble_measured"], 4)
+                            if f.get("bubble_measured") is not None
+                            else None,
+                        "gpipe_peak_bytes": g.get("peak_bytes"),
+                        "pp1f1b_peak_bytes": f.get("peak_bytes"),
+                        "gpipe_temp_bytes": g.get("temp_bytes"),
+                        "pp1f1b_temp_bytes": f.get("temp_bytes"),
+                        "mem_reduction": round(mem_ratio, 2)
+                        if mem_ratio else None,
+                        "vs_baseline": None})
+        print(f"[bench] composed pipeline (S=4, M=8, dp2xpp4) bubble "
+              f"{f['bubble_grid']:.3f} 1f1b vs {g['bubble_grid']:.3f} "
+              f"gpipe  step {f['step_ms']:7.1f} ms vs "
+              f"{g['step_ms']:7.1f} ms (cpu)"
+              + (f"  temp mem {mem_ratio:4.2f}x smaller with remat"
+                 if mem_ratio else ""), file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] composed_1f1b: FAILED {e!r}", file=sys.stderr)
 
     if on_tpu:
         try:

@@ -9,13 +9,18 @@ composes:
   dp  — batch sharding, gradients meaned across the axis (by shard_map's
         autodiff transpose of the loss pmean; no explicit allreduce),
   pp  — layers split into stages; microbatches flow through a ppermute
-        ring (parallel/pipeline.py). The BACKWARD schedule is the
-        transpose of that scan: stages run in reverse over the inverted
-        ring, microbatch by microbatch, with each stage's weight gradient
-        accumulated across microbatches in the scan-carry cotangent — the
-        GPipe backward. Cross-ROUND gradient accumulation is explicit: the
-        local batch is chunked into rounds scanned sequentially, so
-        activation memory is bounded by one round's pipeline.
+        ring (parallel/pipeline.py). The BACKWARD schedule is selectable
+        (`schedule=`, env MXTPU_PP_SCHEDULE): "gpipe" differentiates the
+        forward scan, so the backward is its transpose — all-forward then
+        all-backward, every microbatch's activations live at once —
+        while "1f1b" runs a one-forward-one-backward steady state where
+        backward for microbatch k overlaps forward for microbatch k+S
+        and at most 2(S−1−s)+1 stage inputs are in flight per stage,
+        with per-stage recompute standing in for stored activations
+        (remat=, env MXNET_REMAT). Cross-ROUND gradient accumulation is
+        explicit: the local batch is chunked into rounds scanned
+        sequentially, so activation memory is bounded by one round's
+        pipeline.
   tp  — Megatron column/row sharding of attention + FFN matmuls with one
         psum after each row-parallel matmul,
   sp  — sequence sharding with ring attention (parallel/ring_attention.py),
@@ -40,7 +45,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel._compat import shard_map
 from ..parallel.moe import moe_apply, moe_apply_a2a
-from ..parallel.pipeline import pipeline_train_apply
+from ..parallel.pipeline import (REMAT_MODES, SCHEDULES, pipeline_train_apply,
+                                 remat_stage_fn, schedule_stats)
 from ..parallel.ring_attention import attention_reference, ring_attention
 
 __all__ = ["ComposedConfig", "ComposedPipelineLM"]
@@ -194,11 +200,32 @@ class ComposedPipelineLM:
         return specs
 
     def make_train_step(self, mesh, n_microbatches=2, grad_accum_rounds=1,
-                        lr=1e-3):
+                        lr=1e-3, schedule=None, remat=None):
         """Returns (step_fn, shard_params, init_opt). step_fn(params, opt,
         tokens, targets, step_i) -> (params, opt, loss); tokens/targets
         (B, T) int32 sharded (dp, sp). ONE jitted program contains the
-        full pipeline fwd+bwd schedule, every collective, and Adam."""
+        full pipeline fwd+bwd schedule, every collective, and Adam.
+
+        `schedule` picks the pipeline backward ("gpipe" or "1f1b",
+        default env MXTPU_PP_SCHEDULE) and `remat` the per-stage
+        rematerialization policy ("none"/"dots_saveable"/"full", default
+        env MXNET_REMAT); both also apply to the no-pp microbatch scan
+        (where remat still bounds activation memory and schedule is
+        moot). The returned step carries `.schedule`, `.remat`,
+        `.bubble_fraction` (the schedule-grid idle fraction),
+        `.schedule_stats`, `.jit_key` and `._cached` (the underlying
+        cached_jit wrapper), and — when step attribution is on — books
+        each call's wall time into the `compute` / `pp_bubble` phases so
+        profiler.mfu_stats() reports the measured bubble."""
+        from ..util import getenv_str
+        if schedule is None:
+            schedule = getenv_str("MXTPU_PP_SCHEDULE")
+        if remat is None:
+            remat = getenv_str("MXNET_REMAT")
+        if schedule not in SCHEDULES:
+            raise ValueError(f"schedule {schedule!r} not in {SCHEDULES}")
+        if remat not in REMAT_MODES:
+            raise ValueError(f"remat {remat!r} not in {REMAT_MODES}")
         cfg = self.cfg
         names = set(mesh.axis_names)
         dp = "dp" if "dp" in names else None
@@ -253,15 +280,18 @@ class ComposedPipelineLM:
                 xr, tr = xs
                 if pp:
                     h, aux = pipeline_train_apply(stage_fn, stage_p, xr,
-                                                  pp, n_microbatches)
+                                                  pp, n_microbatches,
+                                                  schedule=schedule,
+                                                  remat=remat)
                 else:
                     # no pp axis: same microbatch chunking, plain scan —
                     # this IS the grad-accumulation baseline
                     mb = xr.shape[0] // n_microbatches
                     xm = xr.reshape((n_microbatches, mb) + xr.shape[1:])
+                    mb_stage = remat_stage_fn(stage_fn, remat)
 
                     def mb_fn(_, xmb):
-                        hh, aa = stage_fn(stage_p, xmb)
+                        hh, aa = mb_stage(stage_p, xmb)
                         return None, (hh, aa)
                     _, (hs, aas) = lax.scan(mb_fn, None, xm)
                     h = hs.reshape(xr.shape)
@@ -300,13 +330,63 @@ class ComposedPipelineLM:
             return new_params, new_opt, loss
 
         shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
-        jit_step = jax.jit(
-            step,
+        axes_sig = "x".join(f"{a}{mesh.shape[a]}" for a in mesh.axis_names)
+        jit_key = (f"trainstep:composed:{axes_sig}:{schedule}:"
+                   f"remat-{remat}:M{n_microbatches}:R{grad_accum_rounds}")
+        pstats = schedule_stats(schedule, S, n_microbatches)
+        bubble = pstats["bubble_fraction"] if pp else 0.0
+
+        from .. import compile_cache as _cc
+        from .. import profiler as _prof
+        from .. import shardlint as _sl
+        from ..parallel.train import default_compiler_options
+        # grads stay positionally inside the program (value_and_grad is
+        # fused into the step), so params/opt_state are the only donation
+        # candidates; data/step args are neutral. The all-gather budget
+        # covers the param gathers XLA materializes for the replicated
+        # embed/final-LN tensors used on every (round, microbatch) visit.
+        _sl.annotate(jit_key,
+                     arg_roles={0: "params", 1: "opt_state", 2: "data",
+                                3: "data", 4: "step"},
+                     declared_bf16=(jnp.dtype(cfg.dtype) == jnp.bfloat16),
+                     allgather_budget=16)
+        # donation only where the backend actually aliases buffers — the
+        # SL03 true positive the corpus self-run caught here, same gate
+        # as TrainStep and the fused optimizer
+        from ..ops.optimizer_ops import _donation_supported
+        cached = _cc.cached_jit(
+            jit_key, step,
             in_shardings=(shardings,
                           {k: (shardings[k], shardings[k]) for k in specs},
                           NamedSharding(mesh, data_spec),
                           NamedSharding(mesh, data_spec), None),
-            donate_argnums=(0, 1))
+            donate_argnums=(0, 1) if _donation_supported() else (),
+            compiler_options=default_compiler_options())
+
+        def jit_step(params, opt_state, tokens, targets, step_i):
+            if not (pp and _prof.attribution_enabled()):
+                return cached(params, opt_state, tokens, targets, step_i)
+            import time
+            t0 = time.perf_counter()
+            out = cached(params, opt_state, tokens, targets, step_i)
+            jax.block_until_ready(out[2])
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            # one XLA program = one opaque span on the device timeline;
+            # the schedule grid says what share of the stage-ticks inside
+            # it are structurally idle, so the step's wall time is split
+            # by that fraction rather than by (unobservable) per-stage
+            # device spans
+            _prof.observe_phase("compute", dur_ms * (1.0 - bubble), t0=t0)
+            _prof.observe_phase("pp_bubble", dur_ms * bubble, t0=t0)
+            _prof.phase_step_end()
+            return out
+
+        jit_step._cached = cached
+        jit_step.jit_key = jit_key
+        jit_step.schedule = schedule
+        jit_step.remat = remat
+        jit_step.bubble_fraction = bubble
+        jit_step.schedule_stats = pstats
 
         def shard_params(params):
             return {k: jax.device_put(jnp.asarray(v).copy(), shardings[k])

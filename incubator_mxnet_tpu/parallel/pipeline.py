@@ -1,16 +1,46 @@
-"""Pipeline parallelism (pp): GPipe-style microbatch schedule over a mesh
-axis.
+"""Pipeline parallelism (pp): microbatch schedules over a mesh axis.
 
 The reference's only model parallelism is layer placement via `group2ctx`
 (src/executor/graph_executor.cc:986 device-placement pass + cross-device
 copies) with NO pipelining — devices idle while one executes its layers.
 TPU-native redesign: stages live on a `pp` mesh axis inside shard_map;
 microbatches flow stage-to-stage with `lax.ppermute` on a `lax.scan`
-steady-state loop, so after the fill phase every stage computes every
-step (classic GPipe bubble of (S-1)/(S-1+M)).
+steady-state loop, and the WHOLE schedule — forward and backward — is one
+compiled XLA program that composes with dp/tp/sp/ep axes of the same mesh.
 
-All-XLA: no host scheduling, the whole pipeline is one compiled program
-that composes with dp/tp/sp axes of the same mesh.
+Two schedules are provided (`schedule=` / env `MXTPU_PP_SCHEDULE`):
+
+* ``"gpipe"`` — a forward scan over ``M + S - 1`` ticks whose backward is
+  obtained by JAX autodiff: the transpose of the scan runs the stages in
+  reverse over the inverted ppermute ring, microbatch by microbatch.  The
+  two half-programs each idle ``S - 1`` of their ticks per stage, so the
+  bubble fraction is ``(S-1)/(M+S-1)`` — and every microbatch's stage
+  activations stay live through the whole forward (peak ~``M`` microbatch
+  residuals per stage).
+
+* ``"1f1b"`` — one-forward-one-backward: a ``jax.custom_vjp`` whose
+  backward replays the pipeline on a combined warmup/steady/cooldown grid
+  of ``M + 2(S-1)`` ticks.  Each tick has a forward sub-slot (activations
+  hop DOWN the ring) and a backward sub-slot (cotangents hop UP the
+  inverted ring): stage ``s`` runs ``F(s, k)`` at tick ``s + k`` and
+  ``B(s, k)`` at tick ``k + 2(S-1) - s``, so the backward for microbatch
+  ``k`` overlaps the forward for microbatch ``k + S`` and the last stage
+  turns a microbatch around (F then B) within one tick.  Only the stage
+  INPUT of each in-flight microbatch is kept (a ring buffer of ``2S - 1``
+  slots; at most ``2(S-1-s) + 1`` live per stage ``s``, independent of
+  ``M``); the backward sub-slot recomputes the stage forward from that
+  saved input under the active rematerialization policy.  Merging the
+  forward drain into the backward fill leaves only ``2s`` idle ticks on
+  stage ``s``, a bubble fraction of ``(S-1)/(M+2S-2)`` — strictly below
+  GPipe's for any ``M >= 1`` (see schedule_stats / the schedule_grid
+  simulation, and docs/architecture/note_composed_parallelism.md for the
+  derivations).
+
+Per-stage activation REMATERIALIZATION (`remat=` / env `MXNET_REMAT`)
+wraps the stage function in ``jax.checkpoint``: ``"none"`` saves whatever
+autodiff saves, ``"dots_saveable"`` keeps matmul outputs and recomputes
+the rest, ``"full"`` saves nothing but the stage input.  Numerics are
+bit-identical across policies; only the memory/recompute trade-off moves.
 """
 from __future__ import annotations
 
@@ -20,8 +50,111 @@ from jax import lax
 
 from ._compat import shard_map
 
-__all__ = ["pipeline_apply", "pipeline_train_apply", "pipeline_sharded"]
+__all__ = ["pipeline_apply", "pipeline_train_apply", "pipeline_sharded",
+           "remat_stage_fn", "schedule_grid", "schedule_stats",
+           "SCHEDULES", "REMAT_MODES"]
 
+SCHEDULES = ("gpipe", "1f1b")
+REMAT_MODES = ("none", "dots_saveable", "full")
+
+
+def remat_stage_fn(stage_fn, mode):
+    """Wrap a pipeline stage in the requested `jax.checkpoint` policy.
+
+    "none" returns the function unchanged (autodiff saves its usual
+    residuals); "dots_saveable" checkpoints with the dots_saveable policy
+    (matmul outputs kept, elementwise recomputed); "full" checkpoints with
+    the default save-nothing policy (backward recomputes the entire stage
+    from its input). The wrapper changes only WHAT the backward stores,
+    never the values it computes.
+    """
+    if mode in (None, "", "none"):
+        return stage_fn
+    if mode == "dots_saveable":
+        return jax.checkpoint(
+            stage_fn, policy=jax.checkpoint_policies.dots_saveable)
+    if mode == "full":
+        return jax.checkpoint(stage_fn)
+    raise ValueError(f"unknown remat mode {mode!r}; pick from {REMAT_MODES}")
+
+
+# ---------------------------------------------------------------------------
+# schedule grids: the host-side source of truth for what each compiled
+# program makes every stage do at every tick — bubble accounting and the
+# docs' formulas are DERIVED from these, not asserted independently
+# ---------------------------------------------------------------------------
+
+def schedule_grid(schedule, n_stages, n_microbatches):
+    """The (tick, stage) work grid of a schedule: a list over ticks, each
+    a tuple over stages of work-item tuples — ("F", k) / ("B", k) entries,
+    empty when the stage computes garbage that tick (the bubble).
+
+    gpipe ticks cover the forward scan then its autodiff transpose (the
+    backward replays the scan in reverse); 1f1b ticks each carry a forward
+    AND a backward sub-slot of the combined grid.
+    """
+    S, M = n_stages, n_microbatches
+    if schedule == "gpipe":
+        grid = []
+        for t in range(M + S - 1):                    # forward scan
+            grid.append(tuple(
+                (("F", t - s),) if 0 <= t - s < M else ()
+                for s in range(S)))
+        for u in range(M + S - 1):                    # transposed scan
+            t = (M + S - 2) - u
+            grid.append(tuple(
+                (("B", t - s),) if 0 <= t - s < M else ()
+                for s in range(S)))
+        return grid
+    if schedule == "1f1b":
+        grid = []
+        for t in range(M + 2 * (S - 1)):
+            row = []
+            for s in range(S):
+                work = []
+                kf = t - s
+                if 0 <= kf < M:
+                    work.append(("F", kf))
+                kb = t - 2 * (S - 1) + s
+                if 0 <= kb < M:
+                    work.append(("B", kb))
+                row.append(tuple(work))
+            grid.append(tuple(row))
+        return grid
+    raise ValueError(f"unknown schedule {schedule!r}; pick from {SCHEDULES}")
+
+
+def schedule_stats(schedule, n_stages, n_microbatches):
+    """Bubble accounting derived from schedule_grid: a (tick, stage) slot
+    is idle when the stage has no real microbatch that tick (it still
+    executes — on garbage — since the program is lockstep SPMD).  Returns
+    {"ticks", "total_slots", "idle_slots", "bubble_fraction",
+    "analytic_gpipe", "max_live_per_stage"}.  max_live_per_stage is the
+    peak number of in-flight microbatch activations any stage holds for
+    its backward: M for gpipe (autodiff keeps every forward residual until
+    the transpose replays it), max_s 2(S-1-s)+1 for 1f1b (saved input ring,
+    slot k freed the tick B(k) consumes it)."""
+    grid = schedule_grid(schedule, n_stages, n_microbatches)
+    S, M = n_stages, n_microbatches
+    total = len(grid) * S
+    idle = sum(1 for row in grid for work in row if not work)
+    if schedule == "gpipe":
+        max_live = M
+    else:
+        max_live = max(2 * (S - 1 - s) + 1 for s in range(S)) if S else 0
+    return {
+        "ticks": len(grid),
+        "total_slots": total,
+        "idle_slots": idle,
+        "bubble_fraction": idle / total if total else 0.0,
+        "analytic_gpipe": (S - 1) / (M + S - 1) if M + S > 1 else 0.0,
+        "max_live_per_stage": max_live,
+    }
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
 
 def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
     """Run INSIDE shard_map. Executes `stage_fn(stage_params, h)` on each
@@ -45,20 +178,36 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
 
 
 def pipeline_train_apply(stage_fn, stage_params, x, axis_name,
-                         n_microbatches):
+                         n_microbatches, schedule="gpipe", remat="none"):
     """pipeline_apply for TRAINING stages: stage_fn(params, h) returns
     (h_out, aux) where aux is a scalar auxiliary loss (e.g. MoE load
-    balancing). Differentiating through this function yields the pipeline
-    BACKWARD schedule automatically: the transpose of the forward scan
-    runs the stages in reverse with the ppermute ring inverted, microbatch
-    by microbatch, accumulating each stage's weight gradient across
-    microbatches in the scan-carry cotangent — the GPipe backward.
+    balancing).  The function is differentiable either way; `schedule`
+    picks HOW the pipeline backward is scheduled:
 
-    aux is only meaningful for steps where a stage holds a real microbatch
+    * "gpipe": differentiating through the forward scan yields the
+      backward as the autodiff transpose — stages in reverse over the
+      inverted ppermute ring, weight gradients accumulated across
+      microbatches in the scan-carry cotangent.  Simple, but the backward
+      only starts after the whole forward drained, and every microbatch's
+      stage residuals stay live until then.
+    * "1f1b": a custom-vjp backward replays the pipeline on the combined
+      one-forward-one-backward grid (module docstring): B(k) overlaps
+      F(k+S), each stage keeps only a bounded ring of saved stage INPUTS
+      and recomputes its forward from them under the `remat` policy.
+
+    Both schedules compute the same loss and the same gradients (to
+    floating-point accumulation order); tests/test_pipeline_1f1b.py pins
+    the parity.
+
+    aux is only meaningful for slots where a stage holds a real microbatch
     (during fill/drain, stages chew zeros); those contributions are masked
     out. Returns (outputs (B, ...), aux_mean) with aux_mean the mean over
     the S * M real (stage, microbatch) visits.
     """
+    if schedule not in SCHEDULES:
+        raise ValueError(
+            f"unknown schedule {schedule!r}; pick from {SCHEDULES}")
+    stage_fn = remat_stage_fn(stage_fn, remat)
     S = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     B = x.shape[0]
@@ -67,8 +216,6 @@ def pipeline_train_apply(stage_fn, stage_params, x, axis_name,
     mb = B // n_microbatches
     micro = x.reshape((n_microbatches, mb) + x.shape[1:])
 
-    total = n_microbatches + S - 1     # fill + steady + drain
-    out0 = jnp.zeros_like(micro)
     carry0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
     aval = jax.eval_shape(stage_fn, stage_params, carry0)[0]
     if aval.shape != carry0.shape or aval.dtype != carry0.dtype:
@@ -77,16 +224,37 @@ def pipeline_train_apply(stage_fn, stage_params, x, axis_name,
             f"{aval.shape}/{aval.dtype} from {carry0.shape}/{carry0.dtype}; "
             "move width changes inside a stage")
 
+    if schedule == "gpipe":
+        outs, aux_mean = _forward_schedule(stage_fn, stage_params, micro,
+                                           axis_name, S, rank)
+    else:
+        outs, aux_mean = _pipeline_1f1b(stage_fn, stage_params, micro,
+                                        axis_name, S, rank)
+    return outs.reshape((B,) + outs.shape[2:]), aux_mean
+
+
+def _forward_schedule(stage_fn, stage_params, micro, axis_name, S, rank):
+    """The forward scan shared by both schedules: M + S - 1 ticks, stage 0
+    injecting microbatch t, activations hopping the ring after every tick,
+    the last stage collecting its output at t >= S - 1. Differentiating
+    through it yields the gpipe backward; the 1f1b path calls it inside a
+    custom_vjp forward (so autodiff never sees it) and schedules its own
+    backward. Returns (outs (M, mb, ...) psum-broadcast, aux_mean)."""
+    M = micro.shape[0]
+    total = M + S - 1     # fill + steady + drain
+    out0 = jnp.zeros_like(micro)
+    carry0 = jnp.zeros(micro.shape[1:], micro.dtype)
+
     def step(carry, t):
         h_prev, outs, aux_acc = carry
-        mb_idx = jnp.clip(t, 0, n_microbatches - 1)
+        mb_idx = jnp.clip(t, 0, M - 1)
         inject = lax.dynamic_index_in_dim(micro, mb_idx, 0, keepdims=False)
         h_in = jnp.where(rank == 0, inject, h_prev)
         h_out, aux = stage_fn(stage_params, h_in)
         # my microbatch at step t is t - rank; mask fill/drain visits
-        valid = jnp.logical_and(t - rank >= 0, t - rank < n_microbatches)
+        valid = jnp.logical_and(t - rank >= 0, t - rank < M)
         aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
-        out_idx = jnp.clip(t - (S - 1), 0, n_microbatches - 1)
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
         take = jnp.logical_and(rank == S - 1, t >= S - 1)
         outs = lax.cond(
             take,
@@ -101,8 +269,122 @@ def pipeline_train_apply(stage_fn, stage_params, x, axis_name,
         step, (carry0, out0, jnp.float32(0)), jnp.arange(total))
     outs = lax.psum(jnp.where(rank == S - 1, outs, jnp.zeros_like(outs)),
                     axis_name)
-    aux_mean = lax.psum(aux_acc, axis_name) / (S * n_microbatches)
-    return outs.reshape((B,) + outs.shape[2:]), aux_mean
+    aux_mean = lax.psum(aux_acc, axis_name) / (S * M)
+    return outs, aux_mean
+
+
+def _pipeline_1f1b(stage_fn, stage_params, micro, axis_name, S, rank):
+    """The 1F1B schedule as a custom_vjp: the forward is the plain forward
+    scan (saving nothing but its primal inputs), the backward replays the
+    pipeline on the combined grid of T = M + 2(S-1) ticks. Per tick:
+
+      forward sub-slot   F(s, k) at t = s + k: recompute the stage forward
+                         so activations keep flowing down the ring, and
+                         save the stage INPUT in a ring buffer;
+      backward sub-slot  B(s, k) at t = k + 2(S-1) - s: jax.vjp of the
+                         stage at its saved input (the recompute IS the
+                         rematerialization; the checkpoint policy wrapped
+                         around stage_fn bounds what the vjp itself
+                         stores), seeded by the head cotangent on the last
+                         stage or the cotangent that hopped UP the ring,
+                         accumulating weight grads across microbatches.
+
+    Every transposed collective mirrors one forward op: the outs
+    psum-broadcast transposes to a psum of the incoming output cotangents;
+    the downward ppermute transposes to an upward ppermute; the rank-0
+    where-injection transposes to collecting d/d x on rank 0 only.
+    """
+    M, mbs = micro.shape[0], micro.shape[1:]
+    dt = micro.dtype
+
+    # NOTE: the vjp functions re-derive the axis index inside their own
+    # bodies instead of closing over the outer tracer — custom_vjp rules
+    # out closed-over tracers, and everything else captured here
+    # (stage_fn, axis_name, S, shapes) is trace-static.
+
+    @jax.custom_vjp
+    def run(params, xx):
+        return _forward_schedule(stage_fn, params, xx, axis_name, S,
+                                 lax.axis_index(axis_name))
+
+    def fwd(params, xx):
+        return run(params, xx), (params, xx)
+
+    def bwd(res, cots):
+        params, xx = res
+        g_outs, g_aux = cots
+        rank = lax.axis_index(axis_name)
+        # transpose of `outs = psum(where(rank == S-1, outs_buf, 0))`: the
+        # last stage's output buffer receives the psum of every rank's
+        # (identical, head-computed) cotangent
+        g_head = lax.psum(g_outs.astype(dt), axis_name)
+        # transpose of `aux_mean = psum(aux_acc) / (S * M)`: each real
+        # (stage, microbatch) visit's aux scalar gets this cotangent
+        ga_visit = lax.psum(g_aux, axis_name) / (S * M)
+
+        Rbuf = 2 * S - 1            # ring depth: max in-flight saved inputs
+        T = M + 2 * (S - 1)
+        ring0 = jnp.zeros((Rbuf,) + mbs, dt)
+        gx0 = jnp.zeros((M,) + mbs, dt)
+        h0 = jnp.zeros(mbs, dt)
+        g0 = jnp.zeros(mbs, dt)
+        # accumulate weight grads in f32 (bf16 params would otherwise lose
+        # the cross-microbatch accumulation), cast back at the end
+        gp0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+        def tick(carry, t):
+            h_prev, g_prev, ring, gx, gp = carry
+            # ---- forward sub-slot: F(rank, t - rank) -------------------
+            kf = t - rank
+            valid_f = jnp.logical_and(kf >= 0, kf < M)
+            kf_c = jnp.clip(kf, 0, M - 1)
+            inject = lax.dynamic_index_in_dim(xx, kf_c, 0, keepdims=False)
+            h_in = jnp.where(rank == 0, inject, h_prev)
+            # save the stage input; the write is guarded so fill/drain
+            # ticks cannot clobber a live slot through the index clamp
+            ring = jnp.where(
+                valid_f,
+                lax.dynamic_update_index_in_dim(ring, h_in, kf_c % Rbuf, 0),
+                ring)
+            h_out, _ = stage_fn(params, h_in)
+            # ---- backward sub-slot: B(rank, t - 2(S-1) + rank) ---------
+            kb = t - 2 * (S - 1) + rank
+            valid_b = jnp.logical_and(kb >= 0, kb < M)
+            kb_c = jnp.clip(kb, 0, M - 1)
+            h_saved = lax.dynamic_index_in_dim(ring, kb_c % Rbuf, 0,
+                                               keepdims=False)
+            seed = lax.dynamic_index_in_dim(g_head, kb_c, 0, keepdims=False)
+            g_in = jnp.where(rank == S - 1, seed, g_prev)
+            _, vjp_fn = jax.vjp(stage_fn, params, h_saved)
+            gp_i, gh = vjp_fn((g_in, jnp.where(valid_b, ga_visit, 0.0)))
+            gp = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(valid_b, g, 0).astype(
+                    jnp.float32), gp, gp_i)
+            # B(0, k) finishing means d/d x of microbatch k is ready
+            gx = jnp.where(
+                jnp.logical_and(rank == 0, valid_b),
+                lax.dynamic_update_index_in_dim(gx, gh.astype(dt), kb_c, 0),
+                gx)
+            # activations flow DOWN, cotangents flow UP the inverted ring
+            h_next = lax.ppermute(
+                h_out, axis_name, [(i, (i + 1) % S) for i in range(S)])
+            g_next = lax.ppermute(
+                jnp.where(valid_b, gh, jnp.zeros_like(gh)), axis_name,
+                [(i, (i - 1) % S) for i in range(S)])
+            return (h_next, g_next, ring, gx, gp), None
+
+        (_, _, _, gx, gp), _ = lax.scan(
+            tick, (h0, g0, ring0, gx0, gp0), jnp.arange(T))
+        g_params = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), gp, params)
+        # ranks > 0 never consumed xx (the rank-0 where-injection zeroes
+        # their cotangent exactly as the gpipe transpose does)
+        g_x = jnp.where(rank == 0, gx, jnp.zeros_like(gx))
+        return g_params, g_x
+
+    run.defvjp(fwd, bwd)
+    return run(stage_params, micro)
 
 
 def pipeline_sharded(stage_fn, params_stacked, x, mesh, axis="pp",

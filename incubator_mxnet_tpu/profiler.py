@@ -805,15 +805,21 @@ def mfu_stats():
     with _lock:
         comp = _phases.get("compute")
         compute_ms = comp[1] / max(comp[0], 1) if comp else None
+        bub = _phases.get("pp_bubble")
+        bubble_ms = bub[1] / max(bub[0], 1) if bub else None
     out = {"key": key, "flops_per_step": rec["flops"],
            "bytes_per_step": rec.get("bytes_accessed"),
            "compute_ms_per_step": compute_ms,
+           "pp_bubble_ms_per_step": bubble_ms,
+           "pp_bubble_fraction": None,
            "peak_flops": device_peak_flops(),
            "flops_per_sec": None, "mfu": None}
     if compute_ms:
         out["flops_per_sec"] = rec["flops"] / (compute_ms / 1e3)
         if out["peak_flops"]:
             out["mfu"] = out["flops_per_sec"] / out["peak_flops"]
+        if bubble_ms is not None:
+            out["pp_bubble_fraction"] = bubble_ms / (bubble_ms + compute_ms)
     return out
 
 

@@ -387,6 +387,18 @@ ENV_VARS = collections.OrderedDict([
      "Per-sequence page-table width (max pages one stream may own). "
      "Requests whose prompt+max_new_tokens exceed it are rejected as "
      "NON-retryable — no replica can serve them.")),
+    ("MXTPU_PP_SCHEDULE", EnvSpec("gpipe", "str",
+     "Pipeline-parallel microbatch schedule for the composed train "
+     "step: 'gpipe' (all-forward then the transposed all-backward) or "
+     "'1f1b' (one-forward-one-backward steady state with bounded "
+     "in-flight activations). An explicit schedule= argument "
+     "overrides it.")),
+    ("MXNET_REMAT", EnvSpec("none", "str",
+     "Per-stage activation rematerialization policy for pipelined "
+     "train steps: 'none' (store), 'dots_saveable' (jax.checkpoint "
+     "keeping matmul outputs), or 'full' (recompute everything). "
+     "Numerics are bit-identical across policies; only the "
+     "memory/recompute trade-off moves.")),
 ])
 
 _FALSY = frozenset(("", "0", "false", "off", "no"))

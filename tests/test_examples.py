@@ -220,7 +220,11 @@ def test_dec_unsupervised_clustering():
 
 
 def test_sgld_posterior_sampling():
-    out = _run("example/bayesian-methods/sgld.py")
+    # tiny-settings run (the file default's 3000 eager steps were ~20%
+    # of the whole tier-1 time budget); every posterior assertion in
+    # the example still holds with margin at 1000
+    out = _run("example/bayesian-methods/sgld.py",
+               "--steps", "1000", "--burnin", "400")
     assert "SGLD_OK" in out
 
 

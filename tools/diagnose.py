@@ -234,6 +234,35 @@ def main():
     except Exception as e:
         print("control plane probe FAILED:", e)
 
+    print("----------Composed Parallelism (pipeline schedules)----------")
+    try:
+        from incubator_mxnet_tpu.parallel.pipeline import (REMAT_MODES,
+                                                           SCHEDULES,
+                                                           schedule_stats)
+        from incubator_mxnet_tpu.util import getenv_str
+        from incubator_mxnet_tpu import profiler as _prof
+        print("schedule     :", getenv_str("MXTPU_PP_SCHEDULE"),
+              f"(MXTPU_PP_SCHEDULE; one of {'/'.join(SCHEDULES)})")
+        print("remat        :", getenv_str("MXNET_REMAT"),
+              f"(MXNET_REMAT; one of {'/'.join(REMAT_MODES)})")
+        print("bubble fraction by (stages, microbatches):")
+        print("   S  M   gpipe   1f1b   live/stage(gpipe -> 1f1b)")
+        for s, m in ((2, 4), (4, 8), (4, 16), (8, 32)):
+            g = schedule_stats("gpipe", s, m)
+            f = schedule_stats("1f1b", s, m)
+            print(f"  {s:2d} {m:2d}  {g['bubble_fraction']:.4f} "
+                  f"{f['bubble_fraction']:.4f}   "
+                  f"{g['max_live_per_stage']} -> {f['max_live_per_stage']}")
+        phases = _prof.last_step_phases()
+        if phases.get("pp_bubble") is not None:
+            print("last step    :", {k: round(v, 2)
+                                     for k, v in sorted(phases.items())})
+        else:
+            print("last step    : no attributed pp_bubble phase recorded "
+                  "(run a pp>1 step with attribution on)")
+    except Exception as e:
+        print("composed parallelism probe FAILED:", e)
+
     print("----------Static Analysis (mxlint)----------")
     try:
         from tools.mxlint import lint_paths

@@ -131,6 +131,42 @@ def _corpus_partition_rules():
                           key="corpus:partition_rules")
 
 
+def _corpus_composed_1f1b():
+    """The flagship composed-parallel train step on a real multi-axis
+    mesh with the 1F1B pipeline backward, bf16-declared and all-gather
+    budgeted — traced via the cached_jit signature path (no compile).
+    This is the program the pipeline custom_vjp lives in, so SL03
+    donation and SL05 resharding judge the hand-written backward too."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.parallel import make_mesh
+    from incubator_mxnet_tpu.models.composed import (ComposedConfig,
+                                                     ComposedPipelineLM)
+
+    n = len(jax.devices())
+    if n >= 8 and n % 8 == 0:
+        axes = {"dp": n // 4, "pp": 2, "tp": 2}
+    elif n >= 2 and n % 2 == 0:
+        axes = {"dp": n // 2, "pp": 2}
+    else:
+        return      # single device: no pipeline axis to judge
+    cfg = ComposedConfig(vocab_size=32, d_model=16, n_heads=2, n_layers=2,
+                         d_ff=32, n_experts=2, moe_every=1,
+                         capacity_factor=2.0, max_len=32, dtype="bfloat16")
+    model = ComposedPipelineLM(cfg)
+    mesh = make_mesh(axes)
+    params = model.init_params(jax.random.PRNGKey(0), axes["pp"])
+    step, shard_params, init_opt = model.make_train_step(
+        mesh, n_microbatches=2, schedule="1f1b", remat="dots_saveable")
+    p = shard_params(params)
+    rng = np.random.RandomState(0)
+    B = 4 * axes["dp"]
+    tokens = jnp.asarray(rng.randint(0, 32, (B, 8)).astype(np.int32))
+    targets = jnp.asarray(rng.randint(0, 32, (B, 8)).astype(np.int32))
+    step._cached.trace_signature(p, init_opt(p), tokens, targets, 0)
+
+
 def entries():
     """name -> builder, in run order."""
     return OrderedDict([
@@ -139,6 +175,7 @@ def entries():
         ("serve_predict", _corpus_serve_predict),
         ("fused_optimizer", _corpus_fused_optimizer),
         ("partition_rules", _corpus_partition_rules),
+        ("composed_1f1b", _corpus_composed_1f1b),
     ])
 
 
