@@ -46,7 +46,7 @@ import threading
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "create", "ship_kv_pages", "fetch_kv_pages"]
 
 _TPU_TYPES = ("tpu", "dist", "dist_sync", "dist_async", "dist_device_sync",
               "nccl")
@@ -176,6 +176,45 @@ def _flat_unpack_fn(shapes):
 
     from . import compile_cache as _cc
     return _cc.cached_jit(f"kvstore:flat_unpack[{len(shapes)}]", unpack)
+
+
+def ship_kv_pages(client, key, k_rows, v_rows, meta=None):
+    """Ship exported KV page rows to the coordinator's page store (the
+    disaggregated prefill->decode handoff, serve/disagg.py).
+
+    Reuses the pushpull flat-packer: the K and V row stacks ride as ONE
+    contiguous float32 frame over the MAC'd wire (`kv_page_put`), with
+    the shape pair stored in the bundle's meta so the consumer's
+    unpacker derives its static slice offsets. Returns the server's
+    receipt ({"stored", "bytes"}).
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    k_rows = jnp.asarray(k_rows, jnp.float32)
+    v_rows = jnp.asarray(v_rows, jnp.float32)
+    shapes = (tuple(int(d) for d in k_rows.shape),
+              tuple(int(d) for d in v_rows.shape))
+    flat = np.asarray(_flat_pack_fn(shapes)(k_rows, v_rows))
+    meta = dict(meta or {})
+    meta["shapes"] = shapes
+    return client.call("kv_page_put", key, meta, flat)
+
+
+def fetch_kv_pages(client, key, delete=False):
+    """Fetch a shipped KV-page bundle by key; returns
+    (k_rows, v_rows, meta) as numpy arrays, or None when the key is
+    unknown or expired. Non-destructive unless ``delete``: a decode
+    replica that dies mid-admission leaves the bundle fetchable for the
+    router's whole-stream retry."""
+    import numpy as np
+    import jax.numpy as jnp
+    row = client.call("kv_page_get", key, delete)
+    if row is None:
+        return None
+    meta = row["meta"]
+    shapes = tuple(tuple(int(d) for d in s) for s in meta["shapes"])
+    k, v = _flat_unpack_fn(shapes)(jnp.asarray(row["blob"]))
+    return np.asarray(k), np.asarray(v), meta
 
 
 class KVStore:

@@ -186,6 +186,15 @@ class AsyncServer:
         # serving control plane (lazy like _fleet: built on the first
         # serve_* op, so a training-only server allocates nothing)
         self._serve = None
+        # disaggregated-serving page store: ship_key -> (expiry_mono,
+        # meta, flat_blob). Prefill replicas kv_page_put exported KV
+        # pages here; the target decode replica kv_page_get's them.
+        # Entries expire after MXNET_DISAGG_SHIP_TTL seconds (lazily
+        # collected on access) so an orphaned handoff cannot pin bytes.
+        self._page_store = {}
+        self._page_bytes_in = 0
+        self._page_puts = 0
+        self._page_gets = 0
         # per-cluster shared secret: the wire is pickle, so an
         # unauthenticated peer could execute arbitrary code — every
         # connection must present this token (distributed to workers
@@ -381,13 +390,60 @@ class AsyncServer:
                                "stragglers": stragglers, "steps": steps,
                                "phases": phases, "slow_phase": slow_phase})
         if op == "serve_register":
-            _, model, replica_id, generation, buckets, http_addr = msg
+            # v2 senders append the replica's role (prefill/decode/both);
+            # v1 frames keep the 6-tuple and default to "both"
+            _, model, replica_id, generation, buckets, http_addr = msg[:6]
+            role = msg[6] if len(msg) > 6 else "both"
             return ("ok", self._serve_registry().register(
-                model, replica_id, generation, buckets, http_addr))
+                model, replica_id, generation, buckets, http_addr,
+                role=role))
         if op == "serve_beat":
-            _, model, replica_id, generation, ready, draining = msg
+            # v2 senders append a load report dict (kv page headroom for
+            # the router's decode placement); v1 frames are 6-tuples
+            _, model, replica_id, generation, ready, draining = msg[:6]
+            load = msg[6] if len(msg) > 6 else None
             return ("ok", self._serve_registry().beat(
-                model, replica_id, generation, ready, draining))
+                model, replica_id, generation, ready, draining,
+                load=load))
+        if op == "kv_page_put":
+            _, key, meta, blob = msg
+            from .util import getenv_int
+            ttl = getenv_int("MXNET_DISAGG_SHIP_TTL")
+            size = getattr(blob, "nbytes", len(blob))
+            with self._lock:
+                self._page_store_gc_locked()
+                self._page_store[key] = (time.monotonic() + ttl, meta, blob)
+                self._page_puts += 1
+                self._page_bytes_in += size
+            return ("ok", {"stored": True, "bytes": int(size)})
+        if op == "kv_page_get":
+            # non-destructive by default: a decode replica that dies
+            # after fetching must leave the bundle for the retry; the
+            # router's whole-stream retry re-fetches the same key.
+            _, key = msg[:2]
+            delete = bool(msg[2]) if len(msg) > 2 else False
+            with self._lock:
+                self._page_store_gc_locked()
+                row = self._page_store.get(key)
+                if row is not None:
+                    self._page_gets += 1
+                    if delete:
+                        del self._page_store[key]
+            if row is None:
+                return ("ok", None)
+            return ("ok", {"meta": row[1], "blob": row[2]})
+        if op == "kv_page_del":
+            _, key = msg
+            with self._lock:
+                dropped = self._page_store.pop(key, None) is not None
+            return ("ok", {"dropped": dropped})
+        if op == "kv_page_stats":
+            with self._lock:
+                self._page_store_gc_locked()
+                return ("ok", {"entries": len(self._page_store),
+                               "puts": self._page_puts,
+                               "gets": self._page_gets,
+                               "bytes_in": self._page_bytes_in})
         if op == "serve_deregister":
             _, model, replica_id = msg
             return ("ok", self._serve_registry().deregister(
@@ -416,6 +472,14 @@ class AsyncServer:
             from .serve.control_plane import ServeRegistry
             self._serve = ServeRegistry()
         return self._serve
+
+    def _page_store_gc_locked(self):
+        """Drop expired KV-page bundles (caller holds self._lock)."""
+        now = time.monotonic()
+        dead = [k for k, (exp, _, _) in self._page_store.items()
+                if now > exp]
+        for k in dead:
+            del self._page_store[k]
 
     def _dead_locked(self, gen, timeout):
         """Registered ranks with no beat/push within `timeout` seconds,

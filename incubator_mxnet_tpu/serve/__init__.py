@@ -31,6 +31,18 @@ shape-bucketing discipline):
                 KV-cache (free-list pages + per-sequence page tables),
                 AOT-warmed prefill buckets + ONE decode executable,
                 streamed per-token through ModelServer's /generate.
+  prefix_cache.py  PrefixCache — radix tree over token prefixes mapping
+                to refcounted KV pages: a shared prefix is admitted
+                read-only through PageAllocator.share and copy-on-write
+                forked on first divergent write; LRU leaves evict only
+                at refcount 0.
+  disagg.py     PrefillPredictor / PrefillEngine — disaggregated
+                prefill/decode serving: chunked prefill (one executable,
+                traced offsets, decode steps interleave between chunks),
+                page EXPORT on prefill-role replicas, KV-page shipping
+                over the MAC'd kvstore wire, and kv_import admission on
+                decode-role replicas; replica roles flow through the
+                ServeRegistry to the role-aware Router.
 
 Typical use::
 
@@ -49,10 +61,15 @@ from .control_plane import ReplicaAgent, RolloutManager, ServeRegistry
 from .router import NoReplicaAvailable, RouteError, Router, RouterStats
 from .decode import (DecodePredictor, DecodeScheduler, DecodeStream,
                      PageAllocator)
+from .prefix_cache import PrefixCache
+from .disagg import (PrefillEngine, PrefillPredictor, fetch_kv_import,
+                     ship_key_for)
 
 __all__ = ["Predictor", "BucketLadder", "DynamicBatcher", "ModelServer",
            "ServingStats", "LatencyHistogram", "Overloaded",
            "DeadlineExceeded", "ServeRegistry", "ReplicaAgent",
            "RolloutManager", "Router", "RouterStats", "RouteError",
            "NoReplicaAvailable", "DecodePredictor", "DecodeScheduler",
-           "DecodeStream", "PageAllocator"]
+           "DecodeStream", "PageAllocator", "PrefixCache",
+           "PrefillPredictor", "PrefillEngine", "ship_key_for",
+           "fetch_kv_import"]

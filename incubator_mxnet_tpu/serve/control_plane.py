@@ -124,7 +124,12 @@ class ServeRegistry:
         self._live_window = (live_window_s if live_window_s is not None
                              else _live_window_s())
 
-    def register(self, model, replica_id, generation, buckets, http_addr):
+    def register(self, model, replica_id, generation, buckets, http_addr,
+                 role="both"):
+        role = str(role or "both")
+        if role not in ("prefill", "decode", "both"):
+            raise MXNetError(f"replica role {role!r}: want "
+                             f"prefill|decode|both")
         with self._lock:
             if replica_id is None:
                 replica_id = f"r{self._next_id}"
@@ -133,6 +138,8 @@ class ServeRegistry:
                 "generation": int(generation),
                 "buckets": tuple(int(b) for b in (buckets or ())),
                 "http_addr": str(http_addr),
+                "role": role,
+                "load": {},         # latest beat's load report
                 "ready": False,     # readiness arrives with the first beat
                 "draining": False,
                 "seen_mono": time.monotonic(),
@@ -142,10 +149,11 @@ class ServeRegistry:
         _bump("registrations")
         _fault.flight_record("serve_register", model=model,
                              replica=replica_id, generation=int(generation),
-                             http_addr=str(http_addr))
+                             http_addr=str(http_addr), role=role)
         return {"replica_id": replica_id, "epoch": epoch}
 
-    def beat(self, model, replica_id, generation, ready, draining=False):
+    def beat(self, model, replica_id, generation, ready, draining=False,
+             load=None):
         with self._lock:
             row = self._replicas.get((model, replica_id))
             if row is None:
@@ -155,6 +163,8 @@ class ServeRegistry:
             row["generation"] = int(generation)
             row["ready"] = bool(ready)
             row["draining"] = bool(draining)
+            if load is not None:
+                row["load"] = dict(load)
             row["seen_mono"] = time.monotonic()
             epoch = self._epoch
         _bump("beats")
@@ -187,6 +197,8 @@ class ServeRegistry:
                     "generation": row["generation"],
                     "buckets": list(row["buckets"]),
                     "http_addr": row["http_addr"],
+                    "role": row.get("role", "both"),
+                    "load": dict(row.get("load") or {}),
                     "ready": row["ready"],
                     "draining": row["draining"],
                     "live": age <= self._live_window,
@@ -241,18 +253,24 @@ class ReplicaAgent:
         with self._lock:
             reply = self._client_locked().call(
                 "serve_register", self.model, self.replica_id,
-                srv.generation, list(srv.buckets), f"{host}:{port}")
+                srv.generation, list(srv.buckets), f"{host}:{port}",
+                getattr(srv, "role", "both"))
         self.replica_id = reply["replica_id"]
         self.registered = True
         return reply
 
     def beat_now(self):
-        """One beat; re-registers first if the coordinator forgot us."""
+        """One beat; re-registers first if the coordinator forgot us.
+        v2 beats append the server's load report (KV page headroom) so
+        the router can place decode streams by memory, not just
+        round-robin."""
         srv = self._server
+        load = getattr(srv, "load_report", None)
+        load = load() if callable(load) else None
         with self._lock:
             reply = self._client_locked().call(
                 "serve_beat", self.model, self.replica_id,
-                srv.generation, srv.ready, srv.draining)
+                srv.generation, srv.ready, srv.draining, load)
         if not reply.get("registered", True):
             self.register()
             self.beat_now()

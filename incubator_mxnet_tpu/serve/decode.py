@@ -68,7 +68,7 @@ _EOS = object()
 
 
 class PageAllocator:
-    """Free-list allocator over ``num_pages`` fixed-size KV pages.
+    """Refcounted free-list allocator over ``num_pages`` KV pages.
 
     O(1) alloc/free of page IDS only; the backing (P, page_size, H, D)
     pool arrays are owned by the scheduler and never reshaped or
@@ -76,6 +76,15 @@ class PageAllocator:
     caller either sheds 503 or leaves the request queued); freeing a
     page that is not live raises — a double free here would silently
     corrupt another sequence's context, so it must be loud.
+
+    Pages carry a refcount for the prefix cache (serve/prefix_cache.py):
+    ``alloc`` grants exclusive pages (refcount 1), ``share`` adds a
+    holder to an already-live page (a cache hit costs no copy), ``free``
+    drops one hold and only returns the page to the free list when the
+    LAST holder lets go. ``fork`` is the copy-on-write claim: the first
+    divergent WRITE to a shared page trades the caller's hold for a
+    fresh exclusive page (the caller copies the rows); an exclusive page
+    forks to itself, so the unshared fast path stays zero-copy.
     """
 
     def __init__(self, num_pages):
@@ -87,6 +96,7 @@ class PageAllocator:
         # readable tests, recency-reuse for cache locality in practice
         self._free = list(range(self.num_pages - 1, -1, -1))
         self._live_set = set()
+        self._refs = {}
         self.high_water = 0
 
     def alloc(self, n):
@@ -101,16 +111,62 @@ class PageAllocator:
                     f"{len(self._free)}/{self.num_pages} free")
             pages = [self._free.pop() for _ in range(n)]
             self._live_set.update(pages)
+            for p in pages:
+                self._refs[p] = 1
             self.high_water = max(self.high_water, len(self._live_set))
         return pages
+
+    def share(self, pages):
+        """Add one hold per page; pages must already be live (sharing a
+        dead page would alias the free list)."""
+        with self._alloc_lock:
+            for p in pages:
+                if p not in self._live_set:
+                    raise MXNetError(f"share of non-live KV page {p}")
+            for p in pages:
+                self._refs[p] += 1
+        return pages
+
+    def fork(self, page):
+        """Copy-on-write claim before the first divergent write to
+        ``page``. Returns ``(page_to_write, copied)``: the same page
+        with ``copied=False`` when the caller is the only holder, else
+        a fresh exclusive page (caller's hold on the original released)
+        with ``copied=True`` — the CALLER copies the row data, this
+        class only moves ids. May raise Overloaded when no free page
+        remains to back the copy."""
+        with self._alloc_lock:
+            if page not in self._live_set:
+                raise MXNetError(f"fork of non-live KV page {page}")
+            if self._refs[page] == 1:
+                return page, False
+            if not self._free:
+                raise Overloaded(
+                    f"KV page pool exhausted: no free page to fork "
+                    f"shared page {page}")
+            fresh = self._free.pop()
+            self._live_set.add(fresh)
+            self._refs[fresh] = 1
+            self._refs[page] -= 1
+            self.high_water = max(self.high_water, len(self._live_set))
+        return fresh, True
 
     def free(self, pages):
         with self._alloc_lock:
             for p in pages:
                 if p not in self._live_set:
                     raise MXNetError(f"double free of KV page {p}")
-                self._live_set.remove(p)
-                self._free.append(p)
+            for p in pages:
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    self._live_set.remove(p)
+                    self._free.append(p)
+
+    def refcount(self, page):
+        """Current holder count (0 for a free page)."""
+        with self._alloc_lock:
+            return self._refs.get(page, 0)
 
     @property
     def live(self):
@@ -121,6 +177,18 @@ class PageAllocator:
     def free_count(self):
         with self._alloc_lock:
             return len(self._free)
+
+    @property
+    def used_count(self):
+        with self._alloc_lock:
+            return len(self._live_set)
+
+    @property
+    def shared_count(self):
+        """Pages held by more than one owner (the prefix-cache overlap
+        the mxnet_kv_pages_shared gauge reports)."""
+        with self._alloc_lock:
+            return sum(1 for rc in self._refs.values() if rc >= 2)
 
 
 class DecodePredictor:
@@ -404,6 +472,7 @@ class DecodeStream:
         self._pages = None
         self._pages_needed = 0
         self._last_t = None
+        self._kv_import = None
 
     def _deliver(self, tok, now):
         if self.ttft_ms is None:
@@ -453,7 +522,8 @@ class DecodeScheduler:
     on the very next iteration (see module docstring)."""
 
     def __init__(self, predictor, *, stats=None, max_queue=None,
-                 max_new_tokens=None, queue_bound_ms=None, name="decode"):
+                 max_new_tokens=None, queue_bound_ms=None, name="decode",
+                 prefix_cache=None, chunk_prefill=None):
         self.predictor = predictor
         self.stats = stats if stats is not None else ServingStats(name)
         self._max_queue = int(max_queue if max_queue is not None
@@ -465,6 +535,16 @@ class DecodeScheduler:
             queue_bound_ms if queue_bound_ms is not None
             else util.getenv_int("MXNET_DECODE_QUEUE_BOUND_MS"))
         self.allocator = PageAllocator(predictor.num_pages)
+        # prefix_cache: True builds a PrefixCache over this scheduler's
+        # allocator; or pass an instance already bound to it. Cache hits
+        # are completed by CHUNKED suffix prefill (serve/disagg.py), so
+        # a chunk executable is built lazily unless chunk_prefill hands
+        # in a pre-warmed PrefillPredictor.
+        if prefix_cache is True:
+            from .prefix_cache import PrefixCache
+            prefix_cache = PrefixCache(self.allocator, predictor.page_size)
+        self.prefix_cache = prefix_cache
+        self._chunk_fn = chunk_prefill
         s = predictor.slots
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -539,6 +619,14 @@ class DecodeScheduler:
         with self._lock:
             return self._accepting
 
+    @property
+    def active_streams(self):
+        """Streams queued or occupying a slot — the load-report signal
+        routers use for decode placement."""
+        with self._lock:
+            return (len(self._waiting)
+                    + sum(1 for st in self._active if st is not None))
+
     def quiesce(self, timeout=30.0):
         """Wait until no stream is queued or in a slot. Pair with
         pause(): quiescing with admission open may never converge."""
@@ -554,7 +642,7 @@ class DecodeScheduler:
         return False
 
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_ms=None):
+               deadline_ms=None, kv_import=None):
         """Queue one generation; returns a DecodeStream immediately.
 
         Sheds (Overloaded, 503-retryable) rather than queueing into
@@ -565,6 +653,14 @@ class DecodeScheduler:
         (prompt beyond the ladder, page demand beyond the per-sequence
         cap) raise plain MXNetError: retrying those elsewhere cannot
         succeed, so they must not be labelled retryable.
+
+        ``kv_import`` is the disaggregated admission path: a dict with
+        ``k_rows``/``v_rows`` ((m, page_size, H, D) float32 rows as
+        exported by a prefill replica), ``n`` (prompt length those rows
+        cover) and ``next_token`` (the prefill's greedy pick). Admission
+        then writes the shipped rows into freshly allocated pages and
+        starts decoding at position ``n`` — no local prefill, no
+        ladder constraint on the prompt.
         """
         prompt = [int(t) for t in prompt]
         if not prompt:
@@ -575,7 +671,9 @@ class DecodeScheduler:
                       else self._default_max_new)
         if max_new < 1:
             raise MXNetError(f"max_new_tokens={max_new}: need >= 1")
-        if self.predictor.ladder.bucket_for(len(prompt)) is None:
+        if kv_import is not None:
+            kv_import = self._check_kv_import(kv_import, prompt)
+        elif self.predictor.ladder.bucket_for(len(prompt)) is None:
             raise MXNetError(
                 f"prompt length {len(prompt)} exceeds the prefill "
                 f"ladder {self.predictor.ladder.sizes}")
@@ -599,6 +697,7 @@ class DecodeScheduler:
             self._shed_if_projected_wait_locked()
             st = DecodeStream(prompt, max_new, eos_id, deadline)
             st._pages_needed = pages_needed
+            st._kv_import = kv_import
             self._waiting.append(st)
             self.stats.incr("requests_total")
             self.stats.incr("decode_streams_total")
@@ -618,6 +717,28 @@ class DecodeScheduler:
             raise Overloaded(
                 f"projected queue wait {projected_ms:.1f} ms breaches "
                 f"MXNET_DECODE_QUEUE_BOUND_MS={self._queue_bound_ms:.0f}")
+
+    def _check_kv_import(self, kv_import, prompt):
+        p = self.predictor
+        try:
+            n = int(kv_import["n"])
+            nxt = int(kv_import["next_token"])
+            k_rows = _np.asarray(kv_import["k_rows"], _np.float32)
+            v_rows = _np.asarray(kv_import["v_rows"], _np.float32)
+        except (KeyError, TypeError, ValueError) as e:
+            raise MXNetError(f"malformed kv_import: {e}")
+        if n != len(prompt):
+            raise MXNetError(f"kv_import covers {n} tokens but the "
+                             f"prompt has {len(prompt)}")
+        m = math.ceil(n / p.page_size)
+        row_shape = (m, p.page_size, p.num_heads, p.head_dim)
+        for name, rows in (("k_rows", k_rows), ("v_rows", v_rows)):
+            if tuple(rows.shape) != row_shape:
+                raise MXNetError(
+                    f"kv_import {name} shape {tuple(rows.shape)} != "
+                    f"{row_shape} for this replica's geometry")
+        return {"n": n, "next_token": nxt,
+                "k_rows": k_rows, "v_rows": v_rows}
 
     # -- the loop -------------------------------------------------------
     def _loop(self):
@@ -645,11 +766,57 @@ class DecodeScheduler:
         self.stats.set_gauge("kv_pages_live", live)
         self.stats.set_gauge("kv_page_occupancy",
                              live / self.allocator.num_pages)
+        self.stats.set_gauge("kv_pages_free", self.allocator.free_count)
+        self.stats.set_gauge("kv_pages_used", live)
+        self.stats.set_gauge("kv_pages_shared", self.allocator.shared_count)
+        if self.prefix_cache is not None:
+            pc = self.prefix_cache.stats()
+            self.stats.set_gauge("prefix_cache_hits", pc["hits"])
+            self.stats.set_gauge("prefix_cache_misses", pc["misses"])
+            self.stats.set_gauge("prefix_tokens_saved", pc["tokens_saved"])
         with self._lock:
             n_active = sum(st is not None for st in self._active)
             depth = len(self._waiting)
         self.stats.set_gauge("decode_active", n_active)
         self.stats.set_gauge("queue_depth", depth)
+
+    def _chunker(self):
+        if self._chunk_fn is None:
+            from .disagg import PrefillPredictor
+            self._chunk_fn = PrefillPredictor(self.predictor)
+        return self._chunk_fn
+
+    def _claim_pages_locked(self, st):
+        """Build the admission plan for one stream while holding the
+        scheduler lock: every page the stream will EVER touch is claimed
+        here (exclusive alloc, shared prefix-cache hit, or CoW fork of
+        a shared tail), all-or-nothing. Raises Overloaded to hold the
+        queue with nothing leaked."""
+        if st._kv_import is not None:
+            return {"mode": "import",
+                    "pages": self.allocator.alloc(st._pages_needed)}
+        if self.prefix_cache is None:
+            return {"mode": "plain",
+                    "pages": self.allocator.alloc(st._pages_needed)}
+        pages, covered, partial = self.prefix_cache.lookup(st.prompt)
+        cow = None
+        try:
+            if partial:
+                # the suffix prefill writes into the tail page: first
+                # divergent write, so take the copy-on-write claim now
+                fresh, copied = self.allocator.fork(pages[-1])
+                if copied:
+                    cow = (pages[-1], fresh)
+                pages = pages[:-1] + [fresh]
+            extra = st._pages_needed - len(pages)
+            if extra > 0:
+                pages = pages + self.allocator.alloc(extra)
+        except Overloaded:
+            if pages:
+                self.allocator.free(pages)
+            raise
+        return {"mode": "cached", "pages": pages, "covered": covered,
+                "cow": cow}
 
     def _admit(self):
         """Move waiting streams into free slots until slots or pages run
@@ -673,27 +840,26 @@ class DecodeScheduler:
                         "deadline expired while queued"))
                     continue
                 try:
-                    pages = self.allocator.alloc(st._pages_needed)
+                    plan = self._claim_pages_locked(st)
                 except Overloaded:
                     return  # pool exhausted: hold the queue, a retire
                     # will free pages and the next iteration re-admits
                 self._waiting.popleft()
                 slot = free_slots[0]
                 st._slot = slot
-                st._pages = pages
+                st._pages = plan["pages"]
                 queue_wait = now - st.submit_t
+            pages = plan["pages"]
             ptrow = _np.zeros(self.predictor.max_pages_per_seq, _np.int32)
             ptrow[:len(pages)] = pages
             t0 = time.monotonic()
-            nxt, kp, vp = self.predictor.prefill(
-                st.prompt, self._k_pages, self._v_pages, ptrow)
-            self._k_pages, self._v_pages = kp, vp
+            nxt, pos = self._run_admission(st, plan, ptrow)
             now = time.monotonic()
             self.stats.queue_wait.observe(queue_wait)
             self.stats.prefill_time.observe(now - t0)
             with self._lock:
                 self._page_tables[slot] = ptrow
-                self._positions[slot] = len(st.prompt)
+                self._positions[slot] = pos
                 self._tokens[slot] = nxt
                 self._active[slot] = st
             st._deliver(nxt, now)
@@ -703,6 +869,54 @@ class DecodeScheduler:
                     or nxt == st.eos_id or st._cancelled):
                 self._retire(st)
             self._set_pool_gauges()
+
+    def _run_admission(self, st, plan, ptrow):
+        """Fill the stream's pages (no scheduler lock held — device
+        work). Returns (first token, decode start position)."""
+        import jax.numpy as jnp
+        if plan["mode"] == "import":
+            imp = st._kv_import
+            m = len(imp["k_rows"])
+            idx = jnp.asarray(plan["pages"][:m])
+            self._k_pages = self._k_pages.at[idx].set(
+                jnp.asarray(imp["k_rows"]))
+            self._v_pages = self._v_pages.at[idx].set(
+                jnp.asarray(imp["v_rows"]))
+            self.stats.incr("kv_pages_imported_total", m)
+            return imp["next_token"], imp["n"]
+        if plan["mode"] == "cached":
+            if plan["cow"] is not None:
+                src, dst = plan["cow"]
+                self._k_pages = self._k_pages.at[dst].set(
+                    self._k_pages[src])
+                self._v_pages = self._v_pages.at[dst].set(
+                    self._v_pages[src])
+            nxt = self._chunked_prefill(st.prompt, plan["covered"], ptrow)
+            self.prefix_cache.insert(st.prompt, list(plan["pages"]),
+                                     len(st.prompt))
+            return nxt, len(st.prompt)
+        nxt, kp, vp = self.predictor.prefill(
+            st.prompt, self._k_pages, self._v_pages, ptrow)
+        self._k_pages, self._v_pages = kp, vp
+        return nxt, len(st.prompt)
+
+    def _chunked_prefill(self, prompt, start, ptrow):
+        """Prefill positions start..len(prompt)-1 in fixed chunks,
+        interleaving one decode step between chunks whenever slots are
+        active — a colocated replica's in-flight streams never wait for
+        a whole long prompt."""
+        chunker = self._chunker()
+        nxt = None
+        for lo in range(start, len(prompt), chunker.chunk):
+            if lo > start:
+                with self._lock:
+                    busy = any(s is not None for s in self._active)
+                if busy:
+                    self._step()
+            nxt, kp, vp = chunker.prefill_chunk(
+                prompt, lo, self._k_pages, self._v_pages, ptrow)
+            self._k_pages, self._v_pages = kp, vp
+        return nxt
 
     def _step(self):
         """One fixed-shape decode dispatch over all slots, then per-slot

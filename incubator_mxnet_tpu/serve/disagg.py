@@ -1,0 +1,353 @@
+"""Disaggregated prefill/decode serving: replica roles, chunked
+prefill, and KV-page shipping.
+
+Large serving fleets split the two phases of generation because they
+want opposite hardware behavior: PREFILL is one big compute-bound
+matmul burst per request, DECODE is thousands of tiny latency-bound
+steps. Colocating them makes every long prompt a head-of-line stall
+for every in-flight stream. This module supplies the pieces the
+fleet-level split needs:
+
+``PrefillPredictor``
+    ONE chunked-prefill executable (compile-cache key
+    ``serve:prefill_chunk[...]``): prompts are processed in fixed
+    ``MXNET_DISAGG_PREFILL_CHUNK``-token chunks with traced
+    start/length scalars, so any prompt length runs with zero
+    retraces AND a decode-colocated scheduler can interleave a decode
+    step between chunks — a long prompt never stalls a decode step.
+    The chunk attends through the paged KV pool itself, which is what
+    makes PREFIX RESUMPTION free: starting at ``covered`` tokens reads
+    the cached prefix rows another stream already wrote.
+
+``PrefillEngine``
+    The prefill-role replica's engine: own page pool + PrefixCache
+    (serve/prefix_cache.py), chunked prefill, and page EXPORT — the
+    prompt's KV rows come back as host arrays, pages are released
+    immediately (the cache keeps its holds), and the rows ship to the
+    coordinator's page store over the MAC'd kvstore wire via the
+    flat-packer (kvstore.ship_kv_pages). The decode replica fetches
+    them by ship key and admits with ``DecodeScheduler.submit(...,
+    kv_import=...)`` — no prefill recompute, TTFT already paid.
+
+Roles (``prefill`` / ``decode`` / ``both``) are advertised through the
+PR-12 ServeRegistry (serve_register wire v2) and consumed by the
+role-aware Router policy.
+
+Lock hierarchy (tools/mxlint/lock_order.py): engine ``self._lock``
+outermost (serializes runs over the single pool), predictor
+``self._compile_lock`` under it, the module counter ``_lock`` a leaf.
+"""
+from __future__ import annotations
+
+import math
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import util
+from .stats import ServingStats
+
+__all__ = ["PrefillPredictor", "PrefillEngine", "ship_key_for",
+           "fetch_kv_import", "stats", "clear"]
+
+_lock = threading.Lock()
+_counters = {}
+
+
+def _bump(name, n=1):
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def stats():
+    """Module-level disagg counters (prefill runs, pages/bytes shipped,
+    pages fetched) for diagnose.py and tests."""
+    with _lock:
+        return dict(_counters)
+
+
+def clear():
+    with _lock:
+        _counters.clear()
+
+
+def ship_key_for(model, request_id):
+    """Page-store key for one prefill->decode handoff."""
+    return f"kvship:{model}:{request_id}"
+
+
+class PrefillPredictor:
+    """The chunked-prefill executable over a DecodePredictor's geometry.
+
+    Chunks write their KV rows into the sequence's pages (same padded
+    out-of-bounds-drop scatter as decode), then attend over the WHOLE
+    page-table window (max_pages_per_seq * page_size rows) with a causal
+    mask on absolute positions — rows before ``start`` are read from
+    the pages, so resuming after a cached prefix costs nothing extra.
+    Exactly one executable regardless of prompt length or start offset:
+    both are traced int32 scalars, only the chunk width is baked in.
+    """
+
+    def __init__(self, predictor, *, chunk=None):
+        self.predictor = predictor
+        self.chunk = int(chunk if chunk is not None
+                         else util.getenv_int("MXNET_DISAGG_PREFILL_CHUNK"))
+        if self.chunk < 1:
+            raise MXNetError(f"prefill chunk {self.chunk}: need >= 1")
+        self._compile_lock = threading.Lock()
+        self._fn = None
+        self._warm = False
+
+    def _key(self):
+        p = self.predictor
+        return (f"serve:prefill_chunk[c{self.chunk},"
+                f"m{p.max_pages_per_seq},{p._geom_tag()}]")
+
+    def _make_chunk(self):
+        p = self.predictor
+        h_, d_, ps, p_ = p.num_heads, p.head_dim, p.page_size, p.num_pages
+        e_, c = p.embed, self.chunk
+        lmax = p.max_pages_per_seq * ps
+        scale = 1.0 / math.sqrt(d_)
+
+        def call(params, tokens, start, n, k_pages, v_pages, ptrow):
+            # tokens (1, C) int32 — prompt[start:start+C] zero-padded;
+            # start, n () int32 TRACED (one executable for any offset
+            # and prompt length); ptrow (max_pages_per_seq,) int32
+            import jax
+            import jax.numpy as jnp
+            h = params["emb"][tokens[0]]                     # (C, E)
+            q = (h @ params["wq"]).reshape(c, h_, d_)
+            k = (h @ params["wk"]).reshape(c, h_, d_)
+            v = (h @ params["wv"]).reshape(c, h_, d_)
+            pos = start + jnp.arange(c, dtype=jnp.int32)     # absolute
+            valid = pos < n
+            flat = ptrow[pos // ps] * ps + pos % ps
+            flat = jnp.where(valid, flat, p_ * ps)
+            kp = k_pages.reshape(p_ * ps, h_, d_).at[flat].set(
+                k, mode="drop")
+            vp = v_pages.reshape(p_ * ps, h_, d_).at[flat].set(
+                v, mode="drop")
+            # attend over the whole page-table window: rows < start are
+            # the cached/previous-chunk context read back from pages
+            ctx = jnp.arange(lmax, dtype=jnp.int32)
+            cflat = ptrow[ctx // ps] * ps + ctx % ps
+            kc = kp[cflat]                                   # (Lmax, H, D)
+            vc = vp[cflat]
+            s = jnp.einsum("qhd,khd->hqk", q * scale, kc)
+            mask = (ctx[None, :] <= pos[:, None]) & valid[:, None]
+            s = jnp.where(mask[None], s, -1e30)
+            pr = jax.nn.softmax(s, axis=-1)
+            a = jnp.einsum("hqk,khd->qhd", pr, vc).reshape(c, e_)
+            o = a @ params["wo"] + h
+            logits = o @ params["w_out"]                     # (C, V)
+            last = jnp.clip(n - 1 - start, 0, c - 1)
+            nxt = jnp.argmax(logits[last], axis=-1).astype(jnp.int32)
+            return (nxt, kp.reshape(p_, ps, h_, d_),
+                    vp.reshape(p_, ps, h_, d_))
+
+        return call
+
+    def _exec_chunk(self):
+        with self._compile_lock:
+            if self._fn is None:
+                from .. import compile_cache as _cc
+                self._fn = _cc.cached_jit(self._key(), self._make_chunk())
+        return self._fn
+
+    def warmup(self):
+        """AOT-compile the chunk executable; returns
+        {"prefill_chunk": kind} with kind from compile_cache.warmup
+        ("hit"/"disk"/"miss") — kept SEPARATE from
+        DecodePredictor.warmup() so decode-only replicas never build
+        it."""
+        import jax
+        import jax.numpy as jnp
+        p = self.predictor
+        i32 = jnp.int32
+        kv = jax.ShapeDtypeStruct((p.num_pages, p.page_size, p.num_heads,
+                                   p.head_dim), jnp.float32)
+        kind = self._exec_chunk().warmup(
+            p._param_vals,
+            jax.ShapeDtypeStruct((1, self.chunk), i32),
+            jax.ShapeDtypeStruct((), i32), jax.ShapeDtypeStruct((), i32),
+            kv, kv, jax.ShapeDtypeStruct((p.max_pages_per_seq,), i32))
+        self._warm = True
+        return {"prefill_chunk": kind}
+
+    @property
+    def is_warm(self):
+        return self._warm
+
+    def prefill_chunk(self, prompt, start, k_pages, v_pages, ptrow):
+        """Run ONE chunk at offset ``start``; returns (next-token pick,
+        updated pools). The pick is only meaningful once the chunk
+        covers position len(prompt)-1."""
+        import jax.numpy as jnp
+        n = len(prompt)
+        toks = _np.zeros((1, self.chunk), _np.int32)
+        seg = prompt[start:start + self.chunk]
+        toks[0, :len(seg)] = seg
+        fn = self._exec_chunk()
+        nxt, kp, vp = fn(self.predictor._param_vals, jnp.asarray(toks),
+                         jnp.asarray(start, jnp.int32),
+                         jnp.asarray(n, jnp.int32), k_pages, v_pages,
+                         jnp.asarray(ptrow, jnp.int32))
+        self._warm = True
+        _bump("chunks_total")
+        return int(nxt), kp, vp
+
+    def prefill(self, prompt, start, k_pages, v_pages, ptrow):
+        """All chunks from ``start`` to the end of the prompt; returns
+        (next token, updated pools)."""
+        nxt = None
+        for lo in range(start, len(prompt), self.chunk):
+            nxt, k_pages, v_pages = self.prefill_chunk(
+                prompt, lo, k_pages, v_pages, ptrow)
+        return nxt, k_pages, v_pages
+
+
+class PrefillEngine:
+    """A prefill-role replica's engine: chunked prefill over its own
+    page pool + prefix cache, exporting each prompt's KV rows for
+    shipment to a decode replica.
+
+    ``run`` returns a host-side export bundle and immediately releases
+    the stream's page holds (the cache keeps its own), so the pool's
+    steady-state occupancy is just the cached prefix set — a prefill
+    replica's capacity is compute, not memory.
+    """
+
+    def __init__(self, predictor, *, chunk=None, stats=None,
+                 prefix_cache=None, name="prefill"):
+        from .decode import PageAllocator
+        self.predictor = predictor
+        self.chunker = PrefillPredictor(predictor, chunk=chunk)
+        self.allocator = PageAllocator(predictor.num_pages)
+        self.stats = stats if stats is not None else ServingStats(name)
+        if prefix_cache is None:
+            prefix_cache = util.getenv_bool("MXNET_PREFIX_CACHE")
+        if prefix_cache is True:
+            from .prefix_cache import PrefixCache
+            prefix_cache = PrefixCache(self.allocator, predictor.page_size)
+        self.prefix_cache = prefix_cache or None
+        self._lock = threading.Lock()
+        self._k_pages = None
+        self._v_pages = None
+        self.stats.set_gauge("kv_pages_total", predictor.num_pages)
+
+    def warmup(self):
+        return self.chunker.warmup()
+
+    @property
+    def is_warm(self):
+        return self.chunker.is_warm
+
+    def run(self, prompt, max_new_tokens=None):
+        """Prefill one prompt (prefix-cache assisted, chunked); returns
+        the export bundle {"next_token", "n", "k_rows", "v_rows",
+        "cached_tokens"} with (ceil(n/page_size), page_size, H, D)
+        float32 host rows. Raises Overloaded when the pool cannot hold
+        the prompt (retryable — the router picks another replica)."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise MXNetError("empty prompt")
+        p = self.predictor
+        n = len(prompt)
+        m = math.ceil(n / p.page_size)
+        if m > p.max_pages_per_seq:
+            raise MXNetError(
+                f"prompt needs {m} KV pages, per-sequence cap is "
+                f"{p.max_pages_per_seq} (MXNET_KV_PAGES_PER_SEQ)")
+        with self._lock:
+            if self._k_pages is None:
+                self._k_pages, self._v_pages = p.kv_pool()
+            t0 = _now()
+            pages, covered = self._claim_locked(prompt, m)
+            nxt, self._k_pages, self._v_pages = self.chunker.prefill(
+                prompt, covered, self._k_pages, self._v_pages,
+                self._ptrow(pages))
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(prompt, pages, n)
+            idx = _np.asarray(pages, _np.int64)
+            k_rows = _np.asarray(self._k_pages[idx])
+            v_rows = _np.asarray(self._v_pages[idx])
+            self.allocator.free(pages)
+            self.stats.prefill_time.observe(_now() - t0)
+        self.stats.incr("requests_total")
+        self.stats.incr("responses_ok")
+        self.stats.set_gauge("kv_pages_free", self.allocator.free_count)
+        self.stats.set_gauge("kv_pages_used", self.allocator.used_count)
+        self.stats.set_gauge("kv_pages_shared", self.allocator.shared_count)
+        _bump("prefill_requests")
+        if covered:
+            _bump("prefix_tokens_reused", covered)
+        return {"next_token": int(nxt), "n": n, "k_rows": k_rows,
+                "v_rows": v_rows, "cached_tokens": covered}
+
+    def _ptrow(self, pages):
+        row = _np.zeros(self.predictor.max_pages_per_seq, _np.int32)
+        row[:len(pages)] = pages
+        return row
+
+    def _claim_locked(self, prompt, m):
+        """Claim exactly ``m`` pages for the prompt: shared cached
+        prefix + CoW fork of a partial tail + fresh pages for the rest
+        (the same discipline as DecodeScheduler._claim_pages_locked)."""
+        if self.prefix_cache is None:
+            return self.allocator.alloc(m), 0
+        pages, covered, partial = self.prefix_cache.lookup(prompt)
+        try:
+            if partial:
+                fresh, copied = self.allocator.fork(pages[-1])
+                if copied:
+                    src = pages[-1]
+                    self._k_pages = self._k_pages.at[fresh].set(
+                        self._k_pages[src])
+                    self._v_pages = self._v_pages.at[fresh].set(
+                        self._v_pages[src])
+                    self.prefix_cache.note_cow_fork()
+                pages = pages[:-1] + [fresh]
+            extra = m - len(pages)
+            if extra > 0:
+                pages = pages + self.allocator.alloc(extra)
+        except Exception:
+            if pages:
+                self.allocator.free(pages)
+            raise
+        return pages, covered
+
+    # -- shipping -------------------------------------------------------
+    def ship(self, client, key, export):
+        """Push one export bundle to the coordinator's page store over
+        the MAC'd wire (kvstore.ship_kv_pages / flat-packer). Returns
+        the server receipt."""
+        from .. import kvstore as _kv
+        receipt = _kv.ship_kv_pages(
+            client, key, export["k_rows"], export["v_rows"],
+            meta={"n": export["n"], "next_token": export["next_token"],
+                  "page_size": self.predictor.page_size})
+        _bump("pages_shipped", len(export["k_rows"]))
+        _bump("bytes_shipped", int(receipt.get("bytes", 0)))
+        return receipt
+
+
+def fetch_kv_import(client, key, delete=False):
+    """Decode-replica side: fetch a shipped bundle and shape it as the
+    ``kv_import`` dict DecodeScheduler.submit expects. Returns None on
+    an unknown/expired key (caller falls back to local prefill)."""
+    from .. import kvstore as _kv
+    got = _kv.fetch_kv_pages(client, key, delete=delete)
+    if got is None:
+        _bump("fetch_misses")
+        return None
+    k_rows, v_rows, meta = got
+    _bump("pages_fetched", len(k_rows))
+    return {"k_rows": k_rows, "v_rows": v_rows, "n": int(meta["n"]),
+            "next_token": int(meta["next_token"])}
+
+
+def _now():
+    import time
+    return time.monotonic()

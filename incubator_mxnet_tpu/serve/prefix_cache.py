@@ -1,0 +1,248 @@
+"""Copy-on-write prefix cache: a radix tree over token prefixes whose
+nodes map to refcounted KV pages.
+
+Serving fleets see the same system prompt, few-shot preamble, or
+document header thousands of times; recomputing its KV rows per stream
+wastes exactly the prefill FLOPs disaggregation tries to concentrate.
+This cache remembers, per page-aligned chunk of a prompt, WHICH KV page
+already holds those rows. A hit costs ``PageAllocator.share`` — a
+refcount bump, zero data movement — and the stream's page table simply
+points at the shared page; the suffix is completed by chunked prefill
+(serve/disagg.PrefillPredictor).
+
+Sharing rules (the CoW contract, enforced with PageAllocator):
+
+* A cached FULL page (``page_size`` token rows) is immutable: every
+  holder only reads it, so any number of streams share it outright.
+* A cached PARTIAL tail page is immutable BELOW its cached length; the
+  stream that inserted it retains append rights above (its own decode
+  rows land there, never overlapping cached rows). Any OTHER stream
+  that matches the tail must write its divergent suffix into that same
+  page — so admission takes ``PageAllocator.fork``: the first divergent
+  write trades the shared hold for a fresh exclusive copy.
+* The cache holds its OWN refcount on every cached page. "Refcount 0"
+  in eviction terms means no live STREAM holds the page — i.e. the
+  allocator refcount is down to the cache's single hold. LRU eviction
+  touches only such pages; a page pinned by a live stream is never
+  evicted, so a page table can never dangle.
+
+The tree is a radix tree keyed by page-sized token chunks: lookup walks
+child edges chunk by chunk (O(prompt/page_size) dict hops), and partial
+tails hang off the last matched full node. Multiple partial tails with
+different contents may coexist under one node; lookup picks the longest
+one matching the prompt.
+
+Lock hierarchy: the cache's ``self._lock`` is taken first, the
+allocator's leaf lock inside it (same direction as DecodeScheduler ->
+allocator; the allocator never calls back out, so no cycle exists).
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import MXNetError
+from .. import util
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    __slots__ = ("chunk", "page", "n_tokens", "children", "parent", "tick")
+
+    def __init__(self, chunk, page, n_tokens, parent):
+        self.chunk = chunk          # tuple of token ids this edge covers
+        self.page = page            # KV page id holding those rows
+        self.n_tokens = n_tokens    # == page_size for full, < for tails
+        self.children = {}          # chunk tuple -> _Node (full pages)
+        self.parent = parent
+        self.tick = 0               # LRU clock at last touch
+
+
+class PrefixCache:
+    """Radix tree over token prefixes -> refcounted KV pages.
+
+    ``allocator`` is the PageAllocator owning the pool the pages live
+    in; the cache and every scheduler sharing pages MUST use the same
+    allocator instance (page ids are meaningless across pools).
+    """
+
+    def __init__(self, allocator, page_size, *, max_pages=None):
+        if page_size < 1:
+            raise MXNetError("PrefixCache needs page_size >= 1")
+        self.allocator = allocator
+        self.page_size = int(page_size)
+        self.max_pages = int(
+            max_pages if max_pages is not None
+            else util.getenv_int("MXNET_PREFIX_CACHE_PAGES"))
+        self._lock = threading.Lock()
+        self._root = _Node((), -1, 0, None)
+        self._clock = 0
+        self._cached_pages = 0
+        self._hits = 0
+        self._misses = 0
+        self._tokens_saved = 0
+        self._inserted = 0
+        self._evicted = 0
+        self._cow_forks = 0
+
+    # -- lookup ---------------------------------------------------------
+    def lookup(self, prompt):
+        """Longest cached prefix of ``prompt``. Returns
+        ``(pages, covered, partial)``: shared page ids in prefix order,
+        how many leading tokens they cover, and whether the last page is
+        a partial tail (fewer than page_size cached rows — the caller
+        must CoW-fork it before writing its suffix into it).
+
+        Every returned page carries a fresh ``share`` hold for the
+        caller; release with ``allocator.free`` at stream retire.
+        Coverage is capped below ``len(prompt)`` so the suffix prefill
+        always has at least the final prompt position to compute (the
+        next-token logits come from there).
+        """
+        prompt = tuple(int(t) for t in prompt)
+        ps = self.page_size
+        with self._lock:
+            self._clock += 1
+            node = self._root
+            pages = []
+            covered = 0
+            # full pages: only while a strict suffix remains
+            while covered + ps < len(prompt):
+                child = node.children.get(prompt[covered:covered + ps])
+                if child is None or child.n_tokens != ps:
+                    break
+                child.tick = self._clock
+                pages.append(child.page)
+                covered += ps
+                node = child
+            # longest partial tail still leaving >= 1 suffix token
+            best = None
+            for chunk, child in node.children.items():
+                t = child.n_tokens
+                if (t < ps and covered + t < len(prompt)
+                        and chunk == prompt[covered:covered + t]
+                        and (best is None or t > best.n_tokens)):
+                    best = child
+            partial = False
+            if best is not None:
+                best.tick = self._clock
+                pages.append(best.page)
+                covered += best.n_tokens
+                partial = True
+            if pages:
+                self.allocator.share(pages)
+                self._hits += 1
+                self._tokens_saved += covered
+            else:
+                self._misses += 1
+        return pages, covered, partial
+
+    # -- insert ---------------------------------------------------------
+    def insert(self, prompt, pages, n):
+        """Register the first ``n`` prompt tokens' KV pages after a
+        prefill: ``pages[i]`` holds rows ``i*ps .. (i+1)*ps-1``. Full
+        chunks become radix nodes; a non-aligned remainder becomes a
+        partial tail. Chunks already cached are skipped (first insert
+        wins — both pages hold identical rows, replacing would churn
+        refcounts for nothing). The cache takes its own ``share`` hold
+        on every page it registers; inserts that would exceed
+        ``max_pages`` first evict LRU unpinned leaves, and when nothing
+        is evictable the remainder of the insert is dropped.
+        """
+        prompt = tuple(int(t) for t in prompt)
+        n = min(int(n), len(prompt))
+        ps = self.page_size
+        with self._lock:
+            self._clock += 1
+            node = self._root
+            for i in range(n // ps):
+                chunk = prompt[i * ps:(i + 1) * ps]
+                child = node.children.get(chunk)
+                if child is not None and child.n_tokens == ps:
+                    child.tick = self._clock
+                    node = child
+                    continue
+                if not self._make_room_locked():
+                    return
+                child = _Node(chunk, int(pages[i]), ps, node)
+                self.allocator.share([child.page])
+                node.children[chunk] = child
+                child.tick = self._clock
+                node = child
+                self._cached_pages += 1
+                self._inserted += 1
+            tail = n % ps
+            if tail:
+                chunk = prompt[n - tail:n]
+                for child in node.children.values():
+                    if child.n_tokens == tail and child.chunk == chunk:
+                        child.tick = self._clock
+                        return
+                if not self._make_room_locked():
+                    return
+                child = _Node(chunk, int(pages[n // ps]), tail, node)
+                self.allocator.share([child.page])
+                node.children[chunk] = child
+                child.tick = self._clock
+                self._cached_pages += 1
+                self._inserted += 1
+
+    def _make_room_locked(self):
+        """Evict LRU unpinned leaves until one slot is free. A node is
+        evictable only when it is a LEAF (evicting an interior node
+        would orphan its descendants' prefix) and no stream holds its
+        page (allocator refcount == the cache's own hold)."""
+        while self._cached_pages >= self.max_pages:
+            victim = None
+            stack = [self._root]
+            while stack:
+                nd = stack.pop()
+                for child in nd.children.values():
+                    if child.children:
+                        stack.append(child)
+                    elif self.allocator.refcount(child.page) == 1:
+                        if victim is None or child.tick < victim.tick:
+                            victim = child
+            if victim is None:
+                return False
+            del victim.parent.children[victim.chunk]
+            self.allocator.free([victim.page])
+            self._cached_pages -= 1
+            self._evicted += 1
+        return True
+
+    # -- CoW accounting (the fork itself lives on PageAllocator) --------
+    def note_cow_fork(self):
+        with self._lock:
+            self._cow_forks += 1
+
+    # -- maintenance ----------------------------------------------------
+    def clear(self):
+        """Drop every cached page (releases the cache's holds; pages
+        still pinned by live streams stay live until those retire)."""
+        with self._lock:
+            pages = []
+            stack = [self._root]
+            while stack:
+                nd = stack.pop()
+                for child in nd.children.values():
+                    pages.append(child.page)
+                    stack.append(child)
+            self._root.children.clear()
+            self._cached_pages = 0
+        if pages:
+            self.allocator.free(pages)
+        return len(pages)
+
+    def stats(self):
+        with self._lock:
+            lookups = self._hits + self._misses
+            return {"cached_pages": self._cached_pages,
+                    "max_pages": self.max_pages,
+                    "hits": self._hits,
+                    "misses": self._misses,
+                    "hit_rate": (self._hits / lookups) if lookups else 0.0,
+                    "tokens_saved": self._tokens_saved,
+                    "inserted": self._inserted,
+                    "evicted": self._evicted,
+                    "cow_forks": self._cow_forks}

@@ -227,8 +227,9 @@ class Router:
         #                                 OUTERMOST, stats lock is a leaf
         self._replicas = {}             # rid -> {"addr", "ready", "generation"}
         self._breakers = {}             # rid -> _Breaker
-        self._rr = 0
+        self._rr = {}                   # per-role round-robin cursors
         self._client = None
+        self._req_seq = 0               # ship-key uniquifier (under _rlock)
         self._stop = threading.Event()
         self._thread = None
         self._metrics_httpd = None
@@ -237,7 +238,8 @@ class Router:
                 for i, addr in enumerate(replicas):
                     rid = f"static{i}"
                     self._replicas[rid] = {"addr": str(addr), "ready": True,
-                                           "generation": -1}
+                                           "generation": -1, "role": "both",
+                                           "load": {}}
                     self._breakers[rid] = _Breaker()
 
     # -- discovery ------------------------------------------------------
@@ -301,7 +303,9 @@ class Router:
                             and not row.get("draining"))
                 ready += 1 if eligible else 0
                 table[rid] = {"addr": row["http_addr"], "ready": eligible,
-                              "generation": row["generation"]}
+                              "generation": row["generation"],
+                              "role": row.get("role", "both"),
+                              "load": dict(row.get("load") or {})}
                 if rid not in self._breakers:
                     self._breakers[rid] = _Breaker()
             self._replicas = table
@@ -316,15 +320,33 @@ class Router:
         with self._rlock:
             self._replicas = {
                 f"static{i}": {"addr": str(a), "ready": True,
-                               "generation": -1}
+                               "generation": -1, "role": "both",
+                               "load": {}}
                 for i, a in enumerate(replicas)}
             self._breakers = {rid: self._breakers.get(rid, _Breaker())
                               for rid in self._replicas}
 
     # -- breaker plumbing ----------------------------------------------
-    def _candidates(self):
-        """Ready, breaker-admitted (rid, addr) pairs in round-robin
-        order; breaker half-open transitions are recorded on the way."""
+    def _candidates(self, role=None):
+        """Ready, breaker-admitted (rid, addr) pairs; breaker half-open
+        transitions are recorded on the way.
+
+        Role-aware policy (disaggregated serving):
+          role=None     all ready replicas, round-robin — the classic
+                        /predict path.
+          role="prefill" replicas whose role is prefill or both,
+                        DEDICATED prefill replicas first (they exist to
+                        absorb the compute burst; a colocated "both"
+                        replica is the fallback), round-robin per tier —
+                        the TTFT SLO is served by never queueing a
+                        prompt behind decode steps.
+          role="decode" replicas whose role is decode or both, ordered
+                        by KV page headroom (kv_pages_free from the v2
+                        beat's load report, descending) — inter-token
+                        SLOs die when a stream lands on a replica about
+                        to shed on pages. Unreported headroom sorts
+                        last; ties break round-robin.
+        """
         now = time.monotonic()
         transitions = []
         with self._rlock:
@@ -333,17 +355,26 @@ class Router:
                 info = self._replicas[rid]
                 if not info["ready"]:
                     continue
+                rrole = info.get("role", "both")
+                if role is not None and rrole not in (role, "both"):
+                    continue
                 allowed, moved = self._breakers[rid].allow(
                     now, self._breaker_cooldown)
                 if moved:
                     transitions.append((rid, moved))
                 if allowed:
-                    out.append((rid, info["addr"]))
-            self._rr += 1
-            k = self._rr % len(out) if out else 0
+                    out.append((rid, info["addr"], rrole,
+                                info.get("load") or {}))
+            self._rr[role] = self._rr.get(role, 0) + 1
+            k = self._rr[role] % len(out) if out else 0
         for rid, moved in transitions:
             self._record_transition(rid, moved)
-        return out[k:] + out[:k]
+        out = out[k:] + out[:k]         # round-robin rotation
+        if role == "prefill":
+            out.sort(key=lambda c: c[2] != "prefill")   # dedicated first
+        elif role == "decode":
+            out.sort(key=lambda c: -c[3].get("kv_pages_free", -1))
+        return [(rid, addr) for rid, addr, _, _ in out]
 
     def _note_result(self, rid, ok):
         """Feed a call outcome to the replica's breaker (connect-layer
@@ -371,10 +402,30 @@ class Router:
             return {rid: dict(info) for rid, info in self._replicas.items()}
 
     # -- request path ---------------------------------------------------
-    def _backoff_s(self, attempt, deadline):
+    def _backoff_s(self, attempt, deadline, retry_after=None):
+        """Jittered exponential backoff, clipped to the deadline. When
+        the shedding replica sent a Retry-After header it KNOWS when it
+        will have capacity — honor it as a floor instead of hammering
+        back early with a shorter jittered guess."""
         base = min(1.0, self._backoff_ms / 1e3 * (2 ** (attempt - 1)))
         jittered = base * self._rng.uniform(0.5, 1.5)
+        if retry_after is not None:
+            jittered = max(jittered, float(retry_after))
         return max(0.0, min(jittered, deadline - time.monotonic() - 1e-3))
+
+    @staticmethod
+    def _parse_retry_after(headers):
+        """Seconds from a 503's Retry-After header (delta-seconds form
+        only — the serving protocol emits "0.05"-style floats), or None
+        when absent/unparseable."""
+        raw = headers.get("Retry-After") if headers is not None else None
+        if raw is None:
+            return None
+        try:
+            val = float(raw)
+        except (TypeError, ValueError):
+            return None
+        return val if val >= 0 else None
 
     def _hedge_delay_s(self):
         if self._hedge_delay_ms > 0:
@@ -400,7 +451,9 @@ class Router:
         for attempt in range(self._retries + 1):
             if attempt:
                 self.stats.incr("retries_total")
-                pause = self._backoff_s(attempt, deadline)
+                pause = self._backoff_s(
+                    attempt, deadline,
+                    retry_after=getattr(last_err, "retry_after_s", None))
                 if pause > 0:
                     time.sleep(pause)
             if time.monotonic() >= deadline:
@@ -482,7 +535,18 @@ class Router:
         Connect-layer failures feed the replica's breaker exactly like
         /predict; 503 sheds retry without breaker blame. No hedging: a
         duplicate stream doubles token work for tail latency decode
-        rarely has."""
+        rarely has.
+
+        When the fleet has a DEDICATED prefill replica (role
+        "prefill"), the stream is split: /prefill on the prefill tier
+        ships the prompt's KV pages to the coordinator's page store
+        under a fresh ship_key, then /generate on a decode-tier replica
+        imports them — the decode replica never recomputes the prompt.
+        A dead prefill replica blames ITS breaker and the whole stream
+        restarts (greedy decode is deterministic, so the client still
+        sees exactly one coherent token sequence); a decode-side shed
+        retries the decode leg with the same ship_key (the fetch is
+        non-destructive)."""
         if deadline_ms is None:
             deadline_ms = self._deadline_ms
         deadline = time.monotonic() + deadline_ms / 1e3
@@ -492,19 +556,26 @@ class Router:
         for attempt in range(self._retries + 1):
             if attempt:
                 self.stats.incr("retries_total")
-                pause = self._backoff_s(attempt, deadline)
+                pause = self._backoff_s(
+                    attempt, deadline,
+                    retry_after=getattr(last_err, "retry_after_s", None))
                 if pause > 0:
                     time.sleep(pause)
             if time.monotonic() >= deadline:
                 break
-            cands = self._candidates()
-            if not cands:
-                self.stats.incr("no_replica_total")
-                last_err = NoReplicaAvailable(
-                    f"no ready replica for model {self._model!r}")
-                continue
-            kind, value = self._one_stream(cands[0][0], cands[0][1],
-                                           prompt, max_new_tokens, deadline)
+            if self._has_dedicated_prefill():
+                kind, value = self._split_stream(prompt, max_new_tokens,
+                                                 deadline)
+            else:
+                cands = self._candidates(role="decode")
+                if not cands:
+                    self.stats.incr("no_replica_total")
+                    last_err = NoReplicaAvailable(
+                        f"no ready replica for model {self._model!r}")
+                    continue
+                kind, value = self._one_stream(
+                    cands[0][0], cands[0][1], prompt, max_new_tokens,
+                    deadline)
             if kind == "ok":
                 self.stats.latency.observe(time.monotonic() - t0)
                 self.stats.incr("responses_ok_total")
@@ -520,7 +591,106 @@ class Router:
             f"router deadline {deadline_ms}ms exhausted "
             f"({self._retries} retries)")
 
-    def _one_stream(self, rid, addr, prompt, max_new_tokens, deadline):
+    def _has_dedicated_prefill(self):
+        """True when the split prefill->decode path applies: a ready
+        DEDICATED prefill replica exists, a decode-capable replica
+        exists, and a coordinator page store is reachable to ship
+        through. All-"both" fleets take the classic colocated path."""
+        if self._coordinator is None:
+            return False
+        with self._rlock:
+            roles = [info.get("role", "both")
+                     for info in self._replicas.values() if info["ready"]]
+        return ("prefill" in roles
+                and any(r in ("decode", "both") for r in roles))
+
+    def _split_stream(self, prompt, max_new_tokens, deadline):
+        """One disaggregated attempt: /prefill on the prefill tier
+        (ships KV pages under a fresh ship_key), then /generate with
+        that ship_key on the decode tier. Returns ("ok", tokens) |
+        ("retryable", err) | ("fatal", err); prefill-leg failures blame
+        the PREFILL replica's breaker, decode-leg failures the decode
+        replica's — chaos on one tier never exiles the other."""
+        with self._rlock:
+            droles = {rid: info.get("role", "both")
+                      for rid, info in self._replicas.items()}
+        pcands = [(rid, addr) for rid, addr in self._candidates("prefill")
+                  if droles.get(rid) == "prefill"]
+        dcands = self._candidates(role="decode")
+        if not dcands:
+            self.stats.incr("no_replica_total")
+            return ("retryable", NoReplicaAvailable(
+                f"no ready decode replica for model {self._model!r}"))
+        if not pcands:
+            # the prefill tier is gone (every breaker open, or the last
+            # prefill replica died and the live window has not expired
+            # yet): degrade to colocated local prefill on the decode
+            # tier instead of failing the request — same graceful-
+            # degradation contract the breakers give /predict
+            self.stats.incr("disagg_fallbacks_total")
+            return self._one_stream(dcands[0][0], dcands[0][1], prompt,
+                                    max_new_tokens, deadline)
+        from .disagg import ship_key_for
+        with self._rlock:
+            self._req_seq += 1
+            seq = self._req_seq
+        ship_key = ship_key_for(
+            self._model, f"{seq}-{self._rng.getrandbits(32):08x}")
+        kind, value = self._prefill_call(pcands[0][0], pcands[0][1],
+                                         prompt, ship_key, deadline)
+        if kind != "ok":
+            return (kind, value)
+        self.stats.incr("prefill_routed_total")
+        kind, value = self._one_stream(dcands[0][0], dcands[0][1], prompt,
+                                       max_new_tokens, deadline,
+                                       ship_key=ship_key)
+        if kind == "ok":
+            self.stats.incr("disagg_streams_total")
+        return (kind, value)
+
+    def _prefill_call(self, rid, addr, prompt, ship_key, deadline):
+        """One HTTP /prefill against a prefill-tier replica; same
+        outcome classification as /predict (connect errors feed THIS
+        replica's breaker, 503 sheds retry without blame)."""
+        timeout = max(1e-3, deadline - time.monotonic())
+        body = json.dumps({"prompt": [int(t) for t in prompt],
+                           "ship": True,
+                           "ship_key": ship_key}).encode("utf-8")
+        try:
+            _fault.inject("route")      # MXNET_FAULT_INJECT: route@n
+            req = urllib.request.Request(
+                f"http://{addr}/prefill", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                payload = json.loads(r.read().decode("utf-8"))
+            self._note_result(rid, True)
+            return ("ok", payload)
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read().decode("utf-8"))
+            except (ValueError, OSError):
+                detail = {"error": str(e)}
+            self._note_result(rid, True)
+            if e.code in (503, 504) and detail.get("retryable", True):
+                self.stats.incr("sheds_total")
+                err = Overloaded(
+                    f"prefill replica {rid} shed ({e.code}): "
+                    f"{detail.get('error', '')}")
+                err.retry_after_s = self._parse_retry_after(e.headers)
+                return ("retryable", err)
+            return ("fatal", RouteError(
+                f"prefill replica {rid}: {detail.get('error', e)}",
+                status=e.code))
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            self.stats.incr("connect_errors_total")
+            self._note_result(rid, False)
+            return ("retryable", NoReplicaAvailable(
+                f"prefill replica {rid} at {addr} unreachable: {e}"))
+
+    def _one_stream(self, rid, addr, prompt, max_new_tokens, deadline,
+                    ship_key=None):
         """One streamed /generate against one replica, consuming the
         ndjson chunks until the {"done"} line. A stream that dies before
         "done" — reset, timeout, truncation — counts as a connect-layer
@@ -528,10 +698,13 @@ class Router:
         streams is the health contract."""
         import http.client
         timeout = max(1e-3, deadline - time.monotonic())
-        body = json.dumps({"prompt": [int(t) for t in prompt],
-                           "max_new_tokens": max_new_tokens,
-                           "stream": True,
-                           "deadline_ms": timeout * 1e3}).encode("utf-8")
+        req_body = {"prompt": [int(t) for t in prompt],
+                    "max_new_tokens": max_new_tokens,
+                    "stream": True,
+                    "deadline_ms": timeout * 1e3}
+        if ship_key is not None:
+            req_body["ship_key"] = ship_key
+        body = json.dumps(req_body).encode("utf-8")
         tokens = []
         try:
             _fault.inject("route")      # MXNET_FAULT_INJECT: route@n
@@ -572,9 +745,11 @@ class Router:
             self._note_result(rid, True)
             if e.code in (503, 504) and detail.get("retryable", True):
                 self.stats.incr("sheds_total")
-                return ("retryable", Overloaded(
+                err = Overloaded(
                     f"replica {rid} shed ({e.code}): "
-                    f"{detail.get('error', '')}"))
+                    f"{detail.get('error', '')}")
+                err.retry_after_s = self._parse_retry_after(e.headers)
+                return ("retryable", err)
             return ("fatal", RouteError(
                 f"replica {rid}: {detail.get('error', e)}", status=e.code))
         except (urllib.error.URLError, http.client.HTTPException,
@@ -613,9 +788,11 @@ class Router:
             self._note_result(rid, True)
             if e.code in (503, 504) and detail.get("retryable", True):
                 self.stats.incr("sheds_total")
-                return ("retryable", Overloaded(
+                err = Overloaded(
                     f"replica {rid} shed ({e.code}): "
-                    f"{detail.get('error', '')}"))
+                    f"{detail.get('error', '')}")
+                err.retry_after_s = self._parse_retry_after(e.headers)
+                return ("retryable", err)
             return ("fatal", RouteError(
                 f"replica {rid}: {detail.get('error', e)}", status=e.code))
         except (urllib.error.URLError, ConnectionError, TimeoutError,

@@ -69,7 +69,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as _np
 
 from ..base import MXNetError
-from ..util import getenv_int
+from ..util import getenv_int, getenv_str
 from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
 from .stats import ServingStats
 
@@ -148,6 +148,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/generate":
             self._generate()
             return
+        if self.path == "/prefill":
+            self._prefill()
+            return
         if self.path != "/predict":
             self._reply(404, {"error": "not found", "retryable": False})
             return
@@ -186,6 +189,66 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, {"outputs": [o.tolist() for o in outs]})
 
+    def _prefill(self):
+        """Prefill-role endpoint: run chunked prefill, export the KV
+        pages, and (default) ship them to the coordinator's page store
+        under the request's ship_key — the decode replica's /generate
+        fetches them by that key. ``ship: false`` returns the rows
+        inline (coordinator-less tests/tools)."""
+        ms = self._ms
+        if ms.prefill_engine is None:
+            self._reply(404, {"error": "no prefill engine attached "
+                              "(replica role is not prefill-capable)",
+                              "retryable": False})
+            return
+        if ms.draining:
+            self._reply(503, {"error": "draining", "retryable": True},
+                        retry_after="0.1")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            prompt = [int(t) for t in req["prompt"]]
+            ship = bool(req.get("ship", ms.coordinator is not None))
+            ship_key = req.get("ship_key")
+        except (KeyError, ValueError, TypeError) as e:
+            self._reply(400, {"error": f"malformed request: {e}",
+                              "retryable": False})
+            return
+        try:
+            export = ms.prefill_engine.run(prompt)
+        except Overloaded as e:
+            self._reply(e.status, {"error": str(e), "retryable": True},
+                        retry_after="0.05")
+            return
+        except MXNetError as e:
+            self._reply(400, {"error": str(e), "retryable": False})
+            return
+        out = {"next_token": export["next_token"], "n": export["n"],
+               "cached_tokens": export["cached_tokens"],
+               "pages": len(export["k_rows"])}
+        if ship:
+            if ms.coordinator is None:
+                self._reply(400, {"error": "ship requested but no "
+                                  "coordinator attached", "retryable": False})
+                return
+            if not ship_key:
+                self._reply(400, {"error": "ship requested without "
+                                  "ship_key", "retryable": False})
+                return
+            try:
+                receipt = ms.ship_export(ship_key, export)
+            except MXNetError as e:
+                self._reply(503, {"error": f"page shipping failed: {e}",
+                                  "retryable": True}, retry_after="0.05")
+                return
+            out["ship_key"] = ship_key
+            out["shipped_bytes"] = int(receipt.get("bytes", 0))
+        else:
+            out["k_rows"] = export["k_rows"].tolist()
+            out["v_rows"] = export["v_rows"].tolist()
+        self._reply(200, out)
+
     def _generate(self):
         ms = self._ms
         if ms.decoder is None:
@@ -204,13 +267,24 @@ class _Handler(BaseHTTPRequestHandler):
             eos_id = req.get("eos_id")
             stream_mode = bool(req.get("stream", True))
             deadline_ms = req.get("deadline_ms", ms.default_deadline_ms)
+            ship_key = req.get("ship_key")
+            kv_inline = req.get("kv_import")
         except (KeyError, ValueError, TypeError) as e:
             self._reply(400, {"error": f"malformed request: {e}",
                               "retryable": False})
             return
+        kv_import = None
+        if kv_inline is not None:
+            kv_import = kv_inline
+        elif ship_key:
+            # fetch the prefill replica's exported pages; an expired or
+            # unknown key falls back to local prefill (when the prompt
+            # fits this replica's ladder)
+            kv_import = ms.fetch_shipped(ship_key)
         try:
             st = ms.decoder.submit(prompt, max_new_tokens=max_new,
-                                   eos_id=eos_id, deadline_ms=deadline_ms)
+                                   eos_id=eos_id, deadline_ms=deadline_ms,
+                                   kv_import=kv_import)
         except Overloaded as e:
             self._reply(e.status, {"error": str(e), "retryable": True},
                         retry_after="0.05")
@@ -323,13 +397,22 @@ class ModelServer:
                          control into drain/rollout (pause + quiesce
                          alongside the batcher, so PR-12 semantics cover
                          decode streams too).
+    role:                disaggregated-serving role advertised to the
+                         registry: "prefill" (serves /prefill, ships KV
+                         pages), "decode" (serves /generate, imports
+                         shipped pages via ship_key), or "both"
+                         (default, PR-13 colocated behavior). Defaults
+                         to $MXNET_DISAGG_ROLE.
+    prefill_engine:      optional disagg.PrefillEngine; attaches the
+                         /prefill endpoint and its warmth to readiness.
     """
 
     def __init__(self, predictor, host="127.0.0.1", port=0,
                  max_latency_ms=5.0, max_queue=128,
                  default_deadline_ms=1000.0, stats=None, name="serve",
                  model="default", generation=0, coordinator=None,
-                 require_warm=None, decoder=None):
+                 require_warm=None, decoder=None, role=None,
+                 prefill_engine=None):
         self.predictor = predictor
         buckets = (predictor.ladder.sizes if predictor.ladder is not None
                    else (1, 2, 4, 8, 16, 32))
@@ -347,6 +430,15 @@ class ModelServer:
                             and bool(predictor._input_shapes))
         self._require_warm = require_warm
         self.decoder = decoder
+        if role is None:
+            role = getenv_str("MXNET_DISAGG_ROLE")
+        if role not in ("prefill", "decode", "both"):
+            raise MXNetError(f"invalid disagg role {role!r} "
+                             "(want prefill|decode|both)")
+        self.role = role
+        self.prefill_engine = prefill_engine
+        self._ship_client = None        # lazy kvstore client for paging
+        self._ship_lock = threading.Lock()
         self._host, self._port = host, port
         self._httpd = None
         self._thread = None
@@ -379,6 +471,10 @@ class ModelServer:
         if self.decoder is not None and not self.decoder.predictor.is_warm:
             why.append("cold decode executables "
                        "(DecodePredictor.warmup incomplete)")
+        if self.prefill_engine is not None \
+                and not self.prefill_engine.is_warm:
+            why.append("cold prefill-chunk executable "
+                       "(PrefillPredictor.warmup incomplete)")
         if self._coordinator is not None and (
                 self._agent is None or not self._agent.registered):
             why.append("not registered with control plane")
@@ -387,6 +483,59 @@ class ModelServer:
     @property
     def ready(self):
         return self.readiness()[0]
+
+    @property
+    def coordinator(self):
+        return self._coordinator
+
+    # -- disaggregated serving ------------------------------------------
+    def _page_client(self):
+        """Lazy authenticated kvstore client to the coordinator, shared
+        by page shipping (prefill role) and fetching (decode role)."""
+        if self._coordinator is None:
+            raise MXNetError("no coordinator attached for page shipping")
+        with self._ship_lock:
+            if self._ship_client is None:
+                from ..kvstore_server import connect_async_server
+                self._ship_client = connect_async_server(self._coordinator)
+            return self._ship_client
+
+    def ship_export(self, ship_key, export):
+        """Prefill role: push one export bundle to the coordinator's
+        page store (kvstore.ship_kv_pages over the MAC'd wire)."""
+        if self.prefill_engine is None:
+            raise MXNetError("no prefill engine attached")
+        return self.prefill_engine.ship(self._page_client(), ship_key,
+                                        export)
+
+    def fetch_shipped(self, ship_key):
+        """Decode role: resolve a request's ship_key into a kv_import
+        dict (or None on an unknown/expired key — the scheduler then
+        prefills locally). The fetch is non-destructive so a whole-
+        stream router retry can re-fetch the same key; TTL expiry on
+        the coordinator garbage-collects it."""
+        if self._coordinator is None:
+            return None
+        from . import disagg as _disagg
+        try:
+            return _disagg.fetch_kv_import(self._page_client(), ship_key)
+        except MXNetError:
+            return None
+
+    def load_report(self):
+        """Per-beat load snapshot the ReplicaAgent sends as the v2
+        serve_beat payload — the router's decode-placement signal."""
+        load = {"queue_depth": self.stats.queue_depth, "role": self.role}
+        alloc = None
+        if self.decoder is not None:
+            alloc = self.decoder.allocator
+            load["active_streams"] = self.decoder.active_streams
+        elif self.prefill_engine is not None:
+            alloc = self.prefill_engine.allocator
+        if alloc is not None:
+            load["kv_pages_free"] = alloc.free_count
+            load["kv_pages_total"] = alloc.num_pages
+        return load
 
     @property
     def address(self):
@@ -422,6 +571,13 @@ class ModelServer:
         if self._agent is not None:
             self._agent.stop(deregister=True)
             self._agent = None
+        with self._ship_lock:
+            if self._ship_client is not None:
+                try:
+                    self._ship_client.close()
+                except OSError:
+                    pass
+                self._ship_client = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -110,6 +110,10 @@ class ServingStats:
       shed_projected         sheds by the projected-queue-wait bound
       decode_active          gauge, occupied decode slots
       kv_pages_live/total    gauge pair, KV page pool occupancy
+      kv_pages_free/used/shared  allocator occupancy gauges (shared =
+                             refcount >= 2: prefix-cache overlap)
+      kv_pages_imported_total    pages admitted pre-filled (disagg ship)
+      prefix_cache_hits/misses/prefix_tokens_saved  prefix-cache gauges
     plus four histograms: ttft (submit -> first token), token_latency
     (inter-token gap), prefill_time, decode_step_time.
     """
@@ -142,6 +146,13 @@ class ServingStats:
         self.kv_pages_live = 0
         self.kv_pages_total = 0
         self.kv_page_occupancy = 0.0
+        self.kv_pages_free = 0
+        self.kv_pages_used = 0
+        self.kv_pages_shared = 0
+        self.kv_pages_imported_total = 0
+        self.prefix_cache_hits = 0
+        self.prefix_cache_misses = 0
+        self.prefix_tokens_saved = 0
         self._profiler_counters = {}
         # per-bucket latency split: how much of the end-to-end time each
         # compiled bucket spends WAITING vs ON DEVICE — a queue-bound
@@ -224,6 +235,13 @@ class ServingStats:
                 "kv_pages_live": self.kv_pages_live,
                 "kv_pages_total": self.kv_pages_total,
                 "kv_page_occupancy": round(self.kv_page_occupancy, 4),
+                "kv_pages_free": self.kv_pages_free,
+                "kv_pages_used": self.kv_pages_used,
+                "kv_pages_shared": self.kv_pages_shared,
+                "kv_pages_imported_total": self.kv_pages_imported_total,
+                "prefix_cache_hits": self.prefix_cache_hits,
+                "prefix_cache_misses": self.prefix_cache_misses,
+                "prefix_tokens_saved": self.prefix_tokens_saved,
             }
         for prefix, h in (("latency", self.latency),
                           ("queue_wait", self.queue_wait),
@@ -255,8 +273,14 @@ class ServingStats:
             # predict-only profiler tables stay exactly as before
             keys += ["decode_streams_total", "decode_tokens_total",
                      "decode_active", "kv_pages_live", "kv_page_occupancy",
+                     "kv_pages_free", "kv_pages_used", "kv_pages_shared",
                      "ttft_p50_ms", "ttft_p99_ms",
                      "token_p50_ms", "token_p99_ms"]
+            if snap["prefix_cache_hits"] or snap["prefix_cache_misses"]:
+                keys += ["prefix_cache_hits", "prefix_cache_misses",
+                         "prefix_tokens_saved"]
+            if snap["kv_pages_imported_total"]:
+                keys += ["kv_pages_imported_total"]
         for key in keys:
             name = f"{self.name}:{key}"
             c = self._profiler_counters.get(name)
@@ -360,7 +384,21 @@ class ServingStats:
                 ("mxnet_serve_decode_kv_pages_live", self.kv_pages_live,
                  "gauge", "KV pages currently owned by live sequences"),
                 ("mxnet_serve_decode_kv_pages_total", self.kv_pages_total,
-                 "gauge", "KV page pool capacity")):
+                 "gauge", "KV page pool capacity"),
+                # allocator occupancy triple: free + used == total, and
+                # shared counts pages with refcount >= 2 (prefix-cache
+                # overlap a capacity planner must NOT double-count)
+                ("mxnet_kv_pages_free", self.kv_pages_free, "gauge",
+                 "KV pages on the free list of this pool"),
+                ("mxnet_kv_pages_used", self.kv_pages_used, "gauge",
+                 "KV pages with at least one holder in this pool"),
+                ("mxnet_kv_pages_shared", self.kv_pages_shared, "gauge",
+                 "KV pages shared by multiple holders (CoW prefix reuse)"),
+                ("mxnet_serve_prefix_cache_hits", self.prefix_cache_hits,
+                 "counter", "prefix-cache lookups that reused pages"),
+                ("mxnet_serve_prefix_tokens_saved",
+                 self.prefix_tokens_saved, "counter",
+                 "prompt tokens whose prefill was skipped via the cache")):
             lines += [f"# HELP {fam} {help_text}",
                       f"# TYPE {fam} {kind}",
                       f"{fam}{{{labels}}} {val}"]

@@ -387,6 +387,34 @@ ENV_VARS = collections.OrderedDict([
      "Per-sequence page-table width (max pages one stream may own). "
      "Requests whose prompt+max_new_tokens exceed it are rejected as "
      "NON-retryable — no replica can serve them.")),
+    ("MXNET_PREFIX_CACHE", EnvSpec(True, "bool",
+     "Enable the copy-on-write prefix cache on serving engines that "
+     "construct one by default (PrefillEngine, disagg-role "
+     "ModelServers). A cached prefix is shared read-only by any number "
+     "of streams; only the divergent tail page is ever copied.")),
+    ("MXNET_PREFIX_CACHE_PAGES", EnvSpec(64, "int",
+     "Capacity of the prefix cache in KV pages. Inserts beyond it "
+     "evict least-recently-used cached pages, and ONLY pages no live "
+     "stream references (allocator refcount down to the cache's own "
+     "hold); when nothing is evictable the insert is skipped.")),
+    ("MXNET_DISAGG_ROLE", EnvSpec("both", "str",
+     "Serving replica role advertised to the ServeRegistry: 'prefill' "
+     "(chunked prefill + KV-page export only), 'decode' (token "
+     "generation from shipped pages), or 'both' (the PR-13 colocated "
+     "engine). The router places prefill traffic on prefill-capable "
+     "replicas and decode streams on decode-capable ones.")),
+    ("MXNET_DISAGG_PREFILL_CHUNK", EnvSpec(16, "int",
+     "Token rows per chunked-prefill step. Long prompts are processed "
+     "in fixed chunks of this many positions so a decode-colocated "
+     "replica interleaves decode steps between chunks instead of "
+     "stalling a whole prompt's worth of prefill; one executable "
+     "serves every chunk (start/length are traced scalars).")),
+    ("MXNET_DISAGG_SHIP_TTL", EnvSpec(60, "int",
+     "Seconds an exported KV-page bundle survives in the "
+     "coordinator's page store awaiting pickup by the target decode "
+     "replica. Expired bundles are dropped at the next store access; "
+     "a consumer arriving late re-runs prefill instead of reading "
+     "stale pages.")),
     ("MXTPU_PP_SCHEDULE", EnvSpec("gpipe", "str",
      "Pipeline-parallel microbatch schedule for the composed train "
      "step: 'gpipe' (all-forward then the transposed all-backward) or "

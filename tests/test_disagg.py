@@ -1,0 +1,773 @@
+"""Disaggregated prefill/decode serving: copy-on-write prefix cache,
+KV-page shipping over the MAC'd kvstore wire, role-aware routing.
+
+Acceptance criteria from the disaggregation milestone:
+  * PageAllocator refcounts: share is free, fork is copy-on-write (an
+    exclusive page forks to itself), free returns a page only when the
+    LAST holder lets go,
+  * the radix prefix cache shares pages with live streams, evicts only
+    unpinned LRU leaves, and drains every refcount back to zero,
+  * cached / chunk-prefilled / imported admissions are bit-identical to
+    the plain-prefill oracle,
+  * KV pages round-trip the coordinator's page store (non-destructive
+    fetch, delete flag, TTL expiry) and admit into a fresh scheduler,
+  * the Router honors Retry-After on 503 sheds, splits streams across
+    a dedicated prefill tier, blames the right role's breaker when a
+    prefill replica dies, and degrades to colocated prefill with zero
+    failed client requests (multiprocess, kill -9),
+  * mxnet_kv_pages_{free,used,shared} and the prefix-cache counters
+    reach profiler.dumps() and /metrics.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import profiler
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.kvstore import fetch_kv_pages, ship_kv_pages
+from incubator_mxnet_tpu.kvstore_server import (connect_async_server,
+                                                start_async_server)
+from incubator_mxnet_tpu.serve import (DecodePredictor, DecodeScheduler,
+                                       ModelServer, Overloaded,
+                                       PageAllocator, PrefillEngine,
+                                       PrefillPredictor, PrefixCache,
+                                       Router, fetch_kv_import)
+from incubator_mxnet_tpu.serve import disagg as disagg_mod
+from incubator_mxnet_tpu.serve.stats import ServingStats
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_MAX_NEW = 5
+
+
+@pytest.fixture(scope="module")
+def toy():
+    """One warmed DecodePredictor shared by the module."""
+    pred = DecodePredictor.toy(slots=4, page_size=4, num_pages=64,
+                               max_pages_per_seq=8)
+    warm = pred.warmup()
+    return pred, warm
+
+
+@pytest.fixture(scope="module")
+def engine(toy):
+    """One warmed chunk-8 PrefillEngine over the module predictor (the
+    chunk executable is the slow part; tests clear its prefix cache)."""
+    pred, _ = toy
+    eng = PrefillEngine(pred, chunk=8, prefix_cache=True, name="disagg-eng")
+    eng.warmup()
+    return eng
+
+
+def _run_streams(pred, prompts, max_new=_MAX_NEW, **kw):
+    """Sequential oracle: one stream at a time, full result each."""
+    kw.setdefault("max_queue", len(prompts) + 8)
+    sched = DecodeScheduler(pred, **kw)
+    sched.start()
+    try:
+        return [sched.submit(p, max_new_tokens=max_new).result(timeout=120)
+                for p in prompts]
+    finally:
+        sched.stop()
+
+
+class _NoPredict:
+    ladder = None
+    _input_shapes = {}
+    is_warm = True
+
+    def predict(self, feed):
+        raise RuntimeError("unused")
+
+
+# -- PageAllocator refcounts: share / fork / free ----------------------
+
+
+def test_page_allocator_share_fork_refcount():
+    a = PageAllocator(8)
+    pages = a.alloc(2)
+    assert pages == [0, 1]                  # pinned low-ids-first order
+    assert a.refcount(0) == 1 and a.refcount(7) == 0
+    a.share([0])
+    assert a.refcount(0) == 2
+    assert a.shared_count == 1 and a.used_count == 2
+    # dropping one hold keeps the page live
+    a.free([0])
+    assert a.refcount(0) == 1 and a.live == 2
+    # exclusive page forks to itself: the zero-copy fast path
+    page, copied = a.fork(1)
+    assert (page, copied) == (1, False)
+    # shared page forks to a fresh exclusive page, releasing the
+    # caller's hold on the original
+    a.share([0])
+    fresh, copied = a.fork(0)
+    assert copied and fresh not in (0, 1)
+    assert a.refcount(0) == 1 and a.refcount(fresh) == 1
+    a.free([0, 1, fresh])
+    assert a.live == 0 and a.free_count == 8
+    with pytest.raises(MXNetError, match="double free"):
+        a.free([1])
+    with pytest.raises(MXNetError, match="non-live"):
+        a.share([3])
+    with pytest.raises(MXNetError, match="non-live"):
+        a.fork(3)
+
+
+def test_page_allocator_fork_exhaustion_is_retryable():
+    a = PageAllocator(1)
+    (p,) = a.alloc(1)
+    a.share([p])
+    with pytest.raises(Overloaded, match="no free page to fork") as ei:
+        a.fork(p)
+    assert ei.value.retryable and ei.value.status == 503
+    assert a.refcount(p) == 2               # failed fork changed nothing
+    a.free([p, p])
+    assert a.free_count == 1
+
+
+# -- PrefixCache: lookup / insert / eviction / drain -------------------
+
+
+def test_prefix_cache_lookup_coverage_cap():
+    a = PageAllocator(16)
+    cache = PrefixCache(a, 4, max_pages=8)
+    prompt = [5, 4, 3, 2, 1, 6, 7, 8, 9, 10]        # 2 full pages + 2 tail
+    pages = a.alloc(3)
+    cache.insert(prompt, pages, len(prompt))
+    a.free(pages)                           # cache holds keep them live
+    assert a.live == 3
+    # exact prompt: coverage stays strictly below len(prompt) — the
+    # partial tail would leave no suffix position to compute
+    hit, covered, partial = cache.lookup(prompt)
+    assert (covered, partial) == (8, False) and hit == pages[:2]
+    a.free(hit)
+    # longer prompt: the partial tail now qualifies
+    hit, covered, partial = cache.lookup(prompt + [11, 12])
+    assert (covered, partial) == (10, True) and hit == pages
+    a.free(hit)
+    # unrelated prompt: miss, no holds granted
+    assert cache.lookup([30, 29, 28, 27, 26]) == ([], 0, False)
+    st = cache.stats()
+    assert st["hits"] == 2 and st["misses"] == 1
+    assert st["tokens_saved"] == 18 and st["cached_pages"] == 3
+    assert cache.clear() == 3
+    assert a.live == 0 and a.free_count == 16
+
+
+def test_prefix_cache_evicts_only_unpinned_lru_leaves():
+    a = PageAllocator(8)
+    cache = PrefixCache(a, 4, max_pages=2)
+    prompt_a = [1, 2, 3, 4, 5, 6, 7, 8]
+    pages_a = a.alloc(2)
+    cache.insert(prompt_a, pages_a, 8)
+    a.free(pages_a)                         # rc 1: cache only
+    a.share([pages_a[0]])                   # pin the first page
+    prompt_b = [9, 10, 11, 12, 13, 14, 15, 16]
+    pages_b = a.alloc(2)
+    cache.insert(prompt_b, pages_b, 8)
+    st = cache.stats()
+    # A's unpinned leaf was evicted to admit B's first chunk; B's second
+    # chunk found only pinned leaves and was dropped, not forced in
+    assert st["evicted"] == 1 and st["inserted"] == 3
+    assert st["cached_pages"] == 2
+    hit, covered, _ = cache.lookup(prompt_a + [17])
+    assert covered == 4 and hit == [pages_a[0]]     # pinned page survived
+    a.free(hit)
+    hit, covered, _ = cache.lookup(prompt_b + [17])
+    assert covered == 4 and hit == [pages_b[0]]
+    a.free(hit)
+    a.free([pages_a[0]])                    # release the pin
+    a.free(pages_b)                         # release our alloc holds
+    assert cache.clear() == 2
+    assert a.live == 0 and a.free_count == 8
+
+
+# -- chunked prefill executable ----------------------------------------
+
+
+def test_prefill_warmup_keys_are_isolated(toy, engine):
+    pred, warm = toy
+    # the decode-side key set is pinned: chunk warmup must NOT leak into
+    # DecodePredictor.warmup() (decode-only replicas never build it)
+    assert set(warm) == {"prefill:4", "prefill:8", "prefill:16", "decode"}
+    assert set(engine.warmup()) == {"prefill_chunk"}
+    assert engine.is_warm
+    with pytest.raises(MXNetError, match="need >= 1"):
+        PrefillPredictor(pred, chunk=0)
+
+
+def test_prefill_engine_prefix_reuse_bit_identical(toy, engine):
+    pred, _ = toy
+    engine.prefix_cache.clear()
+    prompt = [5, 4, 3, 2, 1, 6, 7, 8, 9, 10]
+    first = engine.run(prompt)
+    assert first["n"] == len(prompt)
+    assert first["k_rows"].shape == (3, 4, 2, 8)
+    assert first["cached_tokens"] == 0
+    # oracle: the prefill pick must equal the first decoded token
+    expected = _run_streams(pred, [prompt], max_new=3,
+                            name="pfx-oracle")[0]
+    assert first["next_token"] == expected[0]
+    # the second run resumes after the cached prefix yet exports
+    # bit-identical rows (full pages are shared, the suffix recomputes)
+    second = engine.run(prompt)
+    assert second["cached_tokens"] == 8
+    assert second["next_token"] == first["next_token"]
+    assert np.array_equal(first["k_rows"], second["k_rows"])
+    assert np.array_equal(first["v_rows"], second["v_rows"])
+    # stream holds were released inside run(); only cache holds remain
+    engine.prefix_cache.clear()
+    assert engine.allocator.live == 0
+    with pytest.raises(MXNetError, match="empty prompt"):
+        engine.run([])
+    with pytest.raises(MXNetError, match="per-sequence cap"):
+        engine.run(list(range(1, 8 * 4 + 2)))
+
+
+# -- scheduler admissions: cached prefix and kv_import -----------------
+
+
+def test_scheduler_cached_admission_bit_identity_and_drain(toy, engine):
+    pred, _ = toy
+    prompt = [1, 2, 3, 4, 5, 6, 7]
+    expected = _run_streams(pred, [prompt], name="cache-oracle")[0]
+    sched = DecodeScheduler(pred, max_queue=8, name="disagg-cache",
+                            prefix_cache=True, chunk_prefill=engine.chunker)
+    sched.start()
+    try:
+        first = sched.submit(prompt, max_new_tokens=_MAX_NEW)\
+                     .result(timeout=60)
+        second = sched.submit(prompt, max_new_tokens=_MAX_NEW)\
+                      .result(timeout=60)
+    finally:
+        sched.stop()
+    assert first == expected and second == expected
+    st = sched.prefix_cache.stats()
+    assert st["hits"] >= 1 and st["tokens_saved"] >= 4
+    # after drain the cache's own holds are the ONLY live refcounts
+    assert sched.allocator.live == st["cached_pages"]
+    sched.prefix_cache.clear()
+    assert sched.allocator.live == 0
+    assert sched.allocator.free_count == pred.num_pages
+
+
+def test_kv_import_admission_matches_oracle(toy, engine):
+    pred, _ = toy
+    engine.prefix_cache.clear()
+    prompt = [2, 4, 6, 8, 10, 12]
+    expected = _run_streams(pred, [prompt], name="imp-oracle")[0]
+    out = engine.run(prompt)
+    imp = {"k_rows": out["k_rows"], "v_rows": out["v_rows"],
+           "n": out["n"], "next_token": out["next_token"]}
+    sched = DecodeScheduler(pred, max_queue=8, name="disagg-import")
+    sched.start()
+    try:
+        got = sched.submit(prompt, max_new_tokens=_MAX_NEW,
+                           kv_import=imp).result(timeout=60)
+        # malformed imports are loud and non-retryable at submit time
+        with pytest.raises(MXNetError, match="covers"):
+            sched.submit(prompt + [1], max_new_tokens=2, kv_import=imp)
+        bad = dict(imp, k_rows=imp["k_rows"][:, :2])
+        with pytest.raises(MXNetError, match="shape"):
+            sched.submit(prompt, max_new_tokens=2, kv_import=bad)
+        with pytest.raises(MXNetError, match="malformed"):
+            sched.submit(prompt, max_new_tokens=2,
+                         kv_import={"n": len(prompt)})
+    finally:
+        sched.stop()
+    assert got == expected
+    assert sched.stats.snapshot()["kv_pages_imported_total"] == 2
+    engine.prefix_cache.clear()
+    assert engine.allocator.live == 0
+
+
+# -- page shipping over the MAC'd wire ---------------------------------
+
+
+def test_ship_fetch_roundtrip_and_ttl(monkeypatch):
+    addr_token = start_async_server()
+    cli = connect_async_server(addr_token)
+    try:
+        rng = np.random.RandomState(0)
+        k = rng.randn(3, 4, 2, 8).astype(np.float32)
+        v = rng.randn(3, 4, 2, 8).astype(np.float32)
+        receipt = ship_kv_pages(cli, "kvship:m:r1", k, v,
+                                meta={"n": 10, "next_token": 5})
+        assert receipt["stored"] and receipt["bytes"] > 0
+        # non-destructive by default: the router's whole-stream retry
+        # re-fetches the same key
+        for _ in range(2):
+            gk, gv, meta = fetch_kv_pages(cli, "kvship:m:r1")
+            assert np.array_equal(gk, k) and np.array_equal(gv, v)
+            assert meta["n"] == 10 and meta["next_token"] == 5
+        # the kv_import shaping helper
+        imp = fetch_kv_import(cli, "kvship:m:r1")
+        assert imp["n"] == 10 and imp["next_token"] == 5
+        assert np.array_equal(imp["k_rows"], k)
+        # delete flag consumes the bundle
+        assert fetch_kv_pages(cli, "kvship:m:r1", delete=True) is not None
+        assert fetch_kv_pages(cli, "kvship:m:r1") is None
+        assert fetch_kv_import(cli, "unknown-key") is None
+        # TTL zero: the bundle expires before the fetch (lazy GC)
+        monkeypatch.setenv("MXNET_DISAGG_SHIP_TTL", "0")
+        ship_kv_pages(cli, "kvship:m:r2", k, v, meta={"n": 10,
+                                                      "next_token": 5})
+        time.sleep(0.01)
+        assert fetch_kv_pages(cli, "kvship:m:r2") is None
+        # (the page store is on the process-singleton coordinator, so
+        # the counters are cumulative across tests — lower-bound only)
+        stats = cli.call("kv_page_stats")
+        assert stats["puts"] >= 2
+    finally:
+        cli.close()
+
+
+# -- satellite: pool gauges reach profiler.dumps and /metrics ----------
+
+
+def test_kv_page_gauges_reach_profiler_and_prometheus(toy, engine):
+    pred, _ = toy
+    profiler.set_config(profile_all=True)
+    profiler.set_state("run")
+    try:
+        stats = ServingStats("disaggst")
+        sched = DecodeScheduler(pred, stats=stats, max_queue=8,
+                                name="disaggst", prefix_cache=True,
+                                chunk_prefill=engine.chunker)
+        sched.start()
+        try:
+            base = [3, 1, 4, 1, 5, 9, 2, 6]
+            for suffix in (7, 8):
+                sched.submit(base + [suffix], max_new_tokens=3)\
+                     .result(timeout=60)
+        finally:
+            sched.stop()
+        snap = stats.snapshot()
+        # the gauges stay CONSISTENT: free + used always cover the pool
+        assert snap["kv_pages_free"] + snap["kv_pages_used"] \
+            == pred.num_pages
+        assert snap["kv_pages_used"] == sched.prefix_cache.stats()[
+            "cached_pages"]
+        assert snap["prefix_cache_hits"] == 1
+        assert snap["prefix_tokens_saved"] == 8
+        table = profiler.dumps(reset=True)
+        for needle in ("disaggst:kv_pages_free", "disaggst:kv_pages_used",
+                       "disaggst:kv_pages_shared",
+                       "disaggst:prefix_cache_hits",
+                       "disaggst:prefix_tokens_saved"):
+            assert needle in table, f"{needle} missing from:\n{table}"
+        assert "disaggst:kv_pages_free" not in profiler.dumps(reset=True)
+        text = stats.render_prometheus()
+        for fam in ("mxnet_kv_pages_free", "mxnet_kv_pages_used",
+                    "mxnet_kv_pages_shared",
+                    "mxnet_serve_prefix_cache_hits",
+                    "mxnet_serve_prefix_tokens_saved"):
+            assert fam in text, f"{fam} missing from /metrics"
+        sched.prefix_cache.clear()
+    finally:
+        profiler.set_state("stop")
+        profiler.set_config(profile_all=False)
+
+
+# -- satellite: Retry-After on 503 sheds -------------------------------
+
+
+def test_parse_retry_after():
+    parse = Router._parse_retry_after
+    assert parse({"Retry-After": "2"}) == 2.0
+    assert parse({"Retry-After": "0.5"}) == 0.5
+    assert parse({}) is None
+    assert parse({"Retry-After": "Thu, 01 Jan 2026 00:00:00 GMT"}) is None
+    assert parse({"Retry-After": "-3"}) is None
+
+
+def test_router_honors_retry_after_on_shed():
+    import http.server
+    calls = []
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            calls.append(time.monotonic())
+            if len(calls) == 1:
+                body = json.dumps({"error": "warming up",
+                                   "retryable": True}).encode("utf-8")
+                self.send_response(503)
+                self.send_header("Retry-After", "1")
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            lines = b"".join(
+                json.dumps(row).encode("utf-8") + b"\n"
+                for row in ({"token": 5}, {"token": 6}, {"done": True}))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Content-Length", str(len(lines)))
+            self.end_headers()
+            self.wfile.write(lines)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        router = Router(replicas=[addr], retries=3, backoff_ms=1,
+                        name="retry-after")
+        toks = router.generate([1, 2, 3], max_new_tokens=2,
+                               deadline_ms=30000)
+        assert toks == [5, 6]
+        # backoff_ms=1 would retry in ~1ms; the header must stretch it
+        assert len(calls) == 2
+        assert calls[1] - calls[0] >= 0.9, \
+            f"Retry-After ignored: retried after {calls[1] - calls[0]:.3f}s"
+        assert router.stats.snapshot()["counters"]["sheds_total"] == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# -- satellite: prefill-replica death blames the right breaker ---------
+
+
+@pytest.mark.timeout(300)
+def test_generate_failover_when_prefill_replica_dies(toy):
+    """A dead dedicated-prefill replica (connection refused) shares the
+    prefill tier with a healthy one. Streams keep succeeding, the DEAD
+    replica's breaker takes the blame, the decode replica's breaker
+    stays closed, and pages genuinely move through the split path."""
+    pred, _ = toy
+    prompt = [1, 2, 3, 4, 5, 6, 7]
+    expected = _run_streams(pred, [prompt], max_new=4,
+                            name="fo-oracle")[0]
+    coord = start_async_server()
+    cli = connect_async_server(coord)
+    eng = PrefillEngine(pred, chunk=8, prefix_cache=True, name="fo-pf")
+    eng.warmup()
+    sched = DecodeScheduler(pred, max_queue=32, name="fo-dec")
+    pf_srv = ModelServer(_NoPredict(), prefill_engine=eng, role="prefill",
+                         coordinator=coord, model="fo", name="fo-pf")
+    dec_srv = ModelServer(_NoPredict(), decoder=sched, role="decode",
+                          coordinator=coord, model="fo", name="fo-dec")
+    router = None
+    try:
+        pf_srv.start()
+        dec_srv.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not (pf_srv.ready
+                                                   and dec_srv.ready):
+            time.sleep(0.05)
+        assert pf_srv.ready and dec_srv.ready
+        # a "replica" nobody listens on: reserve a port, close it
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_addr = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        cli.call("serve_register", "fo", "deadpf", 0, (4, 8, 16),
+                 dead_addr, "prefill")
+        cli.call("serve_beat", "fo", "deadpf", 0, True, False, None)
+        router = Router(coordinator=coord, model="fo", retries=5,
+                        backoff_ms=20, breaker_failures=1,
+                        breaker_cooldown_ms=60000, name="fo-router")
+        router.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with router._rlock:
+                ready = sum(1 for i in router._replicas.values()
+                            if i["ready"])
+            if ready >= 3:
+                break
+            router.refresh()
+            time.sleep(0.1)
+        assert ready >= 3, f"only {ready} replicas discovered"
+        shipped0 = disagg_mod.stats().get("pages_shipped", 0)
+        # round-robin puts the dead replica in rotation: every stream
+        # must still come back correct, whole-stream-retried or not
+        for _ in range(4):
+            assert router.generate(prompt, max_new_tokens=4,
+                                   deadline_ms=60000) == expected
+        snap = router.stats.snapshot()["counters"]
+        assert snap["responses_ok_total"] == 4
+        assert snap.get("requests_failed_total", 0) == 0
+        assert snap.get("disagg_streams_total", 0) >= 1
+        # the DEAD prefill replica took the breaker blame...
+        with router._rlock:
+            dead_br = router._breakers["deadpf"]
+            others = {rid: br.state for rid, br in router._breakers.items()
+                      if rid != "deadpf"}
+        assert dead_br.failures >= 1 or dead_br.state == "open"
+        # ...and neither the healthy prefill nor the decode tier did
+        assert set(others.values()) == {"closed"}, others
+        # pages really moved prefill -> coordinator -> decode
+        assert disagg_mod.stats().get("pages_shipped", 0) > shipped0
+        assert sched.stats.snapshot()["kv_pages_imported_total"] >= 2
+    finally:
+        if router is not None:
+            router.stop()
+        pf_srv.stop()
+        dec_srv.stop()
+        cli.close()
+
+
+# -- the multiprocess drill: 1 prefill + 2 decode, kill -9 -------------
+
+
+_REPLICA = textwrap.dedent("""
+    import json, os, sys, time
+    repo, outdir, idx, role, coord = sys.argv[1:6]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, repo)
+    from incubator_mxnet_tpu.serve import (DecodePredictor, DecodeScheduler,
+                                           ModelServer, PrefillEngine,
+                                           PrefillPredictor)
+
+    class _NoPredict:
+        ladder = None
+        _input_shapes = {}
+        is_warm = True
+        def predict(self, feed):
+            raise RuntimeError("unused")
+
+    pred = DecodePredictor.toy(slots=4, page_size=4, num_pages=64,
+                               max_pages_per_seq=8)
+    sched = None
+    if role == "prefill":
+        eng = PrefillEngine(pred, chunk=8, prefix_cache=True,
+                            name=f"drill-pf{idx}")
+        eng.warmup()
+        srv = ModelServer(_NoPredict(), prefill_engine=eng, role="prefill",
+                          coordinator=coord, model="drill",
+                          name=f"drill-pf{idx}")
+    else:
+        pred.warmup()
+        chunker = PrefillPredictor(pred, chunk=8)
+        chunker.warmup()
+        sched = DecodeScheduler(pred, max_queue=32, name=f"drill-dec{idx}",
+                                prefix_cache=True, chunk_prefill=chunker)
+        srv = ModelServer(_NoPredict(), decoder=sched, role="decode",
+                          coordinator=coord, model="drill",
+                          name=f"drill-dec{idx}")
+    host, port = srv.start()
+    deadline = time.monotonic() + 240
+    while not srv.ready and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert srv.ready, srv.readiness()
+    tmp = os.path.join(outdir, f"ready-{idx}.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(), "addr": f"{host}:{port}"}, f)
+    os.replace(tmp, os.path.join(outdir, f"ready-{idx}.json"))
+    stop = os.path.join(outdir, "stop")
+    deadline = time.monotonic() + 240
+    while not os.path.exists(stop) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    if sched is not None:
+        sched.pause("drill-drain")
+        sched.quiesce(timeout=60)
+        if sched.prefix_cache is not None:
+            sched.prefix_cache.clear()
+        sys.stdout.write("DRAIN " + json.dumps(
+            {"free": sched.allocator.free_count,
+             "total": pred.num_pages}) + chr(10))
+    srv.stop()
+    sys.stdout.write("REPLICA_EXIT_OK" + chr(10))
+""")
+
+
+@pytest.mark.timeout(420)
+def test_disagg_drill_kill_prefill_multiprocess(tmp_path, toy):
+    """The ISSUE's acceptance drill: 1 prefill + 2 decode replicas behind
+    the Router; shared-prefix traffic flows through the split path, the
+    prefill replica is SIGKILLed with streams in flight, every client
+    request still succeeds (failover to colocated prefill on the decode
+    tier), and both decode replicas' prefix-cache refcounts return to
+    zero after drain."""
+    pred, _ = toy
+    prefix = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+    prompts = [prefix + [11 + i] for i in range(10)]
+    oracle = _run_streams(pred, prompts, max_new=4, name="drill-oracle")
+    outdir = tmp_path / "drill"
+    outdir.mkdir()
+    coord = start_async_server()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "MXNET_FAULT_INJECT")}
+    procs = []
+    router = None
+    cli = connect_async_server(coord)
+    try:
+        for i, role in enumerate(("prefill", "decode", "decode")):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _REPLICA, REPO, str(outdir),
+                 str(i), role, coord],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env))
+        info = {}
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and len(info) < 3:
+            for i in range(3):
+                f = outdir / f"ready-{i}.json"
+                if i not in info and f.exists():
+                    info[i] = json.loads(f.read_text())
+                if procs[i].poll() is not None:
+                    raise AssertionError(
+                        f"replica {i} died during boot:\n"
+                        f"{procs[i].stderr.read()[-2000:]}")
+            time.sleep(0.05)
+        assert len(info) == 3, "replicas never became ready"
+
+        router = Router(coordinator=coord, model="drill", retries=8,
+                        backoff_ms=25, breaker_failures=1,
+                        breaker_cooldown_ms=60000, name="drill-router")
+        router.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with router._rlock:
+                ready = sum(1 for i in router._replicas.values()
+                            if i["ready"])
+            if ready >= 3:
+                break
+            router.refresh()
+            time.sleep(0.1)
+        assert ready >= 3
+
+        # phase 1: the healthy fleet serves through the split path
+        for i in range(4):
+            assert router.generate(prompts[i], max_new_tokens=4,
+                                   deadline_ms=90000) == oracle[i]
+        snap = router.stats.snapshot()["counters"]
+        assert snap.get("prefill_routed_total", 0) >= 1, snap
+        assert cli.call("kv_page_stats")["puts"] >= 1   # wire shipping
+
+        # phase 2: kill -9 the prefill replica with streams in flight
+        results, errors = {}, []
+
+        def _client(j):
+            try:
+                results[j] = router.generate(prompts[j], max_new_tokens=4,
+                                             deadline_ms=90000)
+            except Exception as e:      # noqa: BLE001 — assert below
+                errors.append((j, repr(e)))
+
+        threads = [threading.Thread(target=_client, args=(j,))
+                   for j in range(4, 10)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        os.kill(info[0]["pid"], 9)
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, f"client requests failed: {errors}"
+        assert results == {j: oracle[j] for j in range(4, 10)}
+        deadline = time.monotonic() + 60
+        while procs[0].poll() is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert procs[0].poll() == -9
+        snap = router.stats.snapshot()["counters"]
+        assert snap.get("requests_failed_total", 0) == 0
+        assert snap["responses_ok_total"] == 10
+
+        # phase 3: decode replicas drain — prefix-cache refcounts to 0
+        (outdir / "stop").touch()
+        for i in (1, 2):
+            out, err = procs[i].communicate(timeout=120)
+            assert procs[i].returncode == 0, err[-2000:]
+            assert "REPLICA_EXIT_OK" in out
+            drain = json.loads(
+                [ln for ln in out.splitlines()
+                 if ln.startswith("DRAIN ")][0][len("DRAIN "):])
+            assert drain["free"] == drain["total"], drain
+    finally:
+        if router is not None:
+            router.stop()
+        cli.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+# -- throughput race: disaggregated vs colocated (slow) ----------------
+
+
+@pytest.mark.slow
+def test_disagg_throughput_vs_colocated_equal_budget(monkeypatch):
+    """Shared-prefix workload at equal page budget: a prefill engine
+    with a prefix cache feeding two decode schedulers must beat one
+    colocated engine that recomputes the long shared prefix per request
+    by >= 2x aggregate tok/s. Geometry is sized so prefill compute
+    dominates dispatch overhead (250-token prompts, 2 new tokens)."""
+    dims = dict(num_heads=8, head_dim=64, vocab=32)
+    geom = dict(page_size=8, max_pages_per_seq=32, prompt_buckets=(256,))
+    prefix = [(7 * i) % 31 + 1 for i in range(246)]
+    prompts = [prefix + [11 + i, 3, 5, 7] for i in range(12)]
+    new_tokens = 2
+
+    base_pred = DecodePredictor.toy(slots=4, num_pages=128, **dims, **geom)
+    base_pred.warmup()
+    base = DecodeScheduler(base_pred, max_queue=16, name="race-base")
+    base.start()
+    try:
+        base.submit(prompts[0], max_new_tokens=new_tokens)\
+            .result(timeout=300)                    # warm the path
+        t0 = time.monotonic()
+        streams = [base.submit(p, max_new_tokens=new_tokens)
+                   for p in prompts]
+        base_out = [s.result(timeout=300) for s in streams]
+        base_dt = time.monotonic() - t0
+    finally:
+        base.stop()
+
+    # equal page budget: 128 colocated vs 34 prefill + 2 x 47 decode;
+    # cap the prefix cache below the prefill pool so steady state keeps
+    # headroom for each request's fresh suffix pages
+    monkeypatch.setenv("MXNET_PREFIX_CACHE_PAGES", "32")
+    pf_pred = DecodePredictor.toy(slots=1, num_pages=34, **dims, **geom)
+    dec_preds = [DecodePredictor.toy(slots=4, num_pages=47, **dims, **geom)
+                 for _ in range(2)]
+    for p in dec_preds:
+        p.warmup()
+    eng = PrefillEngine(pf_pred, chunk=8, prefix_cache=True,
+                        name="race-pf")
+    eng.warmup()
+    scheds = [DecodeScheduler(p, max_queue=16, name=f"race-dec{i}")
+              for i, p in enumerate(dec_preds)]
+    for s in scheds:
+        s.start()
+    try:
+        ex = eng.run(prompts[0])                    # warm the path
+        scheds[0].submit(prompts[0], max_new_tokens=new_tokens,
+                         kv_import={"k_rows": ex["k_rows"],
+                                    "v_rows": ex["v_rows"], "n": ex["n"],
+                                    "next_token": ex["next_token"]})\
+                 .result(timeout=300)
+        t0 = time.monotonic()
+        streams = []
+        for i, p in enumerate(prompts):
+            ex = eng.run(p)
+            streams.append(scheds[i % 2].submit(
+                p, max_new_tokens=new_tokens,
+                kv_import={"k_rows": ex["k_rows"], "v_rows": ex["v_rows"],
+                           "n": ex["n"], "next_token": ex["next_token"]}))
+        disagg_out = [s.result(timeout=300) for s in streams]
+        disagg_dt = time.monotonic() - t0
+    finally:
+        for s in scheds:
+            s.stop()
+
+    assert disagg_out == base_out                   # same tokens first
+    assert eng.prefix_cache.stats()["hits"] >= 7
+    total = len(prompts) * new_tokens
+    base_tps = total / base_dt
+    disagg_tps = total / disagg_dt
+    assert disagg_tps >= 2.0 * base_tps, \
+        (f"disaggregated {disagg_tps:.1f} tok/s vs colocated "
+         f"{base_tps:.1f} tok/s: < 2x at equal page budget")

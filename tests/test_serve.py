@@ -381,6 +381,7 @@ _SKIP_ALLOWLIST = (
     r"native toolchain unavailable",
     r"donation is a no-op on CPU",
     r"gate only applies off-TPU",
+    r"backend reports no temp memory analysis",
 )
 
 

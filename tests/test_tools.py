@@ -104,6 +104,7 @@ def test_diagnose_runs():
                     "Step Breakdown (profiler attribution)",
                     "Fleet Observability (fleetobs)",
                     "Control Plane (serve)",
+                    "Disaggregated Serving",
                     "Composed Parallelism (pipeline schedules)",
                     "Static Analysis (mxlint)",
                     "Graph Analysis (shardlint)"):

@@ -234,6 +234,49 @@ def main():
     except Exception as e:
         print("control plane probe FAILED:", e)
 
+    print("----------Disaggregated Serving----------")
+    try:
+        from incubator_mxnet_tpu.serve import disagg
+        from incubator_mxnet_tpu.util import (getenv_bool, getenv_int,
+                                              getenv_str)
+        print("roles        :",
+              {"role": getenv_str("MXNET_DISAGG_ROLE"),
+               "prefill_chunk":
+                   getenv_int("MXNET_DISAGG_PREFILL_CHUNK"),
+               "ship_ttl_s": getenv_int("MXNET_DISAGG_SHIP_TTL")})
+        print("prefix cache :",
+              {"enabled": getenv_bool("MXNET_PREFIX_CACHE"),
+               "max_pages": getenv_int("MXNET_PREFIX_CACHE_PAGES")})
+        s = disagg.stats()
+        print("shipping     :",
+              {k: s.get(k, 0) for k in ("prefill_requests", "chunks_total",
+                                        "pages_shipped", "bytes_shipped",
+                                        "pages_fetched", "fetch_misses")})
+        # in-process probe: a tiny radix cache over a throwaway
+        # allocator — exercises share/CoW/evict without any device work
+        from incubator_mxnet_tpu.serve.decode import PageAllocator
+        from incubator_mxnet_tpu.serve.prefix_cache import PrefixCache
+        alloc = PageAllocator(8)
+        cache = PrefixCache(alloc, 4, max_pages=4)
+        seq = [1, 2, 3, 4, 5, 6]
+        pages = alloc.alloc(2)
+        cache.insert(seq, pages, len(seq))
+        alloc.free(pages)
+        hit_pages, covered, partial = cache.lookup(seq + [7])
+        cache.lookup([9, 9, 9, 9, 9])       # miss
+        cs = cache.stats()
+        print("probe        :",
+              {"covered": covered, "partial": partial,
+               "hit_rate": cs["hit_rate"],
+               "cached_pages": cs["cached_pages"]})
+        alloc.free(hit_pages)
+        cache.clear()
+        ok = alloc.free_count == 8
+        print("probe drain  :", "refcounts returned to 0" if ok
+              else f"LEAKED pages ({alloc.free_count}/8 free)")
+    except Exception as e:
+        print("disagg probe FAILED:", e)
+
     print("----------Composed Parallelism (pipeline schedules)----------")
     try:
         from incubator_mxnet_tpu.parallel.pipeline import (REMAT_MODES,

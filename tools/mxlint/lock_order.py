@@ -47,8 +47,21 @@ LOCK_ORDER = {
     "serve/router.py": ("self._rlock", "self._lock"),
     # serve/server: ModelServer's drain/swap lock serializes begin_drain
     # against reload's pause→quiesce→swap→resume; batcher/stats locks
-    # are acquired by callees, not nested at this module's sites.
-    "serve/server.py": ("self._drain_lock",),
+    # are acquired by callees, not nested at this module's sites. The
+    # ship-client lock (lazy kvstore client for KV-page shipping) is a
+    # LEAF — it guards only client construction/teardown and never
+    # nests with the drain lock.
+    "serve/server.py": ("self._drain_lock", "self._ship_lock"),
+    # serve/prefix_cache: one cache lock guards the radix tree, LRU
+    # clock, and counters. PageAllocator calls made under it acquire
+    # the allocator's own leaf lock inside decode.py (cross-module
+    # nesting, declared there) — the cache itself holds exactly one.
+    "serve/prefix_cache.py": ("self._lock",),
+    # serve/disagg: the PrefillEngine run lock (one pool, one run at a
+    # time) is OUTERMOST; PrefillPredictor's executable-construction
+    # lock nests under it via _exec_chunk; the module counter lock is a
+    # LEAF (_bump after engine state settles, fleetobs discipline).
+    "serve/disagg.py": ("self._lock", "self._compile_lock", "_lock"),
     # fleetobs: a FleetRegistry's instance lock guards the per-rank fold
     # state, SLO engine, control-op queue, and stored profiles; the
     # module lock is a LEAF guarding the counter registry and the

@@ -167,6 +167,40 @@ def _corpus_composed_1f1b():
     step._cached.trace_signature(p, init_opt(p), tokens, targets, 0)
 
 
+def _corpus_disagg_prefill_chunk():
+    """The disaggregated-serving chunked-prefill executable
+    (serve/disagg.PrefillPredictor): scatter-into-pages + full-window
+    paged attention with traced start/length offsets, traced via the
+    cached_jit signature path (no compile)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.serve.decode import DecodePredictor
+    from incubator_mxnet_tpu.serve.disagg import PrefillPredictor
+
+    V, H, D = 32, 2, 8
+    E = H * D
+    rng = np.random.RandomState(0)
+    params = {"emb": rng.randn(V, E).astype(np.float32),
+              "wq": rng.randn(E, E).astype(np.float32),
+              "wk": rng.randn(E, E).astype(np.float32),
+              "wv": rng.randn(E, E).astype(np.float32),
+              "wo": rng.randn(E, E).astype(np.float32),
+              "w_out": rng.randn(E, V).astype(np.float32)}
+    pred = DecodePredictor(params, num_heads=H, head_dim=D, vocab=V,
+                           page_size=4, num_pages=16, slots=2,
+                           max_pages_per_seq=4, prompt_buckets=(4, 8))
+    chunker = PrefillPredictor(pred, chunk=8)
+    i32 = jnp.int32
+    kv = jax.ShapeDtypeStruct((pred.num_pages, pred.page_size,
+                               pred.num_heads, pred.head_dim), jnp.float32)
+    chunker._exec_chunk().trace_signature(
+        pred._param_vals,
+        jax.ShapeDtypeStruct((1, chunker.chunk), i32),
+        jax.ShapeDtypeStruct((), i32), jax.ShapeDtypeStruct((), i32),
+        kv, kv, jax.ShapeDtypeStruct((pred.max_pages_per_seq,), i32))
+
+
 def entries():
     """name -> builder, in run order."""
     return OrderedDict([
@@ -176,6 +210,7 @@ def entries():
         ("fused_optimizer", _corpus_fused_optimizer),
         ("partition_rules", _corpus_partition_rules),
         ("composed_1f1b", _corpus_composed_1f1b),
+        ("disagg_prefill_chunk", _corpus_disagg_prefill_chunk),
     ])
 
 
