@@ -1093,6 +1093,66 @@ def bench_disagg_serve(requests=12, prefix_len=24, suffix_len=4,
             "bytes_shipped": ship.get("bytes_shipped", 0)}
 
 
+def bench_spec_decode(streams=16, slots=4):
+    """Speculative-decoding row: the SAME ragged stream set run through
+    plain continuous decode (PR-13 path, one dispatch per token) and
+    through draft-propose / batched-verify speculation at k=2 and k=4
+    (serve/spec_decode.py: one fixed-shape verify dispatch covers up to
+    k+1 tokens per stream per iteration). Greedy acceptance keeps the
+    emitted streams bit-identical, so the ONLY thing this row can
+    measure is dispatch amortization — which is exactly the speculation
+    win and is visible on CPU rounds. Reports tok/s for all three,
+    accept-rate mean, and TTFT + inter-token p50/p99 per variant."""
+    from incubator_mxnet_tpu.serve import DecodePredictor, DecodeScheduler
+    prompts = [[1 + i % 13, 2 + i % 7, 3 + i % 5] for i in range(streams)]
+    lens = [12 + 8 * (i % 4) for i in range(streams)]    # 12..36 tokens
+
+    def run(spec_k):
+        pred = DecodePredictor.toy(slots=slots, page_size=4, num_pages=64,
+                                   max_pages_per_seq=16)
+        pred.warmup()
+        sched = DecodeScheduler(pred, max_queue=streams + 4,
+                                spec_decode=spec_k is not None,
+                                spec_k=spec_k,
+                                name=f"bench-spec-k{spec_k or 0}")
+        sched.start()
+        try:
+            def wave():
+                t0 = time.perf_counter()
+                sts = [sched.submit(p, max_new_tokens=n)
+                       for p, n in zip(prompts, lens)]
+                out = [st.result(timeout=600) for st in sts]
+                wall = time.perf_counter() - t0
+                return sum(len(t) for t in out) / wall, out
+            wave()          # first wave pays dispatch warmup overheads
+            tok_s, toks = wave()
+            snap = sched.stats.snapshot()
+        finally:
+            sched.stop()
+        return tok_s, toks, snap
+
+    plain_tok_s, plain_toks, plain_snap = run(None)
+    row = {"plain_tok_s": plain_tok_s,
+           "plain_ttft_p50_ms": plain_snap["ttft_p50_ms"],
+           "plain_ttft_p99_ms": plain_snap["ttft_p99_ms"],
+           "plain_token_p50_ms": plain_snap["token_p50_ms"],
+           "plain_token_p99_ms": plain_snap["token_p99_ms"]}
+    for k in (2, 4):
+        tok_s, toks, snap = run(k)
+        row[f"spec_k{k}"] = {
+            "tok_s": tok_s,
+            "speedup": tok_s / plain_tok_s if plain_tok_s else None,
+            "bit_identical": toks == plain_toks,
+            "accept_rate": snap["spec_accept_rate_mean"],
+            "adaptive_k": snap["spec_adaptive_k"],
+            "ttft_p50_ms": snap["ttft_p50_ms"],
+            "ttft_p99_ms": snap["ttft_p99_ms"],
+            "token_p50_ms": snap["token_p50_ms"],
+            "token_p99_ms": snap["token_p99_ms"],
+            "verify_p50_ms": snap["spec_verify_p50_ms"]}
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=None,
@@ -1369,6 +1429,41 @@ def main():
               f"({dg['bytes_shipped']} B) shipped", file=sys.stderr)
     except Exception as e:
         print(f"[bench] disagg_serve: FAILED {e!r}", file=sys.stderr)
+
+    # speculative-decoding row also runs in EVERY mode: the dispatch
+    # amortization of one batched verify per k+1 tokens is a scheduler
+    # property, visible on CPU too (greedy keeps streams bit-identical)
+    try:
+        sd = bench_spec_decode()
+        k2, k4 = sd["spec_k2"], sd["spec_k4"]
+        results.append({"mode": "spec_decode", "batch": 16,
+                        "dtype": "float32",
+                        "plain_tok_per_sec": round(sd["plain_tok_s"], 1),
+                        "spec_k2_tok_per_sec": round(k2["tok_s"], 1),
+                        "spec_k4_tok_per_sec": round(k4["tok_s"], 1),
+                        "spec_k2_speedup": round(k2["speedup"], 2),
+                        "spec_k4_speedup": round(k4["speedup"], 2),
+                        "spec_k2_accept_rate": round(k2["accept_rate"], 3),
+                        "spec_k4_accept_rate": round(k4["accept_rate"], 3),
+                        "bit_identical": bool(k2["bit_identical"]
+                                              and k4["bit_identical"]),
+                        "ttft_p50_ms": k4["ttft_p50_ms"],
+                        "ttft_p99_ms": k4["ttft_p99_ms"],
+                        "token_p50_ms": k4["token_p50_ms"],
+                        "token_p99_ms": k4["token_p99_ms"],
+                        "verify_p50_ms": k4["verify_p50_ms"],
+                        "speedup": round(k4["speedup"], 2),
+                        "vs_baseline": None})
+        print(f"[bench] spec decode (16 streams, 4 slots) plain "
+              f"{sd['plain_tok_s']:7.1f} tok/s vs k=2 "
+              f"{k2['tok_s']:7.1f} ({k2['speedup']:4.2f}x) vs k=4 "
+              f"{k4['tok_s']:7.1f} ({k4['speedup']:4.2f}x)  accept "
+              f"{k4['accept_rate']*100:.0f}%  identical="
+              f"{bool(k2['bit_identical'] and k4['bit_identical'])}  "
+              f"token p50 {k4['token_p50_ms']:.1f}/p99 "
+              f"{k4['token_p99_ms']:.1f} ms", file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] spec_decode: FAILED {e!r}", file=sys.stderr)
 
     # checkpoint-overhead row also runs in EVERY mode: it measures the
     # step-path cost of fault tolerance (host snapshot + write-behind),

@@ -44,7 +44,9 @@ import jax.numpy as jnp
 from .flash_attention import _prec, pallas_available
 
 __all__ = ["paged_attention", "paged_attention_reference",
-           "paged_attention_pallas", "register_kernels"]
+           "paged_attention_pallas", "paged_attention_multiquery",
+           "paged_attention_mq_reference", "paged_attention_mq_pallas",
+           "register_kernels"]
 
 _NEG_INF = -1e30
 
@@ -212,6 +214,167 @@ def paged_attention_pallas(q, k_pages, v_pages, page_table, seq_lens,
 
 
 # ---------------------------------------------------------------------------
+# multi-query variant (speculative-decode verify read path)
+# ---------------------------------------------------------------------------
+#
+# Verify scores G = k+1 positions of every sequence in ONE step, so each
+# sequence contributes a BLOCK of G query tokens instead of one, and each
+# query attends to a different-length prefix of the same page walk::
+#
+#     q          (B, G, H, D)     G stacked query tokens per sequence
+#     seq_lens   (B, G) int32     context length per (sequence, query)
+#
+# Everything else (pool layout, page-table indirection, clamp-to-1 on
+# idle rows) is identical to the single-query contract above. The page
+# walk is shared: one DMA per owned page serves all G queries, which is
+# the whole point — verify costs one pass over the KV history, not G.
+
+
+def paged_attention_mq_reference(q, k_pages, v_pages, page_table, seq_lens,
+                                 *, sm_scale=None):
+    """Gather-based multi-query composition: dense per-sequence view,
+    per-(sequence, query) masked softmax. The numerical reference the
+    mq kernel must match before it can win."""
+    from jax import lax
+    B, G, H, D = q.shape
+    seq_lens = jnp.maximum(seq_lens, 1)                  # (B, G)
+    k = k_pages[page_table].reshape(B, -1, H, D)         # (B, T, H, D)
+    v = v_pages[page_table].reshape(B, -1, H, D)
+    prec = _prec(q.dtype)
+    qs = q * jnp.asarray(_scale(sm_scale, D), q.dtype)
+    # s[b, h, g, t] = sum_d qs[b, g, h, d] * k[b, t, h, d]
+    s = lax.dot_general(qs, k, (((3,), (3,)), ((0, 2), (0, 2))),
+                        precision=prec,
+                        preferred_element_type=jnp.float32)
+    t_ids = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    s = jnp.where(t_ids < seq_lens[:, None, :, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    # o[b, h, g, d] = sum_t p[b, h, g, t] * v[b, t, h, d]
+    o = lax.dot_general(p, v, (((3,), (1,)), ((0, 1), (0, 2))),
+                        precision=prec,
+                        preferred_element_type=jnp.float32)
+    return (o / l).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _pa_mq_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_sc, l_sc, acc_sc, *, page_size, sm_scale):
+    """One (sequence b, page j) grid step of multi-query verify. Same
+    double-buffered page walk as _pa_kernel, but the flash recurrence
+    carries a G axis: each of the sequence's G query tokens keeps its
+    own (max, sumexp, acc) and its own length mask, all fed by the ONE
+    page this step DMA'd.
+
+    Refs: q (1, G, H, D) | k, v (1, page_size, H, D) | o (1, G, H, D);
+    scratch m, l (H, G, 128), acc (H, G, D), all f32."""
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+    sl = jnp.maximum(sl_ref[b], 1)                       # (G,)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    # skip pages past the LONGEST query's tail; shorter queries inside
+    # the page are handled by the per-query mask below
+    @pl.when(j * page_size < jnp.max(sl))
+    def _step():
+        prec = _prec(q_ref.dtype)
+        q = q_ref[0] * jnp.asarray(sm_scale, q_ref.dtype)   # (G, H, D)
+        k = k_ref[0]                                        # (ps, H, D)
+        v = v_ref[0]
+        # s[h, g, p] = sum_d q[g, h, d] * k[p, h, d]
+        s = lax.dot_general(q, k, (((2,), (2,)), ((1,), (1,))),
+                            precision=prec,
+                            preferred_element_type=jnp.float32)
+        pos = j * page_size + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(pos < sl[None, :, None], s, _NEG_INF)
+        m_prev = m_sc[:, :, 0]                              # (H, G)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, :, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[:, :, 0] = l_sc[:, :, 0] * alpha + jnp.sum(p, axis=-1)
+        # pv[h, g, d] = sum_p p[h, g, p] * v[p, h, d]
+        pv = lax.dot_general(p.astype(v.dtype), v,
+                             (((2,), (0,)), ((0,), (1,))),
+                             precision=prec,
+                             preferred_element_type=jnp.float32)
+        m_sc[:, :, 0] = m_new
+        acc_sc[:] = acc_sc[:] * alpha[:, :, None] + pv
+
+    @pl.when(j == n_j - 1)
+    def _finish():
+        o = acc_sc[:] / l_sc[:, :, 0][:, :, None]           # (H, G, D)
+        o_ref[0] = o.transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+def paged_attention_mq_pallas(q, k_pages, v_pages, page_table, seq_lens,
+                              *, sm_scale=None, interpret=None):
+    """Invoke the multi-query ragged kernel: grid (B, max_pages), the
+    (B, G) seq_lens matrix scalar-prefetched alongside the page table."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, G, H, D = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    if interpret is None:
+        interpret = _interpret()
+    scale = _scale(sm_scale, D)
+    seq_lens = jnp.maximum(seq_lens.astype(jnp.int32), 1)
+    page_table = page_table.astype(jnp.int32)
+
+    kernel = functools.partial(_pa_mq_kernel, page_size=page_size,
+                               sm_scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, G, H, D), lambda b, j, pt, sl: (b, 0, 0, 0)),
+            pl.BlockSpec((1, page_size, H, D),
+                         lambda b, j, pt, sl: (pt[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, H, D),
+                         lambda b, j, pt, sl: (pt[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, G, H, D),
+                               lambda b, j, pt, sl: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, G, 128), jnp.float32),
+            pltpu.VMEM((H, G, 128), jnp.float32),
+            pltpu.VMEM((H, G, D), jnp.float32),
+        ],
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, G, H, D), q.dtype),
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )
+    return call(page_table, seq_lens, q, k_pages, v_pages)
+
+
+def paged_attention_mq_candidates(args, kwargs):
+    """tuned_call builder for the multi-query entry: shapes only."""
+    from collections import OrderedDict
+    cands = OrderedDict()
+    if not _offer_candidates():
+        return cands
+    q, k_pages = args[0], args[1]
+    if len(q.shape) != 4 or len(k_pages.shape) != 4:
+        return cands
+    cands["pallas"] = paged_attention_mq_pallas
+    return cands
+
+
+# ---------------------------------------------------------------------------
 # autotuner registration + public entry
 # ---------------------------------------------------------------------------
 
@@ -244,6 +407,8 @@ def register_kernels():
     from .. import tune
     tune.register_kernel("paged_attention", paged_attention_candidates,
                          version=1)
+    tune.register_kernel("paged_attention_mq", paged_attention_mq_candidates,
+                         version=1)
 
 
 register_kernels()
@@ -258,4 +423,18 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
     from .. import tune
     return tune.tuned_call(
         "paged_attention", paged_attention_reference,
+        q, k_pages, v_pages, page_table, seq_lens, sm_scale=sm_scale)
+
+
+def paged_attention_multiquery(q, k_pages, v_pages, page_table, seq_lens,
+                               sm_scale=None):
+    """Multi-query ragged paged attention: q is (B, G, H, D) — G stacked
+    query tokens per sequence — and seq_lens is (B, G), one context
+    length per (sequence, query). The speculative-decode verify read
+    path: one shared page walk scores all G positions of every sequence.
+    Dispatches to the tuned winner; the XLA gather composition is the
+    implicit fallback and numerical reference."""
+    from .. import tune
+    return tune.tuned_call(
+        "paged_attention_mq", paged_attention_mq_reference,
         q, k_pages, v_pages, page_table, seq_lens, sm_scale=sm_scale)

@@ -43,6 +43,15 @@ shape-bucketing discipline):
                 over the MAC'd kvstore wire, and kv_import admission on
                 decode-role replicas; replica roles flow through the
                 ServeRegistry to the role-aware Router.
+  spec_decode.py  SpecDecoder / DraftState — speculative decoding on
+                the fixed-shape decode path: a cheap self-draft
+                proposes k tokens per stream per iteration and ONE
+                batched-verify executable (multi-query paged attention)
+                scores every proposal in a single target step;
+                longest-agreeing-prefix acceptance keeps greedy streams
+                bit-identical to plain decode while amortizing dispatch
+                over k+1 tokens. Per-stream adaptive k from an
+                accept-rate EMA; MXNET_SPEC_DECODE / MXNET_SPEC_K.
 
 Typical use::
 
@@ -64,6 +73,7 @@ from .decode import (DecodePredictor, DecodeScheduler, DecodeStream,
 from .prefix_cache import PrefixCache
 from .disagg import (PrefillEngine, PrefillPredictor, fetch_kv_import,
                      ship_key_for)
+from .spec_decode import DraftState, SpecDecoder
 
 __all__ = ["Predictor", "BucketLadder", "DynamicBatcher", "ModelServer",
            "ServingStats", "LatencyHistogram", "Overloaded",
@@ -72,4 +82,4 @@ __all__ = ["Predictor", "BucketLadder", "DynamicBatcher", "ModelServer",
            "NoReplicaAvailable", "DecodePredictor", "DecodeScheduler",
            "DecodeStream", "PageAllocator", "PrefixCache",
            "PrefillPredictor", "PrefillEngine", "ship_key_for",
-           "fetch_kv_import"]
+           "fetch_kv_import", "SpecDecoder", "DraftState"]

@@ -473,6 +473,10 @@ class DecodeStream:
         self._pages_needed = 0
         self._last_t = None
         self._kv_import = None
+        # speculative-decode state (spec schedulers only)
+        self._draft = None
+        self._spec_k = 0
+        self._spec_ema = None
 
     def _deliver(self, tok, now):
         if self.ttft_ms is None:
@@ -523,8 +527,19 @@ class DecodeScheduler:
 
     def __init__(self, predictor, *, stats=None, max_queue=None,
                  max_new_tokens=None, queue_bound_ms=None, name="decode",
-                 prefix_cache=None, chunk_prefill=None):
+                 prefix_cache=None, chunk_prefill=None, spec_decode=None,
+                 spec_k=None):
         self.predictor = predictor
+        # speculative decoding (serve/spec_decode.py): when enabled the
+        # loop's step is draft-propose + ONE batched verify instead of
+        # ONE decode dispatch; emitted tokens are bit-identical under
+        # greedy, so this is purely a throughput knob
+        if spec_decode is None:
+            spec_decode = util.getenv_bool("MXNET_SPEC_DECODE")
+        self.spec = None
+        if spec_decode:
+            from .spec_decode import SpecDecoder
+            self.spec = SpecDecoder(predictor, k=spec_k)
         self.stats = stats if stats is not None else ServingStats(name)
         self._max_queue = int(max_queue if max_queue is not None
                               else util.getenv_int("MXNET_DECODE_QUEUE"))
@@ -570,6 +585,12 @@ class DecodeScheduler:
             self._running = True
         if self._k_pages is None:
             self._k_pages, self._v_pages = self.predictor.kv_pool()
+        # AOT-build the fixed-shape batched-verify executable before the
+        # loop thread serves traffic, so speculation never retraces
+        # mid-stream (same contract as DecodePredictor.warmup: a warm
+        # boot against a populated cache dir reports "disk", not "miss").
+        if self.spec is not None and not self.spec.is_warm:
+            self.spec.warmup()
         self._thread = threading.Thread(target=self._loop,
                                         name="mxtpu-decode", daemon=True)
         self._thread.start()
@@ -852,6 +873,13 @@ class DecodeScheduler:
             pages = plan["pages"]
             ptrow = _np.zeros(self.predictor.max_pages_per_seq, _np.int32)
             ptrow[:len(pages)] = pages
+            if self.spec is not None:
+                # seed the stream's draft with the prompt's KV — works
+                # uniformly for plain/cached/import admission because
+                # the prompt tokens are always known host-side
+                st._draft = self.spec.make_draft(st.prompt)
+                st._spec_k = self.spec.k
+                st._spec_ema = None
             t0 = time.monotonic()
             nxt, pos = self._run_admission(st, plan, ptrow)
             now = time.monotonic()
@@ -919,6 +947,14 @@ class DecodeScheduler:
         return nxt
 
     def _step(self):
+        """One iteration's device work: the speculative draft+verify
+        step when spec decode is on, the plain decode dispatch
+        otherwise."""
+        if self.spec is not None:
+            return self._spec_step()
+        return self._plain_step()
+
+    def _plain_step(self):
         """One fixed-shape decode dispatch over all slots, then per-slot
         deliver/retire. The chaos hook fires BEFORE the device call so a
         kill lands mid-stream with tokens already flushed to clients."""
@@ -964,6 +1000,116 @@ class DecodeScheduler:
             if (len(st._tokens) >= st.max_new_tokens
                     or tok == st.eos_id):
                 self._retire(st)
+        self._set_pool_gauges()
+
+    def _spec_step(self):
+        """One speculative iteration: host-side draft proposals for
+        every active slot, then ONE fixed-shape batched verify dispatch,
+        then longest-agreeing-prefix acceptance (see spec_decode.py for
+        the rule and why greedy outputs stay bit-identical).
+
+        Per-slot depth ``k_s`` is clamped to (a) the stream's adaptive
+        k, (b) ``remaining - 1`` so the m+1 emitted tokens can never
+        overshoot max_new_tokens, and (c) the stream's OWNED page
+        capacity so a speculative write can never land outside pages
+        claimed at admission (ptrow's zero padding would silently alias
+        page 0 otherwise). Unused verify rows pad at position -1. The
+        chaos hook fires BEFORE the verify dispatch, mirroring
+        _plain_step's decode site."""
+        from .. import fault
+        spec = self.spec
+        ps = self.predictor.page_size
+        with self._lock:
+            active = [(i, st) for i, st in enumerate(self._active)
+                      if st is not None]
+            if not active:
+                return
+            base_tokens = self._tokens.copy()
+            base_positions = self._positions.copy()
+            page_tables = self._page_tables.copy()
+        tokens = _np.zeros((self.predictor.slots, spec.width), _np.int32)
+        positions = _np.full((self.predictor.slots, spec.width), -1,
+                             _np.int32)
+        drafts = {}
+        t_draft = time.monotonic()
+        for i, st in active:
+            t0 = int(base_tokens[i])
+            p0 = int(base_positions[i])
+            remaining = st.max_new_tokens - len(st._tokens)
+            owned_cap = len(st._pages) * ps - 1 - p0
+            k_s = max(0, min(st._spec_k, remaining - 1, owned_cap))
+            d = st._draft.propose(t0, k_s) if k_s > 0 else []
+            drafts[i] = d
+            tokens[i, 0] = t0
+            positions[i, 0] = p0
+            for j, dt in enumerate(d):
+                tokens[i, j + 1] = dt
+                positions[i, j + 1] = p0 + j + 1
+        self.stats.spec_draft_time.observe(time.monotonic() - t_draft)
+        fault.inject("verify")
+        t0v = time.monotonic()
+        y, kp, vp = spec.verify(tokens, positions, self._k_pages,
+                                self._v_pages, page_tables)
+        self._k_pages, self._v_pages = kp, vp
+        now = time.monotonic()
+        step_s = now - t0v
+        self.stats.spec_verify_time.observe(step_s)
+        self.stats.decode_step_time.observe(step_s)
+        self.stats.observe_bucket(self.predictor.slots, (), step_s)
+        self.stats.incr("batches_total")
+        self.stats.incr("spec_steps_total")
+        self.stats.set_gauge("batch_occupancy",
+                             len(active) / self.predictor.slots)
+        k_live = []
+        for i, st in active:
+            d = drafts[i]
+            k_s = len(d)
+            m = 0
+            while m < k_s and d[m] == int(y[i, m]):
+                m += 1
+            emitted = list(d[:m]) + [int(y[i, m])]
+            if k_s:
+                frac = m / k_s
+                self.stats.spec_accept_rate.observe(frac)
+                self.stats.incr("spec_tokens_proposed_total", k_s)
+                self.stats.incr("spec_tokens_accepted_total", m)
+                st._spec_ema = (frac if st._spec_ema is None
+                                else (0.5 * frac + 0.5 * st._spec_ema))
+                st._spec_k = spec.next_k(st._spec_k, st._spec_ema)
+            k_live.append(st._spec_k)
+            p0 = int(base_positions[i])
+            # rejection rollback: truncate the draft history to the
+            # accepted prefix (committed KV positions p0..p0+m); page
+            # ownership is untouched — speculation never claims pages
+            st._draft.sync(p0, [int(tokens[i, 0])] + list(d[:m]))
+            with self._lock:
+                self._positions[i] = p0 + m + 1
+                self._tokens[i] = emitted[-1]
+            if st.deadline is not None and now > st.deadline:
+                self.stats.incr("shed_deadline")
+                self._retire(st, DeadlineExceeded(
+                    "deadline expired mid-generation"))
+                continue
+            if st._cancelled:
+                self._retire(st)
+                continue
+            finished = False
+            for tok in emitted:
+                if st._last_t is not None:
+                    self.stats.token_latency.observe(now - st._last_t)
+                st._deliver(tok, now)
+                self.stats.incr("decode_tokens_total")
+                if (len(st._tokens) >= st.max_new_tokens
+                        or tok == st.eos_id):
+                    # plain decode would have stopped HERE: tokens past
+                    # the eos are discarded, keeping streams identical
+                    finished = True
+                    break
+            if finished:
+                self._retire(st)
+        if k_live:
+            self.stats.set_gauge("spec_adaptive_k",
+                                 sum(k_live) / len(k_live))
         self._set_pool_gauges()
 
     def _retire(self, st, error=None):

@@ -47,7 +47,7 @@ import numpy as _np
 
 from .. import fault as _fault
 from ..base import MXNetError
-from ..util import getenv_int
+from ..util import getenv_bool, getenv_int
 from .batcher import DeadlineExceeded, Overloaded
 from .stats import LatencyHistogram
 
@@ -196,7 +196,8 @@ class Router:
                  deadline_ms=None, retries=None, backoff_ms=None,
                  hedge_delay_ms=None, breaker_failures=None,
                  breaker_cooldown_ms=None, refresh_ms=None, stats=None,
-                 name="router"):
+                 name="router", slo_split=None, ttft_slo_ms=None,
+                 token_slo_ms=None):
         if coordinator is None and not replicas:
             raise MXNetError("Router needs a coordinator or a static "
                              "replica list")
@@ -221,6 +222,17 @@ class Router:
         self._refresh_s = max(0.05, (
             refresh_ms if refresh_ms is not None
             else getenv_int("MXNET_ROUTER_REFRESH_MS")) / 1e3)
+        # SLO-split placement (MXNET_ROUTER_SLO_SPLIT): rank candidates
+        # by observed tail-latency headroom against per-role SLOs
+        # instead of pure rotation — see _candidates
+        self._slo_split = (slo_split if slo_split is not None
+                           else getenv_bool("MXNET_ROUTER_SLO_SPLIT"))
+        self._ttft_slo_ms = float(
+            ttft_slo_ms if ttft_slo_ms is not None
+            else getenv_int("MXNET_ROUTER_TTFT_SLO_MS"))
+        self._token_slo_ms = float(
+            token_slo_ms if token_slo_ms is not None
+            else getenv_int("MXNET_ROUTER_TOKEN_SLO_MS"))
         self.stats = stats if stats is not None else RouterStats(name)
         self._rng = random.Random()
         self._rlock = threading.Lock()  # replica table + breakers;
@@ -346,6 +358,18 @@ class Router:
                         SLOs die when a stream lands on a replica about
                         to shed on pages. Unreported headroom sorts
                         last; ties break round-robin.
+
+        SLO-split refinement (MXNET_ROUTER_SLO_SPLIT): replicas report
+        observed tail latencies in their load beat (prefill_p99_ms /
+        ttft_p99_ms / token_p99_ms, serve/server.py load_report) and
+        candidates are ranked by SLO HEADROOM — prefill by
+        MXNET_ROUTER_TTFT_SLO_MS minus the replica's prefill/ttft p99
+        (dedicated tier still first), decode by MXNET_ROUTER_TOKEN_SLO_MS
+        minus inter-token p99, kv_pages_free as the tiebreak. A replica
+        with no latency evidence yet scores headroom 0: below anything
+        proven inside its SLO, above anything proven outside it —
+        "no evidence" is not "fast". Sorts are stable, so equal
+        headroom preserves the round-robin rotation.
         """
         now = time.monotonic()
         transitions = []
@@ -371,10 +395,37 @@ class Router:
             self._record_transition(rid, moved)
         out = out[k:] + out[:k]         # round-robin rotation
         if role == "prefill":
-            out.sort(key=lambda c: c[2] != "prefill")   # dedicated first
+            if self._slo_split:
+                out.sort(key=lambda c: (c[2] != "prefill",
+                                        -self._ttft_headroom(c[3])))
+            else:
+                out.sort(key=lambda c: c[2] != "prefill")  # dedicated first
         elif role == "decode":
-            out.sort(key=lambda c: -c[3].get("kv_pages_free", -1))
+            if self._slo_split:
+                out.sort(key=lambda c: (-self._token_headroom(c[3]),
+                                        -c[3].get("kv_pages_free", -1)))
+            else:
+                out.sort(key=lambda c: -c[3].get("kv_pages_free", -1))
         return [(rid, addr) for rid, addr, _, _ in out]
+
+    def _ttft_headroom(self, load):
+        """TTFT-SLO headroom in ms from a replica's load beat. Dedicated
+        prefill replicas report prefill_p99_ms; colocated "both"
+        replicas report the decode scheduler's ttft_p99_ms. No evidence
+        scores 0 (neutral), so never-measured replicas neither jump the
+        queue nor get starved."""
+        p99 = load.get("prefill_p99_ms", load.get("ttft_p99_ms"))
+        if p99 is None:
+            return 0.0
+        return self._ttft_slo_ms - float(p99)
+
+    def _token_headroom(self, load):
+        """Inter-token-SLO headroom in ms (token_p99_ms from the decode
+        scheduler's per-token gap histogram); no evidence scores 0."""
+        p99 = load.get("token_p99_ms")
+        if p99 is None:
+            return 0.0
+        return self._token_slo_ms - float(p99)
 
     def _note_result(self, rid, ok):
         """Feed a call outcome to the replica's breaker (connect-layer
@@ -695,7 +746,17 @@ class Router:
         ndjson chunks until the {"done"} line. A stream that dies before
         "done" — reset, timeout, truncation — counts as a connect-layer
         breaker failure: the replica proved unable to FINISH, which for
-        streams is the health contract."""
+        streams is the health contract.
+
+        Token accounting is PER-ATTEMPT: ``tokens`` below is a fresh
+        local tally, folded into RouterStats exactly once when this
+        attempt settles — stream_tokens_total on "ok", stream_tokens_
+        discarded_total for partial tokens a failed attempt received
+        before the cut/shed. A whole-stream retry replays the prompt
+        and re-sends those tokens, so folding as-they-arrive would
+        double-count every replayed token; folding only the winning
+        attempt keeps stream_tokens_total equal to what callers were
+        actually handed."""
         import http.client
         timeout = max(1e-3, deadline - time.monotonic())
         req_body = {"prompt": [int(t) for t in prompt],
@@ -721,11 +782,15 @@ class Router:
                         tokens.append(int(row["token"]))
                     elif row.get("done"):
                         self._note_result(rid, True)
+                        self.stats.incr("stream_tokens_total", len(tokens))
                         return ("ok", tokens)
                     elif "error" in row:
                         # in-band error line: the replica answered
-                        # decisively — not a breaker failure
+                        # decisively — not a breaker failure. Tokens
+                        # streamed before it are dead: a retry replays
+                        # them, so they must NOT hit stream_tokens_total
                         self._note_result(rid, True)
+                        self._discard_tokens(tokens)
                         if row.get("retryable"):
                             self.stats.incr("sheds_total")
                             return ("retryable", Overloaded(
@@ -734,6 +799,7 @@ class Router:
                         return ("fatal", RouteError(
                             f"replica {rid}: {row['error']}"))
             self._note_result(rid, False)
+            self._discard_tokens(tokens)
             return ("retryable", NoReplicaAvailable(
                 f"replica {rid} stream ended without done marker "
                 f"({len(tokens)} tokens in)"))
@@ -756,9 +822,16 @@ class Router:
                 ConnectionError, TimeoutError, OSError, ValueError) as e:
             self.stats.incr("connect_errors_total")
             self._note_result(rid, False)
+            self._discard_tokens(tokens)
             return ("retryable", NoReplicaAvailable(
                 f"replica {rid} at {addr} died mid-stream after "
                 f"{len(tokens)} tokens: {e}"))
+
+    def _discard_tokens(self, tokens):
+        """Fold a failed attempt's partial token tally into the discard
+        counter (the retry will replay them from the prompt)."""
+        if tokens:
+            self.stats.incr("stream_tokens_discarded_total", len(tokens))
 
     def _one_call(self, rid, addr, inputs_json, deadline):
         """One HTTP /predict against one replica. Returns (kind, value);

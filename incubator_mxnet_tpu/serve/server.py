@@ -471,6 +471,10 @@ class ModelServer:
         if self.decoder is not None and not self.decoder.predictor.is_warm:
             why.append("cold decode executables "
                        "(DecodePredictor.warmup incomplete)")
+        if self.decoder is not None and self.decoder.spec is not None \
+                and not self.decoder.spec.is_warm:
+            why.append("cold speculative verify executable "
+                       "(SpecDecoder.warmup incomplete)")
         if self.prefill_engine is not None \
                 and not self.prefill_engine.is_warm:
             why.append("cold prefill-chunk executable "
@@ -530,8 +534,22 @@ class ModelServer:
         if self.decoder is not None:
             alloc = self.decoder.allocator
             load["active_streams"] = self.decoder.active_streams
+            # SLO headroom signals for the router's split placement
+            # policy (MXNET_ROUTER_SLO_SPLIT): observed tail latencies
+            # per role. Zero until the first streams complete — the
+            # router treats missing/zero as "no evidence", not "fast".
+            ds = self.decoder.stats
+            if ds.ttft.count:
+                load["ttft_p99_ms"] = round(ds.ttft.percentile(99) * 1e3, 3)
+            if ds.token_latency.count:
+                load["token_p99_ms"] = round(
+                    ds.token_latency.percentile(99) * 1e3, 3)
         elif self.prefill_engine is not None:
             alloc = self.prefill_engine.allocator
+            ps = self.prefill_engine.stats
+            if ps.prefill_time.count:
+                load["prefill_p99_ms"] = round(
+                    ps.prefill_time.percentile(99) * 1e3, 3)
         if alloc is not None:
             load["kv_pages_free"] = alloc.free_count
             load["kv_pages_total"] = alloc.num_pages

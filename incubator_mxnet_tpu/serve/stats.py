@@ -116,6 +116,15 @@ class ServingStats:
       prefix_cache_hits/misses/prefix_tokens_saved  prefix-cache gauges
     plus four histograms: ttft (submit -> first token), token_latency
     (inter-token gap), prefill_time, decode_step_time.
+
+    Speculative-decode counters (spec schedulers only; omitted from
+    every surface until a spec step is seen):
+      spec_steps_total           draft+verify iterations dispatched
+      spec_tokens_proposed_total draft tokens offered to verify
+      spec_tokens_accepted_total draft tokens the target agreed with
+      spec_adaptive_k            gauge, mean per-stream draft depth
+    plus three histograms: spec_accept_rate (per-stream per-step accept
+    FRACTION, 0..1 — not a latency), spec_draft_time, spec_verify_time.
     """
 
     def __init__(self, name="serve"):
@@ -128,6 +137,11 @@ class ServingStats:
         self.token_latency = LatencyHistogram()  # gap between tokens
         self.prefill_time = LatencyHistogram()   # prompt executable
         self.decode_step_time = LatencyHistogram()  # slot-batch step
+        # spec decode: accept rate holds a FRACTION (0..1), reusing the
+        # log-spaced histogram for O(1) observe + percentile reads
+        self.spec_accept_rate = LatencyHistogram()
+        self.spec_draft_time = LatencyHistogram()   # host-side propose
+        self.spec_verify_time = LatencyHistogram()  # batched verify
         self.requests_total = 0
         self.responses_ok = 0
         self.shed_queue_full = 0
@@ -153,6 +167,10 @@ class ServingStats:
         self.prefix_cache_hits = 0
         self.prefix_cache_misses = 0
         self.prefix_tokens_saved = 0
+        self.spec_steps_total = 0
+        self.spec_tokens_proposed_total = 0
+        self.spec_tokens_accepted_total = 0
+        self.spec_adaptive_k = 0.0
         self._profiler_counters = {}
         # per-bucket latency split: how much of the end-to-end time each
         # compiled bucket spends WAITING vs ON DEVICE — a queue-bound
@@ -242,6 +260,12 @@ class ServingStats:
                 "prefix_cache_hits": self.prefix_cache_hits,
                 "prefix_cache_misses": self.prefix_cache_misses,
                 "prefix_tokens_saved": self.prefix_tokens_saved,
+                "spec_steps_total": self.spec_steps_total,
+                "spec_tokens_proposed_total":
+                    self.spec_tokens_proposed_total,
+                "spec_tokens_accepted_total":
+                    self.spec_tokens_accepted_total,
+                "spec_adaptive_k": round(self.spec_adaptive_k, 4),
             }
         for prefix, h in (("latency", self.latency),
                           ("queue_wait", self.queue_wait),
@@ -249,11 +273,18 @@ class ServingStats:
                           ("ttft", self.ttft),
                           ("token", self.token_latency),
                           ("prefill", self.prefill_time),
-                          ("decode_step", self.decode_step_time)):
+                          ("decode_step", self.decode_step_time),
+                          ("spec_draft", self.spec_draft_time),
+                          ("spec_verify", self.spec_verify_time)):
             snap[f"{prefix}_p50_ms"] = round(h.percentile(50) * 1e3, 4)
             snap[f"{prefix}_p95_ms"] = round(h.percentile(95) * 1e3, 4)
             snap[f"{prefix}_p99_ms"] = round(h.percentile(99) * 1e3, 4)
             snap[f"{prefix}_mean_ms"] = round(h.mean * 1e3, 4)
+        # accept rate is a fraction, not a latency: export unscaled
+        snap["spec_accept_rate_p50"] = \
+            round(self.spec_accept_rate.percentile(50), 4)
+        snap["spec_accept_rate_mean"] = \
+            round(self.spec_accept_rate.mean, 4)
         for b, row in self.bucket_snapshot().items():
             for k, v in row.items():
                 snap[f"bucket{b}_{k}"] = v
@@ -281,6 +312,13 @@ class ServingStats:
                          "prefix_tokens_saved"]
             if snap["kv_pages_imported_total"]:
                 keys += ["kv_pages_imported_total"]
+            if snap["spec_steps_total"]:
+                # spec families only on schedulers that speculate, so
+                # plain-decode profiler tables stay exactly as before
+                keys += ["spec_steps_total", "spec_tokens_proposed_total",
+                         "spec_tokens_accepted_total", "spec_adaptive_k",
+                         "spec_accept_rate_mean",
+                         "spec_draft_p50_ms", "spec_verify_p50_ms"]
         for key in keys:
             name = f"{self.name}:{key}"
             c = self._profiler_counters.get(name)
@@ -344,17 +382,19 @@ class ServingStats:
         return "\n".join(lines) + "\n"
 
     @staticmethod
-    def _histogram_lines(fam, labels, state):
-        """Cumulative-`le` exposition for one LatencyHistogram state."""
+    def _histogram_lines(fam, labels, state, scale=1e3):
+        """Cumulative-`le` exposition for one LatencyHistogram state.
+        ``scale`` converts the stored unit for the `le` bounds and sum
+        (1e3: seconds -> ms; 1: dimensionless, e.g. accept fraction)."""
         lines = []
         cum = 0
         for bound, n in zip(state["bounds"], state["counts"]):
             cum += n
             lines.append(f'{fam}_bucket{{{labels},'
-                         f'le="{bound * 1e3:.6g}"}} {cum}')
+                         f'le="{bound * scale:.6g}"}} {cum}')
         cum += state["counts"][-1]
         lines.append(f'{fam}_bucket{{{labels},le="+Inf"}} {cum}')
-        lines.append(f'{fam}_sum{{{labels}}} {state["sum"] * 1e3:.6g}')
+        lines.append(f'{fam}_sum{{{labels}}} {state["sum"] * scale:.6g}')
         lines.append(f'{fam}_count{{{labels}}} {state["count"]}')
         return lines
 
@@ -399,6 +439,43 @@ class ServingStats:
                 ("mxnet_serve_prefix_tokens_saved",
                  self.prefix_tokens_saved, "counter",
                  "prompt tokens whose prefill was skipped via the cache")):
+            lines += [f"# HELP {fam} {help_text}",
+                      f"# TYPE {fam} {kind}",
+                      f"{fam}{{{labels}}} {val}"]
+        if self.spec_steps_total:
+            lines += self._spec_prometheus_lines(labels)
+        return lines
+
+    def _spec_prometheus_lines(self, labels):
+        """`mxnet_serve_spec_*` families (spec schedulers only): the
+        accept-rate histogram (dimensionless `le` bounds), draft/verify
+        time histograms, and the adaptive-k/throughput counters."""
+        lines = []
+        fam = "mxnet_serve_spec_accept_rate"
+        lines += [f"# HELP {fam} per-stream per-step draft accept "
+                  "fraction (0..1)",
+                  f"# TYPE {fam} histogram"]
+        lines += self._histogram_lines(
+            fam, labels, self.spec_accept_rate.snapshot_state(), scale=1)
+        for fam, h, help_text in (
+                ("mxnet_serve_spec_draft_ms", self.spec_draft_time,
+                 "host-side draft proposal time per iteration, in ms"),
+                ("mxnet_serve_spec_verify_ms", self.spec_verify_time,
+                 "batched verify dispatch time per iteration, in ms")):
+            lines += [f"# HELP {fam} {help_text}",
+                      f"# TYPE {fam} histogram"]
+            lines += self._histogram_lines(fam, labels, h.snapshot_state())
+        for fam, val, kind, help_text in (
+                ("mxnet_serve_spec_steps_total", self.spec_steps_total,
+                 "counter", "speculative draft+verify iterations"),
+                ("mxnet_serve_spec_tokens_proposed_total",
+                 self.spec_tokens_proposed_total, "counter",
+                 "draft tokens offered to verify"),
+                ("mxnet_serve_spec_tokens_accepted_total",
+                 self.spec_tokens_accepted_total, "counter",
+                 "draft tokens the target model agreed with"),
+                ("mxnet_serve_spec_adaptive_k", self.spec_adaptive_k,
+                 "gauge", "mean per-stream adaptive draft depth")):
             lines += [f"# HELP {fam} {help_text}",
                       f"# TYPE {fam} {kind}",
                       f"{fam}{{{labels}}} {val}"]

@@ -427,6 +427,39 @@ ENV_VARS = collections.OrderedDict([
      "keeping matmul outputs), or 'full' (recompute everything). "
      "Numerics are bit-identical across policies; only the "
      "memory/recompute trade-off moves.")),
+    ("MXNET_SPEC_DECODE", EnvSpec(False, "bool",
+     "Enable speculative decoding in DecodeScheduler: a host-side "
+     "draft proposes tokens and ONE fixed-shape batched verify "
+     "executable scores them per iteration (serve/spec_decode.py). "
+     "Greedy outputs are bit-identical to plain decode; this is "
+     "purely a throughput knob.")),
+    ("MXNET_SPEC_K", EnvSpec(4, "int",
+     "Maximum draft tokens proposed per stream per speculative "
+     "iteration (the verify executable's width is k+1 and is baked "
+     "into its compiled shape). Per-stream depth adapts below this "
+     "cap when MXNET_SPEC_ADAPT is on.")),
+    ("MXNET_SPEC_ADAPT", EnvSpec(True, "bool",
+     "Adapt each stream's draft depth to its measured accept rate: "
+     "shrink toward 1 below MXNET_SPEC_ACCEPT_FLOOR_PCT, regrow "
+     "toward MXNET_SPEC_K at sustained near-full acceptance. Off: "
+     "every stream always proposes MXNET_SPEC_K tokens.")),
+    ("MXNET_SPEC_ACCEPT_FLOOR_PCT", EnvSpec(50, "int",
+     "Accept-rate floor (percent) for adaptive speculation depth: "
+     "below it a stream's k shrinks by one per iteration, bounding "
+     "wasted verify work when the draft diverges from the target.")),
+    ("MXNET_ROUTER_SLO_SPLIT", EnvSpec(False, "bool",
+     "Rank routing candidates by SLO headroom instead of raw load: "
+     "prefill placements by TTFT-SLO headroom (MXNET_ROUTER_TTFT_"
+     "SLO_MS minus the replica's beaten ttft_p99_ms) and decode "
+     "placements by inter-token-SLO headroom, with kv_pages_free as "
+     "the tiebreak. Off: dedicated-role-first / most-free-pages "
+     "ordering.")),
+    ("MXNET_ROUTER_TTFT_SLO_MS", EnvSpec(500, "int",
+     "Time-to-first-token SLO target (ms) for the prefill tier's "
+     "SLO-split placement ranking.")),
+    ("MXNET_ROUTER_TOKEN_SLO_MS", EnvSpec(100, "int",
+     "Inter-token latency SLO target (ms) for the decode tier's "
+     "SLO-split placement ranking.")),
 ])
 
 _FALSY = frozenset(("", "0", "false", "off", "no"))

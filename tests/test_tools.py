@@ -105,6 +105,7 @@ def test_diagnose_runs():
                     "Fleet Observability (fleetobs)",
                     "Control Plane (serve)",
                     "Disaggregated Serving",
+                    "Speculative Decoding",
                     "Composed Parallelism (pipeline schedules)",
                     "Static Analysis (mxlint)",
                     "Graph Analysis (shardlint)"):

@@ -277,6 +277,41 @@ def main():
     except Exception as e:
         print("disagg probe FAILED:", e)
 
+    print("----------Speculative Decoding----------")
+    try:
+        from incubator_mxnet_tpu.util import getenv_bool, getenv_int
+        print("knobs        :",
+              {"enabled": getenv_bool("MXNET_SPEC_DECODE"),
+               "k": getenv_int("MXNET_SPEC_K"),
+               "adapt": getenv_bool("MXNET_SPEC_ADAPT"),
+               "accept_floor_pct":
+                   getenv_int("MXNET_SPEC_ACCEPT_FLOOR_PCT")})
+        print("router SLO   :",
+              {"split": getenv_bool("MXNET_ROUTER_SLO_SPLIT"),
+               "ttft_slo_ms": getenv_int("MXNET_ROUTER_TTFT_SLO_MS"),
+               "token_slo_ms": getenv_int("MXNET_ROUTER_TOKEN_SLO_MS")})
+        # in-process probe: the numpy self-draft + adaptive-k policy
+        # over a throwaway toy predictor — no device work, no compiles
+        from incubator_mxnet_tpu.serve.decode import DecodePredictor
+        from incubator_mxnet_tpu.serve.spec_decode import SpecDecoder
+        pred = DecodePredictor.toy(slots=2, page_size=4, num_pages=16,
+                                   max_pages_per_seq=4,
+                                   prompt_buckets=(4,))
+        spec = SpecDecoder(pred, k=4)
+        draft = spec.make_draft([1, 2, 3])
+        drafted = draft.propose(4, 3)
+        draft.sync(3, [4] + drafted[:1])        # reject 2 of 3
+        print("probe        :",
+              {"verify_key": spec._verify_key(),
+               "drafted": len(drafted), "rows_after_sync": draft.rows,
+               "k_walk": [spec.next_k(4, 0.2), spec.next_k(2, 0.95),
+                          spec.next_k(3, 0.7)]})
+        ok = draft.rows == 5
+        print("probe sync   :", "rollback truncated to committed rows"
+              if ok else f"WRONG row count ({draft.rows} != 5)")
+    except Exception as e:
+        print("spec decode probe FAILED:", e)
+
     print("----------Composed Parallelism (pipeline schedules)----------")
     try:
         from incubator_mxnet_tpu.parallel.pipeline import (REMAT_MODES,

@@ -77,6 +77,11 @@ LOCK_ORDER = {
     # lock at admission, free with no lock held at retire).
     "serve/decode.py": ("self._lock", "self._compile_lock",
                         "self._alloc_lock"),
+    # serve/spec_decode: the verify-executable construction lock is the
+    # module's ONLY lock (single-flight cached_jit build, mirroring
+    # DecodePredictor); draft state and adaptive-k live entirely on the
+    # scheduler loop thread and need none.
+    "serve/spec_decode.py": ("self._compile_lock",),
     # kvstore_server: update lock outermost (it serializes pushes, like
     # the reference's executor queue); the heartbeat/liveness registry
     # lock is a LEAF — push refreshes liveness only AFTER releasing the

@@ -201,6 +201,40 @@ def _corpus_disagg_prefill_chunk():
         kv, kv, jax.ShapeDtypeStruct((pred.max_pages_per_seq,), i32))
 
 
+def _corpus_spec_verify():
+    """The speculative-decoding batched-verify executable
+    (serve/spec_decode.SpecDecoder): slots x G token/position blocks
+    scattered into the paged pool + multi-query paged attention over
+    per-row windows, traced via the cached_jit signature path (no
+    compile)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.serve.decode import DecodePredictor
+    from incubator_mxnet_tpu.serve.spec_decode import SpecDecoder
+
+    V, H, D = 32, 2, 8
+    E = H * D
+    rng = np.random.RandomState(0)
+    params = {"emb": rng.randn(V, E).astype(np.float32),
+              "wq": rng.randn(E, E).astype(np.float32),
+              "wk": rng.randn(E, E).astype(np.float32),
+              "wv": rng.randn(E, E).astype(np.float32),
+              "wo": rng.randn(E, E).astype(np.float32),
+              "w_out": rng.randn(E, V).astype(np.float32)}
+    pred = DecodePredictor(params, num_heads=H, head_dim=D, vocab=V,
+                           page_size=4, num_pages=16, slots=2,
+                           max_pages_per_seq=4, prompt_buckets=(4, 8))
+    spec = SpecDecoder(pred, k=3)
+    i32 = jnp.int32
+    kv = jax.ShapeDtypeStruct((pred.num_pages, pred.page_size,
+                               pred.num_heads, pred.head_dim), jnp.float32)
+    sg = jax.ShapeDtypeStruct((pred.slots, spec.width), i32)
+    spec._exec_verify().trace_signature(
+        pred._param_vals, sg, sg, kv, kv,
+        jax.ShapeDtypeStruct((pred.slots, pred.max_pages_per_seq), i32))
+
+
 def entries():
     """name -> builder, in run order."""
     return OrderedDict([
@@ -211,6 +245,7 @@ def entries():
         ("partition_rules", _corpus_partition_rules),
         ("composed_1f1b", _corpus_composed_1f1b),
         ("disagg_prefill_chunk", _corpus_disagg_prefill_chunk),
+        ("spec_verify", _corpus_spec_verify),
     ])
 
 
