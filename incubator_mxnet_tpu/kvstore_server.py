@@ -523,10 +523,16 @@ class AsyncServer:
                 try:
                     if hdr is not None and _prof.attribution_enabled():
                         # handler span linked to the worker-side span id
-                        # carried on the wire (merged-timeline join key)
-                        with _prof.span(f"server:{msg[0]}", args={
-                                "link_trace": hdr.get("trace"),
-                                "link_span": hdr.get("span")}):
+                        # carried on the wire (merged-timeline join key);
+                        # request-trace ids, when riding the envelope,
+                        # become link_req_* args so trace_merge can join
+                        # the store's work to the originating request
+                        args = {"link_trace": hdr.get("trace"),
+                                "link_span": hdr.get("span")}
+                        if hdr.get("req_trace") is not None:
+                            args["link_req_trace"] = hdr["req_trace"]
+                            args["link_req_span"] = hdr.get("req_span")
+                        with _prof.span(f"server:{msg[0]}", args=args):
                             reply = self._handle(msg)
                     else:
                         reply = self._handle(msg)
@@ -609,6 +615,43 @@ def _updater_key(key):
         return int(key)
     except (TypeError, ValueError):
         return key
+
+
+def _reqtrace_fields():
+    """Request-trace wire fields (``req_trace``/``req_span``) or None.
+
+    Looked up via sys.modules so a worker that never imported the serving
+    plane pays nothing; with the MXNET_REQTRACE gate off (or no request
+    in flight on this thread) this returns None and the frame stays the
+    plain pickled tuple.
+    """
+    import sys
+    rt = sys.modules.get(__package__ + ".serve.reqtrace")
+    if rt is None:
+        return None
+    try:
+        return rt.wire_fields() or None
+    except Exception:
+        return None
+
+
+def _wire_envelope(msg):
+    """Wrap the op tuple in the v2 ``("__v2__", hdr, msg)`` envelope when
+    step attribution and/or request tracing is live; with both gates off
+    the plain tuple goes out — byte-identical to a v1 client's frame."""
+    from . import profiler as _prof
+    hdr = None
+    if _prof.attribution_enabled():
+        span = _prof.current_span_id()
+        hdr = {"trace": _prof.trace_id(),
+               "span": span if span is not None else _prof.next_span_id()}
+    req = _reqtrace_fields()
+    if req:
+        if hdr is None:
+            hdr = {"trace": _prof.trace_id(),
+                   "span": _prof.next_span_id()}
+        hdr.update(req)
+    return msg if hdr is None else ("__v2__", hdr, msg)
 
 
 class AsyncClient:
@@ -702,19 +745,12 @@ class AsyncClient:
                               send_dir=b"C", recv_dir=b"S")
 
     def call(self, *msg):
-        from . import profiler as _prof
-        wire = msg
-        if _prof.attribution_enabled():
-            # protocol v2: trace/span header travels INSIDE the pickled
-            # payload so the frame MAC authenticates it; the span id is the
-            # caller's innermost active span (the worker-side pushpull
-            # span), letting the server's handler span link back to it
-            span = _prof.current_span_id()
-            wire = ("__v2__",
-                    {"trace": _prof.trace_id(),
-                     "span": span if span is not None
-                     else _prof.next_span_id()},
-                    msg)
+        # protocol v2: a trace/span header travels INSIDE the pickled
+        # payload so the frame MAC authenticates it; the span id is the
+        # caller's innermost active span (the worker-side pushpull span),
+        # letting the server's handler span link back to it.  Request
+        # traces ride the same envelope as req_trace/req_span fields.
+        wire = _wire_envelope(msg)
         last = None
         reply = None
         with self._lock:

@@ -54,7 +54,7 @@ __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
            "Counter", "Marker", "Domain", "compile_event", "compile_stats",
            "compile_totals", "track_jit", "memory_event", "memory_stats",
            "memory_enabled", "render_prometheus",
-           "span", "observe_phase", "attribution_enabled",
+           "span", "observe_phase", "request_phase", "attribution_enabled",
            "attribution_enable",
            "attribution_reset", "phase_stats", "phase_step_end",
            "last_step_phases", "span_records", "next_span_id", "trace_id",
@@ -569,6 +569,17 @@ def observe_phase(phase, dur_ms, t0=None, args=None):
     if t0 is None:
         t0 = time.perf_counter() - dur_ms / 1e3
     _book_phase(str(phase), t0, float(dur_ms), next_span_id(), None, args)
+
+
+def request_phase(phase, t0, dur_ms, span_id, parent_id, extra):
+    """Book one request-scoped span from serve/reqtrace.py regardless of
+    the MXNET_STEP_ATTRIBUTION gate — the reqtrace layer runs behind its
+    own MXNET_REQTRACE gate and has already decided this record should
+    exist. Shares the phase aggregates, span-id sequence, and (while the
+    profiler is running) the chrome-trace event buffer, so request spans
+    land in the same dump files trace_merge joins."""
+    _book_phase(str(phase), t0, float(dur_ms), int(span_id), parent_id,
+                extra)
 
 
 def _phase_bucket(dur_ms):

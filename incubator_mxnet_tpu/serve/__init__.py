@@ -52,6 +52,17 @@ shape-bucketing discipline):
                 bit-identical to plain decode while amortizing dispatch
                 over k+1 tokens. Per-stream adaptive k from an
                 accept-rate EMA; MXNET_SPEC_DECODE / MXNET_SPEC_K.
+  reqtrace.py   RequestTrace — end-to-end request tracing and TTFT
+                budget attribution across the disaggregated plane: a
+                W3C-traceparent-style context minted at the router,
+                propagated in the X-MXNET-Trace header through /prefill
+                and /generate and inside the MAC'd kvstore wire's v2
+                envelope, booking per-hop spans through the profiler
+                timeline so tools/trace_merge.py stitches one request
+                across router/prefill/decode processes. Head sampling +
+                a tail-exemplar ring (errors and SLO breaches always
+                kept), /debugz/requests, and mxnet_reqtrace_* Prometheus
+                exemplars. MXNET_REQTRACE / _SAMPLE / _RING.
 
 Typical use::
 
@@ -74,6 +85,8 @@ from .prefix_cache import PrefixCache
 from .disagg import (PrefillEngine, PrefillPredictor, fetch_kv_import,
                      ship_key_for)
 from .spec_decode import DraftState, SpecDecoder
+from . import reqtrace
+from .reqtrace import RequestTrace
 
 __all__ = ["Predictor", "BucketLadder", "DynamicBatcher", "ModelServer",
            "ServingStats", "LatencyHistogram", "Overloaded",
@@ -82,4 +95,5 @@ __all__ = ["Predictor", "BucketLadder", "DynamicBatcher", "ModelServer",
            "NoReplicaAvailable", "DecodePredictor", "DecodeScheduler",
            "DecodeStream", "PageAllocator", "PrefixCache",
            "PrefillPredictor", "PrefillEngine", "ship_key_for",
-           "fetch_kv_import", "SpecDecoder", "DraftState"]
+           "fetch_kv_import", "SpecDecoder", "DraftState",
+           "reqtrace", "RequestTrace"]

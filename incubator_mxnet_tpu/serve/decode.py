@@ -57,6 +57,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from .. import util
+from . import reqtrace as _rt
 from .batcher import DeadlineExceeded, Overloaded
 from .predictor import BucketLadder
 from .stats import ServingStats
@@ -473,6 +474,10 @@ class DecodeStream:
         self._pages_needed = 0
         self._last_t = None
         self._kv_import = None
+        # request tracing (serve/reqtrace.py): the router-minted context
+        # and the scheduler-measured TTFT budget components
+        self._trace = None
+        self._budget = None
         # speculative-decode state (spec schedulers only)
         self._draft = None
         self._spec_k = 0
@@ -663,7 +668,7 @@ class DecodeScheduler:
         return False
 
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_ms=None, kv_import=None):
+               deadline_ms=None, kv_import=None, trace=None):
         """Queue one generation; returns a DecodeStream immediately.
 
         Sheds (Overloaded, 503-retryable) rather than queueing into
@@ -682,6 +687,10 @@ class DecodeScheduler:
         then writes the shipped rows into freshly allocated pages and
         starts decoding at position ``n`` — no local prefill, no
         ladder constraint on the prompt.
+
+        ``trace`` (a reqtrace.RequestTrace, or None) rides the stream so
+        admission books ``decode_admission``/``first_step`` spans and
+        the TTFT budget components against the request's trace id.
         """
         prompt = [int(t) for t in prompt]
         if not prompt:
@@ -719,6 +728,7 @@ class DecodeScheduler:
             st = DecodeStream(prompt, max_new, eos_id, deadline)
             st._pages_needed = pages_needed
             st._kv_import = kv_import
+            st._trace = trace
             self._waiting.append(st)
             self.stats.incr("requests_total")
             self.stats.incr("decode_streams_total")
@@ -891,7 +901,26 @@ class DecodeScheduler:
                 self._tokens[slot] = nxt
                 self._active[slot] = st
             st._deliver(nxt, now)
-            self.stats.ttft.observe(now - st.submit_t)
+            self.stats.ttft.observe(
+                now - st.submit_t,
+                trace=st._trace.trace_id if st._trace is not None
+                and st._trace.sampled else None)
+            if st._trace is not None:
+                # scheduler-side TTFT budget: queue wait + admission
+                # device work + the residual (bookkeeping, draft seeding,
+                # delivery) as first_step; the server's done row merges
+                # these with the router-side legs
+                ttft_ms = (now - st.submit_t) * 1e3
+                queue_ms = queue_wait * 1e3
+                admission_ms = (now - t0) * 1e3
+                first_step_ms = max(0.0, ttft_ms - queue_ms - admission_ms)
+                st._budget = {"queue_ms": round(queue_ms, 3),
+                              "admission_ms": round(admission_ms, 3),
+                              "first_step_ms": round(first_step_ms, 3)}
+                _rt.observe(st._trace, "decode_admission", admission_ms,
+                            args={"mode": plan["mode"],
+                                  "pages": len(plan["pages"])})
+                _rt.observe(st._trace, "first_step", first_step_ms)
             self.stats.incr("decode_tokens_total")
             if (len(st._tokens) >= st.max_new_tokens
                     or nxt == st.eos_id or st._cancelled):
@@ -967,6 +996,13 @@ class DecodeScheduler:
             tokens = self._tokens.copy()
             positions = self._positions.copy()
             page_tables = self._page_tables.copy()
+        if _rt.enabled():
+            # fault-site breadcrumb carries the active request trace ids
+            # so a kill -9 postmortem joins the request trace
+            traces = [st._trace.trace_id for _, st in active
+                      if st._trace is not None]
+            if traces:
+                fault.flight_record("decode_step", traces=traces)
         fault.inject("decode")
         t0 = time.monotonic()
         nxt, kp, vp = self.predictor.decode(
@@ -1046,10 +1082,21 @@ class DecodeScheduler:
                 tokens[i, j + 1] = dt
                 positions[i, j + 1] = p0 + j + 1
         self.stats.spec_draft_time.observe(time.monotonic() - t_draft)
+        rt_ctxs = ()
+        if _rt.enabled():
+            rt_ctxs = [st._trace for _, st in active
+                       if st._trace is not None]
+            if rt_ctxs:
+                # fault-site breadcrumb: the verify kill drill's
+                # postmortem joins the request trace by these ids
+                fault.flight_record(
+                    "spec_verify",
+                    traces=[c.trace_id for c in rt_ctxs])
         fault.inject("verify")
         t0v = time.monotonic()
         y, kp, vp = spec.verify(tokens, positions, self._k_pages,
-                                self._v_pages, page_tables)
+                                self._v_pages, page_tables,
+                                traces=rt_ctxs)
         self._k_pages, self._v_pages = kp, vp
         now = time.monotonic()
         step_s = now - t0v

@@ -46,6 +46,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from .. import util
+from . import reqtrace as _rt
 from .stats import ServingStats
 
 __all__ = ["PrefillPredictor", "PrefillEngine", "ship_key_for",
@@ -190,10 +191,12 @@ class PrefillPredictor:
         seg = prompt[start:start + self.chunk]
         toks[0, :len(seg)] = seg
         fn = self._exec_chunk()
-        nxt, kp, vp = fn(self.predictor._param_vals, jnp.asarray(toks),
-                         jnp.asarray(start, jnp.int32),
-                         jnp.asarray(n, jnp.int32), k_pages, v_pages,
-                         jnp.asarray(ptrow, jnp.int32))
+        with _rt.span("prefill_chunk", args={"start": int(start),
+                                             "tokens": int(len(seg))}):
+            nxt, kp, vp = fn(self.predictor._param_vals, jnp.asarray(toks),
+                             jnp.asarray(start, jnp.int32),
+                             jnp.asarray(n, jnp.int32), k_pages, v_pages,
+                             jnp.asarray(ptrow, jnp.int32))
         self._warm = True
         _bump("chunks_total")
         return int(nxt), kp, vp
@@ -324,10 +327,11 @@ class PrefillEngine:
         the MAC'd wire (kvstore.ship_kv_pages / flat-packer). Returns
         the server receipt."""
         from .. import kvstore as _kv
-        receipt = _kv.ship_kv_pages(
-            client, key, export["k_rows"], export["v_rows"],
-            meta={"n": export["n"], "next_token": export["next_token"],
-                  "page_size": self.predictor.page_size})
+        with _rt.span("kv_ship", args={"pages": len(export["k_rows"])}):
+            receipt = _kv.ship_kv_pages(
+                client, key, export["k_rows"], export["v_rows"],
+                meta={"n": export["n"], "next_token": export["next_token"],
+                      "page_size": self.predictor.page_size})
         _bump("pages_shipped", len(export["k_rows"]))
         _bump("bytes_shipped", int(receipt.get("bytes", 0)))
         return receipt

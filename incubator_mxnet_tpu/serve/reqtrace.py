@@ -1,0 +1,487 @@
+"""Request-scoped tracing across the disaggregated serving plane.
+
+The fleet telemetry from earlier rounds (phase histograms, serving
+quantiles) explains *populations* of requests; this module explains one
+request.  A ``RequestTrace`` context — W3C-traceparent-style trace id,
+root span id, sampled bit, and deadline baggage — is minted at
+``Router.generate``/``Router.request``, propagated over HTTP in the
+``X-MXNET-Trace`` header through ``/prefill`` and ``/generate`` (every
+retry and hedge attempt a distinct child span), and rides the v2
+``{trace, span}`` envelope inside the MAC'd kvstore wire for
+``kv_page_put``/``kv_page_get``, so a single trace id stitches the
+router, prefill, and decode processes together.
+
+Each hop books chrome-trace X spans through the profiler StepTimeline
+machinery (``router_queue``, ``route_attempt#n``, ``hedge``,
+``prefill_chunk``, ``kv_ship``, ``decode_admission``, ``first_step``,
+``spec_verify``) carrying ``req_trace``/``req_span``/``req_parent``
+args that ``tools/trace_merge.py`` joins onto the shared wall clock and
+``tools/validate_trace.py`` schema-checks.
+
+Gate discipline (the PR-10/11 cached-bool idiom): everything here is
+behind ``MXNET_REQTRACE``.  With the gate off, ``mint`` returns None,
+``span``/``span_for`` return a shared null span, no header is attached,
+the kvstore wire frame stays the plain pickled tuple (byte-identical to
+a build without this module), and ``record_count()`` stays exactly 0 —
+tests assert the counter, not just wall-clock deltas.  Head sampling is
+per-mille via ``MXNET_REQTRACE_SAMPLE``; a bounded tail-exemplar ring
+(``MXNET_REQTRACE_RING``) always promotes error or SLO-breaching
+requests even when head sampling skipped them, and is exposed at
+``/debugz/requests`` and joined to flight-recorder postmortems via the
+trace id carried on breadcrumbs.
+
+Lock hierarchy: the module ``_lock`` is a leaf — it guards the record
+counter and the exemplar rings and is never held across profiler, I/O,
+or other-module calls (``lock_order.py`` declares this).  Span booking
+takes ``profiler._lock`` internally *after* ``_lock`` is released.
+
+See ``docs/architecture/note_request_tracing.md``.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+
+from .. import profiler as _prof
+from ..util import getenv_bool, getenv_int
+
+__all__ = [
+    "TRACE_HEADER", "RequestTrace", "enabled", "enable", "reset",
+    "record_count", "mint", "activate", "current", "current_trace_id",
+    "span", "span_for", "observe", "attempt", "finish", "promote",
+    "wire_fields",
+    "to_header", "from_header", "ring_snapshot", "slowest",
+    "render_prometheus",
+]
+
+TRACE_HEADER = "X-MXNET-Trace"
+
+_lock = threading.Lock()        # leaf: counter + rings only
+_tls = threading.local()        # .ctx = active RequestTrace, .stack = span ids
+
+_enabled = None                 # cached MXNET_REQTRACE bool (None = unread)
+_records = 0                    # spans + ring rows booked; 0 while gate off
+_requests = 0                   # finish() calls (the per-request counter)
+_ring = None                    # deque of recent sampled request summaries
+_exemplars = None               # deque of error / SLO-breach promotions
+_rng = random.Random()          # head-sampling dice (per-process)
+
+
+# ---------------------------------------------------------------------------
+# gate (cached bool, force-override for tests, reset forgets everything)
+# ---------------------------------------------------------------------------
+
+def enabled():
+    """Cached ``MXNET_REQTRACE`` gate — the env var is read once."""
+    global _enabled
+    if _enabled is None:
+        _enabled = getenv_bool("MXNET_REQTRACE")
+    return _enabled
+
+
+def enable(on=True):
+    """Force the gate (tests / diagnose probes). Returns the previous
+    cached value (None if the env var had not been consulted yet)."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(on)
+    return prev
+
+
+def reset():
+    """Forget the cached gate and drop all tracing state."""
+    global _enabled, _records, _requests, _ring, _exemplars
+    with _lock:
+        _enabled = None
+        _records = 0
+        _requests = 0
+        _ring = None
+        _exemplars = None
+
+
+def record_count():
+    """Total reqtrace records booked (spans + ring rows). Exactly 0 while
+    the gate is off — the zero-overhead assert counts records, it does
+    not time anything."""
+    with _lock:
+        return _records
+
+
+# ---------------------------------------------------------------------------
+# context
+# ---------------------------------------------------------------------------
+
+class RequestTrace:
+    """One request's identity: 128-bit trace id, the root span id minted
+    alongside it, the head-sampling decision, and deadline baggage."""
+
+    __slots__ = ("trace_id", "span_id", "sampled", "deadline_ms",
+                 "baggage", "t0", "first_token_t", "budget")
+
+    def __init__(self, trace_id, span_id, sampled, deadline_ms=None,
+                 baggage=None):
+        self.trace_id = trace_id
+        self.span_id = int(span_id)
+        self.sampled = bool(sampled)
+        self.deadline_ms = deadline_ms
+        self.baggage = dict(baggage) if baggage else {}
+        self.t0 = time.perf_counter()
+        self.first_token_t = None
+        self.budget = None      # done-row TTFT breakdown, once known
+
+    def mark_first_token(self):
+        if self.first_token_t is None:
+            self.first_token_t = time.perf_counter()
+
+    @property
+    def ttft_ms(self):
+        if self.first_token_t is None:
+            return None
+        return (self.first_token_t - self.t0) * 1e3
+
+    def __repr__(self):
+        return (f"RequestTrace({self.trace_id}, span={self.span_id}, "
+                f"sampled={self.sampled})")
+
+
+def mint(deadline_ms=None):
+    """Mint a new request context at the router edge. Returns None when
+    the gate is off. The head-sampling decision (``MXNET_REQTRACE_SAMPLE``
+    per-mille) is taken here and travels with the context: an unsampled
+    request emits no spans anywhere, but still carries an id so the
+    tail-exemplar ring can promote it if it errors or breaches SLO."""
+    if not enabled():
+        return None
+    per_mille = max(0, min(1000, getenv_int("MXNET_REQTRACE_SAMPLE")))
+    sampled = _rng.randrange(1000) < per_mille
+    return RequestTrace(f"{_rng.getrandbits(128):032x}",
+                        _prof.next_span_id(), sampled,
+                        deadline_ms=deadline_ms)
+
+
+class _Activation:
+    __slots__ = ("ctx", "prev")
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def __enter__(self):
+        self.prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, exc_type, exc, tb):
+        _tls.ctx = self.prev
+        return False
+
+
+def activate(ctx):
+    """Make ``ctx`` the thread's active request context for the duration
+    of the with-block. ``ctx`` may be None (deactivates)."""
+    return _Activation(ctx)
+
+
+def current():
+    """The thread's active RequestTrace, or None."""
+    if not enabled():
+        return None
+    return getattr(_tls, "ctx", None)
+
+
+def current_trace_id():
+    """Trace id of the active context (sampled or not) — breadcrumb
+    helper so flight-recorder rows can join a postmortem to the request
+    trace. None when the gate is off or no context is active."""
+    ctx = current()
+    return None if ctx is None else ctx.trace_id
+
+
+# ---------------------------------------------------------------------------
+# header codec (W3C traceparent-shaped, plus `;k=v` baggage)
+# ---------------------------------------------------------------------------
+
+def to_header(ctx, **baggage):
+    """``00-<trace32>-<span16>-<flags>`` plus ``;key=value`` baggage.
+    Numeric baggage (deadline_ms, router_ms, prefill_ms, ship_ms) is
+    rendered with millisecond precision to 3 decimals."""
+    flags = "01" if ctx.sampled else "00"
+    parts = [f"00-{ctx.trace_id}-{ctx.span_id & 0xffffffffffffffff:016x}"
+             f"-{flags}"]
+    items = {}
+    if ctx.deadline_ms is not None:
+        items["deadline_ms"] = ctx.deadline_ms
+    items.update(ctx.baggage)
+    items.update({k: v for k, v in baggage.items() if v is not None})
+    for k in sorted(items):
+        v = items[k]
+        parts.append(f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}")
+    return ";".join(parts)
+
+
+def from_header(value):
+    """Parse an ``X-MXNET-Trace`` header back into a RequestTrace.
+    Malformed input returns None — tracing never breaks serving."""
+    if not value or not enabled():
+        return None
+    try:
+        fields = value.split(";")
+        ver, tid, sid, flags = fields[0].split("-")
+        if ver != "00" or len(tid) != 32 or len(sid) != 16:
+            return None
+        int(tid, 16)
+        baggage, deadline = {}, None
+        for item in fields[1:]:
+            k, _, v = item.partition("=")
+            if not _ or not k:
+                return None
+            if k == "deadline_ms":
+                deadline = float(v)
+            else:
+                try:
+                    baggage[k] = float(v)
+                except ValueError:
+                    baggage[k] = v
+        return RequestTrace(tid, int(sid, 16), int(flags, 16) & 1,
+                            deadline_ms=deadline, baggage=baggage)
+    except (ValueError, IndexError):
+        return None
+
+
+def wire_fields():
+    """Header dict fields for the kvstore v2 envelope — ``req_trace`` and
+    ``req_span`` — or None when there is nothing to propagate. The caller
+    (kvstore_server.AsyncClient) only wraps the frame when this (or step
+    attribution) is active, keeping the gate-off wire byte-identical."""
+    if not enabled():
+        return None
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None or not ctx.sampled:
+        return None
+    stack = getattr(_tls, "stack", None)
+    return {"req_trace": ctx.trace_id,
+            "req_span": stack[-1] if stack else ctx.span_id}
+
+
+# ---------------------------------------------------------------------------
+# span emission (books through the profiler StepTimeline machinery)
+# ---------------------------------------------------------------------------
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("ctx", "phase", "args", "t0", "sid", "parent")
+
+    def __init__(self, ctx, phase, args):
+        self.ctx = ctx
+        self.phase = str(phase)
+        self.args = args
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self.parent = stack[-1] if stack else None
+        self.sid = _prof.next_span_id()
+        stack.append(self.sid)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur_ms = (time.perf_counter() - self.t0) * 1e3
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] == self.sid:
+            stack.pop()
+        _emit(self.ctx, self.phase, self.t0, dur_ms, self.sid,
+              self.parent, self.args)
+        return False
+
+
+def span(phase, args=None):
+    """Context-managed span against the thread's active request context.
+    Shared null span (no allocation beyond one _Span) when the gate is
+    off, no context is active, or the request is head-unsampled."""
+    if not enabled():
+        return _NULL_SPAN
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None or not ctx.sampled:
+        return _NULL_SPAN
+    return _Span(ctx, phase, args)
+
+
+def span_for(ctx, phase, args=None):
+    """Explicit-context span for threads where the request is not
+    thread-local active (the decode scheduler loop owns many streams)."""
+    if ctx is None or not ctx.sampled or not enabled():
+        return _NULL_SPAN
+    return _Span(ctx, phase, args)
+
+
+def observe(ctx, phase, dur_ms, t0=None, args=None):
+    """Book an externally measured request span (the queue-wait /
+    first-step pattern: the interval was measured by other code)."""
+    if ctx is None or not ctx.sampled or not enabled():
+        return
+    if t0 is None:
+        t0 = time.perf_counter() - dur_ms / 1e3
+    _emit(ctx, str(phase), t0, float(dur_ms), _prof.next_span_id(),
+          None, args)
+
+
+def attempt(ctx, n, cause, dur_ms, t0=None, hedged=False, replica=None):
+    """One router attempt as a child span: ``route_attempt#<n>`` with a
+    ``cause`` arg (ok, connect-error, 503-shed, hedge-win, ...) so
+    discarded-attempt accounting is trace-visible."""
+    args = {"cause": str(cause)}
+    if hedged:
+        args["hedged"] = True
+    if replica is not None:
+        args["replica"] = replica
+    observe(ctx, f"route_attempt#{int(n)}", dur_ms, t0=t0, args=args)
+
+
+def _emit(ctx, phase, t0, dur_ms, sid, parent, args):
+    global _records
+    extra = {"req_trace": ctx.trace_id, "req_span": sid,
+             "req_parent": parent if parent is not None else ctx.span_id}
+    if ctx.deadline_ms is not None:
+        extra["deadline_ms"] = ctx.deadline_ms
+    if args:
+        extra.update(args)
+    # parent links stay local to this process: cross-process lineage is
+    # expressed via req_parent (the minted root span id), never via the
+    # profiler's containment-checked `parent` arg
+    _prof.request_phase(phase, t0, dur_ms, sid, parent, extra)
+    with _lock:
+        _records += 1
+
+
+# ---------------------------------------------------------------------------
+# tail-exemplar ring
+# ---------------------------------------------------------------------------
+
+def _rings_locked():
+    global _ring, _exemplars
+    if _ring is None:
+        cap = max(getenv_int("MXNET_REQTRACE_RING"), 4)
+        _ring = collections.deque(maxlen=cap)
+        _exemplars = collections.deque(maxlen=cap)
+    return _ring, _exemplars
+
+
+def finish(ctx, status="ok", cause=None, ttft_ms=None, total_ms=None,
+           budget=None, slo_ms=None):
+    """Record a request outcome. Sampled requests land in the recent
+    ring; error or SLO-breaching requests are *always* promoted to the
+    exemplar ring, head sampling notwithstanding — the tail is exactly
+    what aggregate histograms cannot explain."""
+    global _records, _requests
+    if ctx is None or not enabled():
+        return
+    breach = bool(slo_ms is not None and ttft_ms is not None
+                  and ttft_ms > slo_ms)
+    rec = {"trace": ctx.trace_id, "status": str(status), "t": time.time(),
+           "sampled": ctx.sampled}
+    if cause is not None:
+        rec["cause"] = str(cause)
+    if ttft_ms is not None:
+        rec["ttft_ms"] = round(float(ttft_ms), 3)
+    if total_ms is not None:
+        rec["total_ms"] = round(float(total_ms), 3)
+    if budget is not None:
+        rec["budget"] = dict(budget)
+    if ctx.deadline_ms is not None:
+        rec["deadline_ms"] = ctx.deadline_ms
+    if breach:
+        rec["slo_breach"] = True
+    with _lock:
+        ring, exemplars = _rings_locked()
+        if ctx.sampled:
+            ring.append(rec)
+        if status != "ok" or breach:
+            exemplars.append(rec)
+        _records += 1
+        _requests += 1
+
+
+def promote(ctx, cause, detail=None):
+    """Promote a failed ATTEMPT to the exemplar ring immediately, head
+    sampling notwithstanding. A whole-stream retry may still win the
+    request, but the kill -9 postmortem on the replica that cut the
+    stream needs this row to join the trace — waiting for the request's
+    final outcome would lose the evidence."""
+    global _records
+    if ctx is None or not enabled():
+        return
+    rec = {"trace": ctx.trace_id, "status": "error", "cause": str(cause),
+           "t": time.time(), "sampled": ctx.sampled,
+           "elapsed_ms": round((time.perf_counter() - ctx.t0) * 1e3, 3)}
+    if detail is not None:
+        rec["detail"] = str(detail)[:200]
+    with _lock:
+        _, exemplars = _rings_locked()
+        exemplars.append(rec)
+        _records += 1
+
+
+def ring_snapshot():
+    """The ``/debugz/requests`` payload: both rings plus occupancy."""
+    with _lock:
+        if _ring is None:
+            return {"enabled": bool(_enabled), "capacity": 0,
+                    "recent": [], "exemplars": []}
+        return {"enabled": bool(_enabled), "capacity": _ring.maxlen,
+                "recent": list(_ring), "exemplars": list(_exemplars)}
+
+
+def slowest(k=5):
+    """Slowest-k finished requests across both rings (dedup by trace id,
+    sorted by total_ms falling back to ttft_ms) — the diagnose view."""
+    snap = ring_snapshot()
+    by_trace = {}
+    for rec in snap["recent"] + snap["exemplars"]:
+        by_trace[rec["trace"]] = rec
+    key = lambda r: r.get("total_ms") or r.get("ttft_ms") or 0.0  # noqa: E731
+    return sorted(by_trace.values(), key=key, reverse=True)[:max(int(k), 0)]
+
+
+def render_prometheus(labels=""):
+    """``mxnet_reqtrace_*`` text-format families. Conditional like the
+    spec-decode families: empty string until the first record exists, so
+    a gate-off scrape is byte-identical to earlier rounds."""
+    with _lock:
+        records, requests = _records, _requests
+        recent = len(_ring) if _ring is not None else 0
+        exemplars = len(_exemplars) if _exemplars is not None else 0
+        cap = _ring.maxlen if _ring is not None else 0
+    if records == 0:
+        return ""
+    lab = f"{{{labels}}}" if labels else ""
+    lines = [
+        "# TYPE mxnet_reqtrace_records_total counter",
+        f"mxnet_reqtrace_records_total{lab} {records}",
+        "# TYPE mxnet_reqtrace_requests_total counter",
+        f"mxnet_reqtrace_requests_total{lab} {requests}",
+        "# TYPE mxnet_reqtrace_ring_occupancy gauge",
+    ]
+    sep = "," if labels else ""
+    lines.append(f'mxnet_reqtrace_ring_occupancy{{{labels}{sep}'
+                 f'ring="recent"}} {recent}')
+    lines.append(f'mxnet_reqtrace_ring_occupancy{{{labels}{sep}'
+                 f'ring="exemplar"}} {exemplars}')
+    lines.append("# TYPE mxnet_reqtrace_ring_capacity gauge")
+    lines.append(f"mxnet_reqtrace_ring_capacity{lab} {cap}")
+    return "\n".join(lines) + "\n"

@@ -31,6 +31,13 @@ tests and single-host use.
 Lock discipline: ``self._rlock`` guards the replica table + breakers
 and is OUTERMOST; RouterStats' ``self._lock`` is a LEAF — stats calls
 and breadcrumbs happen after _rlock is released.
+
+Request tracing (MXNET_REQTRACE, serve/reqtrace.py): ``generate`` and
+``request`` mint the trace context, every retry/hedge attempt books a
+``route_attempt#n`` child span with a ``cause`` arg, the context rides
+outbound calls in the ``X-MXNET-Trace`` header, breaker breadcrumbs
+carry the active trace id, and the ``/generate`` done row's TTFT budget
+breakdown is folded back into the request's ring record.
 """
 from __future__ import annotations
 
@@ -48,8 +55,9 @@ import numpy as _np
 from .. import fault as _fault
 from ..base import MXNetError
 from ..util import getenv_bool, getenv_int
+from . import reqtrace as _rt
 from .batcher import DeadlineExceeded, Overloaded
-from .stats import LatencyHistogram
+from .stats import LatencyHistogram, reqtrace_exemplar_lines
 
 __all__ = ["Router", "RouterStats", "RouteError", "NoReplicaAvailable"]
 
@@ -71,6 +79,16 @@ class NoReplicaAvailable(MXNetError):
     open); retryable — the fleet may be mid-rollout or mid-recovery."""
     retryable = True
     status = 503
+
+
+def _cause_of(kind, value):
+    """Map an attempt outcome to the reqtrace span `cause` vocabulary:
+    ok / fatal / 503-shed / connect-error."""
+    if kind == "ok":
+        return "ok"
+    if kind == "fatal":
+        return "fatal"
+    return "503-shed" if isinstance(value, Overloaded) else "connect-error"
 
 
 class RouterStats:
@@ -132,6 +150,8 @@ class RouterStats:
                   f'{h["count"]}',
                   f'{fam}_sum{{router="{self.name}"}} {h["sum"] * 1e3:.6g}',
                   f'{fam}_count{{router="{self.name}"}} {h["count"]}']
+        lines += reqtrace_exemplar_lines(
+            self.latency, f'router="{self.name}"', "request_latency")
         return "\n".join(lines) + "\n"
 
 
@@ -439,8 +459,11 @@ class Router:
 
     def _record_transition(self, rid, transition):
         self.stats.incr(f"breaker_{transition}_total")
+        # the active request trace id (if any) rides the breadcrumb so a
+        # kill -9 postmortem joins the request trace by trace_id
         _fault.flight_record("router_breaker", router=self.stats.name,
-                             replica=rid, transition=transition)
+                             replica=rid, transition=transition,
+                             trace=_rt.current_trace_id())
         _log.warning("router[%s] breaker %s -> %s",
                      self.stats.name, rid, transition)
 
@@ -497,48 +520,78 @@ class Router:
         deadline = time.monotonic() + deadline_ms / 1e3
         inputs_json = {k: _np.asarray(v).tolist() for k, v in inputs.items()}
         self.stats.incr("requests_total")
+        ctx = _rt.mint(deadline_ms=deadline_ms)
         t0 = time.monotonic()
         last_err = None
-        for attempt in range(self._retries + 1):
-            if attempt:
-                self.stats.incr("retries_total")
-                pause = self._backoff_s(
-                    attempt, deadline,
-                    retry_after=getattr(last_err, "retry_after_s", None))
-                if pause > 0:
-                    time.sleep(pause)
-            if time.monotonic() >= deadline:
-                break
-            cands = self._candidates()
-            if not cands:
-                self.stats.incr("no_replica_total")
-                last_err = NoReplicaAvailable(
-                    f"no ready replica for model {self._model!r}")
-                continue
-            kind, value = self._attempt(cands, inputs_json, deadline)
-            if kind == "ok":
-                self.stats.latency.observe(time.monotonic() - t0)
-                self.stats.incr("responses_ok_total")
-                return value
-            if kind == "fatal":
-                self.stats.incr("responses_fatal_total")
-                raise value
-            last_err = value
+        with _rt.activate(ctx):
+            for attempt in range(self._retries + 1):
+                qt0 = time.perf_counter()
+                if attempt:
+                    self.stats.incr("retries_total")
+                    pause = self._backoff_s(
+                        attempt, deadline,
+                        retry_after=getattr(last_err, "retry_after_s", None))
+                    if pause > 0:
+                        time.sleep(pause)
+                if time.monotonic() >= deadline:
+                    break
+                cands = self._candidates()
+                if not cands:
+                    self.stats.incr("no_replica_total")
+                    last_err = NoReplicaAvailable(
+                        f"no ready replica for model {self._model!r}")
+                    continue
+                if ctx is not None:
+                    _rt.observe(ctx, "router_queue",
+                                (time.perf_counter() - qt0) * 1e3, t0=qt0)
+                kind, value = self._attempt(cands, inputs_json, deadline,
+                                            attempt_no=attempt)
+                if kind == "ok":
+                    dt = time.monotonic() - t0
+                    self.stats.latency.observe(
+                        dt, trace=ctx.trace_id
+                        if ctx is not None and ctx.sampled else None)
+                    self.stats.incr("responses_ok_total")
+                    if ctx is not None:
+                        _rt.finish(ctx, status="ok", total_ms=dt * 1e3)
+                    return value
+                if ctx is not None:
+                    _rt.promote(ctx, cause=_cause_of(kind, value),
+                                detail=value)
+                if kind == "fatal":
+                    self.stats.incr("responses_fatal_total")
+                    _rt.finish(ctx, status="error", cause="fatal")
+                    raise value
+                last_err = value
         self.stats.incr("requests_failed_total")
+        _rt.finish(ctx, status="error", cause="retries-exhausted")
         if isinstance(last_err, MXNetError):
             raise last_err
         raise DeadlineExceeded(
             f"router deadline {deadline_ms}ms exhausted "
             f"({self._retries} retries)")
 
-    def _attempt(self, cands, inputs_json, deadline):
+    def _attempt(self, cands, inputs_json, deadline, attempt_no=0):
         """One (possibly hedged) attempt against up to two replicas.
         Returns ("ok", outputs) | ("retryable", err) | ("fatal", err)."""
         results = queue.Queue()
+        ctx = _rt.current()
 
         def run(rid, addr, hedged):
-            results.put((self._one_call(rid, addr, inputs_json, deadline),
-                         rid, hedged))
+            # worker threads don't inherit thread-locals: re-activate the
+            # request context so the outbound call carries the header and
+            # the per-attempt span books against the right trace
+            with _rt.activate(ctx):
+                at0 = time.perf_counter()
+                out = self._one_call(rid, addr, inputs_json, deadline)
+                if ctx is not None:
+                    akind, avalue = out
+                    cause = ("hedge-win" if hedged and akind == "ok"
+                             else _cause_of(akind, avalue))
+                    _rt.attempt(ctx, attempt_no, cause,
+                                (time.perf_counter() - at0) * 1e3, t0=at0,
+                                hedged=hedged, replica=rid)
+                results.put((out, rid, hedged))
 
         threading.Thread(target=run, args=(*cands[0], False),
                          daemon=True).start()
@@ -562,6 +615,9 @@ class Router:
                     hedge_fired = True
                     outstanding += 1
                     self.stats.incr("hedges_total")
+                    if ctx is not None:
+                        _rt.observe(ctx, "hedge", wait * 1e3,
+                                    args={"replica": cands[1][0]})
                     threading.Thread(target=run, args=(*cands[1], True),
                                      daemon=True).start()
                 continue
@@ -602,40 +658,62 @@ class Router:
             deadline_ms = self._deadline_ms
         deadline = time.monotonic() + deadline_ms / 1e3
         self.stats.incr("requests_total")
+        ctx = _rt.mint(deadline_ms=deadline_ms)
         t0 = time.monotonic()
         last_err = None
-        for attempt in range(self._retries + 1):
-            if attempt:
-                self.stats.incr("retries_total")
-                pause = self._backoff_s(
-                    attempt, deadline,
-                    retry_after=getattr(last_err, "retry_after_s", None))
-                if pause > 0:
-                    time.sleep(pause)
-            if time.monotonic() >= deadline:
-                break
-            if self._has_dedicated_prefill():
-                kind, value = self._split_stream(prompt, max_new_tokens,
-                                                 deadline)
-            else:
-                cands = self._candidates(role="decode")
-                if not cands:
-                    self.stats.incr("no_replica_total")
-                    last_err = NoReplicaAvailable(
-                        f"no ready replica for model {self._model!r}")
-                    continue
-                kind, value = self._one_stream(
-                    cands[0][0], cands[0][1], prompt, max_new_tokens,
-                    deadline)
-            if kind == "ok":
-                self.stats.latency.observe(time.monotonic() - t0)
-                self.stats.incr("responses_ok_total")
-                return value
-            if kind == "fatal":
-                self.stats.incr("responses_fatal_total")
-                raise value
-            last_err = value
+        with _rt.activate(ctx):
+            for attempt in range(self._retries + 1):
+                qt0 = time.perf_counter()
+                if attempt:
+                    self.stats.incr("retries_total")
+                    pause = self._backoff_s(
+                        attempt, deadline,
+                        retry_after=getattr(last_err, "retry_after_s", None))
+                    if pause > 0:
+                        time.sleep(pause)
+                if time.monotonic() >= deadline:
+                    break
+                if ctx is not None:
+                    _rt.observe(ctx, "router_queue",
+                                (time.perf_counter() - qt0) * 1e3, t0=qt0)
+                at0 = time.perf_counter()
+                if self._has_dedicated_prefill():
+                    kind, value = self._split_stream(prompt, max_new_tokens,
+                                                     deadline)
+                else:
+                    cands = self._candidates(role="decode")
+                    if not cands:
+                        self.stats.incr("no_replica_total")
+                        last_err = NoReplicaAvailable(
+                            f"no ready replica for model {self._model!r}")
+                        continue
+                    kind, value = self._one_stream(
+                        cands[0][0], cands[0][1], prompt, max_new_tokens,
+                        deadline)
+                if ctx is not None:
+                    _rt.attempt(ctx, attempt, _cause_of(kind, value),
+                                (time.perf_counter() - at0) * 1e3, t0=at0)
+                if kind == "ok":
+                    dt = time.monotonic() - t0
+                    self.stats.latency.observe(
+                        dt, trace=ctx.trace_id
+                        if ctx is not None and ctx.sampled else None)
+                    self.stats.incr("responses_ok_total")
+                    if ctx is not None:
+                        _rt.finish(ctx, status="ok", ttft_ms=ctx.ttft_ms,
+                                   total_ms=dt * 1e3, budget=ctx.budget,
+                                   slo_ms=self._ttft_slo_ms)
+                    return value
+                if ctx is not None:
+                    _rt.promote(ctx, cause=_cause_of(kind, value),
+                                detail=value)
+                if kind == "fatal":
+                    self.stats.incr("responses_fatal_total")
+                    _rt.finish(ctx, status="error", cause="fatal")
+                    raise value
+                last_err = value
         self.stats.incr("requests_failed_total")
+        _rt.finish(ctx, status="error", cause="retries-exhausted")
         if isinstance(last_err, MXNetError):
             raise last_err
         raise DeadlineExceeded(
@@ -707,15 +785,25 @@ class Router:
         body = json.dumps({"prompt": [int(t) for t in prompt],
                            "ship": True,
                            "ship_key": ship_key}).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        ctx = _rt.current()
+        if ctx is not None:
+            headers[_rt.TRACE_HEADER] = _rt.to_header(ctx)
         try:
             _fault.inject("route")      # MXNET_FAULT_INJECT: route@n
             req = urllib.request.Request(
                 f"http://{addr}/prefill", data=body,
-                headers={"Content-Type": "application/json"},
-                method="POST")
+                headers=headers, method="POST")
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 payload = json.loads(r.read().decode("utf-8"))
             self._note_result(rid, True)
+            if ctx is not None:
+                # the replica's measured prefill/ship legs become baggage
+                # on the /generate header so the decode side can complete
+                # the TTFT budget breakdown
+                for leg in ("prefill_ms", "ship_ms"):
+                    if payload.get(leg) is not None:
+                        ctx.baggage[leg] = float(payload[leg])
             return ("ok", payload)
         except urllib.error.HTTPError as e:
             try:
@@ -766,23 +854,37 @@ class Router:
         if ship_key is not None:
             req_body["ship_key"] = ship_key
         body = json.dumps(req_body).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        ctx = _rt.current()
+        if ctx is not None:
+            # router_ms = everything this router spent so far that is NOT
+            # the prefill/ship legs already attributed by the prefill
+            # replica (candidate selection, backoff, failed attempts)
+            elapsed = (time.perf_counter() - ctx.t0) * 1e3
+            legs = sum(ctx.baggage.get(k, 0.0)
+                       for k in ("prefill_ms", "ship_ms"))
+            headers[_rt.TRACE_HEADER] = _rt.to_header(
+                ctx, router_ms=max(0.0, elapsed - legs))
         tokens = []
         try:
             _fault.inject("route")      # MXNET_FAULT_INJECT: route@n
             req = urllib.request.Request(
                 f"http://{addr}/generate", data=body,
-                headers={"Content-Type": "application/json"},
-                method="POST")
+                headers=headers, method="POST")
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 for line in r:
                     if not line.strip():
                         continue
                     row = json.loads(line.decode("utf-8"))
                     if "token" in row:
+                        if ctx is not None and not tokens:
+                            ctx.mark_first_token()
                         tokens.append(int(row["token"]))
                     elif row.get("done"):
                         self._note_result(rid, True)
                         self.stats.incr("stream_tokens_total", len(tokens))
+                        if ctx is not None and "budget" in row:
+                            ctx.budget = row["budget"]
                         return ("ok", tokens)
                     elif "error" in row:
                         # in-band error line: the replica answered
@@ -841,12 +943,15 @@ class Router:
         timeout = max(1e-3, deadline - time.monotonic())
         body = json.dumps({"inputs": inputs_json,
                            "deadline_ms": timeout * 1e3}).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        ctx = _rt.current()
+        if ctx is not None:
+            headers[_rt.TRACE_HEADER] = _rt.to_header(ctx)
         try:
             _fault.inject("route")      # MXNET_FAULT_INJECT: route@n
             req = urllib.request.Request(
                 f"http://{addr}/predict", data=body,
-                headers={"Content-Type": "application/json"},
-                method="POST")
+                headers=headers, method="POST")
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 payload = json.loads(r.read().decode("utf-8"))
             self._note_result(rid, True)
@@ -911,12 +1016,17 @@ class Router:
             def do_GET(self):
                 try:
                     if self.path == "/metrics":
-                        body = router.render_prometheus() + "".join(
-                            fn() for fn in extras)
+                        body = (router.render_prometheus()
+                                + _rt.render_prometheus(
+                                    f'router="{router.stats.name}"')
+                                + "".join(fn() for fn in extras))
                         self._send(200, body, "text/plain; version=0.0.4; "
                                               "charset=utf-8")
                     elif self.path == "/replicas":
                         self._send(200, json.dumps(router.replica_table()),
+                                   "application/json")
+                    elif self.path == "/debugz/requests":
+                        self._send(200, json.dumps(_rt.ring_snapshot()),
                                    "application/json")
                     else:
                         self._send(404, "not found\n")

@@ -70,6 +70,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..util import getenv_int, getenv_str
+from . import reqtrace as _rt
 from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
 from .stats import ServingStats
 
@@ -135,9 +136,14 @@ class _Handler(BaseHTTPRequestHandler):
             if ms.decoder is not None and ms.decoder.stats is not ms.stats:
                 ms.decoder.stats.publish()
                 body += ms.decoder.stats.render_prometheus()
+            body += _rt.render_prometheus(f'model="{ms.stats.name}"')
             self._reply_text(
                 200, body,
                 content_type="text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/debugz/requests":
+            # this process's request-trace rings (recent sampled requests
+            # + error/SLO-breach exemplars); empty when MXNET_REQTRACE off
+            self._reply(200, _rt.ring_snapshot())
         else:
             self._reply(404, {"error": "not found", "retryable": False})
 
@@ -215,8 +221,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": f"malformed request: {e}",
                               "retryable": False})
             return
+        # request tracing: adopt the router-minted context so the
+        # prefill_chunk/kv_ship spans and the kvstore wire carry its id,
+        # and return the measured legs for the TTFT budget breakdown
+        ctx = _rt.from_header(self.headers.get(_rt.TRACE_HEADER))
+        t_run = time.perf_counter()
         try:
-            export = ms.prefill_engine.run(prompt)
+            with _rt.activate(ctx):
+                export = ms.prefill_engine.run(prompt)
         except Overloaded as e:
             self._reply(e.status, {"error": str(e), "retryable": True},
                         retry_after="0.05")
@@ -224,6 +236,8 @@ class _Handler(BaseHTTPRequestHandler):
         except MXNetError as e:
             self._reply(400, {"error": str(e), "retryable": False})
             return
+        prefill_ms = (time.perf_counter() - t_run) * 1e3
+        ship_ms = 0.0
         out = {"next_token": export["next_token"], "n": export["n"],
                "cached_tokens": export["cached_tokens"],
                "pages": len(export["k_rows"])}
@@ -236,17 +250,23 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, {"error": "ship requested without "
                                   "ship_key", "retryable": False})
                 return
+            t_ship = time.perf_counter()
             try:
-                receipt = ms.ship_export(ship_key, export)
+                with _rt.activate(ctx):
+                    receipt = ms.ship_export(ship_key, export)
             except MXNetError as e:
                 self._reply(503, {"error": f"page shipping failed: {e}",
                                   "retryable": True}, retry_after="0.05")
                 return
+            ship_ms = (time.perf_counter() - t_ship) * 1e3
             out["ship_key"] = ship_key
             out["shipped_bytes"] = int(receipt.get("bytes", 0))
         else:
             out["k_rows"] = export["k_rows"].tolist()
             out["v_rows"] = export["v_rows"].tolist()
+        if ctx is not None:
+            out["prefill_ms"] = round(prefill_ms, 3)
+            out["ship_ms"] = round(ship_ms, 3)
         self._reply(200, out)
 
     def _generate(self):
@@ -273,6 +293,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": f"malformed request: {e}",
                               "retryable": False})
             return
+        # request tracing: adopt the router-minted context; the decode
+        # stream carries it so the scheduler can book admission spans and
+        # assemble the done-row TTFT budget breakdown
+        ctx = _rt.from_header(self.headers.get(_rt.TRACE_HEADER))
         kv_import = None
         if kv_inline is not None:
             kv_import = kv_inline
@@ -280,11 +304,12 @@ class _Handler(BaseHTTPRequestHandler):
             # fetch the prefill replica's exported pages; an expired or
             # unknown key falls back to local prefill (when the prompt
             # fits this replica's ladder)
-            kv_import = ms.fetch_shipped(ship_key)
+            with _rt.activate(ctx):
+                kv_import = ms.fetch_shipped(ship_key)
         try:
             st = ms.decoder.submit(prompt, max_new_tokens=max_new,
                                    eos_id=eos_id, deadline_ms=deadline_ms,
-                                   kv_import=kv_import)
+                                   kv_import=kv_import, trace=ctx)
         except Overloaded as e:
             self._reply(e.status, {"error": str(e), "retryable": True},
                         retry_after="0.05")
@@ -305,7 +330,12 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001 — decode failure -> 500
                 self._reply(500, {"error": str(e), "retryable": False})
                 return
-            self._reply(200, {"tokens": toks, "ttft_ms": st.ttft_ms})
+            payload = {"tokens": toks, "ttft_ms": st.ttft_ms}
+            if ctx is not None:
+                payload["budget"] = self._budget_row(ctx, st)
+                _rt.finish(ctx, status="ok", ttft_ms=st.ttft_ms,
+                           budget=payload["budget"])
+            self._reply(200, payload)
             return
         # chunked streaming: one ndjson line per token, flushed as the
         # scheduler emits it — the client sees its first token at TTFT,
@@ -324,19 +354,48 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 for tok in st:
                     chunk({"token": tok})
-                chunk({"done": True, "n": len(st._tokens),
-                       "ttft_ms": st.ttft_ms})
+                done_row = {"done": True, "n": len(st._tokens),
+                            "ttft_ms": st.ttft_ms}
+                if ctx is not None:
+                    # TTFT budget breakdown: router-side legs from the
+                    # header baggage + scheduler-measured components; the
+                    # row only exists on traced requests, so the gate-off
+                    # stream stays byte-identical
+                    done_row["budget"] = self._budget_row(ctx, st)
+                    _rt.finish(ctx, status="ok", ttft_ms=st.ttft_ms,
+                               budget=done_row["budget"])
+                chunk(done_row)
             except MXNetError as e:
                 # the chunked response already started: the error must
                 # travel in-band as the final line
                 chunk({"error": str(e),
                        "retryable": bool(getattr(e, "retryable", False))})
+                _rt.finish(ctx, status="error", cause=type(e).__name__,
+                           ttft_ms=st.ttft_ms)
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
         except OSError:
             # client went away mid-stream: stop generating for it
             st.cancel()
             self.close_connection = True
+
+    @staticmethod
+    def _budget_row(ctx, st):
+        """Assemble the done-row TTFT budget: router_ms/prefill_ms/
+        ship_ms ride in as header baggage from the router, queue_ms/
+        admission_ms/first_step_ms are measured by the decode scheduler
+        (DecodeStream._budget). The six components sum to the measured
+        TTFT within scheduling tolerance."""
+        budget = {"router_ms": 0.0, "prefill_ms": 0.0, "ship_ms": 0.0,
+                  "queue_ms": 0.0, "admission_ms": 0.0,
+                  "first_step_ms": 0.0}
+        for leg in ("router_ms", "prefill_ms", "ship_ms"):
+            try:
+                budget[leg] = round(float(ctx.baggage.get(leg, 0.0)), 3)
+            except (TypeError, ValueError):
+                pass
+        budget.update(getattr(st, "_budget", None) or {})
+        return budget
 
     def _admin(self):
         ms = self._ms

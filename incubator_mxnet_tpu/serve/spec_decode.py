@@ -285,14 +285,31 @@ class SpecDecoder:
         return self._warm
 
     # -- runtime entry point (called by the scheduler loop) -------------
-    def verify(self, tokens, positions, k_pages, v_pages, page_tables):
-        """One batched verify dispatch over all slots x G rows."""
+    def verify(self, tokens, positions, k_pages, v_pages, page_tables,
+               traces=()):
+        """One batched verify dispatch over all slots x G rows.
+
+        ``traces`` optionally carries the reqtrace contexts of the streams
+        riding this batch; when non-empty each gets a ``spec_verify`` span
+        covering the shared dispatch (same wall interval, per-request id).
+        """
+        import time as _time
+
         import jax.numpy as jnp
         fn = self._exec_verify()
+        t0 = _time.perf_counter()
         y, kp, vp = fn(self.predictor._param_vals,
                        jnp.asarray(tokens, jnp.int32),
                        jnp.asarray(positions, jnp.int32),
                        k_pages, v_pages,
                        jnp.asarray(page_tables, jnp.int32))
+        out = _np.asarray(y)
+        if traces:
+            from . import reqtrace as _rt
+            dur_ms = (_time.perf_counter() - t0) * 1e3
+            for ctx in traces:
+                _rt.observe(ctx, "spec_verify", dur_ms, t0=t0,
+                            args={"width": self.width,
+                                  "batch": int(len(out))})
         self._warm = True
-        return _np.asarray(y), kp, vp
+        return out, kp, vp

@@ -18,9 +18,29 @@ import logging
 import math
 import threading
 
-__all__ = ["LatencyHistogram", "ServingStats"]
+__all__ = ["LatencyHistogram", "ServingStats", "reqtrace_exemplar_lines"]
 
 _log = logging.getLogger("incubator_mxnet_tpu.serve")
+
+
+def reqtrace_exemplar_lines(hist, labels, histogram):
+    """``mxnet_reqtrace_slow_exemplar`` exposition for one histogram's
+    slowest-K traced requests per bucket (serve/reqtrace.py supplies the
+    trace ids). Empty — and absent from /metrics — until a traced sample
+    was observed, so a gate-off scrape is unchanged."""
+    ex = hist.exemplars()
+    if not ex:
+        return []
+    lines = ["# HELP mxnet_reqtrace_slow_exemplar slowest traced "
+             "requests per latency bucket (value in ms)",
+             "# TYPE mxnet_reqtrace_slow_exemplar gauge"]
+    for bound in sorted(ex):
+        le = "+Inf" if bound == float("inf") else f"{bound * 1e3:.6g}"
+        for secs, trace in ex[bound]:
+            lines.append(f'mxnet_reqtrace_slow_exemplar{{{labels},'
+                         f'histogram="{histogram}",le="{le}",'
+                         f'trace="{trace}"}} {secs * 1e3:.6g}')
+    return lines
 
 
 class LatencyHistogram:
@@ -33,12 +53,14 @@ class LatencyHistogram:
 
     _GROWTH = 1.5
     _FLOOR = 10e-6  # seconds
+    _EXEMPLAR_K = 3  # slowest trace ids retained per bucket
 
     def __init__(self, nbuckets=40):
         self._bounds = [self._FLOOR * self._GROWTH ** i
                         for i in range(nbuckets)]
         self._counts = [0] * (nbuckets + 1)  # +1: overflow bucket
         self._lock = threading.Lock()
+        self._exemplars = None  # bucket idx -> [(seconds, trace_id)] desc
         self.count = 0
         self.sum = 0.0
 
@@ -48,12 +70,37 @@ class LatencyHistogram:
         i = int(math.log(seconds / self._FLOOR) / math.log(self._GROWTH)) + 1
         return min(i, len(self._bounds))
 
-    def observe(self, seconds):
+    def observe(self, seconds, trace=None):
+        """Record one sample. `trace` (a reqtrace trace id, only passed
+        for head-sampled requests) retains the slowest-K exemplars per
+        bucket so a fat histogram tail names the requests that built it;
+        the default None keeps the traced-off hot path allocation-free."""
         seconds = max(0.0, float(seconds))
         with self._lock:
-            self._counts[self._index(seconds)] += 1
+            idx = self._index(seconds)
+            self._counts[idx] += 1
             self.count += 1
             self.sum += seconds
+            if trace is not None:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                slot = self._exemplars.setdefault(idx, [])
+                slot.append((seconds, str(trace)))
+                slot.sort(reverse=True)
+                del slot[self._EXEMPLAR_K:]
+
+    def exemplars(self):
+        """{bucket upper bound (seconds) -> [(seconds, trace_id), ...]
+        slowest-first}; empty dict until a traced sample was observed."""
+        with self._lock:
+            if not self._exemplars:
+                return {}
+            out = {}
+            for idx, slot in self._exemplars.items():
+                bound = (self._bounds[idx] if idx < len(self._bounds)
+                         else float("inf"))
+                out[bound] = list(slot)
+            return out
 
     def percentile(self, q):
         """q in [0, 100] -> seconds (0.0 when empty)."""
@@ -444,6 +491,7 @@ class ServingStats:
                       f"{fam}{{{labels}}} {val}"]
         if self.spec_steps_total:
             lines += self._spec_prometheus_lines(labels)
+        lines += reqtrace_exemplar_lines(self.ttft, labels, "decode_ttft")
         return lines
 
     def _spec_prometheus_lines(self, labels):

@@ -460,6 +460,22 @@ ENV_VARS = collections.OrderedDict([
     ("MXNET_ROUTER_TOKEN_SLO_MS", EnvSpec(100, "int",
      "Inter-token latency SLO target (ms) for the decode tier's "
      "SLO-split placement ranking.")),
+    ("MXNET_REQTRACE", EnvSpec(False, "bool",
+     "Request-scoped tracing across the serving plane "
+     "(serve/reqtrace.py): mint a trace context at the router, "
+     "propagate it via the X-MXNET-Trace header and the kvstore v2 "
+     "wire envelope, and book per-hop chrome-trace spans plus a TTFT "
+     "budget breakdown on the /generate done row. Off (default): "
+     "zero records, wire frames byte-identical.")),
+    ("MXNET_REQTRACE_SAMPLE", EnvSpec(1000, "int",
+     "Head-based sampling rate for request tracing, in per-mille "
+     "(1000 = trace every request). Unsampled requests still carry "
+     "a trace id for tail-exemplar promotion on error/SLO breach, "
+     "but emit no spans.")),
+    ("MXNET_REQTRACE_RING", EnvSpec(64, "int",
+     "Capacity of each request-trace ring (recent sampled requests "
+     "and error/SLO-breach exemplars), served at /debugz/requests. "
+     "Floored at 4.")),
 ])
 
 _FALSY = frozenset(("", "0", "false", "off", "no"))

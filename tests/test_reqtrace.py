@@ -1,0 +1,595 @@
+"""End-to-end request tracing and TTFT budget attribution
+(serve/reqtrace.py) across the disaggregated serving plane.
+
+Acceptance criteria from the request-tracing milestone:
+  * one trace id minted at the router spans router -> prefill -> decode
+    processes in a tools/trace_merge.py merged chrome trace,
+  * the /generate done row carries a TTFT budget breakdown whose legs
+    sum to the measured TTFT within tolerance,
+  * an injected verify@n:kill failure is auto-promoted into the
+    tail-exemplar ring and its flight-recorder postmortem joins the
+    router's exemplars by trace id,
+  * with MXNET_REQTRACE off the serving path puts the plain pickled
+    tuple on the kvstore wire (byte-identical) and books ZERO reqtrace
+    records — counter-asserted, never timed.
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from incubator_mxnet_tpu import profiler
+from incubator_mxnet_tpu.kvstore_server import (_wire_envelope,
+                                                start_async_server)
+from incubator_mxnet_tpu.serve import (DecodePredictor, DecodeScheduler,
+                                       ModelServer, Router)
+from incubator_mxnet_tpu.serve import reqtrace as rt
+from incubator_mxnet_tpu.serve.stats import (LatencyHistogram,
+                                             reqtrace_exemplar_lines)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+sys.path.insert(0, TOOLS)
+import trace_merge  # noqa: E402
+from validate_trace import TraceFormatError, validate_trace  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def toy():
+    pred = DecodePredictor.toy(slots=4, page_size=4, num_pages=64,
+                               max_pages_per_seq=8)
+    pred.warmup()
+    return pred
+
+
+@pytest.fixture
+def traced():
+    """Force the reqtrace gate on for one test; leave no state behind."""
+    rt.reset()
+    rt.enable(True)
+    yield rt
+    rt.reset()
+
+
+class _NoPredict:
+    ladder = None
+    _input_shapes = {}
+    is_warm = True
+
+    def predict(self, feed):
+        raise RuntimeError("predict path unused in reqtrace tests")
+
+
+def _post(url, payload, headers=(), timeout=60):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(dict(headers))
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode("utf-8"), hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _stream(url, payload, headers=(), timeout=120):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(dict(headers))
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode("utf-8"), hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return [json.loads(line) for line in r if line.strip()]
+
+
+# -- the gate: zero records, byte-identical wire -----------------------
+
+
+def test_gate_off_zero_records_and_plain_wire(monkeypatch):
+    monkeypatch.delenv("MXNET_REQTRACE", raising=False)
+    rt.reset()
+    prev = profiler.attribution_enable(False)
+    try:
+        assert rt.enabled() is False
+        assert rt.mint() is None
+        assert rt.mint(deadline_ms=50.0) is None
+        assert rt.current() is None and rt.current_trace_id() is None
+        assert rt.from_header("00-" + "a" * 32 + "-" + "b" * 16 + "-01") \
+            is None
+        # the span surface is a shared null object, finish/promote no-op
+        with rt.activate(None):
+            with rt.span("router_queue"):
+                pass
+            assert rt.wire_fields() is None
+        rt.observe(None, "decode_admission", 1.0)
+        rt.finish(None, status="error", cause="nope")
+        rt.promote(None, cause="nope")
+        # counter-asserted: exactly zero reqtrace records, empty rings
+        assert rt.record_count() == 0
+        snap = rt.ring_snapshot()
+        assert snap["recent"] == [] and snap["exemplars"] == []
+        assert rt.render_prometheus() == ""
+        # the kvstore wire frame is the PLAIN pickled tuple — identical
+        # bytes to a build that never imported this module
+        msg = ("kv_page_put", "k0", b"payload", {"n": 3})
+        assert _wire_envelope(msg) is msg
+        assert pickle.dumps(_wire_envelope(msg)) == pickle.dumps(msg)
+    finally:
+        profiler.attribution_enable(prev)
+        rt.reset()
+
+
+def test_wire_envelope_carries_request_ids(traced):
+    prev = profiler.attribution_enable(False)
+    try:
+        ctx = rt.mint()
+        msg = ("kv_page_get", "k1")
+        with rt.activate(ctx):
+            wire = _wire_envelope(msg)
+        assert wire[0] == "__v2__" and wire[2] == msg
+        hdr = wire[1]
+        assert hdr["req_trace"] == ctx.trace_id
+        assert hdr["req_span"] == ctx.span_id
+        assert isinstance(hdr["trace"], str) and hdr["span"] > 0
+        # no request in flight on this thread -> plain tuple again
+        assert _wire_envelope(msg) is msg
+    finally:
+        profiler.attribution_enable(prev)
+
+
+# -- header codec ------------------------------------------------------
+
+
+def test_header_roundtrip_and_malformed(traced):
+    ctx = rt.mint(deadline_ms=1500.0)
+    hdr = rt.to_header(ctx, router_ms=12.5)
+    assert hdr.startswith(f"00-{ctx.trace_id}-")
+    back = rt.from_header(hdr)
+    assert back.trace_id == ctx.trace_id
+    assert back.sampled == ctx.sampled
+    assert back.deadline_ms == 1500.0
+    assert abs(back.baggage["router_ms"] - 12.5) < 1e-9
+    # the unsampled bit survives the wire
+    ctx.sampled = False
+    back = rt.from_header(rt.to_header(ctx))
+    assert back is not None and back.sampled is False
+    # malformed headers degrade to "no trace", never raise
+    for bad in (None, "", "garbage", "00-xyz-1-01",
+                "00-" + "a" * 31 + "-" + "b" * 16 + "-01",
+                "00-" + "a" * 32 + "-nothex-01"):
+        assert rt.from_header(bad) is None
+
+
+# -- rings, promotion, prometheus --------------------------------------
+
+
+def test_finish_promote_rings_and_prometheus(traced):
+    ok = rt.mint()
+    rt.finish(ok, status="ok", ttft_ms=10.0, total_ms=20.0,
+              budget={"router_ms": 1.0}, slo_ms=500.0)
+    breach = rt.mint()
+    rt.finish(breach, status="ok", ttft_ms=900.0, total_ms=950.0,
+              slo_ms=500.0)
+    err = rt.mint()
+    rt.promote(err, cause="connect-error", detail="replica r1 unreachable")
+    snap = rt.ring_snapshot()
+    assert snap["enabled"] and snap["capacity"] >= 4
+    recent = {r["trace"] for r in snap["recent"]}
+    exemplars = {r["trace"]: r for r in snap["exemplars"]}
+    assert ok.trace_id in recent
+    # SLO breaches and errors are ALWAYS kept, head sampling or not
+    assert exemplars[breach.trace_id]["slo_breach"] is True
+    assert exemplars[err.trace_id]["cause"] == "connect-error"
+    assert exemplars[err.trace_id]["status"] == "error"
+    slow = rt.slowest(5)
+    assert slow and slow[0]["trace"] == breach.trace_id
+    text = rt.render_prometheus('router="r0"')
+    assert 'mxnet_reqtrace_requests_total{router="r0"}' in text
+    assert 'mxnet_reqtrace_ring_occupancy{router="r0",ring="exemplar"} 2' \
+        in text
+    assert rt.record_count() >= 3
+
+
+def test_histogram_slowest_exemplar_lines():
+    h = LatencyHistogram()
+    h.observe(0.010, trace="aaaa")
+    h.observe(0.012, trace="bbbb")
+    h.observe(0.5)                      # untraced: no exemplar kept
+    ex = h.exemplars()
+    assert ex and any("aaaa" in [t for _, t in slot] for slot in ex.values())
+    lines = reqtrace_exemplar_lines(h, 'router="r0"', "request_latency")
+    joined = "\n".join(lines)
+    assert 'histogram="request_latency"' in joined
+    assert 'trace="bbbb"' in joined
+    assert reqtrace_exemplar_lines(LatencyHistogram(), "", "x") == []
+
+
+# -- spans ride the profiler timeline and pass the schema --------------
+
+
+def test_request_spans_validate_in_dump(traced, tmp_path):
+    path = tmp_path / "reqtrace.json"
+    prev = profiler.attribution_enable(True)
+    profiler.set_config(filename=str(path))
+    profiler.start()
+    try:
+        ctx = rt.mint(deadline_ms=2000.0)
+        with rt.activate(ctx):
+            with rt.span("router_queue"):
+                with rt.span("prefill_chunk", args={"start": 0}):
+                    time.sleep(0.001)
+            rt.attempt(ctx, 0, "ok", 1.5, hedged=False, replica="r0")
+        profiler.stop()
+        profiler.dump()
+        assert validate_trace(str(path)) > 0
+        evs = json.loads(path.read_text())["traceEvents"]
+        req = {e["name"]: e["args"] for e in evs
+               if isinstance(e.get("args"), dict)
+               and "req_trace" in e["args"]}
+        assert {"phase:router_queue", "phase:prefill_chunk",
+                "phase:route_attempt#0"} <= set(req)
+        for args in req.values():
+            assert args["req_trace"] == ctx.trace_id
+            assert args["req_span"] > 0
+        # local nesting uses profiler parent containment; cross-process
+        # lineage rides req_parent (the minted root span id)
+        assert req["phase:prefill_chunk"]["parent"] == \
+            req["phase:router_queue"]["span_id"]
+        assert req["phase:router_queue"]["req_parent"] == ctx.span_id
+        assert req["phase:route_attempt#0"]["cause"] == "ok"
+        assert req["phase:route_attempt#0"]["replica"] == "r0"
+    finally:
+        profiler.set_config(filename="profile.json")
+        profiler.attribution_enable(prev)
+
+
+def test_validate_trace_rejects_bad_request_spans():
+    def ev(args):
+        base = {"span_id": 1, "trace": "t"}
+        base.update(args)
+        return {"name": "phase:x", "ph": "X", "ts": 100, "dur": 50,
+                "pid": 0, "cat": "step", "args": base}
+
+    good = ev({"req_trace": "a" * 32, "req_span": 7, "req_parent": 3,
+               "cause": "ok"})
+    assert validate_trace({"traceEvents": [good]}) == 1
+    for bad in ({"req_trace": ""}, {"req_trace": 12},
+                {"req_trace": "t", "req_span": 0},
+                {"req_trace": "t", "req_span": 1, "req_parent": "nope"},
+                {"req_trace": "t", "req_span": 1, "cause": ""}):
+        with pytest.raises(TraceFormatError):
+            validate_trace({"traceEvents": [ev(bad)]})
+
+
+def test_trace_merge_labels_request_ids(tmp_path):
+    def anchor():
+        return {"name": "clock_sync", "ph": "M", "ts": 0, "pid": 0,
+                "args": {"peer": "self", "offset_us": 0.0, "rtt_us": 0.0,
+                         "perf_anchor_us": 0.0, "wall_anchor_us": 10_000.0}}
+
+    def span(sid, req):
+        return {"name": "phase:route_attempt#0", "ph": "X", "cat": "step",
+                "ts": 1000.0, "dur": 100.0, "pid": 0, "tid": 1,
+                "args": {"span_id": sid, "trace": "proc",
+                         "req_trace": req, "req_span": sid}}
+
+    req_id = "c0ffee" + "0" * 26
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps(
+        {"traceEvents": [span(1, req_id), anchor()]}))
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps(
+        {"traceEvents": [span(1, req_id), span(2, "d" * 32), anchor()]}))
+    merged = trace_merge.merge_traces([str(a), str(b)])
+    validate_trace(merged)
+    names = [e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("name") == "process_name"]
+    assert all("req[" in n and req_id[:8] in n for n in names)
+    # both files kept the request id on their spans -> joinable by id
+    per_pid = {}
+    for e in merged["traceEvents"]:
+        if isinstance(e.get("args"), dict) and "req_trace" in e["args"]:
+            per_pid.setdefault(e["pid"], set()).add(e["args"]["req_trace"])
+    assert set.intersection(*per_pid.values()) == {req_id}
+
+
+# -- single-server budget row ------------------------------------------
+
+
+def test_generate_budget_row_sums_to_ttft(toy, traced):
+    sched = DecodeScheduler(toy, max_queue=16, name="rt-budget")
+    ms = ModelServer(_NoPredict(), decoder=sched, name="rt-budget-srv")
+    host, port = ms.start()
+    base = f"http://{host}:{port}"
+    payload = {"prompt": [3, 1, 4, 1, 5], "max_new_tokens": 4,
+               "deadline_ms": 60000}
+    try:
+        ctx = rt.mint()
+        hdr = {rt.TRACE_HEADER: rt.to_header(ctx, router_ms=5.0)}
+        rows = _stream(f"{base}/generate", payload, headers=hdr)
+        done = rows[-1]
+        assert done.get("done") and done["ttft_ms"] > 0
+        budget = done["budget"]
+        assert set(budget) == {"router_ms", "prefill_ms", "ship_ms",
+                               "queue_ms", "admission_ms", "first_step_ms"}
+        # the router-side leg came back from the header baggage
+        assert budget["router_ms"] == 5.0
+        # the scheduler-side legs sum EXACTLY to the server-measured TTFT
+        # (first_step is the residual; only 3-dp rounding separates them)
+        sched_sum = (budget["queue_ms"] + budget["admission_ms"]
+                     + budget["first_step_ms"])
+        assert abs(sched_sum - done["ttft_ms"]) < 0.01, (budget, done)
+        # the server finished the request into its ring with the budget
+        recs = [r for r in rt.ring_snapshot()["recent"]
+                if r["trace"] == ctx.trace_id]
+        assert recs and recs[-1]["budget"] == budget
+        # non-stream replies carry the same breakdown
+        code, body = _post(f"{base}/generate", dict(payload, stream=False),
+                           headers={rt.TRACE_HEADER: rt.to_header(rt.mint())})
+        assert code == 200 and "budget" in body
+        # no header -> no budget key at all (byte-identical reply shape)
+        rows = _stream(f"{base}/generate", payload)
+        assert "budget" not in rows[-1]
+        # gate off -> a PRESENT header is ignored and nothing is recorded
+        rt.reset()
+        before = rt.record_count()
+        rows = _stream(f"{base}/generate", payload, headers=hdr)
+        assert "budget" not in rows[-1]
+        assert rt.record_count() == before == 0
+    finally:
+        ms.stop()
+
+
+# -- the multiprocess drill: router -> prefill -> decode ---------------
+
+
+_REPLICA = textwrap.dedent("""
+    import json, os, sys, time
+    repo, outdir, idx, role, coord = sys.argv[1:6]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, repo)
+    from incubator_mxnet_tpu import profiler
+    from incubator_mxnet_tpu.serve import (DecodePredictor, DecodeScheduler,
+                                           ModelServer, PrefillEngine,
+                                           PrefillPredictor)
+
+    profiler.set_config(
+        filename=os.path.join(outdir, f"trace-{idx}.json"))
+    profiler.start()
+
+    class _NoPredict:
+        ladder = None
+        _input_shapes = {}
+        is_warm = True
+        def predict(self, feed):
+            raise RuntimeError("unused")
+
+    pred = DecodePredictor.toy(slots=4, page_size=4, num_pages=64,
+                               max_pages_per_seq=8)
+    sched = None
+    if role == "prefill":
+        eng = PrefillEngine(pred, chunk=8, prefix_cache=True,
+                            name=f"rt-pf{idx}")
+        eng.warmup()
+        srv = ModelServer(_NoPredict(), prefill_engine=eng, role="prefill",
+                          coordinator=coord, model="rtdrill",
+                          name=f"rt-pf{idx}")
+    else:
+        pred.warmup()
+        chunker = PrefillPredictor(pred, chunk=8)
+        chunker.warmup()
+        sched = DecodeScheduler(pred, max_queue=32, name=f"rt-dec{idx}",
+                                prefix_cache=True, chunk_prefill=chunker)
+        srv = ModelServer(_NoPredict(), decoder=sched, role="decode",
+                          coordinator=coord, model="rtdrill",
+                          name=f"rt-dec{idx}")
+    host, port = srv.start()
+    deadline = time.monotonic() + 240
+    while not srv.ready and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert srv.ready, srv.readiness()
+    tmp = os.path.join(outdir, f"ready-{idx}.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(), "addr": f"{host}:{port}"}, f)
+    os.replace(tmp, os.path.join(outdir, f"ready-{idx}.json"))
+    stop = os.path.join(outdir, "stop")
+    deadline = time.monotonic() + 240
+    while not os.path.exists(stop) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    if sched is not None:
+        sched.pause("rt-drain")
+        sched.quiesce(timeout=60)
+    srv.stop()
+    profiler.stop()
+    profiler.dump()
+    sys.stdout.write("REPLICA_EXIT_OK" + chr(10))
+""")
+
+
+@pytest.mark.timeout(420)
+def test_reqtrace_disagg_drill_multiprocess(tmp_path, toy):
+    """The acceptance drill: 1 prefill + 2 speculative decode replicas
+    behind the Router, MXNET_REQTRACE=1 everywhere. One trace id spans
+    router/prefill/decode in the merged chrome trace; the done-row
+    budget sums to the router-measured TTFT within tolerance; the
+    verify@3:kill victim's flight postmortem joins the router's
+    tail-exemplar ring by trace id."""
+    prefix = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompts = [prefix + [11 + i] for i in range(10)]
+    oracle_sched = DecodeScheduler(toy, max_queue=32, name="rt-oracle")
+    oracle_sched.start()
+    try:
+        oracle = [oracle_sched.submit(p, max_new_tokens=4).result(timeout=120)
+                  for p in prompts]
+    finally:
+        oracle_sched.stop()
+
+    outdir = tmp_path / "drill"
+    flight_dir = tmp_path / "flight"
+    outdir.mkdir()
+    flight_dir.mkdir()
+    coord = start_async_server()
+    base_env = {k: v for k, v in os.environ.items()
+                if k not in ("XLA_FLAGS", "MXNET_FAULT_INJECT",
+                             "MXNET_FLIGHT_RECORDER", "MXNET_SPEC_DECODE",
+                             "MXNET_REQTRACE", "MXNET_STEP_ATTRIBUTION")}
+    base_env["MXNET_REQTRACE"] = "1"
+    base_env["MXNET_STEP_ATTRIBUTION"] = "1"
+    dec_env = dict(base_env, MXNET_SPEC_DECODE="1")
+    victim_env = dict(dec_env, MXNET_FAULT_INJECT="verify@3:kill",
+                      MXNET_FLIGHT_RECORDER=str(flight_dir))
+    router_trace = tmp_path / "trace-router.json"
+    rt.reset()
+    rt.enable(True)
+    prev = profiler.attribution_enable(True)
+    profiler.set_config(filename=str(router_trace))
+    profiler.start()
+    procs = []
+    router = None
+    try:
+        for i, (role, env) in enumerate((("prefill", base_env),
+                                         ("decode", dec_env),
+                                         ("decode", victim_env))):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _REPLICA, REPO, str(outdir),
+                 str(i), role, coord],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env))
+        info = {}
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and len(info) < 3:
+            for i in range(3):
+                f = outdir / f"ready-{i}.json"
+                if i not in info and f.exists():
+                    info[i] = json.loads(f.read_text())
+                if procs[i].poll() is not None:
+                    raise AssertionError(
+                        f"replica {i} died during boot:\n"
+                        f"{procs[i].stderr.read()[-2000:]}")
+            time.sleep(0.05)
+        assert len(info) == 3, "replicas never became ready"
+
+        router = Router(coordinator=coord, model="rtdrill", retries=8,
+                        backoff_ms=25, breaker_failures=1,
+                        breaker_cooldown_ms=60000, name="rt-router")
+        router.start()
+        deadline = time.monotonic() + 60
+        ready = 0
+        while time.monotonic() < deadline:
+            with router._rlock:
+                ready = sum(1 for i in router._replicas.values()
+                            if i["ready"])
+            if ready >= 3:
+                break
+            router.refresh()
+            time.sleep(0.1)
+        assert ready >= 3
+
+        # every request succeeds even while the victim is SIGKILLed
+        # mid-verify; the retry keeps the SAME minted trace id
+        for i in range(10):
+            assert router.generate(prompts[i], max_new_tokens=4,
+                                   deadline_ms=90000) == oracle[i]
+        deadline = time.monotonic() + 120
+        while procs[2].poll() is None and time.monotonic() < deadline:
+            router.generate(prompts[0], max_new_tokens=4,
+                            deadline_ms=90000)
+        assert procs[2].poll() == -9, "victim replica was not SIGKILLed"
+
+        # the done-row budget sums to the router-measured TTFT within
+        # tolerance (loopback HTTP + handler overhead is the residual)
+        recs = [r for r in rt.ring_snapshot()["recent"]
+                if r["status"] == "ok" and r.get("budget")
+                and r.get("ttft_ms")]
+        assert recs, "no finished requests carried a budget"
+        for r in recs:
+            total = sum(r["budget"].values())
+            assert total > 0
+            assert abs(r["ttft_ms"] - total) <= max(500.0,
+                                                    0.5 * r["ttft_ms"]), r
+        # at least one request took the split path: the prefill-replica
+        # measured legs rode the baggage back into the router's budget
+        assert any(r["budget"]["prefill_ms"] > 0 for r in recs), recs
+
+        # verify@3:kill -> the dying request was auto-promoted into the
+        # tail-exemplar ring; the flight postmortem joins it by trace id
+        post = flight_dir / f"flight-{info[2]['pid']}.json"
+        assert post.exists(), list(flight_dir.iterdir())
+        payload = json.loads(post.read_text())
+        assert payload["reason"] == "fault:verify#3"
+        victim_traces = set()
+        for rec in payload.get("records", []):
+            victim_traces.update(rec.get("traces") or ())
+        assert victim_traces, payload
+        exemplar_traces = {r["trace"]
+                           for r in rt.ring_snapshot()["exemplars"]}
+        assert victim_traces & exemplar_traces, (victim_traces,
+                                                 exemplar_traces)
+
+        # observability surfaces: /debugz/requests + reqtrace families
+        mhost, mport = router.start_metrics_http()
+        with urllib.request.urlopen(
+                f"http://{mhost}:{mport}/debugz/requests", timeout=30) as r:
+            ring = json.loads(r.read())
+        assert ring["enabled"] and ring["exemplars"]
+        with urllib.request.urlopen(
+                f"http://{mhost}:{mport}/metrics", timeout=30) as r:
+            metrics = r.read().decode("utf-8")
+        assert "mxnet_reqtrace_records_total" in metrics
+        assert "mxnet_reqtrace_slow_exemplar" in metrics
+
+        # survivors drain and dump their traces
+        (outdir / "stop").touch()
+        for i in (0, 1):
+            out, err = procs[i].communicate(timeout=120)
+            assert procs[i].returncode == 0, err[-2000:]
+            assert "REPLICA_EXIT_OK" in out
+        router.stop()
+        router = None
+        profiler.stop()
+        profiler.dump()
+
+        # ONE trace id spans all three processes in the merged timeline
+        files = [str(router_trace), str(outdir / "trace-0.json"),
+                 str(outdir / "trace-1.json")]
+        merged = trace_merge.merge_traces(files)
+        assert validate_trace(merged) > 0
+        per_pid = {}
+        phases_by_pid = {}
+        for e in merged["traceEvents"]:
+            args = e.get("args")
+            if isinstance(args, dict) and "req_trace" in args:
+                per_pid.setdefault(e["pid"], set()).add(args["req_trace"])
+                phases_by_pid.setdefault(e["pid"], set()).add(e["name"])
+        assert set(per_pid) == {0, 1, 2}, sorted(per_pid)
+        common = set.intersection(*per_pid.values())
+        assert common, per_pid
+        # each hop emitted its own request-scoped phases
+        assert "phase:route_attempt#0" in phases_by_pid[0]
+        assert {"phase:prefill_chunk", "phase:kv_ship"} \
+            <= phases_by_pid[1], phases_by_pid[1]
+        assert {"phase:decode_admission", "phase:first_step"} \
+            <= phases_by_pid[2], phases_by_pid[2]
+        assert "phase:spec_verify" in phases_by_pid[2]
+        # the kvstore wire envelope carried the request ids into the
+        # coordinator's handler spans (this process hosts the store)
+        linked = [e for e in merged["traceEvents"]
+                  if "server:kv_page_" in e.get("name", "")
+                  and isinstance(e.get("args"), dict)
+                  and e["args"].get("link_req_trace")]
+        assert linked, "no kv_page_* handler span carried link_req_trace"
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        profiler.set_config(filename="profile.json")
+        profiler.attribution_enable(prev)
+        rt.reset()

@@ -106,6 +106,7 @@ def test_diagnose_runs():
                     "Control Plane (serve)",
                     "Disaggregated Serving",
                     "Speculative Decoding",
+                    "Request Tracing",
                     "Composed Parallelism (pipeline schedules)",
                     "Static Analysis (mxlint)",
                     "Graph Analysis (shardlint)"):

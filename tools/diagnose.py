@@ -312,6 +312,44 @@ def main():
     except Exception as e:
         print("spec decode probe FAILED:", e)
 
+    print("----------Request Tracing----------")
+    try:
+        from incubator_mxnet_tpu.util import getenv_bool, getenv_int
+        from incubator_mxnet_tpu.serve import reqtrace as _rt
+        print("knobs        :",
+              {"enabled": getenv_bool("MXNET_REQTRACE"),
+               "sample_per_mille": getenv_int("MXNET_REQTRACE_SAMPLE"),
+               "ring": getenv_int("MXNET_REQTRACE_RING")})
+        # in-process probe: force the gate on, walk one synthetic request
+        # through mint -> header roundtrip -> span -> finish, then reset
+        # so the probe leaves no records behind
+        _rt.enable(True)
+        try:
+            ctx = _rt.mint(deadline_ms=250.0)
+            back = _rt.from_header(_rt.to_header(ctx))
+            with _rt.activate(ctx):
+                with _rt.span("router_queue"):
+                    pass
+            _rt.finish(ctx, status="error", cause="diagnose-probe",
+                       ttft_ms=123.0, total_ms=130.0)
+            snap = _rt.ring_snapshot()
+            print("probe        :",
+                  {"header_ok": back is not None
+                   and back.trace_id == ctx.trace_id,
+                   "records": _rt.record_count(),
+                   "ring": {"recent": len(snap["recent"]),
+                            "exemplars": len(snap["exemplars"]),
+                            "capacity": snap["capacity"]}})
+            print("slowest-5    :",
+                  [(r["trace"][:8],
+                    r.get("total_ms") or r.get("ttft_ms")
+                    or r.get("elapsed_ms"))
+                   for r in _rt.slowest(5)])
+        finally:
+            _rt.reset()
+    except Exception as e:
+        print("request tracing probe FAILED:", e)
+
     print("----------Composed Parallelism (pipeline schedules)----------")
     try:
         from incubator_mxnet_tpu.parallel.pipeline import (REMAT_MODES,

@@ -82,6 +82,11 @@ LOCK_ORDER = {
     # DecodePredictor); draft state and adaptive-k live entirely on the
     # scheduler loop thread and need none.
     "serve/spec_decode.py": ("self._compile_lock",),
+    # serve/reqtrace: one module lock, a LEAF — it guards the record
+    # counter and the exemplar rings and is never held across profiler,
+    # I/O, or other-module calls; span booking takes profiler._lock
+    # internally only AFTER this lock is released.
+    "serve/reqtrace.py": ("_lock",),
     # kvstore_server: update lock outermost (it serializes pushes, like
     # the reference's executor queue); the heartbeat/liveness registry
     # lock is a LEAF — push refreshes liveness only AFTER releasing the

@@ -23,6 +23,12 @@ server handler span args) survives the merge untouched, so a server
 ``server:push`` span can be matched to the worker span that caused it by
 ``args.link_span`` + ``args.link_trace``.
 
+Request traces (serve/reqtrace.py) join the same way: spans carrying
+``args.req_trace`` keep their request ids through the merge, each input
+file's process_name label lists the request trace ids it contains
+(``req[...]``), so one request can be followed router -> prefill ->
+decode across the per-process tracks by filtering on its req_trace.
+
 CLI:
   python tools/trace_merge.py -o merged.json worker0.json worker1.json ...
 
@@ -103,15 +109,19 @@ def merge_traces(paths, allow_unsynced=False):
             shift = (sync["wall_anchor_us"] - sync["perf_anchor_us"]
                      + sync["offset_us"])
         trace_ids = set()
+        req_traces = set()
         for ev in events:
             e = dict(ev)
             e["pid"] = pid
             if isinstance(e.get("ts"), (int, float)):
                 e["ts"] = e["ts"] + shift
-            t = (e.get("args") or {}).get("trace") \
-                if isinstance(e.get("args"), dict) else None
+            a = e.get("args") if isinstance(e.get("args"), dict) else {}
+            t = a.get("trace")
             if isinstance(t, str):
                 trace_ids.add(t)
+            rt = a.get("req_trace")
+            if isinstance(rt, str):
+                req_traces.add(rt)
             merged.append(e)
         rp = next((ev for ev in events if ev.get("ph") == "M"
                    and ev.get("name") == "remote_profile"
@@ -124,6 +134,13 @@ def merge_traces(paths, allow_unsynced=False):
             label = f"trace{pid}"
         if trace_ids:
             label += f" [{', '.join(sorted(trace_ids))}]"
+        if req_traces:
+            # request ids this process participated in (reqtrace layer);
+            # truncated to keep Perfetto's process rail readable
+            shown = sorted(req_traces)[:4]
+            more = len(req_traces) - len(shown)
+            label += " req[" + ", ".join(t[:8] for t in shown)
+            label += (f", +{more}" if more > 0 else "") + "]"
         merged.append({"name": "process_name", "ph": "M", "pid": pid,
                        "ts": 0, "cat": "__metadata",
                        "args": {"name": label}})

@@ -30,6 +30,16 @@ from tools/trace_merge.py):
     rank ships back over the kvstore wire) carry an int rank >= 0, a
     positive int request_id, and int steps/segments >= 0
 
+Request-span invariants (X events whose args carry "req_trace" — the
+serve/reqtrace.py request-tracing layer riding the same span machinery):
+  * req_trace is a non-empty string (the request's 32-hex trace id)
+  * req_span is a positive int (the span's own id within the request)
+  * req_parent, when present, is a positive int (cross-process lineage —
+    containment is NOT checked for it: the parent lives in another
+    process's file and clock)
+  * "cause", when present, is a non-empty string (route_attempt#n /
+    exemplar-promotion classification)
+
 Usable as a library (`validate_trace(path_or_dict)` returns the event
 count, raises TraceFormatError) or a CLI (`python tools/validate_trace.py
 trace.json ...` exits non-zero on the first invalid file).
@@ -109,6 +119,24 @@ def _check_remote_profile(i, ev):
                      f"{args['request_id']!r}")
 
 
+def _check_request_span(i, ev, args):
+    """X events stamped by serve/reqtrace.py: request-scoped lineage
+    rides req_trace/req_span/req_parent args (see module docstring)."""
+    rt = args.get("req_trace")
+    if not isinstance(rt, str) or not rt:
+        _fail(i, ev, f"bad req_trace {rt!r}")
+    rs = args.get("req_span")
+    if not isinstance(rs, int) or isinstance(rs, bool) or rs <= 0:
+        _fail(i, ev, f"bad req_span {rs!r}")
+    rp = args.get("req_parent")
+    if rp is not None and (not isinstance(rp, int) or isinstance(rp, bool)
+                           or rp <= 0):
+        _fail(i, ev, f"bad req_parent {rp!r}")
+    cause = args.get("cause")
+    if cause is not None and (not isinstance(cause, str) or not cause):
+        _fail(i, ev, f"bad cause {cause!r}")
+
+
 def _check_spans(events):
     """Nested-span well-formedness across the whole (possibly merged,
     multi-process) event list; see the module docstring."""
@@ -135,6 +163,8 @@ def _check_spans(events):
         sid = args["span_id"]
         if not isinstance(sid, int) or isinstance(sid, bool) or sid <= 0:
             _fail(i, ev, f"bad span_id {sid!r}")
+        if "req_trace" in args:
+            _check_request_span(i, ev, args)
         trace = args.get("trace")
         if trace is not None and not isinstance(trace, str):
             _fail(i, ev, f"bad trace id {trace!r}")
