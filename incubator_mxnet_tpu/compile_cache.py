@@ -46,6 +46,7 @@ import logging
 import os
 import pickle
 import threading
+from . import mxsan as _mxsan
 import time
 from collections import OrderedDict
 
@@ -57,7 +58,7 @@ _CONST_HASH_BYTES = 1 << 20  # consts larger than this hash by shape/dtype only
 _SIG_MEMO_MAX = 512          # per-wrapper signature->fingerprint memo bound
 
 # Module lock guards the LRU + counters (declared in tools/mxlint/lock_order.py).
-_lock = threading.Lock()
+_lock = _mxsan.lock("compile_cache.py", "_lock")
 _mem = OrderedDict()         # fingerprint -> loaded executable (LRU)
 _stats = {
     "hits": 0,               # memory-tier hits
@@ -358,8 +359,10 @@ class _CachedJit:
         # (tracer args, exotic leaves, executable/aval skew) runs here,
         # keeping track_jit's probe-based accounting for those calls
         self._fallback = _prof.track_jit(key, self._jfn)
-        self._lock = threading.Lock()           # guards _fps memo
-        self._compile_lock = threading.Lock()   # single-flight compiles
+        self._lock = _mxsan.lock(
+            "compile_cache.py", "self._lock")           # guards _fps memo
+        self._compile_lock = _mxsan.lock(
+            "compile_cache.py", "self._compile_lock")   # single-flight compiles
         self._fps = OrderedDict()               # call sig -> fingerprint
 
     # -- internals ------------------------------------------------------

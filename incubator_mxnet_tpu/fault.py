@@ -44,6 +44,7 @@ import weakref
 from collections import deque
 
 from .base import MXNetError
+from . import mxsan as _mxsan
 
 __all__ = ["CheckpointManager", "AsyncCheckpointManager", "PreemptionHandler",
            "get_dead_nodes", "resume_or_start", "FaultInjector", "inject",
@@ -58,7 +59,7 @@ _log = logging.getLogger("incubator_mxnet_tpu.fault")
 # mxnet_worker_* Prometheus families read this registry)
 # ---------------------------------------------------------------------------
 
-_stats_lock = threading.Lock()
+_stats_lock = _mxsan.lock("fault.py", "_stats_lock")
 _counters = {
     "ckpt_saves": 0,            # snapshots committed to disk (sync + async)
     "ckpt_async_snapshots": 0,  # save_async calls accepted into the queue
@@ -127,7 +128,7 @@ class FaultInjector:
         if spec is None:
             from .util import getenv_str
             spec = getenv_str("MXNET_FAULT_INJECT")
-        self._lock = threading.Lock()
+        self._lock = _mxsan.lock("fault.py", "self._lock")
         self._hits = {}
         self._rules = {}        # site -> [(n, action, arg)]
         for part in (spec or "").split(","):
@@ -209,7 +210,8 @@ def inject(site):
 # MXNET_FLIGHT_RECORDER (a directory path) with the cached-boolean pattern.
 # ---------------------------------------------------------------------------
 
-_flight_lock = threading.Lock()     # guards the ring; LEAF, nests under none
+_flight_lock = _mxsan.lock(
+    "fault.py", "_flight_lock")     # guards the ring; LEAF, nests under none
 _flight_dir = None                  # cached MXNET_FLIGHT_RECORDER read
 _flight_ring = None                 # deque of recent records
 _flight_sig_installed = False
@@ -648,7 +650,8 @@ class AsyncCheckpointManager(CheckpointManager):
             from .util import getenv_int
             queue_size = getenv_int("MXNET_CKPT_QUEUE")
         self.queue_size = max(1, int(queue_size))
-        self._wlock = threading.Lock()      # guards _pending/_busy/_error
+        self._wlock = _mxsan.lock(
+            "fault.py", "self._wlock")      # guards _pending/_busy/_error
         self._pending = deque()
         self._work = threading.Event()      # snapshot queued
         self._settled = threading.Event()   # queue empty AND writer idle

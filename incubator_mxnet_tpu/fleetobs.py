@@ -42,6 +42,7 @@ import re
 import shutil
 import tempfile
 import threading
+from . import mxsan as _mxsan
 import time
 import weakref
 from collections import deque
@@ -56,7 +57,7 @@ _log = logging.getLogger("incubator_mxnet_tpu.fleetobs")
 # lock order (declared in tools/mxlint/lock_order.py): a FleetRegistry's
 # self._lock may be held when the module _lock is taken (_bump from
 # fold()); never the other way around
-_lock = threading.Lock()
+_lock = _mxsan.lock("fleetobs.py", "_lock")
 _enabled = None
 
 _counters = {
@@ -424,7 +425,7 @@ class FleetRegistry:
     LIVE_WINDOW_S = 30.0    # a rank silent this long is down in /fleet
 
     def __init__(self, specs=None, interval_s=None):
-        self._lock = threading.Lock()
+        self._lock = _mxsan.lock("fleetobs.py", "self._lock")
         self._ranks = {}        # (gen, rank) -> state dict
         self._fleet_hist = {}   # phase -> [count, sum_ms, buckets]
         self._pending = {}      # (gen, rank) -> control dict

@@ -138,7 +138,9 @@ class ImageRecordIter(DataIter):
                 self._queue.put(DataBatch(data=[nd.array(data)],
                                           label=batch.label, pad=batch.pad))
 
-        self._worker = threading.Thread(target=produce, daemon=True)
+        self._worker = threading.Thread(target=produce,
+                                        name="mxtpu-image-prefetch",
+                                        daemon=True)
         self._worker.start()
 
     def reset(self):

@@ -296,7 +296,9 @@ class PrefetchingIter(DataIter):
                     return
                 self._queue.put(batch)
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(target=worker,
+                                        name="mxtpu-io-prefetch",
+                                        daemon=True)
         self._thread.start()
         if self._device or self._mesh is not None:
             from .prefetch import DevicePrefetcher

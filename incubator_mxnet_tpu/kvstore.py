@@ -45,6 +45,7 @@ import threading
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
+from . import mxsan as _mxsan
 
 __all__ = ["KVStore", "create", "ship_kv_pages", "fetch_kv_pages"]
 
@@ -227,7 +228,7 @@ class KVStore:
     # shared sequence counters (store generation, barrier tag, heartbeat)
     # live on the class; every bump goes through _next_seq so concurrent
     # store creation / barriers from io worker threads cannot tear them
-    _class_lock = threading.Lock()
+    _class_lock = _mxsan.lock("kvstore.py", "KVStore._class_lock")
     _async_gen_counter = 0
 
     @classmethod

@@ -72,6 +72,7 @@ import time
 
 from . import fault as _fault
 from .base import MXNetError
+from . import mxsan as _mxsan
 
 __all__ = ["AsyncServer", "AsyncClient", "start_async_server",
            "connect_async_server"]
@@ -160,14 +161,15 @@ class AsyncServer:
         # inheriting the previous store's converged state
         self._store = {}            # (gen, key) -> NDArray weight
         self._updaters = {}         # gen -> Updater
-        self._lock = threading.Lock()   # serializes updates, like the
+        self._lock = _mxsan.lock(
+            "kvstore_server.py", "self._lock")   # serializes updates, like the
         #                                 reference's executor queue
         self._push_counts = {}      # (gen, rank) -> pushes handled
         # liveness registry (reference kvstore_dist.h:121 get_dead_nodes):
         # fed by register/heartbeat/push, read by dead_nodes/membership.
         # _hb_lock is a LEAF lock — never held together with self._lock
         # (push refreshes liveness after releasing the update lock)
-        self._hb_lock = threading.Lock()
+        self._hb_lock = _mxsan.lock("kvstore_server.py", "self._hb_lock")
         self._liveness = {}         # (gen, rank) -> (last_monotonic, step)
         self._phase_reports = {}    # (gen, rank) -> {phase: ms} last step
         self._members = {}          # gen -> set of registered ranks
@@ -553,7 +555,7 @@ class AsyncServer:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(target=self._client_loop, args=(conn,),
-                                 daemon=True)
+                                 name="mxtpu-kv-client", daemon=True)
             t.start()
             self._threads.append(t)
 
@@ -578,7 +580,8 @@ class AsyncServer:
             self._sock.bind((bind, 0))
         self._sock.listen(64)
         port = self._sock.getsockname()[1]
-        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t = threading.Thread(target=self._accept_loop,
+                             name="mxtpu-kv-accept", daemon=True)
         t.start()
         self._threads.append(t)
         advertise = _host_ip() if bind in ("0.0.0.0", "::") else bind
@@ -679,7 +682,10 @@ class AsyncClient:
         from .util import getenv_bool, getenv_int
         self._addr = addr
         self._token = token
-        self._lock = threading.Lock()
+        # mxsan site "AsyncClient._lock" keeps the connection lock (held
+        # across socket I/O by design, BLOCKING_OK) distinct from the
+        # server's update lock, which shares the self._lock spelling.
+        self._lock = _mxsan.lock("kvstore_server.py", "AsyncClient._lock")
         self._sock = None
         self._chan = None
         self._connect_timeout = getenv_int("MXNET_KVSTORE_CONNECT_TIMEOUT")

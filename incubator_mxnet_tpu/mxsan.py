@@ -1,0 +1,682 @@
+"""Witness-based concurrency sanitizer (runtime half of the lock rules).
+
+``tools/mxlint/lock_order.py`` *declares* the lock hierarchy and CC02
+enforces it lexically, but nothing checked what threads actually do at
+runtime — exactly the gap that produced PR 3's GC self-deadlock and
+PR 6's first-call latch race.  This module closes it with the classic
+witness algorithm (the FreeBSD ``WITNESS(4)`` / TSan lock-order idea):
+every instrumented lock acquisition made while another instrumented
+lock is held records an *edge* ``held_top -> acquired`` with the
+acquiring thread's trimmed stack.  A cycle in the observed edge graph
+is an AB/BA deadlock that merely hasn't hung yet — the sanitizer
+reports it from the orderings alone, no hang required.
+
+Instrumentation is a thin factory shim: modules create their locks via
+``mxsan.lock("serve/decode.py", "self._lock")`` instead of
+``threading.Lock()``.  Gate discipline (the PR-10/11 cached-bool
+idiom): with ``MXNET_MXSAN`` off the factories return the *raw stdlib
+primitives* — not a pass-through wrapper, the very same object type a
+build without this module would create — and ``record_count()`` stays
+exactly 0 (tests assert the counter, not wall-clock deltas).  Gate on,
+they return ``_SanLock`` wrappers that maintain a per-thread held
+stack, record first-seen edges / re-entry on non-reentrant locks into
+bounded tables plus a chronological event ring (``MXNET_MXSAN_RING``),
+and run an incremental cycle check on each new edge.  Blocking-call
+interceptors (``time.sleep``, un-timed ``Thread.join``, un-timed
+``queue.Queue.get``, ``subprocess.Popen``, socket connect/accept/
+send/recv) additionally flag lock-held-across-blocking-call, and
+``threading.Thread.start`` is shadowed so unnamed or leaked non-daemon
+threads surface at drain.
+
+``witness()`` snapshots everything as a plain-JSON dict;
+``dump(path)`` (or ``MXNET_MXSAN_LOG`` at interpreter exit) writes it
+for offline replay via ``python -m tools.mxsan``, whose analyzer
+cross-checks every observed edge against ``lock_order.py`` — an
+observed nesting absent from the declarations is a finding, which is
+what makes the registry *proven* rather than aspirational.
+
+Lock hierarchy: the module ``_lock`` is a LEAF guarding the event
+ring, edge/blocking/re-entry tables, and counters; no instrumented
+code, I/O, or other-module call ever runs under it.  It is a raw
+stdlib lock on purpose (the sanitizer cannot instrument itself).
+
+See ``docs/architecture/note_static_analysis.md`` (runtime-sanitizer
+chapter).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import threading
+import time
+import traceback
+import weakref
+
+from .util import getenv_bool, getenv_int, getenv_str
+
+__all__ = [
+    "enabled", "enable", "reset", "record_count", "clear", "stats",
+    "lock", "rlock", "condition",
+    "edges", "events", "witness", "dump", "thread_findings",
+    "render_prometheus",
+]
+
+_lock = threading.Lock()        # LEAF: ring + tables + counters only
+_tls = threading.local()        # .held = list of _SanLock this thread holds
+
+_enabled = None                 # cached MXNET_MXSAN bool (None = unread)
+_records = 0                    # observations booked; exactly 0 while off
+_acquires = 0                   # instrumented acquisitions (diagnostics)
+_dropped = 0                    # ring evictions
+_events = None                  # deque ring of chronological observations
+_edges = None                   # (a, b) -> {count, thread, stack}
+_adj = None                     # a -> set(b), the observed-order digraph
+_blocking = None                # (kind, innermost_site) -> {count, ...}
+_reentry = None                 # site -> {count, thread, stack}
+_cycles = None                  # list of deduped cycle reports
+_cycle_keys = None              # frozenset(edge pairs) already reported
+_threads = None                 # deque of (name, daemon, weakref) started
+_installed = False              # blocking/thread interceptors in place
+_atexit_done = False            # MXNET_MXSAN_LOG dump hook registered
+_orig = {}                      # saved originals for _uninstall
+_sock_added = []                # socket.socket attrs we ADDED (vs replaced)
+
+_STACK_DEPTH = 6                # trimmed frames kept per observation
+_THREAD_CAP = 512               # started-thread table bound
+# Thread names outside our control (pool workers, harness plumbing):
+# exempt from the mxtpu-* naming rule, still subject to nothing else.
+_THREAD_EXEMPT = ("ThreadPoolExecutor", "Dummy-", "pytest", "asyncio",
+                  "pydevd", "paramiko")
+# socketserver/ThreadingHTTPServer spawn their own per-connection
+# threads internally; their targets, not their names, identify them.
+_THREAD_EXEMPT_SUBSTR = ("(process_request_thread)", "(serve_forever)")
+
+
+# ---------------------------------------------------------------------------
+# gate (cached bool, force-override for tests, reset forgets everything)
+# ---------------------------------------------------------------------------
+
+def enabled():
+    """Cached ``MXNET_MXSAN`` gate — the env var is read once."""
+    global _enabled
+    if _enabled is None:
+        _enabled = getenv_bool("MXNET_MXSAN")
+        if _enabled:
+            _install()
+    return _enabled
+
+
+def enable(on=True):
+    """Force the gate (tests / diagnose probes). Returns the previous
+    cached value (None if the env var had not been consulted yet)."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(on)
+    if _enabled:
+        _install()
+    else:
+        _uninstall()
+    return prev
+
+
+def reset():
+    """Forget the cached gate, restore every intercepted callable, and
+    drop all witness state."""
+    global _enabled
+    _uninstall()
+    with _lock:
+        _enabled = None
+        _clear_locked(stats=True)
+
+
+def record_count():
+    """Total sanitizer observations booked (edge sightings, blocking
+    calls under a lock, re-entries, cycles). Exactly 0 while the gate
+    is off — the zero-overhead assert counts records, it does not time
+    anything."""
+    with _lock:
+        return _records
+
+
+def clear(stats=False):
+    """Drop the ring and witness tables; with ``stats=True`` also zero
+    the counters (mirrors ``shardlint.clear``)."""
+    with _lock:
+        _clear_locked(stats=stats)
+
+
+def _clear_locked(stats=False):
+    global _records, _acquires, _dropped
+    global _events, _edges, _adj, _blocking, _reentry
+    global _cycles, _cycle_keys, _threads
+    _events = None
+    _edges = None
+    _adj = None
+    _blocking = None
+    _reentry = None
+    _cycles = None
+    _cycle_keys = None
+    _threads = None
+    if stats:
+        _records = 0
+        _acquires = 0
+        _dropped = 0
+
+
+def stats():
+    """Plain picklable counter snapshot (all-zero while the gate is
+    off; asserted by the zero-overhead tests)."""
+    with _lock:
+        return {
+            "enabled": bool(_enabled),
+            "records": _records,
+            "acquires": _acquires,
+            "dropped": _dropped,
+            "edges": len(_edges) if _edges else 0,
+            "blocking": sum(b["count"] for b in _blocking.values())
+            if _blocking else 0,
+            "reentries": sum(r["count"] for r in _reentry.values())
+            if _reentry else 0,
+            "cycles": len(_cycles) if _cycles else 0,
+            "threads": len(_threads) if _threads else 0,
+        }
+
+
+# ---------------------------------------------------------------------------
+# recording internals (every helper here runs with _lock held briefly
+# and never calls out of the module)
+# ---------------------------------------------------------------------------
+
+def _stack():
+    """Trimmed acquisition stack: repo-relative ``file:line:func`` rows,
+    innermost last, mxsan's own frames dropped."""
+    rows = []
+    for fr in traceback.extract_stack():
+        fn = fr.filename.replace(os.sep, "/")
+        if fn.endswith("incubator_mxnet_tpu/mxsan.py"):
+            continue
+        for mark in ("incubator_mxnet_tpu/", "tools/", "tests/"):
+            i = fn.rfind(mark)
+            if i >= 0:
+                fn = fn[i:]
+                break
+        else:
+            fn = fn.rsplit("/", 1)[-1]
+        rows.append("%s:%d:%s" % (fn, fr.lineno, fr.name))
+    return rows[-_STACK_DEPTH:]
+
+
+def _push_event(ev):
+    """Append to the bounded ring (drop-oldest, counted) and bump the
+    record counter. Caller holds _lock."""
+    global _events, _records, _dropped
+    if _events is None:
+        _events = collections.deque(
+            maxlen=max(64, getenv_int("MXNET_MXSAN_RING")))
+    if len(_events) == _events.maxlen:
+        _dropped += 1
+    _events.append(ev)
+    _records += 1
+
+
+def _held():
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _note_edge(a, b, thread_name):
+    """First sighting of a->b books an edge (and runs the incremental
+    cycle check); repeats just bump its count."""
+    global _edges, _adj
+    stack = _stack()
+    with _lock:
+        if _edges is None:
+            _edges = {}
+            _adj = {}
+        row = _edges.get((a, b))
+        if row is not None:
+            row["count"] += 1
+            _records_bump()
+            return
+        _edges[(a, b)] = {"count": 1, "thread": thread_name, "stack": stack}
+        _adj.setdefault(a, set()).add(b)
+        _push_event({"type": "edge", "a": a, "b": b,
+                     "thread": thread_name, "stack": stack})
+        _check_cycle_locked(a, b, thread_name)
+
+
+def _records_bump():
+    global _records
+    _records += 1
+
+
+def _check_cycle_locked(a, b, thread_name):
+    """New edge a->b closed a cycle iff b already reaches a. BFS over
+    the small site digraph; dedup by the cycle's edge set."""
+    global _cycles, _cycle_keys
+    path = _find_path_locked(b, a)
+    if path is None:
+        return
+    full = (a,) + path              # a -> b -> ... -> a
+    pairs = tuple(zip(full, full[1:]))
+    key = frozenset(pairs)
+    if _cycle_keys is None:
+        _cycle_keys = set()
+        _cycles = []
+    if key in _cycle_keys:
+        return
+    _cycle_keys.add(key)
+    stacks = {}
+    for pa, pb in pairs:
+        row = _edges.get((pa, pb))
+        stacks["%s -> %s" % (pa, pb)] = {
+            "thread": row["thread"] if row else "?",
+            "stack": row["stack"] if row else [],
+        }
+    cyc = {"path": list(full), "edges": [list(p) for p in pairs],
+           "stacks": stacks, "thread": thread_name}
+    _cycles.append(cyc)
+    _push_event(dict(cyc, type="cycle"))
+
+
+def _find_path_locked(src, dst):
+    if _adj is None:
+        return None
+    q = collections.deque([(src, (src,))])
+    seen = {src}
+    while q:
+        node, path = q.popleft()
+        if node == dst:
+            return path
+        for nxt in _adj.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                q.append((nxt, path + (nxt,)))
+    return None
+
+
+def _note_reentry(site, thread_name):
+    global _reentry
+    stack = _stack()
+    with _lock:
+        if _reentry is None:
+            _reentry = {}
+        row = _reentry.get(site)
+        if row is not None:
+            row["count"] += 1
+            _records_bump()
+            return
+        _reentry[site] = {"count": 1, "thread": thread_name, "stack": stack}
+        _push_event({"type": "reentry", "site": site,
+                     "thread": thread_name, "stack": stack})
+
+
+def _note_blocking(kind):
+    """A known-blocking call ran on a thread holding >=1 instrumented
+    lock. Never raises — this sits inside intercepted stdlib calls."""
+    try:
+        if not _enabled:
+            return
+        held = getattr(_tls, "held", None)
+        if not held:
+            return
+        global _blocking
+        site = held[-1].site
+        held_sites = [h.site for h in held]
+        thread_name = threading.current_thread().name
+        stack = _stack()
+        with _lock:
+            if _blocking is None:
+                _blocking = {}
+            row = _blocking.get((kind, site))
+            if row is not None:
+                row["count"] += 1
+                _records_bump()
+                return
+            _blocking[(kind, site)] = {
+                "count": 1, "held": held_sites,
+                "thread": thread_name, "stack": stack,
+            }
+            _push_event({"type": "blocking", "kind": kind, "site": site,
+                         "held": held_sites, "thread": thread_name,
+                         "stack": stack})
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the instrumented lock
+# ---------------------------------------------------------------------------
+
+class _SanLock:
+    """Wrapper around one stdlib lock: forwards acquire/release and
+    books held-stack + edge/re-entry observations. Only ever handed
+    out while the gate is ON."""
+
+    __slots__ = ("site", "_inner", "_reentrant", "__weakref__")
+
+    def __init__(self, site, inner, reentrant):
+        self.site = site
+        self._inner = inner
+        self._reentrant = reentrant
+
+    def acquire(self, blocking=True, timeout=-1):
+        held = _held()
+        thread_name = threading.current_thread().name
+        already = any(h is self for h in held)
+        if already and not self._reentrant:
+            # Would self-deadlock; report BEFORE blocking on it so the
+            # witness survives even if the caller then hangs.
+            _note_reentry(self.site, thread_name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            global _acquires
+            if held and not already:
+                _note_edge(held[-1].site, self.site, thread_name)
+            with _lock:
+                _acquires += 1
+            held.append(self)
+        return got
+
+    def release(self):
+        held = getattr(_tls, "held", None)
+        if held:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is self:
+                    del held[i]
+                    break
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _is_owned(self):
+        # threading.Condition needs this for RLock-backed waits; fall
+        # back to the held-stack for plain locks.
+        inner = getattr(self._inner, "_is_owned", None)
+        if inner is not None:
+            return inner()
+        return any(h is self for h in getattr(_tls, "held", ()))
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.release()
+
+    def __repr__(self):
+        return "<mxsan %s of %r>" % (self.site, self._inner)
+
+
+def lock(module, name):
+    """A ``threading.Lock`` for acquisition site ``module:name`` (the
+    lock_order.py spellings, e.g. ``lock("serve/decode.py",
+    "self._lock")``). Gate off: the raw stdlib object."""
+    if not enabled():
+        return threading.Lock()
+    return _SanLock("%s:%s" % (module, name), threading.Lock(), False)
+
+
+def rlock(module, name):
+    """A ``threading.RLock`` for site ``module:name`` (re-entry on it
+    is legal and never reported)."""
+    if not enabled():
+        return threading.RLock()
+    return _SanLock("%s:%s" % (module, name), threading.RLock(), True)
+
+
+def condition(module, name, lock=None):
+    """A ``threading.Condition``. An explicit ``lock`` (instrumented or
+    not) is passed through; otherwise the underlying RLock is created
+    via :func:`rlock` so waits/notifies book edges too."""
+    if not enabled():
+        return threading.Condition(lock)
+    if lock is None:
+        lock = rlock(module, name)
+    return threading.Condition(lock)
+
+
+# ---------------------------------------------------------------------------
+# blocking-call + thread-lifecycle interceptors
+# ---------------------------------------------------------------------------
+
+def _install():
+    """Shadow the known-blocking stdlib calls and Thread.start. Installed
+    when the gate turns on; every original is restored by _uninstall."""
+    global _installed, _atexit_done
+    if _installed:
+        return
+    _installed = True
+    import queue as _queue
+    import socket as _socket
+    import subprocess as _subprocess
+
+    _orig["time.sleep"] = time.sleep
+
+    def _sleep(secs):
+        _note_blocking("time.sleep")
+        return _orig["time.sleep"](secs)
+    time.sleep = _sleep
+
+    _orig["Thread.join"] = threading.Thread.join
+
+    def _join(self, timeout=None):
+        if timeout is None:
+            _note_blocking("Thread.join")
+        return _orig["Thread.join"](self, timeout)
+    threading.Thread.join = _join
+
+    _orig["Thread.start"] = threading.Thread.start
+
+    def _start(self):
+        _note_thread(self)
+        return _orig["Thread.start"](self)
+    threading.Thread.start = _start
+
+    _orig["Queue.get"] = _queue.Queue.get
+
+    def _get(self, block=True, timeout=None):
+        if block and timeout is None:
+            _note_blocking("queue.get")
+        return _orig["Queue.get"](self, block, timeout)
+    _queue.Queue.get = _get
+
+    _orig["Popen.__init__"] = _subprocess.Popen.__init__
+
+    def _popen(self, *a, **kw):
+        _note_blocking("subprocess.Popen")
+        return _orig["Popen.__init__"](self, *a, **kw)
+    _subprocess.Popen.__init__ = _popen
+
+    del _sock_added[:]
+    for meth in ("connect", "accept", "recv", "send", "sendall"):
+        real = getattr(_socket.socket, meth)
+        if meth in vars(_socket.socket):
+            _orig["socket." + meth] = real
+        else:
+            _sock_added.append(meth)   # inherited from C base: delattr later
+
+        def _make(meth=meth, real=real):
+            def _wrapped(self, *a, **kw):
+                _note_blocking("socket." + meth)
+                return real(self, *a, **kw)
+            _wrapped.__name__ = meth
+            return _wrapped
+        setattr(_socket.socket, meth, _make())
+
+    if not _atexit_done:
+        _atexit_done = True
+        atexit.register(_atexit_dump)
+
+
+def _uninstall():
+    global _installed
+    if not _installed:
+        return
+    _installed = False
+    import queue as _queue
+    import socket as _socket
+    import subprocess as _subprocess
+    time.sleep = _orig.pop("time.sleep")
+    threading.Thread.join = _orig.pop("Thread.join")
+    threading.Thread.start = _orig.pop("Thread.start")
+    _queue.Queue.get = _orig.pop("Queue.get")
+    _subprocess.Popen.__init__ = _orig.pop("Popen.__init__")
+    for meth in _sock_added:
+        try:
+            delattr(_socket.socket, meth)
+        except AttributeError:
+            pass
+    del _sock_added[:]
+    for key in [k for k in _orig if k.startswith("socket.")]:
+        setattr(_socket.socket, key.split(".", 1)[1], _orig.pop(key))
+
+
+def _note_thread(t):
+    """Book a started thread for the drain-time lifecycle audit. Never
+    raises."""
+    try:
+        if not _enabled:
+            return
+        global _threads
+        with _lock:
+            if _threads is None:
+                _threads = collections.deque(maxlen=_THREAD_CAP)
+            _threads.append((t.name, bool(t.daemon), weakref.ref(t)))
+    except Exception:
+        pass
+
+
+def thread_findings():
+    """Drain-time audit of threads started while the gate was on:
+    rows with a non-``mxtpu-*`` name ("unnamed") and/or still-alive
+    non-daemon threads ("leaked"). Empty list when clean."""
+    with _lock:
+        rows = list(_threads) if _threads else []
+    out = []
+    for name, daemon, ref in rows:
+        name = name or ""
+        if name.startswith(_THREAD_EXEMPT) or \
+                any(s in name for s in _THREAD_EXEMPT_SUBSTR):
+            continue
+        t = ref()
+        alive = bool(t is not None and t.is_alive())
+        problems = []
+        if not name.startswith("mxtpu-"):
+            problems.append("unnamed")
+        if alive and not daemon:
+            problems.append("leaked")
+        if problems:
+            out.append({"name": name, "daemon": daemon, "alive": alive,
+                        "problems": problems})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# snapshots, witness log, telemetry
+# ---------------------------------------------------------------------------
+
+def edges():
+    """Observed-edge table as {"a -> b": count} (diagnose probe)."""
+    with _lock:
+        if not _edges:
+            return {}
+        return {"%s -> %s" % k: v["count"] for k, v in _edges.items()}
+
+
+def events():
+    """Chronological ring snapshot (oldest first)."""
+    with _lock:
+        return list(_events) if _events else []
+
+
+def witness():
+    """The full witness snapshot as a plain-JSON dict — the same shape
+    ``python -m tools.mxsan`` replays from disk."""
+    threads = thread_findings()
+    with _lock:
+        return {
+            "version": 1,
+            "stats": {
+                "enabled": bool(_enabled),
+                "records": _records,
+                "acquires": _acquires,
+                "dropped": _dropped,
+            },
+            "edges": [
+                {"a": a, "b": b, "count": row["count"],
+                 "thread": row["thread"], "stack": row["stack"]}
+                for (a, b), row in (_edges or {}).items()
+            ],
+            "blocking": [
+                {"kind": kind, "site": site, "count": row["count"],
+                 "held": row["held"], "thread": row["thread"],
+                 "stack": row["stack"]}
+                for (kind, site), row in (_blocking or {}).items()
+            ],
+            "reentry": [
+                {"site": site, "count": row["count"],
+                 "thread": row["thread"], "stack": row["stack"]}
+                for site, row in (_reentry or {}).items()
+            ],
+            "cycles": list(_cycles or []),
+            "threads": threads,
+            "events": list(_events or []),
+        }
+
+
+def dump(path=None):
+    """Write the witness log as JSON. ``path`` defaults to
+    ``MXNET_MXSAN_LOG``; returns the path written or None."""
+    path = path or getenv_str("MXNET_MXSAN_LOG")
+    if not path:
+        return None
+    snap = witness()
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(snap, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def _atexit_dump():
+    try:
+        if _enabled and record_count():
+            dump()
+    except Exception:
+        pass
+
+
+_PROM_FAMILIES = (
+    ("records", "counter", "Sanitizer observations booked."),
+    ("acquires", "counter", "Instrumented lock acquisitions."),
+    ("edges", "gauge", "Distinct observed lock-order edges."),
+    ("blocking", "counter", "Blocking calls made while holding a lock."),
+    ("reentries", "counter", "Re-entry attempts on non-reentrant locks."),
+    ("cycles", "gauge", "Distinct lock-order cycles observed."),
+    ("dropped", "counter", "Witness ring evictions."),
+)
+
+
+def render_prometheus(labels=""):
+    """``mxnet_mxsan_*`` exposition block; empty string until the first
+    record so a gate-off scrape is byte-identical."""
+    snap = stats()
+    if not snap["records"]:
+        return ""
+    lab = "{%s}" % labels if labels else ""
+    out = []
+    for stat, mtype, help_text in _PROM_FAMILIES:
+        name = "mxnet_mxsan_" + stat
+        if mtype == "counter":
+            name += "_total"
+        out.append("# HELP %s %s" % (name, help_text))
+        out.append("# TYPE %s %s" % (name, mtype))
+        out.append("%s%s %d" % (name, lab, snap[stat]))
+    return "\n".join(out) + "\n"

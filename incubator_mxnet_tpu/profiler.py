@@ -48,6 +48,7 @@ import weakref
 from collections import defaultdict
 
 from .base import MXNetError
+from . import mxsan as _mxsan
 
 __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
            "pause", "resume", "is_running", "Scope", "Task", "Event",
@@ -61,7 +62,7 @@ __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
            "clock_sync_event", "cost_event", "cost_stats",
            "cost_from_executable", "device_peak_flops", "mfu_stats"]
 
-_lock = threading.Lock()
+_lock = _mxsan.lock("profiler.py", "_lock")
 _state = {
     "running": False,
     "paused": False,
@@ -257,7 +258,7 @@ def _counter_sample_locked(name, value):
 # jit/compile tracker
 # ---------------------------------------------------------------------------
 
-_clock = threading.Lock()
+_clock = _mxsan.lock("profiler.py", "_clock")
 # key -> [hits, misses, compile_ms_total, last_ms, disk_hits]; disk_hits
 # counts the subset of hits served by deserializing a persistent-cache
 # entry (compile_cache disk tier) rather than reusing an in-process one
@@ -350,7 +351,7 @@ def track_jit(key, fn):
     # otherwise both read called=False and both record a miss (the CC01
     # unlocked read-modify-write pattern mxlint polices)
     state = {"called": False, "captured": False}
-    state_lock = threading.Lock()
+    state_lock = _mxsan.lock("profiler.py", "state_lock")
 
     def _maybe_capture(args, kwargs):
         # shardlint graph capture for track_jit sites that did not route
@@ -845,7 +846,7 @@ def mfu_stats():
 # there would self-deadlock. It only appends to _pending_frees (atomic
 # under the GIL); the books are settled at the next drain point
 # (_note_alloc / memory_stats / render_prometheus).
-_mlock = threading.Lock()
+_mlock = _mxsan.lock("profiler.py", "_mlock")
 _mem = {
     "enabled": False,
     "live": defaultdict(int),     # device label -> live bytes
@@ -1079,6 +1080,21 @@ def _fleetobs_stats(always=False):
     return snap
 
 
+def _mxsan_stats(always=False):
+    """Concurrency-sanitizer counters (mxsan.stats(): acquisitions
+    witnessed, observed lock-order edges, blocking-under-lock sightings,
+    re-entries, cycles), or None while the MXNET_MXSAN gate is off and
+    nothing was recorded (unless `always`)."""
+    try:
+        from . import mxsan as _mx
+        snap = _mx.stats()
+    except Exception:       # noqa: BLE001 — torn-down interpreter
+        return None
+    if not always and not any(snap.values()):
+        return None
+    return snap
+
+
 # ---------------------------------------------------------------------------
 # dump / dumps
 # ---------------------------------------------------------------------------
@@ -1220,6 +1236,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     fault_snap = _fault_stats()
     sl_snap = _shardlint_stats()
     fleet_snap = _fleetobs_stats()
+    mxsan_snap = _mxsan_stats()
     if reset:
         # reset=True means reset: every stat family this dump reports
         # restarts, not just the event/counter/compile subset (the old
@@ -1257,6 +1274,11 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             _fo.clear(stats=True)
         except Exception:       # noqa: BLE001
             pass
+        try:
+            from . import mxsan as _mx
+            _mx.clear(stats=True)
+        except Exception:       # noqa: BLE001
+            pass
     if format == "json":
         out = {
             "stats": {k: {"count": v[0], "total_us": _finite(v[1], 0.0),
@@ -1285,6 +1307,8 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             out["shardlint"] = sl_snap
         if fleet_snap is not None:
             out["fleetobs"] = fleet_snap
+        if mxsan_snap is not None:
+            out["mxsan"] = mxsan_snap
         if mem is not None:
             out["memory"] = {"live_bytes": mem["live_bytes"],
                              "peak_bytes": mem["peak_bytes"],
@@ -1377,6 +1401,12 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
                   "-" * 46]
         for k in sorted(fleet_snap):
             lines.append(f"{'fleet_' + k:<34}{fleet_snap[k]:>12}")
+    if mxsan_snap is not None:
+        lines += ["", f"{'Concurrency sanitizer (mxsan)':<34}{'Value':>12}",
+                  "-" * 46]
+        for k in ("enabled", "records", "acquires", "edges", "blocking",
+                  "reentries", "cycles", "threads", "dropped"):
+            lines.append(f"{'mxsan_' + k:<34}{int(mxsan_snap[k]):>12}")
     if mem is not None and (mem["live_bytes"] or mem["peak_bytes"]):
         lines += ["", f"{'Memory (device)':<48}{'Live(bytes)':>14}"
                       f"{'Peak(bytes)':>14}",
@@ -1627,6 +1657,17 @@ def render_prometheus():
             v = ft[stat]
             v = f"{v:.3f}" if isinstance(v, float) else f"{v}"
             lines.append(f"mxnet_worker_{prom} {v}")
+
+    # mxnet_mxsan_*: the concurrency-sanitizer surface. mxsan renders
+    # its own block and returns "" until the first record, so a gate-off
+    # scrape stays byte-identical to a build without the sanitizer.
+    try:
+        from . import mxsan as _mx
+        san = _mx.render_prometheus().rstrip("\n")
+    except Exception:       # noqa: BLE001 — torn-down interpreter
+        san = ""
+    if san:
+        lines.append(san)
 
     _drain_frees()
     with _mlock:

@@ -26,6 +26,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from .stats import ServingStats
+from .. import mxsan as _mxsan
 
 __all__ = ["DynamicBatcher", "Overloaded", "DeadlineExceeded"]
 
@@ -89,7 +90,7 @@ class DynamicBatcher:
         self.stats = stats if stats is not None else ServingStats(name)
         self._thread = None
         self._running = False
-        self._lock = threading.Lock()
+        self._lock = _mxsan.lock("serve/batcher.py", "self._lock")
         # drain support (control plane / graceful shutdown): pause()
         # closes admission (submit sheds with a retryable Overloaded so
         # routers reroute), quiesce() waits for the queue + the in-flight

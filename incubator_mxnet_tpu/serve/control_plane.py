@@ -48,13 +48,14 @@ import urllib.request
 from .. import fault as _fault
 from ..base import MXNetError
 from ..util import getenv_bool, getenv_int
+from .. import mxsan as _mxsan
 
 __all__ = ["ServeRegistry", "ReplicaAgent", "RolloutManager"]
 
 _log = logging.getLogger("incubator_mxnet_tpu.serve.control_plane")
 
 # -- module counter registry (diagnose.py Control Plane section) -----------
-_lock = threading.Lock()
+_lock = _mxsan.lock("serve/control_plane.py", "_lock")
 _counters = {
     "registrations": 0,         # serve_register ops handled
     "deregistrations": 0,       # serve_deregister ops handled
@@ -117,7 +118,7 @@ class ServeRegistry:
     """
 
     def __init__(self, live_window_s=None):
-        self._lock = threading.Lock()
+        self._lock = _mxsan.lock("serve/control_plane.py", "self._lock")
         self._replicas = {}     # (model, rid) -> row dict
         self._next_id = 0
         self._epoch = 0         # bumps on register/deregister
@@ -231,7 +232,8 @@ class ReplicaAgent:
                         else max(1, getenv_int("MXNET_HEARTBEAT_INTERVAL")))
         self.replica_id = None
         self.registered = False
-        self._lock = threading.Lock()       # guards the wire client handle
+        self._lock = _mxsan.lock(
+            "serve/control_plane.py", "self._lock")       # guards the wire client handle
         self._client = None
         self._stop = threading.Event()
         self._thread = None
@@ -355,7 +357,8 @@ class RolloutManager:
                         else getenv_int("MXNET_ROLLOUT_SETTLE_MS") / 1e3)
         self._reload_timeout = reload_timeout_s
         self._slo_check = slo_check
-        self._lock = threading.Lock()   # guards state/history/counters
+        self._lock = _mxsan.lock(
+            "serve/control_plane.py", "self._lock")   # guards state/history/counters
         self.state = "idle"
         self.generation = None
         self.history = []               # [(monotonic, state, info)]

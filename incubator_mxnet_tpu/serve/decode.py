@@ -61,6 +61,7 @@ from . import reqtrace as _rt
 from .batcher import DeadlineExceeded, Overloaded
 from .predictor import BucketLadder
 from .stats import ServingStats
+from .. import mxsan as _mxsan
 
 __all__ = ["PageAllocator", "DecodePredictor", "DecodeScheduler",
            "DecodeStream"]
@@ -92,7 +93,7 @@ class PageAllocator:
         if num_pages < 1:
             raise MXNetError("PageAllocator needs at least one page")
         self.num_pages = int(num_pages)
-        self._alloc_lock = threading.Lock()
+        self._alloc_lock = _mxsan.lock("serve/decode.py", "self._alloc_lock")
         # pop() takes from the tail: keep low page ids first for
         # readable tests, recency-reuse for cache locality in practice
         self._free = list(range(self.num_pages - 1, -1, -1))
@@ -247,7 +248,8 @@ class DecodePredictor:
         import jax.numpy as jnp
         self._param_vals = {k: jnp.asarray(v, jnp.float32)
                             for k, v in params.items()}
-        self._compile_lock = threading.Lock()
+        self._compile_lock = _mxsan.lock(
+            "serve/decode.py", "self._compile_lock")
         self._prefill_fns = {}
         self._decode_fn = None
         self._warm_keys = set()
@@ -566,7 +568,7 @@ class DecodeScheduler:
         self.prefix_cache = prefix_cache
         self._chunk_fn = chunk_prefill
         s = predictor.slots
-        self._lock = threading.Lock()
+        self._lock = _mxsan.lock("serve/decode.py", "self._lock")
         self._wake = threading.Event()
         self._waiting = deque()
         self._active = [None] * s

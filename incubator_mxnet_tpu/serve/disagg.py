@@ -48,11 +48,12 @@ from ..base import MXNetError
 from .. import util
 from . import reqtrace as _rt
 from .stats import ServingStats
+from .. import mxsan as _mxsan
 
 __all__ = ["PrefillPredictor", "PrefillEngine", "ship_key_for",
            "fetch_kv_import", "stats", "clear"]
 
-_lock = threading.Lock()
+_lock = _mxsan.lock("serve/disagg.py", "_lock")
 _counters = {}
 
 
@@ -96,7 +97,8 @@ class PrefillPredictor:
                          else util.getenv_int("MXNET_DISAGG_PREFILL_CHUNK"))
         if self.chunk < 1:
             raise MXNetError(f"prefill chunk {self.chunk}: need >= 1")
-        self._compile_lock = threading.Lock()
+        self._compile_lock = _mxsan.lock(
+            "serve/disagg.py", "self._compile_lock")
         self._fn = None
         self._warm = False
 
@@ -235,7 +237,7 @@ class PrefillEngine:
             from .prefix_cache import PrefixCache
             prefix_cache = PrefixCache(self.allocator, predictor.page_size)
         self.prefix_cache = prefix_cache or None
-        self._lock = threading.Lock()
+        self._lock = _mxsan.lock("serve/disagg.py", "self._lock")
         self._k_pages = None
         self._v_pages = None
         self.stats.set_gauge("kv_pages_total", predictor.num_pages)

@@ -31,6 +31,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from .. import mxsan as _mxsan
 
 __all__ = ["Predictor", "BucketLadder"]
 
@@ -153,7 +154,8 @@ class Predictor:
         self._executables = {}
         self._warm_buckets = set()      # buckets warmup() has realized
         self._cap_warned = False
-        self._compile_lock = threading.Lock()
+        self._compile_lock = _mxsan.lock(
+            "serve/predictor.py", "self._compile_lock")
         self._run = self._sym._build_eval(training=False)
         self._inputs = {}
         self._outputs = None

@@ -42,6 +42,7 @@ import threading
 
 from ..base import MXNetError
 from .. import util
+from .. import mxsan as _mxsan
 
 __all__ = ["PrefixCache"]
 
@@ -74,7 +75,7 @@ class PrefixCache:
         self.max_pages = int(
             max_pages if max_pages is not None
             else util.getenv_int("MXNET_PREFIX_CACHE_PAGES"))
-        self._lock = threading.Lock()
+        self._lock = _mxsan.lock("serve/prefix_cache.py", "self._lock")
         self._root = _Node((), -1, 0, None)
         self._clock = 0
         self._cached_pages = 0

@@ -47,6 +47,7 @@ import time
 
 from .. import profiler as _prof
 from ..util import getenv_bool, getenv_int
+from .. import mxsan as _mxsan
 
 __all__ = [
     "TRACE_HEADER", "RequestTrace", "enabled", "enable", "reset",
@@ -59,7 +60,8 @@ __all__ = [
 
 TRACE_HEADER = "X-MXNET-Trace"
 
-_lock = threading.Lock()        # leaf: counter + rings only
+_lock = _mxsan.lock(
+    "serve/reqtrace.py", "_lock")        # leaf: counter + rings only
 _tls = threading.local()        # .ctx = active RequestTrace, .stack = span ids
 
 _enabled = None                 # cached MXNET_REQTRACE bool (None = unread)

@@ -58,6 +58,7 @@ from ..util import getenv_bool, getenv_int
 from . import reqtrace as _rt
 from .batcher import DeadlineExceeded, Overloaded
 from .stats import LatencyHistogram, reqtrace_exemplar_lines
+from .. import mxsan as _mxsan
 
 __all__ = ["Router", "RouterStats", "RouteError", "NoReplicaAvailable"]
 
@@ -98,7 +99,7 @@ class RouterStats:
 
     def __init__(self, name="router"):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = _mxsan.lock("serve/router.py", "self._lock")
         self._counters = {}
         self._gauges = {}
         self.latency = LatencyHistogram()   # internally locked
@@ -255,7 +256,8 @@ class Router:
             else getenv_int("MXNET_ROUTER_TOKEN_SLO_MS"))
         self.stats = stats if stats is not None else RouterStats(name)
         self._rng = random.Random()
-        self._rlock = threading.Lock()  # replica table + breakers;
+        self._rlock = _mxsan.lock(
+            "serve/router.py", "self._rlock")  # replica table + breakers;
         #                                 OUTERMOST, stats lock is a leaf
         self._replicas = {}             # rid -> {"addr", "ready", "generation"}
         self._breakers = {}             # rid -> _Breaker
@@ -594,7 +596,7 @@ class Router:
                 results.put((out, rid, hedged))
 
         threading.Thread(target=run, args=(*cands[0], False),
-                         daemon=True).start()
+                         name="mxtpu-router-attempt", daemon=True).start()
         outstanding, hedge_fired = 1, False
         first_failure = None
         while outstanding:
@@ -619,6 +621,7 @@ class Router:
                         _rt.observe(ctx, "hedge", wait * 1e3,
                                     args={"replica": cands[1][0]})
                     threading.Thread(target=run, args=(*cands[1], True),
+                                     name="mxtpu-router-hedge",
                                      daemon=True).start()
                 continue
             outstanding -= 1

@@ -73,6 +73,7 @@ from ..util import getenv_int, getenv_str
 from . import reqtrace as _rt
 from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
 from .stats import ServingStats
+from .. import mxsan as _mxsan
 
 __all__ = ["ModelServer"]
 
@@ -497,13 +498,14 @@ class ModelServer:
         self.role = role
         self.prefill_engine = prefill_engine
         self._ship_client = None        # lazy kvstore client for paging
-        self._ship_lock = threading.Lock()
+        self._ship_lock = _mxsan.lock("serve/server.py", "self._ship_lock")
         self._host, self._port = host, port
         self._httpd = None
         self._thread = None
         self._agent = None
         self._draining = False
-        self._drain_lock = threading.Lock()     # serializes drain/swap
+        self._drain_lock = _mxsan.lock(
+            "serve/server.py", "self._drain_lock")     # serializes drain/swap
         self._prev = None       # (predictor, generation) for rollback
         self._prev_sigterm = None
 

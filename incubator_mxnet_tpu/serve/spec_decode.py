@@ -72,6 +72,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from .. import util
+from .. import mxsan as _mxsan
 
 __all__ = ["DraftState", "SpecDecoder"]
 
@@ -184,7 +185,8 @@ class SpecDecoder:
         self._draft_factory = draft_factory
         self._params_np = {name: _np.asarray(v, _np.float32)
                            for name, v in predictor._param_vals.items()}
-        self._compile_lock = threading.Lock()
+        self._compile_lock = _mxsan.lock(
+            "serve/spec_decode.py", "self._compile_lock")
         self._verify_fn = None
         self._warm = False
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 import math
 import threading
+from .. import mxsan as _mxsan
 
 __all__ = ["LatencyHistogram", "ServingStats", "reqtrace_exemplar_lines"]
 
@@ -59,7 +60,7 @@ class LatencyHistogram:
         self._bounds = [self._FLOOR * self._GROWTH ** i
                         for i in range(nbuckets)]
         self._counts = [0] * (nbuckets + 1)  # +1: overflow bucket
-        self._lock = threading.Lock()
+        self._lock = _mxsan.lock("serve/stats.py", "self._lock")
         self._exemplars = None  # bucket idx -> [(seconds, trace_id)] desc
         self.count = 0
         self.sum = 0.0
@@ -176,7 +177,7 @@ class ServingStats:
 
     def __init__(self, name="serve"):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = _mxsan.lock("serve/stats.py", "self._lock")
         self.latency = LatencyHistogram()      # end-to-end (submit->result)
         self.queue_wait = LatencyHistogram()   # submit->dispatch
         self.forward_time = LatencyHistogram()  # batched predict call

@@ -23,6 +23,7 @@ explicitly declared, so un-annotated user jits are never false positives.
 from __future__ import annotations
 
 import threading
+from . import mxsan as _mxsan
 
 __all__ = ["Capture", "enabled", "enable", "reset", "annotate",
            "annotation_for", "record_jit", "record_tuned",
@@ -31,7 +32,7 @@ __all__ = ["Capture", "enabled", "enable", "reset", "annotate",
 
 # Guards the capture buffer, counters, and annotation table
 # (declared in tools/mxlint/lock_order.py).
-_lock = threading.Lock()
+_lock = _mxsan.lock("shardlint.py", "_lock")
 _captures = []
 _annotations = {}            # jit key -> metadata dict
 _stats = {

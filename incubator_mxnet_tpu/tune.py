@@ -42,6 +42,7 @@ import json
 import logging
 import os
 import threading
+from . import mxsan as _mxsan
 import time
 from collections import OrderedDict
 
@@ -52,7 +53,7 @@ _MAGIC = b"MXTN1\n"   # on-disk: MAGIC + fp + "\n" + sha256(body) + "\n" + body
 _SUFFIX = ".mxtn"
 _SUBDIR = "tuned"     # under MXNET_EXEC_CACHE_DIR, beside the .mxec blobs
 
-_lock = threading.Lock()
+_lock = _mxsan.lock("tune.py", "_lock")
 _kernels = {}        # kernel name -> KernelSpec
 _winners = {}        # fingerprint -> record dict
 _stats = {

@@ -476,6 +476,22 @@ ENV_VARS = collections.OrderedDict([
      "Capacity of each request-trace ring (recent sampled requests "
      "and error/SLO-breach exemplars), served at /debugz/requests. "
      "Floored at 4.")),
+    ("MXNET_MXSAN", EnvSpec(False, "bool",
+     "Witness-based concurrency sanitizer (mxsan.py): lock factories "
+     "return instrumented wrappers that record per-thread acquisition "
+     "orderings, blocking calls made under a lock, and re-entry on "
+     "non-reentrant locks; tools/mxsan cross-checks the observed edges "
+     "against tools/mxlint/lock_order.py and reports AB/BA cycles "
+     "before they hang. Off (default): factories hand back the raw "
+     "stdlib primitives — zero records, zero wrappers.")),
+    ("MXNET_MXSAN_RING", EnvSpec(4096, "int",
+     "Capacity of the mxsan witness event ring; once full the OLDEST "
+     "event is dropped (counted in mxsan.stats()['dropped']). "
+     "Floored at 64.")),
+    ("MXNET_MXSAN_LOG", EnvSpec("", "str",
+     "When set (and MXNET_MXSAN is on), mxsan writes its witness log "
+     "(events + observed edge table) to this path as JSON at interpreter "
+     "exit, for offline replay via `python -m tools.mxsan <path>`.")),
 ])
 
 _FALSY = frozenset(("", "0", "false", "off", "no"))

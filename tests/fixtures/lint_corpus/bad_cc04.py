@@ -1,0 +1,19 @@
+"""CC04 corpus: blocking calls made while holding a lock."""
+import queue
+import threading
+import time
+
+_lock = threading.Lock()
+_work_q = queue.Queue()
+
+
+def drain(worker):
+    with _lock:
+        time.sleep(0.5)
+        item = _work_q.get()
+        worker.join()
+    return item
+
+
+def _flush_locked(sock):
+    sock.sendall(b"bye")

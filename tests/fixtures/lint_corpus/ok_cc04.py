@@ -1,0 +1,22 @@
+"""CC04 corpus (clean): bounded waits, and unbounded waits outside."""
+import queue
+import threading
+import time
+
+_lock = threading.Lock()
+_work_q = queue.Queue()
+
+
+def drain(worker, names):
+    with _lock:
+        item = _work_q.get(timeout=1.0)
+        worker.join(timeout=1.0)
+        label = ", ".join(names)
+    time.sleep(0.01)
+    return item, label
+
+
+def flush(sock):
+    with _lock:
+        payload = b"bye"
+    sock.sendall(payload)

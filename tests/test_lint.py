@@ -110,6 +110,35 @@ def test_suppression_wrong_rule_does_not_silence():
     assert [f.rule for f in findings] == ["EV01"]
 
 
+def test_cc04_timed_waits_pass_untimed_fire():
+    src = ('import threading\n'
+           '_lock = threading.Lock()\n'
+           'def go(t):\n'
+           '    with _lock:\n'
+           '        t.join(timeout=1.0)\n'
+           '    with _lock:\n'
+           '        t.join()\n')
+    findings, _ = lint_source(src)
+    assert [(f.rule, f.line) for f in findings] == [("CC04", 7)]
+
+
+def test_cc04_blocking_ok_leaf_allowance():
+    # the same subprocess-under-lock body fires in an unregistered
+    # module but is allowed at native/__init__.py, whose module lock is
+    # a reviewed BLOCKING_OK entry (single-flight native build)
+    src = ('import subprocess\n'
+           'import threading\n'
+           '_lock = threading.Lock()\n'
+           'def build(cmd):\n'
+           '    with _lock:\n'
+           '        subprocess.run(cmd, timeout=120)\n')
+    findings, _ = lint_source(src)
+    assert [f.rule for f in findings] == ["CC04"]
+    findings, _ = lint_source(
+        src, path="incubator_mxnet_tpu/native/__init__.py")
+    assert findings == []
+
+
 # -- CLI contract ----------------------------------------------------------
 
 def test_cli_json_clean_on_package():

@@ -1,0 +1,553 @@
+"""mxsan: the witness-based runtime lock-order sanitizer.
+
+Acceptance criteria from the concurrency-sanitizer milestone:
+  * with MXNET_MXSAN off the lock factories hand back the raw stdlib
+    primitives (byte-for-byte the object a build without mxsan would
+    create) and record_count() stays EXACTLY 0 — counter-asserted,
+    never timed,
+  * gate on, nested acquisitions record witness edges with stacks; a
+    forced AB/BA drill (FaultInjector delay widening the window)
+    reports the cycle naming both acquisition stacks WITHOUT hanging,
+  * blocking calls (sleep / un-timed join / un-timed queue.get) made
+    under an instrumented lock, re-entry on a plain Lock, and
+    unnamed/leaked threads are all reported,
+  * python -m tools.mxsan replays a dumped witness log against
+    lock_order.py: exit 0 clean / 1 findings / 2 usage, and the waiver
+    registry (reason required, budget <= 5) is pinned EXACT,
+  * a multithreaded corpus of real serving components runs sanitizer-on
+    with the finding set exactly empty — the tier-1 gate that makes
+    lock_order.py proven rather than aspirational.
+"""
+import json
+import os
+import pickle
+import queue
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from incubator_mxnet_tpu import fault, mxsan, profiler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.mxsan import (RULES, analyze, declared_edge_count,  # noqa: E402
+                         load_witness)
+from tools.mxsan.waivers import WAIVERS  # noqa: E402
+
+# the corpus-gate waiver set, asserted EXACTLY: adding a waiver means
+# updating this list (and defending its reason in review). Budget 5.
+EXPECTED_WAIVED = []
+
+
+@pytest.fixture
+def san():
+    """Force the sanitizer gate on for one test; leave no state (and no
+    intercepted stdlib callables) behind."""
+    mxsan.reset()
+    mxsan.enable(True)
+    yield mxsan
+    mxsan.reset()
+
+
+def _run_cli(args, env=None):
+    return subprocess.run([sys.executable, "-m", "tools.mxsan"] + args,
+                          capture_output=True, text=True, cwd=REPO, env=env)
+
+
+# -- gate discipline: zero overhead while off --------------------------
+
+
+def test_gate_off_returns_raw_stdlib_objects(monkeypatch):
+    monkeypatch.delenv("MXNET_MXSAN", raising=False)
+    mxsan.reset()
+    raw_sleep = time.sleep
+    lk = mxsan.lock("profiler.py", "_lock")
+    rl = mxsan.rlock("profiler.py", "_clock")
+    cv = mxsan.condition("serve/decode.py", "self._lock")
+    # the very same types threading would hand out, not wrappers
+    assert type(lk) is type(threading.Lock())
+    assert type(rl) is type(threading.RLock())
+    assert type(cv) is threading.Condition
+    # and no interceptor was installed
+    assert time.sleep is raw_sleep
+    with lk:
+        with rl:
+            time.sleep(0)
+    assert mxsan.record_count() == 0
+
+
+def test_gate_off_zero_records_and_stable_stats(monkeypatch):
+    monkeypatch.delenv("MXNET_MXSAN", raising=False)
+    mxsan.reset()
+    before = mxsan.stats()
+    assert before["enabled"] is False
+    assert not any(v for k, v in before.items() if k != "enabled")
+    a = mxsan.lock("serve/stats.py", "self._lock")
+    b = mxsan.lock("serve/batcher.py", "self._lock")
+    for _ in range(50):
+        with a:
+            with b:
+                pass
+    after = mxsan.stats()
+    # byte-for-byte stable: nesting raw locks books nothing at all
+    assert pickle.dumps(after) == pickle.dumps(before)
+    assert mxsan.record_count() == 0
+    assert mxsan.render_prometheus() == ""
+    assert mxsan.witness()["edges"] == []
+
+
+# -- edge recording + the declaration cross-check ----------------------
+
+
+def test_edge_recording_and_dedup(san):
+    outer = san.lock("profiler.py", "_lock")
+    inner = san.lock("profiler.py", "_clock")
+    for _ in range(3):
+        with outer:
+            with inner:
+                pass
+    assert san.edges() == {"profiler.py:_lock -> profiler.py:_clock": 3}
+    snap = san.stats()
+    assert snap["edges"] == 1 and snap["acquires"] == 6
+    # the edge is one event (first sighting); repeats only bump counters
+    assert [e["type"] for e in san.events()] == ["edge"]
+    ed = san.witness()["edges"][0]
+    assert ed["thread"] and ed["stack"], "edges carry thread + stack"
+    res = analyze(san.witness(), waivers=())
+    assert res.clean, [f.render() for f in res.findings]
+
+
+def test_inverted_order_is_san02(san):
+    # profiler.py declares _lock before _clock; observe the inversion
+    outer = san.lock("profiler.py", "_clock")
+    inner = san.lock("profiler.py", "_lock")
+    with outer:
+        with inner:
+            pass
+    res = analyze(san.witness(), waivers=())
+    assert [f.rule for f in res.findings] == ["SAN02"]
+    f = res.findings[0]
+    assert f.key == "profiler.py:_clock -> profiler.py:_lock"
+    assert "inverts the declared order" in f.message
+    assert "profiler.py:_clock -> profiler.py:_lock" in f.detail["stacks"]
+
+
+def test_undeclared_cross_module_edge_is_san02(san):
+    a = san.lock("serve/stats.py", "self._lock")
+    b = san.lock("serve/batcher.py", "self._lock")
+    with a:
+        with b:
+            pass
+    res = analyze(san.witness(), waivers=())
+    assert [f.rule for f in res.findings] == ["SAN02"]
+    assert "CROSS_MODULE_EDGES" in res.findings[0].message
+    # the declared direction (server drain -> batcher) stays clean: the
+    # registry is directional, not symmetric
+    san.clear(stats=True)
+    c = san.lock("serve/server.py", "self._drain_lock")
+    with c:
+        with b:
+            pass
+    assert analyze(san.witness(), waivers=()).clean
+
+
+def test_undeclared_lock_name_is_san02(san):
+    outer = san.lock("profiler.py", "_lock")
+    rogue = san.lock("profiler.py", "_rogue")
+    with outer:
+        with rogue:
+            pass
+    res = analyze(san.witness(), waivers=())
+    assert [f.rule for f in res.findings] == ["SAN02"]
+    assert "_rogue" in res.findings[0].message
+    assert "absent from the declared order" in res.findings[0].message
+
+
+# -- the AB/BA deadlock drill ------------------------------------------
+
+
+def test_abba_cycle_drill_names_both_stacks(san):
+    """Two threads nest the same pair in opposite orders; the injected
+    delay models the slow critical section that makes the interleaving
+    a real hang in production. The witness reports the cycle from the
+    orderings alone — every join is timeout-guarded, nothing hangs."""
+    a = san.lock("tests/drill.py", "A")
+    b = san.lock("tests/drill.py", "B")
+    inj = fault.FaultInjector("drill@1:delay=0.05")
+    t1_done = threading.Event()
+
+    def chain_ab():
+        with a:
+            inj.fire("drill")       # sleeps 50ms while holding A
+            with b:
+                pass
+        t1_done.set()
+
+    def chain_ba():
+        assert t1_done.wait(timeout=10)
+        with b:
+            got = a.acquire(timeout=5)
+            assert got
+            a.release()
+
+    t1 = threading.Thread(target=chain_ab, name="mxtpu-drill-ab")
+    t2 = threading.Thread(target=chain_ba, name="mxtpu-drill-ba")
+    t1.start()
+    t2.start()
+    t1.join(timeout=30)
+    t2.join(timeout=30)
+    assert not t1.is_alive() and not t2.is_alive()
+
+    wit = san.witness()
+    assert len(wit["cycles"]) == 1
+    cyc = wit["cycles"][0]
+    assert cyc["path"][0] == cyc["path"][-1]
+    assert set(cyc["path"]) == {"tests/drill.py:A", "tests/drill.py:B"}
+    stacks = cyc["stacks"]
+    assert set(stacks) == {"tests/drill.py:A -> tests/drill.py:B",
+                           "tests/drill.py:B -> tests/drill.py:A"}
+    threads_seen = {row["thread"] for row in stacks.values()}
+    assert threads_seen == {"mxtpu-drill-ab", "mxtpu-drill-ba"}
+    for row in stacks.values():
+        assert row["stack"], "each edge carries its acquisition stack"
+    # the injector delay under A was itself caught as SAN03, and the
+    # injector's own lock nested under A as an (undeclared) edge
+    kinds = {(b_["kind"], b_["site"]) for b_ in wit["blocking"]}
+    assert ("time.sleep", "tests/drill.py:A") in kinds
+    findings = analyze(wit, waivers=()).findings
+    assert "SAN01" in {f.rule for f in findings}
+    san01 = [f for f in findings if f.rule == "SAN01"][0]
+    assert len(san01.detail["stacks"]) == 2
+
+
+# -- re-entry ----------------------------------------------------------
+
+
+def test_reentry_reported_and_rlock_exempt(san):
+    lk = san.lock("tests/reentry.py", "plain")
+    assert lk.acquire(timeout=1)
+    # would self-deadlock: reported BEFORE blocking, timeout bails out
+    assert lk.acquire(timeout=0.01) is False
+    lk.release()
+    assert san.stats()["reentries"] == 1
+    res = analyze(san.witness(), waivers=())
+    assert ("SAN04", "tests/reentry.py:plain") in \
+        [(f.rule, f.key) for f in res.findings]
+
+    san.clear(stats=True)
+    rl = san.rlock("tests/reentry.py", "rlock")
+    with rl:
+        with rl:                # legal on an RLock, never reported
+            pass
+    assert san.stats()["reentries"] == 0
+
+
+# -- blocking-under-lock -----------------------------------------------
+
+
+def test_blocking_under_lock_kinds(san):
+    lk = san.lock("tests/blocking.py", "L")
+    q = queue.Queue()
+    q.put("ready")
+    t = threading.Thread(target=lambda: None, name="mxtpu-blk", daemon=True)
+    t.start()
+    while t.is_alive():
+        pass
+    with lk:
+        time.sleep(0)           # kind: time.sleep
+        q.get()                 # kind: queue.get (un-timed, item ready)
+        t.join()                # kind: Thread.join (un-timed, finished)
+    kinds = {(row["kind"], row["site"]) for row in san.witness()["blocking"]}
+    assert kinds == {("time.sleep", "tests/blocking.py:L"),
+                     ("queue.get", "tests/blocking.py:L"),
+                     ("Thread.join", "tests/blocking.py:L")}
+    rules = [(f.rule, f.key) for f in analyze(san.witness(),
+                                              waivers=()).findings]
+    for kind in ("time.sleep", "queue.get", "Thread.join"):
+        assert ("SAN03", "%s @ tests/blocking.py:L" % kind) in rules
+    # timed variants never record
+    san.clear(stats=True)
+    q.put("again")
+    with lk:
+        q.get(timeout=1)
+        t.join(timeout=1)
+    assert san.stats()["blocking"] == 0
+
+
+def test_blocking_ok_site_skipped_by_analyzer(san):
+    # native/__init__.py:_lock is a reviewed BLOCKING_OK entry (the
+    # single-flight g++ build): observed blocking there is not a finding
+    lk = san.lock("native/__init__.py", "_lock")
+    with lk:
+        time.sleep(0)
+    assert san.stats()["blocking"] == 1
+    assert analyze(san.witness(), waivers=()).clean
+
+
+def test_no_record_without_lock_held(san):
+    q = queue.Queue()
+    q.put(1)
+    time.sleep(0)
+    q.get()
+    assert san.record_count() == 0
+
+
+# -- the bounded ring --------------------------------------------------
+
+
+def test_ring_bound_drops_counted(san, monkeypatch):
+    monkeypatch.setenv("MXNET_MXSAN_RING", "64")
+    san.clear(stats=True)       # next event re-reads the ring size
+    outer = san.lock("tests/ring.py", "outer")
+    for i in range(70):
+        inner = san.lock("tests/ring.py", "leaf%03d" % i)
+        with outer:
+            with inner:
+                pass
+    snap = san.stats()
+    assert snap["edges"] == 70          # the dedup table is NOT the ring
+    assert len(san.events()) == 64      # the ring is bounded
+    assert snap["dropped"] == 6         # evictions are counted
+    # the floor: a tiny MXNET_MXSAN_RING still keeps 64
+    monkeypatch.setenv("MXNET_MXSAN_RING", "8")
+    san.clear(stats=True)
+    with outer:
+        with san.lock("tests/ring.py", "post"):
+            pass
+    assert len(san.events()) == 1
+
+
+# -- thread lifecycle --------------------------------------------------
+
+
+def test_thread_lifecycle_audit(san):
+    ev = threading.Event()
+    anon = threading.Thread(target=lambda: None)            # unnamed
+    good = threading.Thread(target=ev.wait, name="mxtpu-audit-ok",
+                            daemon=True)
+    leak = threading.Thread(target=ev.wait, name="mxtpu-audit-leak",
+                            daemon=False)                   # the regression
+    anon.start()
+    good.start()
+    leak.start()
+    anon.join(timeout=10)
+    try:
+        rows = {r["name"]: r for r in san.thread_findings()}
+        assert [r for r in rows.values() if "unnamed" in r["problems"]], \
+            "the anonymous thread must be reported"
+        assert rows["mxtpu-audit-leak"]["problems"] == ["leaked"]
+        assert "mxtpu-audit-ok" not in rows      # named daemon: clean
+        res = analyze(san.witness(), waivers=())
+        assert "SAN05" in {f.rule for f in res.findings}
+    finally:
+        ev.set()
+        leak.join(timeout=10)
+    assert not leak.is_alive()
+    # once joined, the leak row clears; the unnamed row remains
+    names = {r["name"] for r in san.thread_findings()}
+    assert "mxtpu-audit-leak" not in names
+
+
+# -- condition variables -----------------------------------------------
+
+
+def test_condition_participates(san):
+    cond = san.condition("tests/cond.py", "c")
+    box = []
+
+    def producer():
+        with cond:
+            box.append("item")
+            cond.notify()
+
+    t = threading.Thread(target=producer, name="mxtpu-cond", daemon=True)
+    with cond:
+        t.start()
+        deadline = time.monotonic() + 10
+        while not box and time.monotonic() < deadline:
+            cond.wait(timeout=0.5)
+    t.join(timeout=10)
+    assert box == ["item"]
+    assert san.stats()["acquires"] >= 2      # both sides went through it
+
+
+# -- witness log + CLI replay ------------------------------------------
+
+
+def test_witness_subprocess_roundtrip_clean(tmp_path):
+    """End-to-end adoption flow: a child process runs with MXNET_MXSAN=1
+    and MXNET_MXSAN_LOG set, nests locks in the declared order, and the
+    atexit hook dumps the witness — which python -m tools.mxsan replays
+    clean (exit 0)."""
+    log = str(tmp_path / "witness.json")
+    child = textwrap.dedent("""
+        from incubator_mxnet_tpu import mxsan
+        assert mxsan.enabled(), "gate must come from the environment"
+        outer = mxsan.lock("profiler.py", "_lock")
+        inner = mxsan.lock("profiler.py", "_clock")
+        with outer:
+            with inner:
+                pass
+        assert mxsan.record_count() == 1
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               MXNET_MXSAN="1", MXNET_MXSAN_LOG=log)
+    r = subprocess.run([sys.executable, "-c", child], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+    snap = load_witness(log)
+    assert snap["version"] == 1
+    assert [e["a"] for e in snap["edges"]] == ["profiler.py:_lock"]
+
+    p = _run_cli([log])
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "1 observed edge" in p.stdout
+    assert ("(%d declared orderable)" % declared_edge_count()) in p.stdout
+
+    # flip the edge on disk: the replay must now convict it (exit 1)
+    snap["edges"][0]["a"], snap["edges"][0]["b"] = \
+        snap["edges"][0]["b"], snap["edges"][0]["a"]
+    bad = str(tmp_path / "inverted.json")
+    with open(bad, "w") as f:
+        json.dump(snap, f)
+    p = _run_cli([bad])
+    assert p.returncode == 1
+    assert "SAN02" in p.stdout and "inverts the declared order" in p.stdout
+
+    p = _run_cli([bad, "--format=json"])
+    assert p.returncode == 1
+    data = json.loads(p.stdout)
+    assert data["clean"] is False
+    assert [f["rule"] for f in data["findings"]] == ["SAN02"]
+    assert data["findings"][0]["detail"]["stacks"]
+
+
+def test_cli_usage_errors(tmp_path):
+    assert _run_cli([]).returncode == 2
+    assert _run_cli([str(tmp_path / "no-such.json")]).returncode == 2
+    garbage = str(tmp_path / "garbage.json")
+    with open(garbage, "w") as f:
+        f.write("{\"not\": \"a witness\"}")
+    assert _run_cli([garbage]).returncode == 2
+    p = _run_cli(["--list"])
+    assert p.returncode == 0
+    for rule in sorted(RULES):
+        assert rule in p.stdout
+
+
+# -- waivers -----------------------------------------------------------
+
+
+def test_waiver_requires_reason_and_budget(san):
+    assert len(WAIVERS) <= 5, "waiver budget: at most 5, each defended"
+    for rule, glob, reason in WAIVERS:
+        assert rule in RULES and glob
+        assert reason and reason.strip(), "every waiver needs a reason"
+    a = san.lock("serve/stats.py", "self._lock")
+    b = san.lock("serve/batcher.py", "self._lock")
+    with a:
+        with b:
+            pass
+    wit = san.witness()
+    # an empty reason never waives
+    res = analyze(wit, waivers=[("SAN02", "*", "")])
+    assert [f.rule for f in res.findings] == ["SAN02"]
+    assert res.waived == []
+    # a justified glob does, and keeps the reason on the record
+    res = analyze(wit, waivers=[("SAN02", "serve/stats.py:*", "corpus")])
+    assert res.clean
+    assert [(f.rule, f.waive_reason) for f in res.waived] == \
+        [("SAN02", "corpus")]
+
+
+# -- telemetry ---------------------------------------------------------
+
+
+def test_profiler_dumps_and_prometheus(monkeypatch):
+    monkeypatch.delenv("MXNET_MXSAN", raising=False)
+    mxsan.reset()
+    # gate off: no mxsan key, no family, byte-identical scrape
+    assert "mxsan" not in json.loads(profiler.dumps(format="json"))
+    assert "mxnet_mxsan" not in profiler.render_prometheus()
+    mxsan.enable(True)
+    try:
+        a = mxsan.lock("profiler.py", "_lock")
+        b = mxsan.lock("profiler.py", "_clock")
+        with a:
+            with b:
+                pass
+        out = json.loads(profiler.dumps(format="json"))
+        assert out["mxsan"]["edges"] == 1 and out["mxsan"]["records"] == 1
+        table = profiler.dumps(format="table")
+        assert "Concurrency sanitizer (mxsan)" in table
+        assert "mxsan_edges" in table
+        prom = profiler.render_prometheus()
+        assert "mxnet_mxsan_records_total 1" in prom
+        assert "mxnet_mxsan_edges 1" in prom
+        assert mxsan.render_prometheus(labels='rank="0"').count('{rank="0"}') \
+            == len(mxsan.render_prometheus().strip().splitlines()) // 3
+        # dumps(reset=True) restarts the sanitizer family like the rest
+        profiler.dumps(format="json", reset=True)
+        assert mxsan.record_count() == 0
+        assert mxsan.stats()["edges"] == 0
+    finally:
+        mxsan.reset()
+
+
+# -- the corpus gate ---------------------------------------------------
+
+
+def test_corpus_gate_zero_findings(san):
+    """Real serving components, multithreaded, sanitizer on: decode
+    scheduler + prefix cache + page allocator + serving stats + fault
+    injector, driven by joined mxtpu-* client threads. The finding set
+    must be EXACTLY empty (waiver list pinned to EXPECTED_WAIVED) —
+    this is what makes lock_order.py a proven registry."""
+    from incubator_mxnet_tpu.serve.decode import (DecodePredictor,
+                                                  DecodeScheduler)
+    from incubator_mxnet_tpu.serve.stats import ServingStats
+    pred = DecodePredictor.toy(slots=2, page_size=4, num_pages=32,
+                               max_pages_per_seq=4, prompt_buckets=(4,))
+    pred.warmup()
+    stats = ServingStats("sancorpus")
+    sched = DecodeScheduler(pred, stats=stats, prefix_cache=True,
+                            max_queue=32, name="sancorpus")
+    inj = fault.FaultInjector("sancorpus@999:drop")
+    sched.start()
+    errors = []
+    try:
+        def client(i):
+            try:
+                for j in range(3):
+                    inj.fire("sancorpus")
+                    prompt = [1 + (7 * i + j) % 29, 2 + i, 1 + j][:2 + j % 2]
+                    st = sched.submit(prompt, max_new_tokens=3)
+                    st.result(timeout=120)
+                    stats.incr("requests_total")
+            except Exception as e:          # noqa: BLE001
+                errors.append(e)
+
+        workers = [threading.Thread(target=client, args=(i,),
+                                    name="mxtpu-corpus-%d" % i)
+                   for i in range(4)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join(timeout=240)
+        assert not any(t.is_alive() for t in workers)
+        assert errors == []
+    finally:
+        sched.stop()
+    wit = san.witness()
+    assert wit["stats"]["acquires"] > 0
+    assert wit["edges"], "the corpus must actually witness nested locking"
+    res = analyze(wit)                      # the in-tree waiver registry
+    assert [f"{f.rule} {f.key}" for f in res.findings] == [], \
+        "\n\n".join(f.render() for f in res.findings)
+    assert [f"{f.rule} {f.key}" for f in res.waived] == EXPECTED_WAIVED

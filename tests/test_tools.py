@@ -109,6 +109,7 @@ def test_diagnose_runs():
                     "Request Tracing",
                     "Composed Parallelism (pipeline schedules)",
                     "Static Analysis (mxlint)",
+                    "Concurrency Sanitizer (mxsan)",
                     "Graph Analysis (shardlint)"):
         assert section in r.stdout, f"missing section {section!r}"
     assert "probe FAILED" not in r.stdout, r.stdout

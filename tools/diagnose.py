@@ -398,6 +398,57 @@ def main():
     except Exception as e:
         print("mxlint probe FAILED:", e)
 
+    print("----------Concurrency Sanitizer (mxsan)----------")
+    try:
+        from incubator_mxnet_tpu import mxsan as _mx
+        from incubator_mxnet_tpu.util import getenv_int, getenv_str
+        from tools.mxsan import RULES as SAN_RULES
+        from tools.mxsan import analyze, declared_edge_count
+        from tools.mxsan.waivers import WAIVERS as SAN_WAIVERS
+        from tools.mxlint.lock_order import (BLOCKING_OK,
+                                             CROSS_MODULE_EDGES,
+                                             LOCK_ORDER)
+        print("gate         :", "on" if _mx.enabled()
+              else "off (MXNET_MXSAN unset)")
+        print("knobs        :",
+              {"ring": getenv_int("MXNET_MXSAN_RING"),
+               "log": getenv_str("MXNET_MXSAN_LOG") or "(unset)"})
+        print("declared     :",
+              {"modules": len(LOCK_ORDER),
+               "edges": declared_edge_count(),
+               "cross_module": len(CROSS_MODULE_EDGES),
+               "blocking_ok": len(BLOCKING_OK)})
+        print("rules        :")
+        for rule, (title, _hint) in sorted(SAN_RULES.items()):
+            print(f"  {rule}: {title}")
+        # in-process probe: force the gate on, nest two probe locks in
+        # profiler.py's declared order, and replay the witness through
+        # the analyzer — a clean run proves the loop end to end; the
+        # finally leaves no witness state behind
+        _mx.enable(True)
+        try:
+            outer = _mx.lock("profiler.py", "_lock")
+            inner = _mx.lock("profiler.py", "_clock")
+            with outer:
+                with inner:
+                    pass
+            wit = _mx.witness()
+            res = analyze(wit, waivers=())
+            print("probe        :",
+                  {"records": _mx.record_count(),
+                   "edges": [f"{e['a']} -> {e['b']}"
+                             for e in wit["edges"]],
+                   "findings": [f.key for f in res.findings] or "clean"})
+        finally:
+            _mx.reset()
+        print("waivers      :", len(SAN_WAIVERS))
+        for rule, glob, reason in SAN_WAIVERS:
+            print(f"  {rule} on {glob}: {reason}")
+        print("run it       : MXNET_MXSAN=1 MXNET_MXSAN_LOG=w.json "
+              "<workload>; python -m tools.mxsan w.json [--format=json]")
+    except Exception as e:
+        print("mxsan probe FAILED:", e)
+
     print("----------Graph Analysis (shardlint)----------")
     try:
         from incubator_mxnet_tpu import shardlint

@@ -53,6 +53,12 @@ RULES = {
         "function that takes this lock called while it is held",
         "call the *_locked variant, or restructure so the lock is "
         "released first (threading.Lock is not reentrant)"),
+    "CC04": (
+        "blocking call while holding a lock",
+        "move the sleep/join/un-timed get/subprocess/socket call outside "
+        "the with-lock body, give the wait a timeout, or add the lock "
+        "site to BLOCKING_OK in tools/mxlint/lock_order.py with a "
+        "justification"),
     "EV01": (
         "raw os.environ read of an MXNET_*/MXTPU_* variable",
         "route through util.getenv_int/getenv_bool/getenv_str so the "

@@ -1,4 +1,4 @@
-"""Concurrency rules (CC01-CC03).
+"""Concurrency rules (CC01-CC04).
 
 CC01 — an attribute that is guarded by a lock *somewhere* in its class
 (read-modify-written inside ``with self._lock``) must be guarded
@@ -18,6 +18,16 @@ acquires that same lock: ``threading.Lock`` is not reentrant, so this is
 a guaranteed self-deadlock.  The in-tree convention is that helpers named
 ``*_locked`` expect the caller to hold the lock; the rule understands it.
 
+CC04 — a known-blocking call (``time.sleep``, un-timed ``Thread.join``,
+un-timed ``queue.get``, ``subprocess.*``, socket connect/accept/
+recv/sendall) lexically inside a ``with <lock>`` body stalls every
+other waiter on that lock for the duration; the same call inside a
+``*_locked``-contract function blocks the *caller's* lock just as
+surely.  Timed variants (``join(timeout=...)``, ``get(timeout=...)``)
+are bounded waits and pass.  Locks whose whole purpose is to serialize
+an I/O conversation are allowed via ``lock_order.BLOCKING_OK`` — the
+leaf-lock allowance the runtime sanitizer (SAN03) shares.
+
 Functions named ``*_locked`` are exempt from CC01 (their contract is
 "caller holds the lock"), as is ``__init__`` (no concurrent access before
 construction completes).
@@ -27,7 +37,7 @@ from __future__ import annotations
 import ast
 
 from .core import Finding, dotted, lock_key, root_name
-from .lock_order import LOCK_ORDER
+from .lock_order import BLOCKING_OK, LOCK_ORDER
 
 
 def _order_for(mod):
@@ -281,10 +291,115 @@ def _cc03(mod, findings):
                     f"at this call site"))
 
 
+_SOCKET_METHODS = ("connect", "accept", "recv", "recv_into", "sendall")
+_QUEUE_HINTS = ("queue", "_q", "q")
+
+
+def _modkey(mod):
+    """This module's LOCK_ORDER key ('' when unregistered)."""
+    rel = mod.relpath.replace("\\", "/")
+    for key in LOCK_ORDER:
+        if rel.endswith("incubator_mxnet_tpu/" + key) or rel == key:
+            return key
+    return ""
+
+
+def _class_name_chain(node):
+    """Name of the class enclosing `node`, '' at module level."""
+    n = getattr(node, "mx_parent", None)
+    while n is not None:
+        if isinstance(n, ast.ClassDef):
+            return n.name
+        n = getattr(n, "mx_parent", None)
+    return ""
+
+
+def _queue_like(recv):
+    """Receiver name suggests a queue (`self._queue`, `work_q`, `q`)."""
+    if recv is None:
+        return False
+    tail = recv.split(".")[-1].lower()
+    return ("queue" in tail or tail == "q" or tail.endswith("_q"))
+
+
+def _blocking_kind(call):
+    """What un-bounded wait this Call is, or None."""
+    fname = dotted(call.func)
+    kwargs = {kw.arg for kw in call.keywords}
+    if fname == "time.sleep":
+        return "time.sleep"
+    if fname is not None and fname.startswith("subprocess."):
+        return fname
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    recv = dotted(call.func.value)
+    if attr in _SOCKET_METHODS or (
+            fname is not None and fname.startswith("socket.") and
+            attr == "create_connection"):
+        return "socket-ish .%s" % attr
+    if attr == "join" and not call.args and "timeout" not in kwargs:
+        # zero-argument join is Thread.join (str.join always takes the
+        # iterable); a timeout keyword makes it a bounded wait
+        return ".join()"
+    if attr == "get" and not call.args and "timeout" not in kwargs and \
+            _queue_like(recv):
+        return "un-timed queue .get()"
+    return None
+
+
+def _cc04(mod, findings):
+    modkey = _modkey(mod)
+
+    def _allowed(lock, cls):
+        # leaf-lock allowance: the site (or its class-qualified mxsan
+        # spelling, e.g. AsyncClient._lock for self._lock) is declared
+        # safe to hold across a bounded wait in lock_order.BLOCKING_OK
+        if not modkey:
+            return False
+        if "%s:%s" % (modkey, lock) in BLOCKING_OK:
+            return True
+        if lock.startswith("self.") and cls:
+            return "%s:%s.%s" % (modkey, cls, lock[5:]) in BLOCKING_OK
+        return False
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _blocking_kind(node)
+        if kind is None:
+            continue
+        cls = _class_name_chain(node)
+        held = _held_locks(node)
+        if held:
+            live = [k for k in held if not _allowed(k, cls)]
+            if not live:
+                continue
+            findings.append(Finding(
+                "CC04", mod.relpath, node.lineno, node.col_offset,
+                f"blocking {kind} while holding `{live[-1]}`; every "
+                f"other waiter stalls for the full wait"))
+            continue
+        fn = _fn_name_chain(node)
+        if fn.endswith("_locked") and not fn.startswith("__"):
+            # the contract lock is the caller's; a class-qualified
+            # BLOCKING_OK entry (e.g. AsyncClient._lock) covers every
+            # *_locked method of that class
+            if modkey and cls and any(
+                    w.startswith("%s:%s." % (modkey, cls))
+                    for w in BLOCKING_OK):
+                continue
+            findings.append(Finding(
+                "CC04", mod.relpath, node.lineno, node.col_offset,
+                f"blocking {kind} inside `{fn}` (the *_locked contract "
+                f"means the caller is holding the lock)"))
+
+
 def check(mod):
     findings = []
     _cc01(mod, findings)
     _cc01_module_globals(mod, findings)
     _cc02(mod, findings)
     _cc03(mod, findings)
+    _cc04(mod, findings)
     return findings

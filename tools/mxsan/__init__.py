@@ -1,0 +1,232 @@
+"""mxsan analyzer: judge a witness snapshot against lock_order.py.
+
+The runtime half (``incubator_mxnet_tpu/mxsan.py``) records what
+threads actually did — lock-order edges with acquisition stacks,
+blocking calls made under a lock, re-entry attempts, thread lifecycle
+rows.  This package is the judgement half: pure stdlib, never imports
+the package under test (mirroring tools/mxlint), so it can replay a
+witness log from any process.
+
+Rules:
+  SAN01  observed lock-order cycle (AB/BA potential deadlock)
+  SAN02  observed edge contradicts lock_order.py (undeclared lock,
+         inverted order, or an undeclared cross-module nesting)
+  SAN03  blocking call while holding a lock
+  SAN04  re-entry attempt on a non-reentrant lock
+  SAN05  thread lifecycle (non-``mxtpu-*`` name, leaked non-daemon)
+
+Waivers mirror shardlint's registry contract: (rule, key-glob, reason)
+tuples in ``tools/mxsan/waivers.py``, reason required, budget pinned
+EXACT by tests/test_mxsan.py.
+"""
+from __future__ import annotations
+
+import fnmatch
+import json
+
+from tools.mxlint.lock_order import (BLOCKING_OK, CROSS_MODULE_EDGES,
+                                     LOCK_ORDER)
+
+__all__ = ["RULES", "Finding", "SanResult", "analyze", "load_witness",
+           "declared_edge_count"]
+
+RULES = {
+    "SAN01": ("observed lock-order cycle",
+              "two lock chains close a loop: some thread interleaving "
+              "deadlocks. Break the cycle by acquiring in one order."),
+    "SAN02": ("observed edge contradicts lock_order.py",
+              "a real thread nested locks in an order the registry "
+              "does not declare. Declare the nesting (cross-module "
+              "edges go in CROSS_MODULE_EDGES) or fix the code."),
+    "SAN03": ("blocking call while holding a lock",
+              "sleep/join/un-timed get/subprocess/socket under a lock "
+              "stalls every waiter. Move the wait outside the lock or "
+              "add the site to BLOCKING_OK with a justification."),
+    "SAN04": ("re-entry on a non-reentrant lock",
+              "the holding thread re-acquired a plain Lock: guaranteed "
+              "self-deadlock once the timeout is removed. Split the "
+              "function or use the *_locked-callee convention."),
+    "SAN05": ("thread lifecycle violation",
+              "threads need an mxtpu-* name and must be daemon or "
+              "joined; an anonymous live non-daemon thread outlives "
+              "its owner silently."),
+}
+
+
+class Finding:
+    """One judged violation: rule id, a stable key the waiver globs
+    match against, a one-line message, and the witness detail (stacks,
+    threads) for the report."""
+
+    def __init__(self, rule, key, message, detail=None):
+        self.rule = rule
+        self.key = key
+        self.message = message
+        self.detail = detail or {}
+        self.waive_reason = None
+
+    def render(self):
+        title = RULES[self.rule][0]
+        out = ["%s [%s]: %s — %s" % (self.rule, self.key, title,
+                                     self.message)]
+        for label, row in sorted(self.detail.get("stacks", {}).items()):
+            out.append("  %s (thread %s):" % (label, row.get("thread", "?")))
+            for frame in row.get("stack", ()):
+                out.append("    %s" % frame)
+        return "\n".join(out)
+
+    def as_dict(self):
+        return {"rule": self.rule, "key": self.key,
+                "message": self.message, "detail": self.detail,
+                "waive_reason": self.waive_reason}
+
+
+class SanResult:
+    def __init__(self, findings, waived, stats):
+        self.findings = findings
+        self.waived = waived
+        self.stats = stats
+
+    @property
+    def clean(self):
+        return not self.findings
+
+    def as_dict(self):
+        return {
+            "clean": self.clean,
+            "findings": [f.as_dict() for f in self.findings],
+            "waived": [f.as_dict() for f in self.waived],
+            "stats": dict(self.stats),
+        }
+
+
+def declared_edge_count():
+    """Orderable pairs the registry declares: every within-module pair
+    LOCK_ORDER permits plus every cross-module edge."""
+    n = len(CROSS_MODULE_EDGES)
+    for order in LOCK_ORDER.values():
+        n += len(order) * (len(order) - 1) // 2
+    return n
+
+
+def load_witness(path):
+    """Read a witness log written by ``mxsan.dump`` (raises ValueError
+    on a structurally unusable file)."""
+    with open(path) as f:
+        snap = json.load(f)
+    if not isinstance(snap, dict) or "edges" not in snap:
+        raise ValueError("not a mxsan witness log (no 'edges' table)")
+    return snap
+
+
+def _site(raw):
+    """Split ``module:lock`` (the mxsan site spelling)."""
+    if ":" in raw:
+        return raw.split(":", 1)
+    return "", raw
+
+
+def _check_edge(a, b):
+    """SAN02 message for observed edge a->b, or None if declared."""
+    mod_a, name_a = _site(a)
+    mod_b, name_b = _site(b)
+    if mod_a != mod_b:
+        if (a, b) in CROSS_MODULE_EDGES:
+            return None
+        return ("cross-module nesting %s -> %s is not declared in "
+                "CROSS_MODULE_EDGES" % (a, b))
+    order = LOCK_ORDER.get(mod_a)
+    if order is None:
+        return ("module %s holds nested locks but has no lock_order.py "
+                "entry" % mod_a)
+    missing = [n for n in (name_a, name_b) if n not in order]
+    if missing:
+        return ("lock%s %s of %s absent from the declared order" %
+                ("s" if len(missing) > 1 else "",
+                 ", ".join(missing), mod_a))
+    if order.index(name_a) >= order.index(name_b):
+        return ("observed %s -> %s inverts the declared order (%s)" %
+                (name_a, name_b, ", ".join(order)))
+    return None
+
+
+def analyze(witness, waivers=None):
+    """Judge one witness snapshot (live ``mxsan.witness()`` dict or a
+    replayed log). ``waivers=None`` uses the in-tree registry; pass
+    ``()`` to disable."""
+    if waivers is None:
+        from .waivers import WAIVERS
+        waivers = WAIVERS
+    findings = []
+
+    for cyc in witness.get("cycles", ()):
+        key = " -> ".join(cyc.get("path", ()))
+        n = len(cyc.get("edges", ()))
+        findings.append(Finding(
+            "SAN01", key,
+            "%d-edge cycle closed by thread %s; every edge's first "
+            "acquisition stack follows" % (n, cyc.get("thread", "?")),
+            {"stacks": cyc.get("stacks", {})}))
+
+    for edge in witness.get("edges", ()):
+        a, b = edge["a"], edge["b"]
+        msg = _check_edge(a, b)
+        if msg is not None:
+            key = "%s -> %s" % (a, b)
+            findings.append(Finding(
+                "SAN02", key,
+                "%s (seen %dx, thread %s)" % (msg, edge.get("count", 1),
+                                              edge.get("thread", "?")),
+                {"stacks": {key: {"thread": edge.get("thread", "?"),
+                                  "stack": edge.get("stack", [])}}}))
+
+    for row in witness.get("blocking", ()):
+        site = row["site"]
+        if site in BLOCKING_OK:
+            continue
+        key = "%s @ %s" % (row["kind"], site)
+        findings.append(Finding(
+            "SAN03", key,
+            "%s called %dx while holding %s" %
+            (row["kind"], row.get("count", 1),
+             ", ".join(row.get("held", (site,)))),
+            {"stacks": {key: {"thread": row.get("thread", "?"),
+                              "stack": row.get("stack", [])}}}))
+
+    for row in witness.get("reentry", ()):
+        site = row["site"]
+        findings.append(Finding(
+            "SAN04", site,
+            "thread %s re-acquired non-reentrant %s (%dx)" %
+            (row.get("thread", "?"), site, row.get("count", 1)),
+            {"stacks": {site: {"thread": row.get("thread", "?"),
+                               "stack": row.get("stack", [])}}}))
+
+    for row in witness.get("threads", ()):
+        findings.append(Finding(
+            "SAN05", row.get("name", ""),
+            "thread %r (daemon=%s, alive=%s): %s" %
+            (row.get("name", ""), row.get("daemon"), row.get("alive"),
+             ", ".join(row.get("problems", ())))))
+
+    kept, waived = [], []
+    for f in findings:
+        reason = _waive_reason(f, waivers)
+        if reason:
+            f.waive_reason = reason
+            waived.append(f)
+        else:
+            kept.append(f)
+    order = sorted(RULES)
+    kept.sort(key=lambda f: (order.index(f.rule), f.key))
+    return SanResult(kept, waived, witness.get("stats", {}))
+
+
+def _waive_reason(finding, waivers):
+    for rule, pattern, reason in waivers:
+        # an empty reason never waives: the registry contract (and the
+        # budget test) requires each entry to justify itself
+        if reason and rule == finding.rule and \
+                fnmatch.fnmatchcase(finding.key, pattern):
+            return reason
+    return None

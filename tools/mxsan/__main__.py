@@ -1,0 +1,84 @@
+"""mxsan CLI: replay a recorded witness log against lock_order.py.
+
+    python -m tools.mxsan WITNESS.json [--format=text|json]
+                          [--list] [--no-waivers]
+
+The log is written by the runtime half: run the workload with
+``MXNET_MXSAN=1 MXNET_MXSAN_LOG=/path/witness.json`` (or call
+``mxsan.dump(path)`` at drain) and replay it here — the analyzer is
+pure stdlib and never imports the package, so the judgement can run on
+a machine that cannot.
+
+Exit status: 0 clean, 1 findings, 2 usage error (missing or
+structurally invalid log).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import RULES, analyze, declared_edge_count, load_witness
+
+
+def _render_text(result):
+    for f in result.findings:
+        print(f.render())
+    n, w = len(result.findings), len(result.waived)
+    print("mxsan: %d observed edge%s (%d declared orderable), "
+          "%d finding%s, %d waived" %
+          (result.stats.get("edges_observed", 0),
+           "" if result.stats.get("edges_observed", 0) == 1 else "s",
+           declared_edge_count(),
+           n, "" if n == 1 else "s", w))
+    for f in result.waived:
+        print("  waived %s on %s (%s)" % (f.rule, f.key, f.waive_reason))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxsan",
+        description="witness-based lock-order sanitizer (replay half)")
+    ap.add_argument("witness", nargs="?", default=None,
+                    help="witness log written by mxsan.dump / "
+                         "MXNET_MXSAN_LOG")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list", action="store_true",
+                    help="list rules and waivers, then exit")
+    ap.add_argument("--no-waivers", action="store_true",
+                    help="judge with the waiver registry disabled")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        from .waivers import WAIVERS
+        print("rules:")
+        for rule, (title, _hint) in sorted(RULES.items()):
+            print("  %s: %s" % (rule, title))
+        print("waivers: %d" % len(WAIVERS))
+        for rule, glob, reason in WAIVERS:
+            print("  %s on %s: %s" % (rule, glob, reason))
+        return 0
+
+    if not args.witness:
+        print("mxsan: a witness log is required (see --help)",
+              file=sys.stderr)
+        return 2
+    try:
+        snap = load_witness(args.witness)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print("mxsan: cannot read witness %s: %s" % (args.witness, e),
+              file=sys.stderr)
+        return 2
+
+    result = analyze(snap, waivers=() if args.no_waivers else None)
+    result.stats = dict(result.stats,
+                        edges_observed=len(snap.get("edges", ())))
+    if args.format == "json":
+        print(json.dumps(result.as_dict(), indent=2, sort_keys=True))
+    else:
+        _render_text(result)
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,25 @@
+"""Registry waivers for mxsan witness findings.
+
+A finding here judges runtime behaviour, so there is no source line to
+carry an inline suppression; deliberate exceptions are waived centrally
+as (rule, finding-key glob, reason).
+
+Rules of the registry (the shardlint contract):
+  * every entry carries a reason — an empty reason never waives and is
+    a test failure;
+  * the list is BUDGETED: tests/test_mxsan.py pins the exact entries
+    and caps the count at 5, so a waiver is a reviewed, deliberate
+    exception, not a pressure valve.
+
+Finding keys by rule:
+  SAN01  "siteA -> siteB -> ... -> siteA"   (the cycle path)
+  SAN02  "siteA -> siteB"                   (the observed edge)
+  SAN03  "kind @ site"                      (e.g. "time.sleep @ ...")
+  SAN04  "site"
+  SAN05  thread name
+
+Sites are spelled ``<module>:<lock name>`` exactly as in
+tools/mxlint/lock_order.py.
+"""
+
+WAIVERS = []
