@@ -851,7 +851,7 @@ from incubator_mxnet_tpu.models.composed import (ComposedConfig,
                                                  ComposedPipelineLM)
 
 S, M = 4, 8
-cfg = ComposedConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=4,
+cfg = ComposedConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=8,
                      d_ff=64, n_experts=4, moe_every=2, capacity_factor=4.0,
                      aux_weight=0.01, max_len=64, dtype="float32")
 model = ComposedPipelineLM(cfg)
@@ -861,10 +861,17 @@ tokens = jnp.asarray(rng.randint(0, 64, (16, 16)).astype(np.int32))
 targets = jnp.asarray(rng.randint(0, 64, (16, 16)).astype(np.int32))
 prev = profiler.attribution_enable(True)
 out = {{}}
-for sched, remat in (("gpipe", "none"), ("1f1b", "dots_saveable")):
+for sched, remat, v, off in (("gpipe", "none", 1, False),
+                             ("1f1b", "dots_saveable", 1, False),
+                             ("interleaved", "none", 2, False),
+                             ("zb1", "none", 1, False),
+                             ("gpipe_offload", "none", 1, True)):
+    real = sched.split("_")[0]
     step, shard_params, init_opt = model.make_train_step(
-        mesh, n_microbatches=M, schedule=sched, remat=remat)
-    p = shard_params(model.init_params(jax.random.PRNGKey(0), S))
+        mesh, n_microbatches=M, schedule=real, remat=remat,
+        n_chunks=(v if v > 1 else None), offload=off)
+    p = shard_params(model.init_params(jax.random.PRNGKey(0), S,
+                                       n_chunks=v))
     opt = init_opt(p)
     for _ in range(2):   # cold compile + the one sharding respecialization
         p, opt, loss = step(p, opt, tokens, targets, 0)
@@ -894,7 +901,8 @@ print(json.dumps(out))
 
 def bench_composed_1f1b():
     """Pipeline-schedule row: the composed-parallel train step racing
-    GPipe against 1F1B at fixed geometry (S=4 stages, M=8 microbatches,
+    GPipe, 1F1B, interleaved (v=2 virtual chunks) and ZB-H1 zero-bubble
+    at fixed geometry (S=4 stages, M=8 microbatches,
     dp2 x pp4) in a fresh subprocess with 8 forced host devices. Step
     time on CPU is a tie by construction (one sequential XLA program
     either way) — the metrics that carry the row are the bubble
@@ -1499,28 +1507,48 @@ def main():
         mem_ratio = (g["temp_bytes"] / f["temp_bytes"]
                      if g.get("temp_bytes") and f.get("temp_bytes")
                      else None)
-        results.append({"mode": "composed_1f1b", "batch": 16,
-                        "dtype": "float32",
-                        "stages": 4, "microbatches": 8,
-                        "gpipe_step_ms": round(g["step_ms"], 1),
-                        "pp1f1b_step_ms": round(f["step_ms"], 1),
-                        "gpipe_bubble": g["bubble_grid"],
-                        "pp1f1b_bubble": f["bubble_grid"],
-                        "pp1f1b_bubble_measured":
-                            round(f["bubble_measured"], 4)
-                            if f.get("bubble_measured") is not None
-                            else None,
-                        "gpipe_peak_bytes": g.get("peak_bytes"),
-                        "pp1f1b_peak_bytes": f.get("peak_bytes"),
-                        "gpipe_temp_bytes": g.get("temp_bytes"),
-                        "pp1f1b_temp_bytes": f.get("temp_bytes"),
-                        "mem_reduction": round(mem_ratio, 2)
-                        if mem_ratio else None,
-                        "vs_baseline": None})
+        row = {"mode": "composed_1f1b", "batch": 16,
+               "dtype": "float32",
+               "stages": 4, "microbatches": 8,
+               "gpipe_step_ms": round(g["step_ms"], 1),
+               "pp1f1b_step_ms": round(f["step_ms"], 1),
+               "gpipe_bubble": g["bubble_grid"],
+               "pp1f1b_bubble": f["bubble_grid"],
+               "pp1f1b_bubble_measured":
+                   round(f["bubble_measured"], 4)
+                   if f.get("bubble_measured") is not None
+                   else None,
+               "gpipe_peak_bytes": g.get("peak_bytes"),
+               "pp1f1b_peak_bytes": f.get("peak_bytes"),
+               "gpipe_temp_bytes": g.get("temp_bytes"),
+               "pp1f1b_temp_bytes": f.get("temp_bytes"),
+               "mem_reduction": round(mem_ratio, 2)
+               if mem_ratio else None,
+               "vs_baseline": None}
+        # the zero-bubble frontier: interleaved v=2 and ZB-H1 ride the
+        # same subprocess; measured bubble must equal the grid analytic
+        for name, key in (("interleaved", "interleaved"), ("zb1", "zb1")):
+            e = pr.get(key)
+            if not e:
+                continue
+            row[f"{name}_step_ms"] = round(e["step_ms"], 1)
+            row[f"{name}_bubble"] = e["bubble_grid"]
+            row[f"{name}_bubble_measured"] = (
+                round(e["bubble_measured"], 4)
+                if e.get("bubble_measured") is not None else None)
+            row[f"{name}_peak_bytes"] = e.get("peak_bytes")
+            row[f"{name}_temp_bytes"] = e.get("temp_bytes")
+        go = pr.get("gpipe_offload")
+        if go:
+            row["offload_temp_bytes"] = go.get("temp_bytes")
+        results.append(row)
+        z = pr.get("zb1", {})
         print(f"[bench] composed pipeline (S=4, M=8, dp2xpp4) bubble "
-              f"{f['bubble_grid']:.3f} 1f1b vs {g['bubble_grid']:.3f} "
-              f"gpipe  step {f['step_ms']:7.1f} ms vs "
-              f"{g['step_ms']:7.1f} ms (cpu)"
+              f"{g['bubble_grid']:.3f} gpipe / {f['bubble_grid']:.3f} "
+              f"1f1b / "
+              f"{pr.get('interleaved', {}).get('bubble_grid', -1):.3f} "
+              f"interleaved(v2) / {z.get('bubble_grid', -1):.3f} zb1  "
+              f"step {f['step_ms']:7.1f} ms (cpu)"
               + (f"  temp mem {mem_ratio:4.2f}x smaller with remat"
                  if mem_ratio else ""), file=sys.stderr)
     except Exception as e:

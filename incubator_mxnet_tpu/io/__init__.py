@@ -1,9 +1,10 @@
 from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, CSVIter,
                  ResizeIter, PrefetchingIter, MXDataIter, ImageRecordIter,
                  MNISTIter, LibSVMIter)
-from .prefetch import DevicePrefetcher, prefetch_to_device
+from .prefetch import (DevicePrefetcher, HostOffloader,
+                       prefetch_to_device)
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "CSVIter",
            "ResizeIter", "PrefetchingIter", "MXDataIter", "ImageRecordIter",
-           "MNISTIter", "LibSVMIter", "DevicePrefetcher",
+           "MNISTIter", "LibSVMIter", "DevicePrefetcher", "HostOffloader",
            "prefetch_to_device"]

@@ -32,7 +32,7 @@ import numpy as _np
 
 from ..base import MXNetError
 
-__all__ = ["DevicePrefetcher", "prefetch_to_device"]
+__all__ = ["DevicePrefetcher", "HostOffloader", "prefetch_to_device"]
 
 
 def prefetch_to_device(iterator, size=2, mesh=None, axis="dp", device=None,
@@ -289,3 +289,145 @@ class DevicePrefetcher:
             self.close()
         except Exception:                       # noqa: BLE001 — interpreter
             pass                                # shutdown: queue/thread gone
+
+
+class HostOffloader:
+    """The DevicePrefetcher's machinery run in REVERSE: a bounded window of
+    async device->host copies of live activations, prefetched BACK to the
+    device ahead of their consumer. Reference: the MXNet dependency engine
+    hiding D2H/H2D under compute via dependency-ordered async copies —
+    here ``jax.device_put`` to a host ``memory_kind`` is the async copy and
+    the bounded window is the double buffer.
+
+    ``put(key, a)`` issues the D2H and returns immediately; when the
+    in-flight window is full it first BLOCKS on the oldest transfer (that
+    wait is the ``offload_wait_ms_per_step`` stall the counters surface —
+    0 in steady state means the copies hide under compute). ``prefetch``
+    issues the H2D back without blocking; ``get`` returns the
+    device-resident array, waiting only if the prefetch hasn't landed.
+    Round trips are bit-identical by construction (same buffer, moved).
+
+    Telemetry, through the same profiler counter registry as the input
+    pipeline (``profiler.dumps()`` / the ``/metrics`` scrape):
+
+    - ``d2h_bytes``               — cumulative bytes parked on the host
+    - ``offload_wait_ms_per_step`` — consumer time blocked on the window
+
+    On backends without addressable host memory spaces the offloader
+    degrades to an on-device ring (``host_backed`` False): the window
+    accounting and telemetry stay live, the copies become no-ops.
+    """
+
+    def __init__(self, window=2):
+        if window < 1:
+            raise MXNetError("offload window must be >= 1")
+        self.window = window
+        self._host = {}           # key -> host-resident array
+        self._back = {}           # key -> device-put-back array (prefetch)
+        self._order = []          # FIFO of in-flight D2H keys
+        self._shardings = {}      # key -> original device sharding
+        self.d2h_bytes = 0
+        self.h2d_bytes = 0
+        self.last_wait_ms = 0.0
+        self.wait_ms_total = 0.0
+        self.puts = 0
+        self._counters = None
+        self._host_kind = self._probe_host_kind()
+
+    @staticmethod
+    def _probe_host_kind():
+        import jax
+        try:
+            kinds = {m.kind for d in jax.local_devices()
+                     for m in d.addressable_memories()}
+        except Exception:                       # noqa: BLE001 — old jax
+            return None
+        for kind in ("pinned_host", "unpinned_host"):
+            if kind in kinds:
+                return kind
+        return None
+
+    @property
+    def host_backed(self):
+        return self._host_kind is not None
+
+    # -- D2H ---------------------------------------------------------------
+    def put(self, key, a):
+        """Issue an async D2H of `a`; blocks only when the window is full
+        (on the OLDEST in-flight transfer, double-buffer style)."""
+        import jax
+        if key in self._host or key in self._back:
+            raise MXNetError(f"offload key {key!r} already live")
+        wait_ms = 0.0
+        while len(self._order) >= self.window:
+            oldest = self._order.pop(0)
+            t0 = time.perf_counter()
+            jax.block_until_ready(self._host[oldest])
+            wait_ms += (time.perf_counter() - t0) * 1e3
+        self._shardings[key] = getattr(a, "sharding", None)
+        if self._host_kind is not None and self._shardings[key] is not None:
+            dst = self._shardings[key].with_memory_kind(self._host_kind)
+            self._host[key] = jax.device_put(a, dst)
+        else:
+            self._host[key] = a                 # degraded: on-device ring
+        self._order.append(key)
+        try:
+            self.d2h_bytes += int(a.nbytes)
+        except (TypeError, AttributeError):
+            pass
+        self.puts += 1
+        self.last_wait_ms = wait_ms
+        self.wait_ms_total += wait_ms
+        self._publish(wait_ms)
+        return self._host[key]
+
+    # -- H2D ---------------------------------------------------------------
+    def prefetch(self, key):
+        """Issue the async H2D back to the original sharding; returns
+        immediately (call one backward-tick ahead of `get`)."""
+        import jax
+        if key in self._back:
+            return
+        if key not in self._host:
+            raise MXNetError(f"offload key {key!r} not resident")
+        a = self._host.pop(key)
+        if key in self._order:
+            self._order.remove(key)
+        sh = self._shardings.pop(key)
+        if self._host_kind is not None and sh is not None:
+            a = jax.device_put(a, sh)
+        self._back[key] = a
+        try:
+            self.h2d_bytes += int(a.nbytes)
+        except (TypeError, AttributeError):
+            pass
+
+    def get(self, key):
+        """Device-resident array for `key`; issues the H2D itself if no
+        prefetch ran (then the wait is the transfer, which is the stall
+        the schedule is supposed to hide)."""
+        if key not in self._back:
+            self.prefetch(key)
+        return self._back.pop(key)
+
+    # -- telemetry ---------------------------------------------------------
+    def _publish(self, wait_ms):
+        from .. import profiler
+        if not profiler.is_running():
+            return
+        if self._counters is None:
+            self._counters = (
+                profiler.Counter(name="d2h_bytes"),
+                profiler.Counter(name="offload_wait_ms_per_step"))
+        self._counters[0].set_value(self.d2h_bytes)
+        self._counters[1].set_value(round(wait_ms, 3))
+
+    def stats(self):
+        """Always-readable snapshot (counters need a running profiler)."""
+        return {"puts": self.puts, "d2h_bytes": self.d2h_bytes,
+                "h2d_bytes": self.h2d_bytes,
+                "last_wait_ms": self.last_wait_ms,
+                "wait_ms_total": self.wait_ms_total,
+                "resident": len(self._host) + len(self._back),
+                "in_flight": len(self._order), "window": self.window,
+                "host_backed": self.host_backed}

@@ -82,22 +82,29 @@ class ComposedPipelineLM:
             else "dense"
 
     # -- parameters --------------------------------------------------------
-    def init_params(self, key, n_stages):
+    def init_params(self, key, n_stages, n_chunks=1):
+        """Stage-stacked parameters: every per-block tensor leads with the
+        stage dim S, or with (v, S) when `n_chunks` > 1 for the
+        interleaved schedule — index [c, s] holds VIRTUAL stage c*S + s
+        (the loop layout: sharding dim 1 over pp hands rank r exactly its
+        v chunks, and the dense oracle walks virtual stages in vs
+        order)."""
         cfg = self.cfg
-        if cfg.n_layers % n_stages:
+        if cfg.n_layers % (n_stages * n_chunks):
             raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
-                             f"pp stages {n_stages}")
-        lps = cfg.n_layers // n_stages
+                             f"pp stages*chunks {n_stages}x{n_chunks}")
+        lps = cfg.n_layers // (n_stages * n_chunks)
         dt = jnp.dtype(cfg.dtype)
         d, f, E = cfg.d_model, cfg.d_ff, cfg.n_experts
         keys = iter(jax.random.split(key, 4 + 16 * cfg.n_layers))
+        lead = (n_chunks, n_stages) if n_chunks > 1 else (n_stages,)
 
         def dense(fan_in, shape):
             return (jax.random.normal(next(keys), shape, jnp.float32) /
                     math.sqrt(fan_in)).astype(dt)
 
         def stacked(fan_in, shape):
-            return (jax.random.normal(next(keys), (n_stages,) + shape,
+            return (jax.random.normal(next(keys), lead + shape,
                                       jnp.float32) / math.sqrt(fan_in)
                     ).astype(dt)
 
@@ -109,14 +116,14 @@ class ComposedPipelineLM:
         }
         for j in range(lps):
             b = f"b{j}_"
-            params[b + "ln1_g"] = jnp.ones((n_stages, d), dt)
-            params[b + "ln1_b"] = jnp.zeros((n_stages, d), dt)
+            params[b + "ln1_g"] = jnp.ones(lead + (d,), dt)
+            params[b + "ln1_b"] = jnp.zeros(lead + (d,), dt)
             params[b + "wq"] = stacked(d, (d, d))
             params[b + "wk"] = stacked(d, (d, d))
             params[b + "wv"] = stacked(d, (d, d))
             params[b + "wo"] = stacked(d, (d, d))
-            params[b + "ln2_g"] = jnp.ones((n_stages, d), dt)
-            params[b + "ln2_b"] = jnp.zeros((n_stages, d), dt)
+            params[b + "ln2_g"] = jnp.ones(lead + (d,), dt)
+            params[b + "ln2_b"] = jnp.zeros(lead + (d,), dt)
             if self._ffn_kind(j) == "moe":
                 params[b + "wg"] = stacked(d, (d, E))
                 params[b + "w1"] = stacked(d, (E, d, f))
@@ -171,14 +178,18 @@ class ComposedPipelineLM:
         return x + y, aux
 
     # -- composed train step ----------------------------------------------
-    def param_specs(self, mesh):
-        """PartitionSpec per param name for a stage-stacked tree."""
+    def param_specs(self, mesh, n_chunks=1):
+        """PartitionSpec per param name for a stage-stacked tree; with
+        `n_chunks` > 1 the (v, S)-stacked tensors shard dim 1 over pp
+        (the chunk dim stays local — every rank holds its v chunks)."""
         names = set(mesh.axis_names)
         pp = "pp" if "pp" in names else None
         tp = "tp" if "tp" in names else None
         ep = "ep" if "ep" in names else ("dp" if "dp" in names else None)
         specs = {}
-        lps = self.cfg.n_layers // (mesh.shape["pp"] if pp else 1)
+        lps = self.cfg.n_layers // (
+            (mesh.shape["pp"] if pp else 1) * n_chunks)
+        lead = (None, pp) if n_chunks > 1 else (pp,)
         specs["embed"] = P()
         specs["pos_embed"] = P()
         specs["lnf_g"] = P()
@@ -186,47 +197,79 @@ class ComposedPipelineLM:
         for j in range(lps):
             b = f"b{j}_"
             for s in ("ln1_g", "ln1_b", "ln2_g", "ln2_b"):
-                specs[b + s] = P(pp)
+                specs[b + s] = P(*lead)
             for s in ("wq", "wk", "wv"):       # column-parallel
-                specs[b + s] = P(pp, None, tp)
-            specs[b + "wo"] = P(pp, tp, None)  # row-parallel
+                specs[b + s] = P(*lead, None, tp)
+            specs[b + "wo"] = P(*lead, tp, None)  # row-parallel
             if self._ffn_kind(j) == "moe":
-                specs[b + "wg"] = P(pp)
-                specs[b + "w1"] = P(pp, ep)
-                specs[b + "w2"] = P(pp, ep)
+                specs[b + "wg"] = P(*lead)
+                specs[b + "w1"] = P(*lead, ep)
+                specs[b + "w2"] = P(*lead, ep)
             else:
-                specs[b + "w_in"] = P(pp, None, tp)
-                specs[b + "w_out"] = P(pp, tp, None)
+                specs[b + "w_in"] = P(*lead, None, tp)
+                specs[b + "w_out"] = P(*lead, tp, None)
         return specs
 
     def make_train_step(self, mesh, n_microbatches=2, grad_accum_rounds=1,
-                        lr=1e-3, schedule=None, remat=None):
+                        lr=1e-3, schedule=None, remat=None, n_chunks=None,
+                        offload=None):
         """Returns (step_fn, shard_params, init_opt). step_fn(params, opt,
         tokens, targets, step_i) -> (params, opt, loss); tokens/targets
         (B, T) int32 sharded (dp, sp). ONE jitted program contains the
         full pipeline fwd+bwd schedule, every collective, and Adam.
 
-        `schedule` picks the pipeline backward ("gpipe" or "1f1b",
-        default env MXTPU_PP_SCHEDULE) and `remat` the per-stage
-        rematerialization policy ("none"/"dots_saveable"/"full", default
-        env MXNET_REMAT); both also apply to the no-pp microbatch scan
-        (where remat still bounds activation memory and schedule is
-        moot). The returned step carries `.schedule`, `.remat`,
-        `.bubble_fraction` (the schedule-grid idle fraction),
+        `schedule` picks the pipeline backward ("gpipe" / "1f1b" /
+        "interleaved" / "zb1", default env MXTPU_PP_SCHEDULE) and `remat`
+        the per-stage rematerialization policy ("none"/"dots_saveable"/
+        "full", default env MXNET_REMAT); both also apply to the no-pp
+        microbatch scan (where remat still bounds activation memory and
+        schedule is moot). "interleaved" additionally takes `n_chunks`
+        virtual-stage chunks per rank (default env MXTPU_PP_VSTAGES;
+        params must come from init_params(..., n_chunks=v)), and
+        `offload` (default env MXNET_PP_OFFLOAD) stages saved activations
+        to host memory through the save_and_offload checkpoint policy —
+        it overrides `remat`, which must stay "none"/"full" alongside it.
+        The returned step carries `.schedule`, `.remat`, `.n_chunks`,
+        `.offload`, `.bubble_fraction` (the schedule-grid idle fraction),
         `.schedule_stats`, `.jit_key` and `._cached` (the underlying
         cached_jit wrapper), and — when step attribution is on — books
         each call's wall time into the `compute` / `pp_bubble` phases so
-        profiler.mfu_stats() reports the measured bubble."""
-        from ..util import getenv_str
+        profiler.mfu_stats() reports the measured bubble, plus the
+        per-step host-offload traffic on the `d2h_bytes` counter when
+        offloading."""
+        from ..util import getenv_bool, getenv_int, getenv_str
         if schedule is None:
             schedule = getenv_str("MXTPU_PP_SCHEDULE")
         if remat is None:
             remat = getenv_str("MXNET_REMAT")
+        if offload is None:
+            offload = getenv_bool("MXNET_PP_OFFLOAD")
         if schedule not in SCHEDULES:
-            raise ValueError(f"schedule {schedule!r} not in {SCHEDULES}")
+            # the env knob lands here too: name every valid schedule
+            # instead of surfacing a raw KeyError from a grid lookup
+            raise ValueError(
+                f"schedule {schedule!r} not in {SCHEDULES} "
+                "(set MXTPU_PP_SCHEDULE or pass schedule=)")
         if remat not in REMAT_MODES:
             raise ValueError(f"remat {remat!r} not in {REMAT_MODES}")
+        if offload and remat not in ("none", "full"):
+            raise ValueError(
+                f"offload overrides the remat policy; remat={remat!r} "
+                "cannot compose with it — use remat='none' or 'full'")
+        if n_chunks is None:
+            n_chunks = getenv_int("MXTPU_PP_VSTAGES") \
+                if schedule == "interleaved" else 1
+        v = max(int(n_chunks), 1)
+        if v > 1 and schedule != "interleaved":
+            raise ValueError(
+                f"n_chunks={v} only applies to schedule='interleaved', "
+                f"not {schedule!r}")
         cfg = self.cfg
+        if cfg.n_layers % ((mesh.shape["pp"] if "pp" in
+                            set(mesh.axis_names) else 1) * v):
+            raise ValueError(
+                f"n_layers {cfg.n_layers} not divisible by pp "
+                f"stages*chunks")
         names = set(mesh.axis_names)
         dp = "dp" if "dp" in names else None
         pp = "pp" if "pp" in names else None
@@ -234,9 +277,9 @@ class ComposedPipelineLM:
         sp = "sp" if "sp" in names else None
         ep = "ep" if "ep" in names else dp
         S = mesh.shape[pp] if pp else 1
-        lps = cfg.n_layers // S
+        lps = cfg.n_layers // (S * v)
         model = self
-        specs = self.param_specs(mesh)
+        specs = self.param_specs(mesh, n_chunks=v)
         data_spec = P(dp, sp)
         mesh_axes = [a for a in (dp, pp, tp, sp,
                                  "ep" if "ep" in names else None) if a]
@@ -253,9 +296,11 @@ class ComposedPipelineLM:
         def local_loss(params, tokens, targets):
             # stage-stacked tensors (the b*_ block params) arrive with a
             # local stage dim of 1 under a pp axis, or S=1 without one —
-            # either way the local stage is slice 0
-            stage_p = {k: (v[0] if k.startswith("b") else v)
-                       for k, v in params.items()}
+            # either way the local stage is slice 0; (v, S)-stacked
+            # tensors keep their local chunk dim (v, 1, ...) -> (v, ...)
+            stage_p = {k: ((p[:, 0] if v > 1 else p[0])
+                           if k.startswith("b") else p)
+                       for k, p in params.items()}
             B_l, T_l = tokens.shape
             n_sp = mesh.shape[sp] if sp else 1
             if T_l * n_sp > cfg.max_len:
@@ -279,16 +324,35 @@ class ComposedPipelineLM:
             def round_fn(carry, xs):
                 xr, tr = xs
                 if pp:
-                    h, aux = pipeline_train_apply(stage_fn, stage_p, xr,
-                                                  pp, n_microbatches,
-                                                  schedule=schedule,
-                                                  remat=remat)
+                    h, aux = pipeline_train_apply(
+                        stage_fn, stage_p, xr, pp, n_microbatches,
+                        schedule=schedule, remat=remat,
+                        n_chunks=(v if schedule == "interleaved"
+                                  else None),
+                        offload=offload)
                 else:
                     # no pp axis: same microbatch chunking, plain scan —
-                    # this IS the grad-accumulation baseline
+                    # this IS the grad-accumulation baseline. With chunked
+                    # (v, ...) params every virtual stage still runs, in
+                    # vs order (S=1, so vs == c).
                     mb = xr.shape[0] // n_microbatches
                     xm = xr.reshape((n_microbatches, mb) + xr.shape[1:])
-                    mb_stage = remat_stage_fn(stage_fn, remat)
+                    if v > 1:
+                        def all_chunks(sp_, hh):
+                            aa = jnp.float32(0)
+                            for c in range(v):
+                                chunk = {k: leaf[c]
+                                         for k, leaf in sp_.items()}
+                                hh, a = stage_fn(chunk, hh)
+                                aa = aa + a
+                            # per chunk-visit mean, matching the pipeline's
+                            # psum/(V*M) normalization
+                            return hh, aa / v
+                        mb_stage = remat_stage_fn(all_chunks, remat,
+                                                  offload=offload)
+                    else:
+                        mb_stage = remat_stage_fn(stage_fn, remat,
+                                                  offload=offload)
 
                     def mb_fn(_, xmb):
                         hh, aa = mb_stage(stage_p, xmb)
@@ -333,7 +397,15 @@ class ComposedPipelineLM:
         axes_sig = "x".join(f"{a}{mesh.shape[a]}" for a in mesh.axis_names)
         jit_key = (f"trainstep:composed:{axes_sig}:{schedule}:"
                    f"remat-{remat}:M{n_microbatches}:R{grad_accum_rounds}")
-        pstats = schedule_stats(schedule, S, n_microbatches)
+        # suffixes only when non-default, so pre-existing keys (and the
+        # shardlint waivers annotated on them) stay byte-stable
+        if v > 1:
+            jit_key += f":v{v}"
+        if offload:
+            jit_key += ":offload"
+        pstats = schedule_stats(schedule, S, n_microbatches,
+                                n_chunks=(v if schedule == "interleaved"
+                                          else None))
         bubble = pstats["bubble_fraction"] if pp else 0.0
 
         from .. import compile_cache as _cc
@@ -363,7 +435,24 @@ class ComposedPipelineLM:
             donate_argnums=(0, 1) if _donation_supported() else (),
             compiler_options=default_compiler_options())
 
+        _off_counter = []
+
+        def _book_offload(tokens):
+            # analytic D2H traffic: every (stage, chunk, round, microbatch)
+            # visit parks its stage-input residual on pinned host exactly
+            # once, so one step moves S*v copies of the full (B, T, D)
+            # activation regardless of the M/R chunking
+            if not (offload and _prof.is_running()):
+                return
+            if not _off_counter:
+                _off_counter.append(_prof.Counter(name="d2h_bytes"))
+            B_, T_ = tokens.shape[0], tokens.shape[1]
+            _off_counter[0].increment(
+                S * v * B_ * T_ * cfg.d_model
+                * jnp.dtype(cfg.dtype).itemsize)
+
         def jit_step(params, opt_state, tokens, targets, step_i):
+            _book_offload(tokens)
             if not (pp and _prof.attribution_enabled()):
                 return cached(params, opt_state, tokens, targets, step_i)
             import time
@@ -385,6 +474,8 @@ class ComposedPipelineLM:
         jit_step.jit_key = jit_key
         jit_step.schedule = schedule
         jit_step.remat = remat
+        jit_step.n_chunks = v
+        jit_step.offload = offload
         jit_step.bubble_fraction = bubble
         jit_step.schedule_stats = pstats
 
@@ -408,17 +499,25 @@ class ComposedPipelineLM:
         composed run; the oracle reproduces that chunking so dispatch
         decisions — and with dropless capacity, the loss — match)."""
         cfg = self.cfg
-        S = params["b0_wq"].shape[0]
-        lps = cfg.n_layers // S
+        wq = params["b0_wq"]
+        # (v, S, ...)-stacked block tensors mark a chunked (interleaved)
+        # layout; execution order is virtual-stage order vs = c*S + s
+        if wq.ndim == 4:
+            v_chunks, S = wq.shape[0], wq.shape[1]
+        else:
+            v_chunks, S = 1, wq.shape[0]
+        lps = cfg.n_layers // (S * v_chunks)
         B, T = tokens.shape
         x = params["embed"][tokens] + params["pos_embed"][jnp.arange(T)]
 
         def run_blocks(xg):
             aux_total = jnp.float32(0)
-            for s in range(S):
+            for vs in range(v_chunks * S):
+                c, s = vs // S, vs % S
+                p = {k: ((v[c, s] if v_chunks > 1 else v[s])
+                         if v.ndim and k.startswith("b") else v)
+                     for k, v in params.items()}
                 for j in range(lps):
-                    p = {k: (v[s] if v.ndim and k.startswith("b") else v)
-                         for k, v in params.items()}
                     kind = self._ffn_kind(j)
                     Bg, Tg, D = xg.shape
                     h = self._ln(xg, p[f"b{j}_ln1_g"], p[f"b{j}_ln1_b"])
@@ -477,10 +576,10 @@ class ComposedPipelineLM:
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 nll = -jnp.take_along_axis(logp, tr[..., None],
                                            axis=-1)[..., 0]
-                # the composed aux is meaned over the S * M real
-                # (stage, microbatch) visits; aux_sum here has summed all
-                # blocks over all M microbatches
-                aux_mean = aux_sum / (S * n_microbatches)
+                # the composed aux is meaned over the S * v * M real
+                # (stage, chunk, microbatch) visits; aux_sum here has
+                # summed all blocks over all M microbatches
+                aux_mean = aux_sum / (S * v_chunks * n_microbatches)
                 round_losses.append(jnp.mean(nll) +
                                     cfg.aux_weight * aux_mean)
             losses.append(jnp.mean(jnp.stack(round_losses)))

@@ -8,7 +8,7 @@ microbatches flow stage-to-stage with `lax.ppermute` on a `lax.scan`
 steady-state loop, and the WHOLE schedule — forward and backward — is one
 compiled XLA program that composes with dp/tp/sp/ep axes of the same mesh.
 
-Two schedules are provided (`schedule=` / env `MXTPU_PP_SCHEDULE`):
+Four schedules are provided (`schedule=` / env `MXTPU_PP_SCHEDULE`):
 
 * ``"gpipe"`` — a forward scan over ``M + S - 1`` ticks whose backward is
   obtained by JAX autodiff: the transpose of the scan runs the stages in
@@ -36,29 +36,69 @@ Two schedules are provided (`schedule=` / env `MXTPU_PP_SCHEDULE`):
   simulation, and docs/architecture/note_composed_parallelism.md for the
   derivations).
 
+* ``"interleaved"`` — virtual pipeline stages: each rank holds ``v >= 2``
+  chunks (`n_chunks=` / env `MXTPU_PP_VSTAGES`) in the LOOP layout
+  (virtual stage ``vs = c*S + r`` lives on rank ``r = vs % S``), so the
+  fill/drain ramp costs one CHUNK of layers per rank instead of a full
+  stage and the bubble shrinks ~``1/v`` below 1F1B.  Work placement comes
+  from a host-side greedy simulation over (tick, rank) slots — one F and
+  one B sub-slot per rank per tick, activations on the same uniform
+  down-ring (the ``S-1 -> 0`` hop advances the chunk index) — compiled
+  into static per-tick index tables the scan body gathers at its rank.
+  Stage params carry a leading chunk dim ``v`` selected per tick with a
+  dynamic index.
+
+* ``"zb1"`` — ZB-H1 zero-bubble: 1F1B's grid with the backward SPLIT into
+  an input-grad half-pass ``B`` (``jax.vjp`` w.r.t. the activation only —
+  the cotangent keeps hopping up the ring with no weight-grad work on the
+  critical path) and a weight-grad half-pass ``W`` (``jax.vjp`` w.r.t.
+  the params only, replayed later from the same saved input and stored
+  output cotangent).  ``W`` passes are placed by a host-side greedy that
+  defers just enough of them to fill the 1F1B cooldown ticks, so the only
+  idle weight left is the warmup corner: at S=4/M=8 the bubble is
+  6/132 = 4.5% vs 21.4% for 1F1B.  Saved inputs live until their W pass
+  (not their B pass) consumes them — still bounded by ``2S - 1`` ring
+  slots, independent of ``M``.
+
 Per-stage activation REMATERIALIZATION (`remat=` / env `MXNET_REMAT`)
 wraps the stage function in ``jax.checkpoint``: ``"none"`` saves whatever
 autodiff saves, ``"dots_saveable"`` keeps matmul outputs and recomputes
 the rest, ``"full"`` saves nothing but the stage input.  Numerics are
 bit-identical across policies; only the memory/recompute trade-off moves.
+
+ACTIVATION OFFLOAD (`offload=` / env `MXNET_PP_OFFLOAD`) additionally
+tags each stage input with `checkpoint_name` and checkpoints the stage
+under `save_and_offload_only_these_names`: the saved inputs are staged to
+host memory (`pinned_host`) as they are produced and fetched back ahead
+of the backward that consumes them — the on-device residual footprint is
+the in-flight transfer window, not the schedule depth.  This is the
+steady-state D2H/H2D overlap the reference engine's dependency-ordered
+async copies implement, expressed as an XLA memory-space constraint; the
+host-side counterpart (explicit double-buffered `device_put` machinery
+with `d2h_bytes` / `offload_wait_ms_per_step` counters) is
+io/prefetch.HostOffloader.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ._compat import shard_map
 
 __all__ = ["pipeline_apply", "pipeline_train_apply", "pipeline_sharded",
            "remat_stage_fn", "schedule_grid", "schedule_stats",
-           "SCHEDULES", "REMAT_MODES"]
+           "SCHEDULES", "REMAT_MODES", "OFFLOAD_NAME"]
 
-SCHEDULES = ("gpipe", "1f1b")
+SCHEDULES = ("gpipe", "1f1b", "interleaved", "zb1")
 REMAT_MODES = ("none", "dots_saveable", "full")
 
+# the checkpoint_name tag offloaded stage inputs are filed under
+OFFLOAD_NAME = "pp_stage_input"
 
-def remat_stage_fn(stage_fn, mode):
+
+def remat_stage_fn(stage_fn, mode, offload=False):
     """Wrap a pipeline stage in the requested `jax.checkpoint` policy.
 
     "none" returns the function unchanged (autodiff saves its usual
@@ -67,7 +107,31 @@ def remat_stage_fn(stage_fn, mode):
     the default save-nothing policy (backward recomputes the entire stage
     from its input). The wrapper changes only WHAT the backward stores,
     never the values it computes.
+
+    offload=True tags the stage input with `checkpoint_name` and
+    checkpoints under `save_and_offload_only_these_names`: nothing stays
+    on device, the tagged input is staged to host memory and fetched back
+    for the recompute — i.e. "full" remat whose one residual lives in
+    host memory instead of HBM. The explicit policies are mutually
+    exclusive with it ("none"/"full" compose trivially; a saveable-dots
+    policy cannot also be expressed as a named-offload list), so offload
+    overrides `mode` and only "none"/"full" are accepted alongside it.
     """
+    if offload:
+        if mode not in (None, "", "none", "full"):
+            raise ValueError(
+                f"offload overrides remat policy; remat={mode!r} cannot "
+                "compose with it — use remat='none' or 'full'")
+
+        def named(params, h):
+            return stage_fn(
+                params, jax.ad_checkpoint.checkpoint_name(h, OFFLOAD_NAME))
+
+        policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=[OFFLOAD_NAME],
+            offload_src="device", offload_dst="pinned_host")
+        return jax.checkpoint(named, policy=policy)
     if mode in (None, "", "none"):
         return stage_fn
     if mode == "dots_saveable":
@@ -84,14 +148,89 @@ def remat_stage_fn(stage_fn, mode):
 # docs' formulas are DERIVED from these, not asserted independently
 # ---------------------------------------------------------------------------
 
-def schedule_grid(schedule, n_stages, n_microbatches):
-    """The (tick, stage) work grid of a schedule: a list over ticks, each
-    a tuple over stages of work-item tuples — ("F", k) / ("B", k) entries,
-    empty when the stage computes garbage that tick (the bubble).
+def _zb1_w_ticks(S, M):
+    """Greedy W placement for ZB-H1: {(s, k): tick}. F/B keep 1F1B's grid
+    positions; each stage walks its ticks in order and runs a pending
+    weight-grad half-pass (FIFO over microbatches, so the accumulation
+    order matches the fused backward bit-for-bit) whenever the tick is
+    otherwise idle — or eagerly, same tick as a B, once deferring any
+    longer would leave more pending W's than idle ticks remain to absorb
+    them. That defers exactly enough W work to fill the cooldown."""
+    T = M + 2 * (S - 1)
+    ticks = {}
+    for s in range(S):
+        fb_busy = set()
+        for k in range(M):
+            fb_busy.add(s + k)                     # F(s, k)
+            fb_busy.add(2 * (S - 1) - s + k)       # B(s, k)
+        first_b = 2 * (S - 1) - s
+        idle = [t for t in range(T) if t not in fb_busy and t > first_b]
+        pending = 0
+        nxt = 0
+        for t in range(T):
+            if first_b <= t < first_b + M:
+                pending += 1                       # B(s, t - first_b) ran
+            if pending <= 0:
+                continue
+            future_idle = sum(1 for u in idle if u > t)
+            if t in idle or pending > future_idle:
+                ticks[(s, nxt)] = t
+                nxt += 1
+                pending -= 1
+        if nxt != M:          # pigeonhole: [first_b, T) has M + s ticks
+            raise AssertionError(
+                f"zb1 W placement incomplete: stage {s} placed {nxt}/{M}")
+    return ticks
+
+
+def _interleaved_events(S, M, v, with_backward):
+    """Greedy interleaved-schedule simulation: tick placement {(vs, k): t}
+    for F and (when with_backward) B over virtual stages vs = c*S + r.
+    One F and one B sub-slot per rank per tick; an activation produced at
+    tick t reaches the next rank at t+1; the last virtual stage may turn
+    a microbatch around (F then B) within one tick, exactly like 1F1B —
+    with v=1 the simulation reproduces the closed-form 1F1B grid."""
+    V = v * S
+    tF, tB = {}, {}
+    t = 0
+    want = V * M * (2 if with_backward else 1)
+    while len(tF) + len(tB) < want:
+        if t > 4 * (v * M + 2 * V):   # far past any valid schedule length
+            raise AssertionError(
+                f"interleaved schedule did not converge: S={S} M={M} v={v}")
+        for r in range(S):
+            ready_f = [(vs, k) for vs in range(r, V, S) for k in range(M)
+                       if (vs, k) not in tF
+                       and (vs == 0 or tF.get((vs - 1, k), t) < t)]
+            if ready_f:
+                # depth-first: run the deepest ready chunk so microbatches
+                # reach the head (and their backward) as early as possible
+                vs, k = min(ready_f, key=lambda e: (-e[0], e[1]))
+                tF[(vs, k)] = t
+            if with_backward:
+                ready_b = [
+                    (vs, k) for vs in range(r, V, S) for k in range(M)
+                    if (vs, k) not in tB
+                    and ((vs == V - 1 and tF.get((vs, k), t + 1) <= t)
+                         or (vs < V - 1 and tB.get((vs + 1, k), t) < t))]
+                if ready_b:
+                    vs, k = min(ready_b, key=lambda e: (e[1], e[0]))
+                    tB[(vs, k)] = t
+        t += 1
+    return tF, tB, t
+
+
+def schedule_grid(schedule, n_stages, n_microbatches, n_chunks=None):
+    """The (tick, rank) work grid of a schedule: a list over ticks, each
+    a tuple over pipeline ranks of work-item tuples, empty when the rank
+    computes garbage that tick (the bubble).  Work items are ("F", k) /
+    ("B", k) for gpipe and 1f1b, plus ("W", k) weight-grad half-passes
+    for zb1, and ("F", c, k) / ("B", c, k) with the chunk index for
+    interleaved (`n_chunks` = v, default 2).
 
     gpipe ticks cover the forward scan then its autodiff transpose (the
-    backward replays the scan in reverse); 1f1b ticks each carry a forward
-    AND a backward sub-slot of the combined grid.
+    backward replays the scan in reverse); the other schedules' ticks
+    each carry every sub-slot of their combined forward/backward grid.
     """
     S, M = n_stages, n_microbatches
     if schedule == "gpipe":
@@ -106,7 +245,11 @@ def schedule_grid(schedule, n_stages, n_microbatches):
                 (("B", t - s),) if 0 <= t - s < M else ()
                 for s in range(S)))
         return grid
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "zb1"):
+        w_ticks = _zb1_w_ticks(S, M) if schedule == "zb1" else {}
+        w_by_tick = {}
+        for (s, k), t in w_ticks.items():
+            w_by_tick[(t, s)] = k
         grid = []
         for t in range(M + 2 * (S - 1)):
             row = []
@@ -118,35 +261,102 @@ def schedule_grid(schedule, n_stages, n_microbatches):
                 kb = t - 2 * (S - 1) + s
                 if 0 <= kb < M:
                     work.append(("B", kb))
+                if (t, s) in w_by_tick:
+                    work.append(("W", w_by_tick[(t, s)]))
                 row.append(tuple(work))
             grid.append(tuple(row))
+        return grid
+    if schedule == "interleaved":
+        v = 2 if n_chunks is None else n_chunks
+        if v < 1:
+            raise ValueError(f"interleaved needs n_chunks >= 1, got {v}")
+        tF, tB, T = _interleaved_events(S, M, v, with_backward=True)
+        by_slot = {}
+        for kind, events in (("F", tF), ("B", tB)):
+            for (vs, k), t in events.items():
+                by_slot.setdefault((t, vs % S), []).append(
+                    (kind, vs // S, k))
+        grid = []
+        for t in range(T):
+            grid.append(tuple(
+                tuple(sorted(by_slot.get((t, r), ())))
+                for r in range(S)))
         return grid
     raise ValueError(f"unknown schedule {schedule!r}; pick from {SCHEDULES}")
 
 
-def schedule_stats(schedule, n_stages, n_microbatches):
-    """Bubble accounting derived from schedule_grid: a (tick, stage) slot
-    is idle when the stage has no real microbatch that tick (it still
-    executes — on garbage — since the program is lockstep SPMD).  Returns
-    {"ticks", "total_slots", "idle_slots", "bubble_fraction",
-    "analytic_gpipe", "max_live_per_stage"}.  max_live_per_stage is the
-    peak number of in-flight microbatch activations any stage holds for
-    its backward: M for gpipe (autodiff keeps every forward residual until
-    the transpose replays it), max_s 2(S-1-s)+1 for 1f1b (saved input ring,
-    slot k freed the tick B(k) consumes it)."""
-    grid = schedule_grid(schedule, n_stages, n_microbatches)
+def _tick_weights(schedule, S, M, ticks):
+    """Relative cost of each tick's lockstep body, in F-pass units: a
+    forward is 1, a fused backward (recompute + input- and weight-grads)
+    2, so a full 1F1B tick is 3 and zb1's split B and W half-passes are
+    ~1.5 each (the program phases below use 1/3/2 — warmup runs an
+    F-only body, steady F+B+W, cooldown B+W).  Weighting idle slots by
+    what their tick's body actually costs keeps the bubble fraction
+    honest when different program phases compile to different scan
+    bodies; for gpipe and 1f1b the weighted fraction reduces to the old
+    unweighted one (uniform 3 for 1f1b; 1-then-2 for gpipe's symmetric
+    halves)."""
+    if schedule == "gpipe":
+        half = M + S - 1
+        return [1 if t < half else 2 for t in range(ticks)]
+    if schedule == "zb1":
+        return [1 if t < S - 1 else (3 if t < M + S - 1 else 2)
+                for t in range(ticks)]
+    return [3] * ticks          # 1f1b / interleaved: one uniform body
+
+
+def schedule_stats(schedule, n_stages, n_microbatches, n_chunks=None):
+    """Bubble accounting derived from schedule_grid: a (tick, rank) slot
+    is idle when the rank has no real work that tick (it still executes —
+    on garbage — since the program is lockstep SPMD), and each slot is
+    weighted by its tick's body cost (_tick_weights) so phases whose
+    bodies compile to less work count for less.  Returns {"ticks",
+    "total_slots", "idle_slots", "weighted_idle", "weighted_total",
+    "bubble_fraction", "analytic_gpipe", "max_live_per_stage"}.
+    max_live_per_stage is the peak number of in-flight microbatch
+    activations any rank holds for its backward: M for gpipe (autodiff
+    keeps every forward residual until the transpose replays it),
+    max_s 2(S-1-s)+1 for 1f1b (saved-input ring, slot k freed the tick
+    B(k) consumes it), grid-derived for zb1 (inputs live until their W
+    half-pass) and interleaved (v chunks' arrivals queue per rank)."""
+    grid = schedule_grid(schedule, n_stages, n_microbatches, n_chunks)
     S, M = n_stages, n_microbatches
+    weights = _tick_weights(schedule, S, M, len(grid))
     total = len(grid) * S
     idle = sum(1 for row in grid for work in row if not work)
+    w_total = sum(weights) * S
+    w_idle = sum(w for w, row in zip(weights, grid)
+                 for work in row if not work)
     if schedule == "gpipe":
         max_live = M
-    else:
+    elif schedule == "1f1b":
         max_live = max(2 * (S - 1 - s) + 1 for s in range(S)) if S else 0
+    elif schedule == "zb1":
+        w_ticks = _zb1_w_ticks(S, M)
+        max_live = max(
+            (sum(1 for k in range(M) if s + k <= t <= w_ticks[(s, k)])
+             for s in range(S) for t in range(len(grid))), default=0)
+    else:
+        v = 2 if n_chunks is None else n_chunks
+        tF, tB, T = _interleaved_events(S, M, v, with_backward=True)
+        max_live = 0
+        for r in range(S):
+            for t in range(T):
+                n = 0
+                for vs in range(r, v * S, S):
+                    for k in range(M):
+                        start = (tF[(vs, k)] if vs == 0
+                                 else tF[(vs - 1, k)] + 1)
+                        if start <= t <= tB[(vs, k)]:
+                            n += 1
+                max_live = max(max_live, n)
     return {
         "ticks": len(grid),
         "total_slots": total,
         "idle_slots": idle,
-        "bubble_fraction": idle / total if total else 0.0,
+        "weighted_idle": w_idle,
+        "weighted_total": w_total,
+        "bubble_fraction": w_idle / w_total if w_total else 0.0,
         "analytic_gpipe": (S - 1) / (M + S - 1) if M + S > 1 else 0.0,
         "max_live_per_stage": max_live,
     }
@@ -178,7 +388,8 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
 
 
 def pipeline_train_apply(stage_fn, stage_params, x, axis_name,
-                         n_microbatches, schedule="gpipe", remat="none"):
+                         n_microbatches, schedule="gpipe", remat="none",
+                         n_chunks=None, offload=False):
     """pipeline_apply for TRAINING stages: stage_fn(params, h) returns
     (h_out, aux) where aux is a scalar auxiliary loss (e.g. MoE load
     balancing).  The function is differentiable either way; `schedule`
@@ -194,20 +405,35 @@ def pipeline_train_apply(stage_fn, stage_params, x, axis_name,
       one-forward-one-backward grid (module docstring): B(k) overlaps
       F(k+S), each stage keeps only a bounded ring of saved stage INPUTS
       and recomputes its forward from them under the `remat` policy.
+    * "interleaved": 1f1b over v virtual stages per rank (`n_chunks`,
+      default 2) in the loop layout — stage_params must carry a leading
+      chunk dim v; fill/drain ramps cost a chunk, not a stage.
+    * "zb1": 1f1b with the backward split into input-grad and weight-grad
+      half-passes; the weight halves fill the cooldown (ZB-H1).
 
-    Both schedules compute the same loss and the same gradients (to
+    All schedules compute the same loss and the same gradients (to
     floating-point accumulation order); tests/test_pipeline_1f1b.py pins
     the parity.
+
+    `offload=True` (env MXNET_PP_OFFLOAD) stages each saved stage input
+    to host memory via the save_and_offload checkpoint policy
+    (remat_stage_fn) — for the autodiff-scheduled residuals (gpipe) the
+    per-stage on-device footprint becomes the in-flight transfer window
+    instead of the M-deep residual stack.
 
     aux is only meaningful for slots where a stage holds a real microbatch
     (during fill/drain, stages chew zeros); those contributions are masked
     out. Returns (outputs (B, ...), aux_mean) with aux_mean the mean over
-    the S * M real (stage, microbatch) visits.
+    the real (stage, microbatch) visits — S * M, or v*S * M interleaved.
     """
     if schedule not in SCHEDULES:
         raise ValueError(
             f"unknown schedule {schedule!r}; pick from {SCHEDULES}")
-    stage_fn = remat_stage_fn(stage_fn, remat)
+    if n_chunks is not None and n_chunks > 1 and schedule != "interleaved":
+        raise ValueError(
+            f"n_chunks={n_chunks} only applies to schedule='interleaved', "
+            f"not {schedule!r}")
+    stage_fn = remat_stage_fn(stage_fn, remat, offload=offload)
     S = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     B = x.shape[0]
@@ -217,7 +443,11 @@ def pipeline_train_apply(stage_fn, stage_params, x, axis_name,
     micro = x.reshape((n_microbatches, mb) + x.shape[1:])
 
     carry0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
-    aval = jax.eval_shape(stage_fn, stage_params, carry0)[0]
+    if schedule == "interleaved":
+        chunk0 = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        aval = jax.eval_shape(stage_fn, chunk0, carry0)[0]
+    else:
+        aval = jax.eval_shape(stage_fn, stage_params, carry0)[0]
     if aval.shape != carry0.shape or aval.dtype != carry0.dtype:
         raise ValueError(
             f"pipeline stage must preserve activation shape/dtype: got "
@@ -227,9 +457,17 @@ def pipeline_train_apply(stage_fn, stage_params, x, axis_name,
     if schedule == "gpipe":
         outs, aux_mean = _forward_schedule(stage_fn, stage_params, micro,
                                            axis_name, S, rank)
-    else:
+    elif schedule == "1f1b":
         outs, aux_mean = _pipeline_1f1b(stage_fn, stage_params, micro,
                                         axis_name, S, rank)
+    elif schedule == "zb1":
+        outs, aux_mean = _pipeline_zb1(stage_fn, stage_params, micro,
+                                       axis_name, S, rank)
+    else:
+        v = 2 if n_chunks is None else n_chunks
+        outs, aux_mean = _pipeline_interleaved(stage_fn, stage_params,
+                                               micro, axis_name, S, rank,
+                                               v)
     return outs.reshape((B,) + outs.shape[2:]), aux_mean
 
 
@@ -380,6 +618,424 @@ def _pipeline_1f1b(stage_fn, stage_params, micro, axis_name, S, rank):
             lambda g, p: g.astype(p.dtype), gp, params)
         # ranks > 0 never consumed xx (the rank-0 where-injection zeroes
         # their cotangent exactly as the gpipe transpose does)
+        g_x = jnp.where(rank == 0, gx, jnp.zeros_like(gx))
+        return g_params, g_x
+
+    run.defvjp(fwd, bwd)
+    return run(stage_params, micro)
+
+
+def _pipeline_zb1(stage_fn, stage_params, micro, axis_name, S, rank):
+    """ZB-H1: 1F1B's grid with the backward split into half-passes.
+
+    The input-grad half ``B(s, k)`` keeps 1F1B's tick ``k + 2(S-1) - s``
+    but differentiates the stage w.r.t. its ACTIVATION only (the params
+    tangent is dead code XLA drops), so the cotangent hops up the ring
+    with no weight-grad work on the critical path; the output cotangent
+    it consumed is parked in a second ring.  The weight-grad half
+    ``W(s, k)`` replays ``jax.vjp`` w.r.t. the PARAMS only from the same
+    saved input and parked cotangent at the tick the host-side greedy
+    (_zb1_w_ticks) assigned — mostly the cooldown ticks 1F1B leaves
+    idle.  W consumption is FIFO in k per stage, so weight grads
+    accumulate in the same microbatch order as the fused backward.
+
+    The program is three scans — warmup (F-only body), steady (F+B+W),
+    cooldown (B+W) — so the idle warmup corner is the only bubble left
+    and each phase's body compiles to exactly the work its ticks do
+    (the 1/3/2 weights in _tick_weights).
+    """
+    M, mbs = micro.shape[0], micro.shape[1:]
+    dt = micro.dtype
+    T = M + 2 * (S - 1)
+    w_ticks = _zb1_w_ticks(S, M)
+    kw_np = np.full((T, S), -1, np.int32)
+    for (s, k), t in w_ticks.items():
+        kw_np[t, s] = k
+    # saved inputs live [F(s,k), W(s,k)] and parked cotangents
+    # [B(s,k), W(s,k)]; both live sets are contiguous in k (F, B and the
+    # FIFO W ticks are all ascending in k), so modular slots never
+    # collide as long as the ring covers the peak count
+    Rbuf = 1 + max(
+        (sum(1 for k in range(M) if s + k <= t <= w_ticks[(s, k)])
+         for s in range(S) for t in range(T)), default=0)
+    Rg = 1 + max(
+        (sum(1 for k in range(M)
+             if 2 * (S - 1) - s + k <= t <= w_ticks[(s, k)])
+         for s in range(S) for t in range(T)), default=0)
+
+    # NOTE: as in _pipeline_1f1b, the vjp bodies re-derive the axis index
+    # and close over only trace-static values (tables, shapes, stage_fn).
+
+    @jax.custom_vjp
+    def run(params, xx):
+        return _forward_schedule(stage_fn, params, xx, axis_name, S,
+                                 lax.axis_index(axis_name))
+
+    def fwd(params, xx):
+        return run(params, xx), (params, xx)
+
+    def bwd(res, cots):
+        params, xx = res
+        g_outs, g_aux = cots
+        rank = lax.axis_index(axis_name)
+        g_head = lax.psum(g_outs.astype(dt), axis_name)
+        ga_visit = lax.psum(g_aux, axis_name) / (S * M)
+        # jnp.array (copy) folds the static table into an XLA constant;
+        # asarray would alias it through a device_put eqn inside the jit
+        # (an SL05 implicit-transfer finding)
+        kw_rows = jnp.array(kw_np)
+
+        ring0 = jnp.zeros((Rbuf,) + mbs, dt)
+        gring0 = jnp.zeros((Rg,) + mbs, dt)
+        gx0 = jnp.zeros((M,) + mbs, dt)
+        h0 = jnp.zeros(mbs, dt)
+        g0 = jnp.zeros(mbs, dt)
+        gp0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+        def tick(carry, xs, do_f, do_b):
+            h_prev, g_prev, ring, gring, gx, gp = carry
+            t, kw_row = xs
+            h_next = h_prev
+            g_next = g_prev
+            if do_f:
+                # ---- forward sub-slot: F(rank, t - rank) ---------------
+                kf = t - rank
+                valid_f = jnp.logical_and(kf >= 0, kf < M)
+                kf_c = jnp.clip(kf, 0, M - 1)
+                inject = lax.dynamic_index_in_dim(xx, kf_c, 0,
+                                                  keepdims=False)
+                h_in = jnp.where(rank == 0, inject, h_prev)
+                ring = jnp.where(
+                    valid_f,
+                    lax.dynamic_update_index_in_dim(ring, h_in,
+                                                    kf_c % Rbuf, 0),
+                    ring)
+                h_out, _ = stage_fn(params, h_in)
+                h_next = lax.ppermute(
+                    h_out, axis_name, [(i, (i + 1) % S) for i in range(S)])
+            if do_b:
+                # ---- input-grad sub-slot: B(rank, t - 2(S-1) + rank) ---
+                kb = t - 2 * (S - 1) + rank
+                valid_b = jnp.logical_and(kb >= 0, kb < M)
+                kb_c = jnp.clip(kb, 0, M - 1)
+                h_saved = lax.dynamic_index_in_dim(ring, kb_c % Rbuf, 0,
+                                                   keepdims=False)
+                seed = lax.dynamic_index_in_dim(g_head, kb_c, 0,
+                                                keepdims=False)
+                g_in = jnp.where(rank == S - 1, seed, g_prev)
+                _, vjp_h = jax.vjp(lambda hh: stage_fn(params, hh),
+                                   h_saved)
+                gh, = vjp_h((g_in, jnp.where(valid_b, ga_visit, 0.0)))
+                # park the cotangent B consumed; W replays it for the
+                # weight-grad half from the same saved input
+                gring = jnp.where(
+                    valid_b,
+                    lax.dynamic_update_index_in_dim(gring, g_in,
+                                                    kb_c % Rg, 0),
+                    gring)
+                gx = jnp.where(
+                    jnp.logical_and(rank == 0, valid_b),
+                    lax.dynamic_update_index_in_dim(gx, gh.astype(dt),
+                                                    kb_c, 0),
+                    gx)
+                # ---- weight-grad sub-slot: W at the greedy's tick ------
+                kw = jnp.take(kw_row, rank)
+                valid_w = kw >= 0
+                kw_c = jnp.clip(kw, 0, M - 1)
+                h_w = lax.dynamic_index_in_dim(ring, kw_c % Rbuf, 0,
+                                               keepdims=False)
+                g_w = lax.dynamic_index_in_dim(gring, kw_c % Rg, 0,
+                                               keepdims=False)
+                _, vjp_p = jax.vjp(lambda pp_: stage_fn(pp_, h_w), params)
+                gp_i, = vjp_p((g_w, jnp.where(valid_w, ga_visit, 0.0)))
+                gp = jax.tree_util.tree_map(
+                    lambda acc, g: acc + jnp.where(valid_w, g, 0).astype(
+                        jnp.float32), gp, gp_i)
+                g_next = lax.ppermute(
+                    jnp.where(valid_b, gh, jnp.zeros_like(gh)), axis_name,
+                    [(i, (i - 1) % S) for i in range(S)])
+            return (h_next, g_next, ring, gring, gx, gp), None
+
+        def seg(lo, hi):
+            return (jnp.arange(lo, hi), kw_rows[lo:hi])
+
+        carry = (h0, g0, ring0, gring0, gx0, gp0)
+        if S > 1:   # warmup [0, S-1): forward-only body
+            carry, _ = lax.scan(
+                lambda c, xs: tick(c, xs, True, False), carry,
+                seg(0, S - 1))
+        carry, _ = lax.scan(     # steady [S-1, M+S-1): F + B + W
+            lambda c, xs: tick(c, xs, True, True), carry,
+            seg(S - 1, M + S - 1))
+        if S > 1:   # cooldown [M+S-1, T): B + W only
+            carry, _ = lax.scan(
+                lambda c, xs: tick(c, xs, False, True), carry,
+                seg(M + S - 1, T))
+        _, _, _, _, gx, gp = carry
+        g_params = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), gp, params)
+        g_x = jnp.where(rank == 0, gx, jnp.zeros_like(gx))
+        return g_params, g_x
+
+    run.defvjp(fwd, bwd)
+    return run(stage_params, micro)
+
+
+def _alloc_ring_slots(intervals):
+    """Linear-scan register allocation over [start, end]-inclusive
+    lifetime intervals: returns ({key: slot}, n_slots) with no two
+    overlapping intervals sharing a slot. A slot is reusable only for
+    intervals starting STRICTLY after the previous occupant's end — the
+    scan bodies store arrivals before reads, so a same-tick handoff
+    through one slot would clobber the value still being consumed."""
+    import heapq
+    slots, free, busy = {}, [], []
+    n = 0
+    for start, end, key in sorted(intervals,
+                                  key=lambda e: (e[0], e[1], e[2])):
+        while busy and busy[0][0] < start:
+            heapq.heappush(free, heapq.heappop(busy)[1])
+        if free:
+            sl = heapq.heappop(free)
+        else:
+            sl = n
+            n += 1
+        slots[key] = sl
+        heapq.heappush(busy, (end, sl))
+    return slots, n
+
+
+def _interleaved_tables(S, M, v, with_backward):
+    """Compile the interleaved greedy simulation into static per-(tick,
+    rank) index tables the scan bodies gather at their own rank: for the
+    F sub-slot `kf`/`cf` (microbatch/chunk, -1 = garbage tick), `sfr`
+    (input-ring slot the F event reads — and writes, when it injects),
+    `sst` (ring slot an arriving activation is parked in, -1 = drop) and
+    `cout` (output-collect index on the last virtual stage); for the B
+    sub-slot `kb`/`cb`/`sbr` plus the cotangent ring's `gst`/`gbr`.
+    Returns (tables, T, Rbuf, Rg)."""
+    V = v * S
+    tF, tB, T = _interleaved_events(S, M, v, with_backward)
+
+    def table():
+        return np.full((T, S), -1, np.int32)
+
+    kf, cf, sfr, sst, cout = (table() for _ in range(5))
+    kb, cb, sbr, gst, gbr = (table() for _ in range(5))
+    Rbuf = 1
+    Rg = 1
+    for r in range(S):
+        ivs = []
+        for vs in range(r, V, S):
+            for k in range(M):
+                start = tF[(vs, k)] if vs == 0 else tF[(vs - 1, k)] + 1
+                end = tB[(vs, k)] if with_backward else tF[(vs, k)]
+                ivs.append((start, end, (vs, k)))
+        slots, n = _alloc_ring_slots(ivs)
+        Rbuf = max(Rbuf, n)
+        for vs in range(r, V, S):
+            for k in range(M):
+                t = tF[(vs, k)]
+                kf[t, r] = k
+                cf[t, r] = vs // S
+                sfr[t, r] = slots[(vs, k)]
+                if vs == V - 1:
+                    cout[t, r] = k
+                if vs > 0:
+                    sst[tF[(vs - 1, k)] + 1, r] = slots[(vs, k)]
+                if with_backward:
+                    tb = tB[(vs, k)]
+                    kb[tb, r] = k
+                    cb[tb, r] = vs // S
+                    sbr[tb, r] = slots[(vs, k)]
+        if with_backward:
+            givs = [(tB[(vs + 1, k)] + 1, tB[(vs, k)], (vs, k))
+                    for vs in range(r, V, S) if vs < V - 1
+                    for k in range(M)]
+            gslots, gn = _alloc_ring_slots(givs)
+            Rg = max(Rg, gn)
+            for (vs, k), sl in gslots.items():
+                gbr[tB[(vs, k)], r] = sl
+                gst[tB[(vs + 1, k)] + 1, r] = sl
+    tables = dict(kf=kf, cf=cf, sfr=sfr, sst=sst, cout=cout,
+                  kb=kb, cb=cb, sbr=sbr, gst=gst, gbr=gbr)
+    return tables, T, Rbuf, Rg
+
+
+def _pipeline_interleaved(stage_fn, stage_params, micro, axis_name, S,
+                          rank, v):
+    """Interleaved virtual stages as a custom_vjp: stage_params carry a
+    leading chunk dim v (chunk c on rank r is virtual stage c*S + r — the
+    loop layout), selected per tick by a dynamic index from the static
+    tables.  Activations ride the SAME uniform down-ring as 1f1b: the
+    hop off rank S-1 lands on rank 0 as the next chunk's input (the
+    "chunk roll" is pure table bookkeeping), and the V-1 -> garbage hop
+    is dropped by an sst of -1.  Because the greedy may hold an arrival
+    for a few ticks before its F runs (the rank is busy with another
+    chunk), arrivals are parked in the saved-input ring on receipt and
+    every F reads its input from the ring; B reads the same slot later,
+    so one ring serves both the in-flight queue and the saved inputs.
+    Cotangents hop the inverted ring into a second parked ring the same
+    way.  The primal forward is its own F-only table program; the
+    backward replays forward and backward together, like 1f1b."""
+    M, mbs = micro.shape[0], micro.shape[1:]
+    dt = micro.dtype
+    V = v * S
+    ftab, Tf, Rf, _ = _interleaved_tables(S, M, v, with_backward=False)
+    btab, Tb, Rbuf, Rg = _interleaved_tables(S, M, v, with_backward=True)
+
+    def rows(tab, names):
+        # jnp.array (copy) folds the static tables into XLA constants;
+        # asarray would stage them through device_put eqns (SL05)
+        return tuple(jnp.array(tab[n]) for n in names)
+
+    def chunk_params(params, c):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            params)
+
+    # NOTE: as in _pipeline_1f1b, the vjp bodies re-derive the axis index
+    # and close over only trace-static values (tables, shapes, stage_fn).
+
+    @jax.custom_vjp
+    def run(params, xx):
+        rank = lax.axis_index(axis_name)
+        ring0 = jnp.zeros((Rf,) + mbs, dt)
+        out0 = jnp.zeros_like(xx)
+
+        def ftick(carry, xs):
+            h_prev, ring, outs, aux_acc = carry
+            kf_r, cf_r, sfr_r, sst_r, co_r = (
+                jnp.take(row, rank) for row in xs)
+            ring = jnp.where(
+                sst_r >= 0,
+                lax.dynamic_update_index_in_dim(
+                    ring, h_prev, jnp.clip(sst_r, 0, Rf - 1), 0),
+                ring)
+            valid_f = kf_r >= 0
+            kf_c = jnp.clip(kf_r, 0, M - 1)
+            sf_c = jnp.clip(sfr_r, 0, Rf - 1)
+            inject = lax.dynamic_index_in_dim(xx, kf_c, 0, keepdims=False)
+            is_inj = jnp.logical_and(
+                valid_f, jnp.logical_and(rank == 0, cf_r == 0))
+            ring = jnp.where(
+                is_inj,
+                lax.dynamic_update_index_in_dim(ring, inject, sf_c, 0),
+                ring)
+            h_in = lax.dynamic_index_in_dim(ring, sf_c, 0, keepdims=False)
+            h_out, aux = stage_fn(
+                chunk_params(params, jnp.clip(cf_r, 0, v - 1)), h_in)
+            aux_acc = aux_acc + jnp.where(valid_f, aux, 0.0)
+            outs = jnp.where(
+                co_r >= 0,
+                lax.dynamic_update_index_in_dim(
+                    outs, h_out.astype(outs.dtype),
+                    jnp.clip(co_r, 0, M - 1), 0),
+                outs)
+            h_next = lax.ppermute(
+                h_out, axis_name, [(i, (i + 1) % S) for i in range(S)])
+            return (h_next, ring, outs, aux_acc), None
+
+        (_, _, outs, aux_acc), _ = lax.scan(
+            ftick, (jnp.zeros(mbs, dt), ring0, out0, jnp.float32(0)),
+            rows(ftab, ("kf", "cf", "sfr", "sst", "cout")))
+        outs = lax.psum(
+            jnp.where(rank == S - 1, outs, jnp.zeros_like(outs)),
+            axis_name)
+        aux_mean = lax.psum(aux_acc, axis_name) / (V * M)
+        return outs, aux_mean
+
+    def fwd(params, xx):
+        return run(params, xx), (params, xx)
+
+    def bwd(res, cots):
+        params, xx = res
+        g_outs, g_aux = cots
+        rank = lax.axis_index(axis_name)
+        g_head = lax.psum(g_outs.astype(dt), axis_name)
+        ga_visit = lax.psum(g_aux, axis_name) / (V * M)
+
+        ring0 = jnp.zeros((Rbuf,) + mbs, dt)
+        gring0 = jnp.zeros((Rg,) + mbs, dt)
+        gx0 = jnp.zeros((M,) + mbs, dt)
+        gp0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+        def tick(carry, xs):
+            h_prev, g_prev, ring, gring, gx, gp = carry
+            (kf_r, cf_r, sfr_r, sst_r, kb_r, cb_r, sbr_r, gst_r,
+             gbr_r) = (jnp.take(row, rank) for row in xs)
+            # park this tick's arrivals before anything reads the rings
+            ring = jnp.where(
+                sst_r >= 0,
+                lax.dynamic_update_index_in_dim(
+                    ring, h_prev, jnp.clip(sst_r, 0, Rbuf - 1), 0),
+                ring)
+            gring = jnp.where(
+                gst_r >= 0,
+                lax.dynamic_update_index_in_dim(
+                    gring, g_prev, jnp.clip(gst_r, 0, Rg - 1), 0),
+                gring)
+            # ---- forward sub-slot --------------------------------------
+            valid_f = kf_r >= 0
+            kf_c = jnp.clip(kf_r, 0, M - 1)
+            sf_c = jnp.clip(sfr_r, 0, Rbuf - 1)
+            inject = lax.dynamic_index_in_dim(xx, kf_c, 0, keepdims=False)
+            is_inj = jnp.logical_and(
+                valid_f, jnp.logical_and(rank == 0, cf_r == 0))
+            ring = jnp.where(
+                is_inj,
+                lax.dynamic_update_index_in_dim(ring, inject, sf_c, 0),
+                ring)
+            h_in = lax.dynamic_index_in_dim(ring, sf_c, 0, keepdims=False)
+            h_out, _ = stage_fn(
+                chunk_params(params, jnp.clip(cf_r, 0, v - 1)), h_in)
+            # ---- backward sub-slot -------------------------------------
+            valid_b = kb_r >= 0
+            kb_c = jnp.clip(kb_r, 0, M - 1)
+            cb_c = jnp.clip(cb_r, 0, v - 1)
+            h_saved = lax.dynamic_index_in_dim(
+                ring, jnp.clip(sbr_r, 0, Rbuf - 1), 0, keepdims=False)
+            seed = lax.dynamic_index_in_dim(g_head, kb_c, 0,
+                                            keepdims=False)
+            is_seed = jnp.logical_and(
+                valid_b, jnp.logical_and(rank == S - 1, cb_r == v - 1))
+            g_parked = lax.dynamic_index_in_dim(
+                gring, jnp.clip(gbr_r, 0, Rg - 1), 0, keepdims=False)
+            g_in = jnp.where(is_seed, seed, g_parked)
+            _, vjp_fn = jax.vjp(stage_fn, chunk_params(params, cb_c),
+                                h_saved)
+            gp_c, gh = vjp_fn((g_in, jnp.where(valid_b, ga_visit, 0.0)))
+            gp = jax.tree_util.tree_map(
+                lambda acc, g: lax.dynamic_update_index_in_dim(
+                    acc,
+                    lax.dynamic_index_in_dim(acc, cb_c, 0, keepdims=False)
+                    + jnp.where(valid_b, g, 0).astype(jnp.float32),
+                    cb_c, 0),
+                gp, gp_c)
+            is_gx = jnp.logical_and(
+                valid_b, jnp.logical_and(rank == 0, cb_r == 0))
+            gx = jnp.where(
+                is_gx,
+                lax.dynamic_update_index_in_dim(gx, gh.astype(dt), kb_c,
+                                                0),
+                gx)
+            h_next = lax.ppermute(
+                h_out, axis_name, [(i, (i + 1) % S) for i in range(S)])
+            g_next = lax.ppermute(
+                jnp.where(valid_b, gh, jnp.zeros_like(gh)), axis_name,
+                [(i, (i - 1) % S) for i in range(S)])
+            return (h_next, g_next, ring, gring, gx, gp), None
+
+        carry0 = (jnp.zeros(mbs, dt), jnp.zeros(mbs, dt), ring0, gring0,
+                  gx0, gp0)
+        (_, _, _, _, gx, gp), _ = lax.scan(
+            tick, carry0,
+            rows(btab, ("kf", "cf", "sfr", "sst", "kb", "cb", "sbr",
+                        "gst", "gbr")))
+        g_params = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), gp, params)
         g_x = jnp.where(rank == 0, gx, jnp.zeros_like(gx))
         return g_params, g_x
 

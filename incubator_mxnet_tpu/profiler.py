@@ -819,11 +819,21 @@ def mfu_stats():
         compute_ms = comp[1] / max(comp[0], 1) if comp else None
         bub = _phases.get("pp_bubble")
         bubble_ms = bub[1] / max(bub[0], 1) if bub else None
+    with _lock:
+        # last sample wins: the activation-offload counters (booked by the
+        # composed step / HostOffloader) ride along so an offload run's
+        # D2H traffic shows up next to its MFU
+        offl = {}
+        for name, _ts, val in _counters:
+            if name in ("d2h_bytes", "offload_wait_ms_per_step"):
+                offl[name] = val
     out = {"key": key, "flops_per_step": rec["flops"],
            "bytes_per_step": rec.get("bytes_accessed"),
            "compute_ms_per_step": compute_ms,
            "pp_bubble_ms_per_step": bubble_ms,
            "pp_bubble_fraction": None,
+           "d2h_bytes": offl.get("d2h_bytes"),
+           "offload_wait_ms_per_step": offl.get("offload_wait_ms_per_step"),
            "peak_flops": device_peak_flops(),
            "flops_per_sec": None, "mfu": None}
     if compute_ms:

@@ -417,10 +417,26 @@ ENV_VARS = collections.OrderedDict([
      "stale pages.")),
     ("MXTPU_PP_SCHEDULE", EnvSpec("gpipe", "str",
      "Pipeline-parallel microbatch schedule for the composed train "
-     "step: 'gpipe' (all-forward then the transposed all-backward) or "
+     "step: 'gpipe' (all-forward then the transposed all-backward), "
      "'1f1b' (one-forward-one-backward steady state with bounded "
-     "in-flight activations). An explicit schedule= argument "
-     "overrides it.")),
+     "in-flight activations), 'interleaved' (v virtual chunks per "
+     "rank, bubble ~1/v of 1F1B's), or 'zb1' (ZB-H1: backward split "
+     "into input-grad and weight-grad half-passes, W-passes filling "
+     "the cooldown). An explicit schedule= argument overrides it.")),
+    ("MXTPU_PP_VSTAGES", EnvSpec(2, "int",
+     "Virtual pipeline chunks per rank (v) for the 'interleaved' "
+     "schedule — block params are (v, S)-stacked and rank r runs "
+     "virtual stages c*S+r. Ignored by other schedules; an explicit "
+     "n_chunks= argument overrides it.")),
+    ("MXNET_PP_OFFLOAD", EnvSpec(False, "bool",
+     "Offload per-(stage, microbatch) saved activations to pinned "
+     "host memory inside the pipelined train step (jax.checkpoint "
+     "offload policy on the stage-input residual): per-stage live "
+     "HBM is bounded by the in-flight transfer window instead of "
+     "the schedule depth, at the price of D2H/H2D traffic the "
+     "schedule hides under compute. Composes with MXNET_REMAT none/"
+     "full only. Publishes d2h_bytes / offload_wait_ms_per_step "
+     "through the profiler counter registry.")),
     ("MXNET_REMAT", EnvSpec("none", "str",
      "Per-stage activation rematerialization policy for pipelined "
      "train steps: 'none' (store), 'dots_saveable' (jax.checkpoint "

@@ -40,12 +40,14 @@ EXPECTED_BAD_COUNTS = {
 # the corpus self-run's waived findings, asserted EXACTLY as
 # (rule, capture key) pairs: a new waived finding means a deliberate
 # waivers.py change, defended in review. Budget: at most 10 entries.
-# The composed 1F1B step is bf16-declared with the same f32
+# The composed 1F1B and ZB-H1 steps are bf16-declared with the same f32
 # master-precision loss/optimizer design as trainstep:sgd, so the one
-# existing trainstep:* SL02 waiver covers both keys.
+# existing trainstep:* SL02 waiver covers all three keys.
 EXPECTED_WAIVED = [
     ("SL02", "trainstep:composed:dp2xpp2xtp2:1f1b:"
              "remat-dots_saveable:M2:R1"),
+    ("SL02", "trainstep:composed:dp2xpp2xtp2:zb1:"
+             "remat-none:M4:R1"),
     ("SL02", "trainstep:sgd"),
 ]
 

@@ -355,19 +355,28 @@ def main():
         from incubator_mxnet_tpu.parallel.pipeline import (REMAT_MODES,
                                                            SCHEDULES,
                                                            schedule_stats)
-        from incubator_mxnet_tpu.util import getenv_str
+        from incubator_mxnet_tpu.util import getenv_bool, getenv_int, \
+            getenv_str
         from incubator_mxnet_tpu import profiler as _prof
         print("schedule     :", getenv_str("MXTPU_PP_SCHEDULE"),
               f"(MXTPU_PP_SCHEDULE; one of {'/'.join(SCHEDULES)})")
         print("remat        :", getenv_str("MXNET_REMAT"),
               f"(MXNET_REMAT; one of {'/'.join(REMAT_MODES)})")
+        print("vstages      :", getenv_int("MXTPU_PP_VSTAGES"),
+              "(MXTPU_PP_VSTAGES; interleaved chunks per rank)")
+        print("offload      :", getenv_bool("MXNET_PP_OFFLOAD"),
+              "(MXNET_PP_OFFLOAD; stage inputs -> pinned host)")
         print("bubble fraction by (stages, microbatches):")
-        print("   S  M   gpipe   1f1b   live/stage(gpipe -> 1f1b)")
+        print("   S  M   gpipe   1f1b  il(v2)    zb1   "
+              "live/stage(gpipe -> 1f1b)")
         for s, m in ((2, 4), (4, 8), (4, 16), (8, 32)):
             g = schedule_stats("gpipe", s, m)
             f = schedule_stats("1f1b", s, m)
+            il = schedule_stats("interleaved", s, m, n_chunks=2)
+            z = schedule_stats("zb1", s, m)
             print(f"  {s:2d} {m:2d}  {g['bubble_fraction']:.4f} "
-                  f"{f['bubble_fraction']:.4f}   "
+                  f"{f['bubble_fraction']:.4f}  {il['bubble_fraction']:.4f} "
+                  f"{z['bubble_fraction']:.4f}   "
                   f"{g['max_live_per_stage']} -> {f['max_live_per_stage']}")
         phases = _prof.last_step_phases()
         if phases.get("pp_bubble") is not None:

@@ -167,6 +167,43 @@ def _corpus_composed_1f1b():
     step._cached.trace_signature(p, init_opt(p), tokens, targets, 0)
 
 
+def _corpus_composed_zb1():
+    """The ZB-H1 zero-bubble composed step: backward split into B/W
+    half-passes with parked-cotangent rings inside the custom_vjp — the
+    most schedule-dense program in the repo, traced via the cached_jit
+    signature path (no compile) so SL02 bf16 policy, SL03 donation,
+    SL04 all-gather budget and SL05 resharding judge the split backward
+    the same way they judge the fused one."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.parallel import make_mesh
+    from incubator_mxnet_tpu.models.composed import (ComposedConfig,
+                                                     ComposedPipelineLM)
+
+    n = len(jax.devices())
+    if n >= 8 and n % 8 == 0:
+        axes = {"dp": n // 4, "pp": 2, "tp": 2}
+    elif n >= 2 and n % 2 == 0:
+        axes = {"dp": n // 2, "pp": 2}
+    else:
+        return      # single device: no pipeline axis to judge
+    cfg = ComposedConfig(vocab_size=32, d_model=16, n_heads=2, n_layers=2,
+                         d_ff=32, n_experts=2, moe_every=1,
+                         capacity_factor=2.0, max_len=32, dtype="bfloat16")
+    model = ComposedPipelineLM(cfg)
+    mesh = make_mesh(axes)
+    params = model.init_params(jax.random.PRNGKey(0), axes["pp"])
+    step, shard_params, init_opt = model.make_train_step(
+        mesh, n_microbatches=4, schedule="zb1", remat="none")
+    p = shard_params(params)
+    rng = np.random.RandomState(0)
+    B = 4 * axes["dp"]
+    tokens = jnp.asarray(rng.randint(0, 32, (B, 8)).astype(np.int32))
+    targets = jnp.asarray(rng.randint(0, 32, (B, 8)).astype(np.int32))
+    step._cached.trace_signature(p, init_opt(p), tokens, targets, 0)
+
+
 def _corpus_disagg_prefill_chunk():
     """The disaggregated-serving chunked-prefill executable
     (serve/disagg.PrefillPredictor): scatter-into-pages + full-window
@@ -244,6 +281,7 @@ def entries():
         ("fused_optimizer", _corpus_fused_optimizer),
         ("partition_rules", _corpus_partition_rules),
         ("composed_1f1b", _corpus_composed_1f1b),
+        ("composed_zb1", _corpus_composed_zb1),
         ("disagg_prefill_chunk", _corpus_disagg_prefill_chunk),
         ("spec_verify", _corpus_spec_verify),
     ])
